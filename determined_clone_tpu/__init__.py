@@ -1,0 +1,28 @@
+"""determined_clone_tpu — a TPU-native deep-learning training platform.
+
+A ground-up rebuild of the capabilities of Determined (reference surveyed in
+SURVEY.md): distributed training, hyperparameter search, cluster scheduling and
+experiment tracking — with the trial execution engine being JAX/XLA (pjit /
+shard_map sharding, XLA collectives over ICI/DCN) instead of launched
+PyTorch/Horovod/DeepSpeed worlds, and slots being TPU chips / pod slices
+instead of CUDA devices.
+
+Top-level layout (≈ reference layer map, SURVEY.md §1):
+
+- ``config``    experiment configuration (≈ expconf, master/pkg/schemas/expconf)
+- ``core``      Core API: train/checkpoint/preempt/searcher/distributed contexts
+                (≈ harness/determined/core)
+- ``parallel``  device meshes, partition specs, pipeline/sequence parallelism
+                (TPU-native superset of the reference's DP/ZeRO/PP via DeepSpeed)
+- ``ops``       functional NN layers + Pallas TPU kernels
+- ``models``    built-in model families (mnist MLP/CNN, GPT, ResNet, BERT)
+- ``training``  JaxTrial API + Trainer loop (≈ harness/determined/pytorch)
+- ``searcher``  hyperparameter search methods (≈ master/pkg/searcher)
+- ``storage``   checkpoint storage backends (≈ harness/determined/common/storage)
+- ``api``       REST client / session to the master (≈ determined/common/api)
+- ``cli``       the ``det``-equivalent command line
+- ``sdk``       Python SDK (≈ determined/common/experimental)
+- ``master``/``agent``  C++ control plane and TPU-VM node daemon
+"""
+
+__version__ = "0.1.0"

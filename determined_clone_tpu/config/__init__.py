@@ -1,0 +1,41 @@
+"""Experiment configuration (expconf equivalent — SURVEY.md §2.1, §5.6)."""
+from determined_clone_tpu.config.experiment import (
+    CheckpointStorageConfig,
+    ConfigError,
+    ExperimentConfig,
+    LogPolicy,
+    ResourcesConfig,
+    SearcherConfig,
+    merge_configs,
+)
+from determined_clone_tpu.config.hyperparameters import (
+    Categorical,
+    Const,
+    Double,
+    Hyperparameter,
+    HyperparameterSpace,
+    Int,
+    Log,
+    parse_hyperparameter,
+)
+from determined_clone_tpu.config.length import Length, Unit
+
+__all__ = [
+    "CheckpointStorageConfig",
+    "ConfigError",
+    "ExperimentConfig",
+    "LogPolicy",
+    "ResourcesConfig",
+    "SearcherConfig",
+    "merge_configs",
+    "Categorical",
+    "Const",
+    "Double",
+    "Hyperparameter",
+    "HyperparameterSpace",
+    "Int",
+    "Log",
+    "parse_hyperparameter",
+    "Length",
+    "Unit",
+]

@@ -1,0 +1,353 @@
+"""Experiment configuration — the expconf equivalent.
+
+Mirrors the reference's versioned, validated experiment config
+(master/pkg/schemas/expconf/experiment_config.go:20-50) with TPU-native
+resources: ``slots_per_trial`` counts TPU chips and ``topology`` names a pod
+slice shape (e.g. "v5e-8", "2x4"), which the scheduler's fitting logic treats
+as an ICI-adjacency constraint rather than a flat slot count.
+
+Parsing follows the reference pipeline (expconf/parse.go): parse → fill
+defaults → validate, with union types for searcher / checkpoint storage and
+clear error messages on invalid input.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from determined_clone_tpu.config.hyperparameters import HyperparameterSpace
+from determined_clone_tpu.config.length import Length
+
+
+class ConfigError(ValueError):
+    """Invalid experiment configuration."""
+
+
+# ---------------------------------------------------------------------------
+# Searcher union (reference: expconf/searcher_config.go:16-28)
+# ---------------------------------------------------------------------------
+
+_SEARCHER_NAMES = {"single", "random", "grid", "asha", "adaptive_asha", "custom"}
+
+
+@dataclasses.dataclass
+class SearcherConfig:
+    name: str = "single"
+    metric: str = "loss"
+    smaller_is_better: bool = True
+    max_length: Optional[Length] = None
+    # random
+    max_trials: int = 1
+    # asha / adaptive_asha
+    max_time: Optional[int] = None      # rungs ceiling, in scheduling units
+    num_rungs: int = 5
+    divisor: int = 4
+    max_concurrent_trials: int = 16
+    # adaptive_asha
+    mode: str = "standard"              # aggressive | standard | conservative
+    bracket_rungs: Optional[List[int]] = None
+    # single / stopping-based asha
+    stop_once: bool = False
+    # source-of-truth blob for anything extra (custom searchers)
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @staticmethod
+    def from_dict(raw: Dict[str, Any]) -> "SearcherConfig":
+        if not isinstance(raw, dict):
+            raise ConfigError(f"searcher must be a mapping, got {raw!r}")
+        name = raw.get("name", "single")
+        if name not in _SEARCHER_NAMES:
+            raise ConfigError(
+                f"unknown searcher name {name!r}; expected one of {sorted(_SEARCHER_NAMES)}"
+            )
+        known = {f.name for f in dataclasses.fields(SearcherConfig)} - {"extra"}
+        cfg = SearcherConfig(
+            name=name,
+            metric=raw.get("metric", "loss"),
+            smaller_is_better=bool(raw.get("smaller_is_better", True)),
+            max_length=Length.from_dict(raw["max_length"]) if "max_length" in raw else None,
+            max_trials=int(raw.get("max_trials", 1)),
+            max_time=raw.get("max_time"),
+            num_rungs=int(raw.get("num_rungs", 5)),
+            divisor=int(raw.get("divisor", 4)),
+            max_concurrent_trials=int(raw.get("max_concurrent_trials", 16)),
+            mode=raw.get("mode", "standard"),
+            bracket_rungs=raw.get("bracket_rungs"),
+            stop_once=bool(raw.get("stop_once", False)),
+            extra={k: v for k, v in raw.items() if k not in known},
+        )
+        cfg.validate()
+        return cfg
+
+    def validate(self) -> None:
+        if self.name in ("random", "grid", "asha", "adaptive_asha") and self.max_trials < 1:
+            raise ConfigError(f"searcher.max_trials must be >= 1, got {self.max_trials}")
+        if self.name in ("asha", "adaptive_asha"):
+            if self.divisor < 2:
+                raise ConfigError(f"searcher.divisor must be >= 2, got {self.divisor}")
+            if self.num_rungs < 1:
+                raise ConfigError(f"searcher.num_rungs must be >= 1, got {self.num_rungs}")
+        if self.name == "adaptive_asha" and self.mode not in (
+            "aggressive", "standard", "conservative",
+        ):
+            raise ConfigError(f"unknown adaptive_asha mode {self.mode!r}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "name": self.name,
+            "metric": self.metric,
+            "smaller_is_better": self.smaller_is_better,
+        }
+        if self.max_length is not None:
+            d["max_length"] = self.max_length.to_dict()
+        if self.name in ("random", "grid", "asha", "adaptive_asha"):
+            d["max_trials"] = self.max_trials
+        if self.name in ("asha", "adaptive_asha"):
+            d.update(
+                max_time=self.max_time, num_rungs=self.num_rungs, divisor=self.divisor,
+                max_concurrent_trials=self.max_concurrent_trials, stop_once=self.stop_once,
+            )
+        if self.name == "adaptive_asha":
+            d["mode"] = self.mode
+        d.update(self.extra)
+        return d
+
+
+# ---------------------------------------------------------------------------
+# Resources (TPU-native: chips + slice topology)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ResourcesConfig:
+    slots_per_trial: int = 1            # TPU chips per trial (gang size)
+    topology: Optional[str] = None      # e.g. "v5e-8", "2x4"; None = any fit
+    resource_pool: str = "default"
+    priority: Optional[int] = None      # priority-scheduler weight
+    max_slots: Optional[int] = None     # cap across concurrent trials
+
+    @staticmethod
+    def from_dict(raw: Dict[str, Any]) -> "ResourcesConfig":
+        cfg = ResourcesConfig(
+            slots_per_trial=int(raw.get("slots_per_trial", 1)),
+            topology=raw.get("topology"),
+            resource_pool=raw.get("resource_pool", "default"),
+            priority=int(raw["priority"]) if raw.get("priority") is not None else None,
+            max_slots=raw.get("max_slots"),
+        )
+        if cfg.slots_per_trial < 0:
+            raise ConfigError(f"resources.slots_per_trial must be >= 0, got {cfg.slots_per_trial}")
+        if cfg.priority is not None and not (1 <= int(cfg.priority) <= 99):
+            raise ConfigError("resources.priority must be in [1, 99]")
+        return cfg
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in dataclasses.asdict(self).items() if v is not None}
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint storage union (reference: expconf checkpoint_storage_config;
+# harness/determined/common/storage backends)
+# ---------------------------------------------------------------------------
+
+_STORAGE_TYPES = {"shared_fs", "directory", "gcs", "s3"}
+
+
+@dataclasses.dataclass
+class CheckpointStorageConfig:
+    type: str = "shared_fs"
+    host_path: Optional[str] = None       # shared_fs
+    storage_path: Optional[str] = None    # shared_fs subdir / directory path
+    container_path: Optional[str] = None  # directory
+    bucket: Optional[str] = None          # gcs / s3
+    prefix: Optional[str] = None          # gcs / s3
+    save_experiment_best: int = 0
+    save_trial_best: int = 1
+    save_trial_latest: int = 1
+
+    @staticmethod
+    def from_dict(raw: Dict[str, Any]) -> "CheckpointStorageConfig":
+        t = raw.get("type", "shared_fs")
+        if t not in _STORAGE_TYPES:
+            raise ConfigError(
+                f"unknown checkpoint_storage.type {t!r}; expected one of {sorted(_STORAGE_TYPES)}"
+            )
+        cfg = CheckpointStorageConfig(
+            type=t,
+            host_path=raw.get("host_path"),
+            storage_path=raw.get("storage_path"),
+            container_path=raw.get("container_path"),
+            bucket=raw.get("bucket"),
+            prefix=raw.get("prefix"),
+            save_experiment_best=int(raw.get("save_experiment_best", 0)),
+            save_trial_best=int(raw.get("save_trial_best", 1)),
+            save_trial_latest=int(raw.get("save_trial_latest", 1)),
+        )
+        if t == "shared_fs" and not cfg.host_path:
+            raise ConfigError("checkpoint_storage.host_path is required for shared_fs storage")
+        if t == "directory" and not cfg.container_path:
+            raise ConfigError(
+                "checkpoint_storage.container_path is required for directory storage"
+            )
+        if t in ("gcs", "s3") and not cfg.bucket:
+            raise ConfigError(f"checkpoint_storage.bucket is required for {t} storage")
+        return cfg
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in dataclasses.asdict(self).items() if v is not None}
+
+
+# ---------------------------------------------------------------------------
+# Log policies (reference: expconf log_policies → logpattern subsystem)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class LogPolicy:
+    pattern: str
+    action: str = "exclude_node"  # exclude_node | cancel_retries
+
+    @staticmethod
+    def from_dict(raw: Dict[str, Any]) -> "LogPolicy":
+        if "pattern" not in raw:
+            raise ConfigError("log policy requires a `pattern`")
+        action = raw.get("action", "exclude_node")
+        if isinstance(action, dict):  # reference's {"type": "..."} form
+            action = action.get("type", "exclude_node")
+        if action not in ("exclude_node", "cancel_retries"):
+            raise ConfigError(f"unknown log policy action {action!r}")
+        return LogPolicy(pattern=raw["pattern"], action=action)
+
+
+# ---------------------------------------------------------------------------
+# The experiment config itself
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ExperimentConfig:
+    name: str = "unnamed-experiment"
+    entrypoint: Optional[str] = None
+    searcher: SearcherConfig = dataclasses.field(default_factory=SearcherConfig)
+    resources: ResourcesConfig = dataclasses.field(default_factory=ResourcesConfig)
+    hyperparameters: HyperparameterSpace = dataclasses.field(
+        default_factory=HyperparameterSpace
+    )
+    checkpoint_storage: Optional[CheckpointStorageConfig] = None
+    checkpoint_policy: str = "best"     # best | all | none
+    min_validation_period: Optional[Length] = None
+    min_checkpoint_period: Optional[Length] = None
+    perform_initial_validation: bool = False
+    max_restarts: int = 5
+    records_per_epoch: int = 0
+    scheduling_unit: int = 100          # batches per searcher unit
+    experiment_seed: int = 0            # reproducibility.experiment_seed
+    labels: List[str] = dataclasses.field(default_factory=list)
+    workspace: str = "Uncategorized"
+    project: str = "Uncategorized"
+    log_policies: List[LogPolicy] = dataclasses.field(default_factory=list)
+    profiling_enabled: bool = False
+    environment: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    data: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    raw: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @staticmethod
+    def from_dict(raw: Dict[str, Any]) -> "ExperimentConfig":
+        if not isinstance(raw, dict):
+            raise ConfigError(f"experiment config must be a mapping, got {type(raw).__name__}")
+        profiling = raw.get("profiling", {})
+        cfg = ExperimentConfig(
+            name=raw.get("name", "unnamed-experiment"),
+            entrypoint=raw.get("entrypoint"),
+            searcher=SearcherConfig.from_dict(raw.get("searcher", {})),
+            resources=ResourcesConfig.from_dict(raw.get("resources", {})),
+            hyperparameters=HyperparameterSpace(raw.get("hyperparameters", {})),
+            checkpoint_storage=(
+                CheckpointStorageConfig.from_dict(raw["checkpoint_storage"])
+                if raw.get("checkpoint_storage") else None
+            ),
+            checkpoint_policy=raw.get("checkpoint_policy", "best"),
+            min_validation_period=(
+                Length.from_dict(raw["min_validation_period"])
+                if "min_validation_period" in raw else None
+            ),
+            min_checkpoint_period=(
+                Length.from_dict(raw["min_checkpoint_period"])
+                if "min_checkpoint_period" in raw else None
+            ),
+            perform_initial_validation=bool(raw.get("perform_initial_validation", False)),
+            max_restarts=int(raw.get("max_restarts", 5)),
+            records_per_epoch=int(raw.get("records_per_epoch", 0)),
+            scheduling_unit=int(raw.get("scheduling_unit", 100)),
+            experiment_seed=int(
+                (raw.get("reproducibility") or {}).get("experiment_seed", 0)
+            ),
+            labels=list(raw.get("labels", []) or []),
+            workspace=raw.get("workspace", "Uncategorized"),
+            project=raw.get("project", "Uncategorized"),
+            log_policies=[LogPolicy.from_dict(p) for p in raw.get("log_policies", []) or []],
+            profiling_enabled=bool(
+                profiling.get("enabled", False) if isinstance(profiling, dict) else profiling
+            ),
+            environment=raw.get("environment", {}) or {},
+            data=raw.get("data", {}) or {},
+            raw=raw,
+        )
+        cfg.validate()
+        return cfg
+
+    @staticmethod
+    def from_yaml(path: str) -> "ExperimentConfig":
+        import yaml  # lazy; pyyaml ships with the baked-in stack
+
+        with open(path) as f:
+            return ExperimentConfig.from_dict(yaml.safe_load(f) or {})
+
+    def validate(self) -> None:
+        if self.checkpoint_policy not in ("best", "all", "none"):
+            raise ConfigError(
+                f"checkpoint_policy must be best|all|none, got {self.checkpoint_policy!r}"
+            )
+        if self.max_restarts < 0:
+            raise ConfigError(f"max_restarts must be >= 0, got {self.max_restarts}")
+        if self.scheduling_unit < 1:
+            raise ConfigError(f"scheduling_unit must be >= 1, got {self.scheduling_unit}")
+        if self.searcher.name == "grid" and self.hyperparameters.grid_size() == 0:
+            # a grid over an empty space is a single trial; allowed, like the reference
+            pass
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "name": self.name,
+            "searcher": self.searcher.to_dict(),
+            "resources": self.resources.to_dict(),
+            "hyperparameters": self.hyperparameters.raw,
+            "checkpoint_policy": self.checkpoint_policy,
+            "max_restarts": self.max_restarts,
+            "records_per_epoch": self.records_per_epoch,
+            "scheduling_unit": self.scheduling_unit,
+            "reproducibility": {"experiment_seed": self.experiment_seed},
+            "labels": self.labels,
+            "workspace": self.workspace,
+            "project": self.project,
+        }
+        if self.entrypoint:
+            d["entrypoint"] = self.entrypoint
+        if self.checkpoint_storage:
+            d["checkpoint_storage"] = self.checkpoint_storage.to_dict()
+        if self.min_validation_period:
+            d["min_validation_period"] = self.min_validation_period.to_dict()
+        if self.min_checkpoint_period:
+            d["min_checkpoint_period"] = self.min_checkpoint_period.to_dict()
+        if self.data:
+            d["data"] = self.data
+        return d
+
+
+def merge_configs(base: Dict[str, Any], override: Dict[str, Any]) -> Dict[str, Any]:
+    """Template merging (reference: master/internal/templates + schemas.Merge):
+    override wins per key; nested dicts merge recursively; lists replace."""
+    out = dict(base)
+    for k, v in override.items():
+        if k in out and isinstance(out[k], dict) and isinstance(v, dict):
+            out[k] = merge_configs(out[k], v)
+        else:
+            out[k] = v
+    return out

@@ -1,0 +1,49 @@
+"""MLP classifier — the mnist workhorse.
+
+Capability target: the reference's mnist_pytorch tutorial model
+(examples/tutorials/mnist_pytorch, gated at >0.97 accuracy by
+e2e_tests/tests/nightly/test_convergence.py:25).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from determined_clone_tpu.ops.layers import dense, dense_init, softmax_cross_entropy
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden_dims: Sequence[int] = (128, 64)
+    n_classes: int = 10
+    compute_dtype: Any = jnp.float32
+
+
+def init(key: jax.Array, cfg: MLPConfig) -> Params:
+    dims = [cfg.in_dim, *cfg.hidden_dims, cfg.n_classes]
+    keys = jax.random.split(key, len(dims) - 1)
+    return {
+        f"layer_{i}": dense_init(keys[i], dims[i], dims[i + 1])
+        for i in range(len(dims) - 1)
+    }
+
+
+def apply(params: Params, cfg: MLPConfig, x: jax.Array) -> jax.Array:
+    """x: [B, in_dim] (or [B, 28, 28(, 1)], flattened here) → logits [B, C]."""
+    x = x.reshape(x.shape[0], -1)
+    n = len(params)
+    for i in range(n):
+        x = dense(params[f"layer_{i}"], x, compute_dtype=cfg.compute_dtype)
+        if i < n - 1:
+            x = jax.nn.relu(x)
+    return x.astype(jnp.float32)
+
+
+def loss_fn(params: Params, cfg: MLPConfig, x: jax.Array, y: jax.Array) -> jax.Array:
+    return jnp.mean(softmax_cross_entropy(apply(params, cfg, x), y))

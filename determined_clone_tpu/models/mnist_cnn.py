@@ -1,0 +1,76 @@
+"""Small conv net for mnist — NHWC, TPU-layout-native.
+
+Matches the capability of the reference tutorial's conv model
+(examples/tutorials/mnist_pytorch/model_def.py): two conv blocks + two dense
+layers, dropout between them.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from determined_clone_tpu.ops.layers import (
+    conv2d,
+    conv_init,
+    dense,
+    dense_init,
+    dropout,
+    softmax_cross_entropy,
+)
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MnistCNNConfig:
+    n_filters_1: int = 32
+    n_filters_2: int = 64
+    dropout_1: float = 0.25
+    dropout_2: float = 0.5
+    n_classes: int = 10
+    compute_dtype: Any = jnp.float32
+
+
+def init(key: jax.Array, cfg: MnistCNNConfig) -> Params:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    flat = 7 * 7 * cfg.n_filters_2  # 28 → 14 → 7 after two stride-2 pools
+    return {
+        "conv1": conv_init(k1, 1, cfg.n_filters_1, 3),
+        "conv2": conv_init(k2, cfg.n_filters_1, cfg.n_filters_2, 3),
+        "fc1": dense_init(k3, flat, 128),
+        "fc2": dense_init(k4, 128, cfg.n_classes),
+    }
+
+
+def _maxpool2(x: jax.Array) -> jax.Array:
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+    )
+
+
+def apply(params: Params, cfg: MnistCNNConfig, x: jax.Array, *,
+          training: bool = False, dropout_key: Optional[jax.Array] = None) -> jax.Array:
+    """x: [B, 28, 28, 1] NHWC (flat [B, 784] accepted) → logits [B, C]."""
+    if x.ndim == 2:
+        x = x.reshape(-1, 28, 28, 1)
+    k1 = k2 = None
+    if dropout_key is not None:
+        k1, k2 = jax.random.split(dropout_key)
+    x = jax.nn.relu(conv2d(params["conv1"], x, compute_dtype=cfg.compute_dtype))
+    x = _maxpool2(x)
+    x = jax.nn.relu(conv2d(params["conv2"], x, compute_dtype=cfg.compute_dtype))
+    x = _maxpool2(x)
+    x = dropout(k1, x, cfg.dropout_1, training)
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(dense(params["fc1"], x, compute_dtype=cfg.compute_dtype))
+    x = dropout(k2, x, cfg.dropout_2, training)
+    return dense(params["fc2"], x, compute_dtype=cfg.compute_dtype).astype(jnp.float32)
+
+
+def loss_fn(params: Params, cfg: MnistCNNConfig, x: jax.Array, y: jax.Array, *,
+            training: bool = False, dropout_key: Optional[jax.Array] = None) -> jax.Array:
+    logits = apply(params, cfg, x, training=training, dropout_key=dropout_key)
+    return jnp.mean(softmax_cross_entropy(logits, y))

@@ -1,0 +1,154 @@
+"""Attention ops, including the sequence-parallel paths the reference lacks.
+
+Three implementations, one semantic:
+ - ``mha``: plain XLA attention (einsum + softmax). XLA fuses this well on
+   TPU; correct reference implementation for tests.
+ - ``causal_blockwise_attention``: lax.scan over key/value blocks with a
+   streaming (online-softmax) accumulator — the memory-efficient form that
+   long sequences need; the basis for ring attention.
+ - ``ring_attention``: context-parallel attention over the mesh's ``sp``
+   axis: each shard holds a sequence slice, K/V blocks rotate around the
+   ring via ppermute while compute overlaps (SURVEY.md §5.7 — absent in the
+   reference, first-class here).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _causal_mask(q_len: int, k_len: int, q_offset: int = 0, k_offset: int = 0):
+    """[q_len, k_len] bool mask; True = attendable."""
+    q_pos = q_offset + jnp.arange(q_len)[:, None]
+    k_pos = k_offset + jnp.arange(k_len)[None, :]
+    return q_pos >= k_pos
+
+
+def mha(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True,
+        mask: Optional[jax.Array] = None) -> jax.Array:
+    """Multi-head attention. q,k,v: [B, T, H, D]. Softmax in fp32."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(d))
+    if causal:
+        cm = _causal_mask(q.shape[1], k.shape[1])
+        scores = jnp.where(cm[None, None], scores, NEG_INF)
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _online_softmax_block(carry, qkv_block, *, scale):
+    """One streaming-softmax step: merge a new K/V block into (acc, m, l).
+
+    acc: running unnormalized output [B, Tq, H, D] (fp32)
+    m:   running row max           [B, H, Tq]     (fp32)
+    l:   running row denominator   [B, H, Tq]     (fp32)
+    """
+    acc, m, l = carry
+    q, k_blk, v_blk, block_mask = qkv_block
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
+    s = jnp.where(block_mask, s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1
+    alpha = jnp.exp(jnp.where(m > NEG_INF / 2, m - m_new, NEG_INF))
+    p = jnp.exp(s - m_new[..., None])
+    p = jnp.where(block_mask, p, 0.0)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    acc_new = acc * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32)
+    )
+    return (acc_new, m_new, l_new)
+
+
+def causal_blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                               block_size: int = 512) -> jax.Array:
+    """Streaming attention over K/V blocks via lax.scan; O(T·block) memory
+    instead of O(T²). Matches ``mha(causal=True)`` numerically (fp32 softmax)."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    block_size = min(block_size, Tk)
+    if Tk % block_size != 0:
+        raise ValueError(
+            f"block_size {block_size} must evenly divide the K/V sequence length {Tk}"
+        )
+    n_blocks = Tk // block_size
+    scale = 1.0 / (D ** 0.5)
+
+    k_blocks = k.reshape(B, n_blocks, block_size, H, D).transpose(1, 0, 2, 3, 4)
+    v_blocks = v.reshape(B, n_blocks, block_size, H, D).transpose(1, 0, 2, 3, 4)
+
+    def step(carry, inputs):
+        idx, k_blk, v_blk = inputs
+        bmask = _causal_mask(Tq, block_size, q_offset=0, k_offset=idx * block_size)
+        carry = _online_softmax_block(
+            carry, (q, k_blk, v_blk, bmask[None, None]), scale=scale
+        )
+        return carry, None
+
+    acc0 = jnp.zeros((B, Tq, H, D), jnp.float32)
+    m0 = jnp.full((B, H, Tq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Tq), jnp.float32)
+    (acc, m, l), _ = jax.lax.scan(
+        step, (acc0, m0, l0), (jnp.arange(n_blocks), k_blocks, v_blocks)
+    )
+    out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *, axis_name: str,
+                   axis_index: jax.Array, axis_size: int) -> jax.Array:
+    """Causal ring attention inside shard_map: the sequence axis is sharded
+    over ``axis_name``; K/V shards rotate via ppermute so every query shard
+    sees the full sequence with only neighbor ICI traffic.
+
+    q,k,v: [B, T_local, H, D] — the local sequence slice. Global positions of
+    this shard's queries are axis_index*T_local + [0, T_local).
+    """
+    B, T, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def body(i, state):
+        acc, m, l, k_cur, v_cur = state
+        # K/V currently held arrived from shard (axis_index - i) mod size.
+        src = (axis_index - i) % axis_size
+        bmask = _causal_mask(T, T, q_offset=axis_index * T, k_offset=src * T)
+        acc, m, l = _online_softmax_block(
+            (acc, m, l), (q, k_cur, v_cur, bmask[None, None]), scale=scale
+        )
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (acc, m, l, k_nxt, v_nxt)
+
+    acc0 = jnp.zeros((B, T, H, D), jnp.float32)
+    m0 = jnp.full((B, H, T), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, T), jnp.float32)
+    acc, m, l, _, _ = jax.lax.fori_loop(
+        0, axis_size, body, (acc0, m0, l0, k, v)
+    )
+    out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def rotary_embedding(x: jax.Array, positions: jax.Array, *,
+                     base: float = 10000.0) -> jax.Array:
+    """RoPE. x: [B, T, H, D] (D even), positions: [T] or [B, T]."""
+    D = x.shape[-1]
+    half = D // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    if positions.ndim == 1:
+        positions = positions[None, :]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(x.dtype)

@@ -1,0 +1,38 @@
+"""Parallelism: meshes, partition specs, pipeline & sequence parallelism.
+
+TPU-native superset of the reference's parallelism inventory (SURVEY.md §2.7):
+DP / FSDP(ZeRO) / TP / PP plus first-class SP and EP.
+"""
+from determined_clone_tpu.parallel.mesh import (
+    AXES,
+    MeshSpec,
+    data_parallel_submesh_size,
+    make_mesh,
+    mesh_axis_size,
+    single_device_mesh,
+)
+from determined_clone_tpu.parallel.sharding import (
+    ShardingRules,
+    batch_spec,
+    batch_seq_spec,
+    constrain,
+    replicated,
+    shard_put,
+    tree_paths_and_leaves,
+)
+
+__all__ = [
+    "AXES",
+    "MeshSpec",
+    "data_parallel_submesh_size",
+    "make_mesh",
+    "mesh_axis_size",
+    "single_device_mesh",
+    "ShardingRules",
+    "batch_spec",
+    "batch_seq_spec",
+    "constrain",
+    "replicated",
+    "shard_put",
+    "tree_paths_and_leaves",
+]

@@ -1,0 +1,118 @@
+"""Device-mesh construction — the TPU-native heart of the parallelism story.
+
+The reference has no mesh concept: its parallelism is launched NCCL worlds
+(SURVEY.md §2.7-2.8). Here, every parallelism strategy is an axis of one
+``jax.sharding.Mesh``:
+
+  axis   meaning                            reference analogue
+  -----  ---------------------------------  -------------------------------
+  dp     data parallel (batch split)        horovod / torch DDP allreduce
+  fsdp   fully-sharded data parallel        DeepSpeed ZeRO 1-3
+  tp     tensor (megatron) parallel         DeepSpeed/Megatron slice ranks
+  pp     pipeline parallel                  DeepSpeed PipelineParallelGrid
+  sp     sequence/context parallel          (absent in reference — §5.7)
+  ep     expert parallel (MoE)              (absent in reference)
+
+Unused axes keep size 1 so a single PartitionSpec vocabulary works at every
+scale; XLA's partitioner drops size-1 axes at compile time, so they are free.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order. dp and fsdp are outermost (gradient/param reduction
+# scopes ride DCN across hosts if they must); tp/sp innermost (highest-traffic
+# collectives stay on ICI neighbors).
+AXES: Tuple[str, ...] = ("dp", "fsdp", "pp", "ep", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape. -1 for at most one axis means "absorb remaining
+    devices" (like a -1 in a reshape)."""
+
+    dp: int = -1
+    fsdp: int = 1
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def axis_sizes(self) -> Tuple[int, ...]:
+        return (self.dp, self.fsdp, self.pp, self.ep, self.sp, self.tp)
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        """Fill in the -1 axis given a device count; validate the product."""
+        sizes = list(self.axis_sizes())
+        wild = [i for i, s in enumerate(sizes) if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {self}")
+        fixed = math.prod(s for s in sizes if s != -1)
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"cannot fit mesh {self} on {n_devices} devices: fixed axes "
+                    f"product {fixed} does not divide {n_devices}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {self} wants {fixed} devices but {n_devices} are available"
+            )
+        for name, s in zip(AXES, sizes):
+            if s < 1:
+                raise ValueError(f"mesh axis {name} must be >= 1, got {s}")
+        return MeshSpec(*sizes)
+
+    @staticmethod
+    def from_dict(d: dict) -> "MeshSpec":
+        unknown = set(d) - set(AXES)
+        if unknown:
+            raise ValueError(f"unknown mesh axes {sorted(unknown)}; valid: {AXES}")
+        return MeshSpec(**{k: int(v) for k, v in d.items()})
+
+    def to_dict(self) -> dict:
+        return {a: s for a, s in zip(AXES, self.axis_sizes())}
+
+
+def make_mesh(
+    spec: Optional[MeshSpec] = None,
+    devices: Optional[Sequence[Any]] = None,
+) -> Mesh:
+    """Build a Mesh laid out so the innermost logical axes map to physically
+    adjacent devices (ICI neighbors on a real slice).
+
+    jax.devices() on TPU enumerates chips in torus-major order, so reshaping
+    that flat order with tp innermost keeps tp collectives on nearest
+    neighbors — the layout rule from the scaling-book recipe.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    spec = (spec or MeshSpec()).resolve(len(devices))
+    dev_array = np.asarray(devices, dtype=object).reshape(spec.axis_sizes())
+    return Mesh(dev_array, AXES)
+
+
+def single_device_mesh(device: Optional[Any] = None) -> Mesh:
+    """A 1×1×…×1 mesh over one device; lets the same pjit code path run
+    unsharded (the reference's single-slot trial case)."""
+    if device is None:
+        device = jax.devices()[0]
+    return make_mesh(MeshSpec(dp=1), [device])
+
+
+def mesh_axis_size(mesh: Mesh, *axes: str) -> int:
+    return math.prod(mesh.shape[a] for a in axes)
+
+
+def data_parallel_submesh_size(mesh: Mesh) -> int:
+    """Total batch-sharding degree: dp × fsdp (fsdp shards the batch too —
+    ZeRO semantics: data-parallel gradients, sharded params/optimizer)."""
+    return mesh_axis_size(mesh, "dp", "fsdp")

@@ -1,0 +1,9 @@
+#!/bin/bash
+# Run the test suite on a virtual 8-device CPU mesh.
+#
+# PALLAS_AXON_POOL_IPS is cleared so the axon TPU-tunnel sitecustomize skips
+# its PJRT relay handshake (it serializes every python process behind the
+# single TPU grant, ~minutes of startup latency); tests are CPU-only anyway.
+exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}" \
+    python -m pytest "${@:-tests/}" -q

@@ -1,0 +1,12 @@
+"""Test configuration: force a virtual 8-device CPU mesh before JAX initializes.
+
+Mirrors the reference's "artificial slots" trick (agent/internal/detect/detect.go:39-56)
+— an 8-"chip" gang runs on one box — but via XLA's host-platform device count so that
+jax.sharding.Mesh code paths are exercised exactly as they would be on a v5e-8.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,186 @@
+"""Model-family tests — tiny deterministic models, the reference's fixture
+strategy (harness/tests/experiment/fixtures/pytorch_onevar_model.py etc.)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from determined_clone_tpu.models import gpt, mlp, mnist_cnn
+from determined_clone_tpu.ops import attention
+from determined_clone_tpu.parallel import MeshSpec, make_mesh, shard_put
+from determined_clone_tpu.parallel.sharding import batch_spec
+
+
+class TestAttention:
+    def test_blockwise_matches_full(self):
+        key = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        B, T, H, D = 2, 64, 4, 16
+        q = jax.random.normal(kq, (B, T, H, D))
+        k = jax.random.normal(kk, (B, T, H, D))
+        v = jax.random.normal(kv, (B, T, H, D))
+        full = attention.mha(q, k, v, causal=True)
+        blocked = attention.causal_blockwise_attention(q, k, v, block_size=16)
+        np.testing.assert_allclose(np.asarray(full), np.asarray(blocked),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_causality(self):
+        key = jax.random.PRNGKey(1)
+        B, T, H, D = 1, 32, 2, 8
+        q, k, v = (jax.random.normal(kk, (B, T, H, D))
+                   for kk in jax.random.split(key, 3))
+        out1 = attention.mha(q, k, v, causal=True)
+        # perturbing the future must not change the past
+        k2 = k.at[:, T // 2:].set(0.0)
+        v2 = v.at[:, T // 2:].set(0.0)
+        out2 = attention.mha(q, k2, v2, causal=True)
+        np.testing.assert_allclose(np.asarray(out1[:, : T // 2]),
+                                   np.asarray(out2[:, : T // 2]), atol=1e-5)
+
+    def test_rotary_preserves_norm(self):
+        x = jax.random.normal(jax.random.PRNGKey(2), (1, 16, 2, 8))
+        rot = attention.rotary_embedding(x, jnp.arange(16))
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(x), axis=-1),
+            np.linalg.norm(np.asarray(rot), axis=-1),
+            rtol=1e-5,
+        )
+
+
+class TestMLP:
+    def test_shapes_and_grad(self):
+        cfg = mlp.MLPConfig(in_dim=16, hidden_dims=(8,), n_classes=4)
+        params = mlp.init(jax.random.PRNGKey(0), cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1), (5, 16))
+        y = jnp.array([0, 1, 2, 3, 0])
+        logits = mlp.apply(params, cfg, x)
+        assert logits.shape == (5, 4)
+        g = jax.grad(mlp.loss_fn)(params, cfg, x, y)
+        assert jax.tree.structure(g) == jax.tree.structure(params)
+
+    def test_learns_linearly_separable(self):
+        cfg = mlp.MLPConfig(in_dim=2, hidden_dims=(16,), n_classes=2)
+        params = mlp.init(jax.random.PRNGKey(0), cfg)
+        key = jax.random.PRNGKey(42)
+        x = jax.random.normal(key, (256, 2))
+        y = (x[:, 0] > 0).astype(jnp.int32)
+
+        @jax.jit
+        def step(p):
+            loss, g = jax.value_and_grad(mlp.loss_fn)(p, cfg, x, y)
+            return jax.tree.map(lambda w, gw: w - 0.5 * gw, p, g), loss
+
+        for _ in range(60):
+            params, loss = step(params)
+        assert float(loss) < 0.1
+
+
+class TestMnistCNN:
+    def test_forward(self):
+        cfg = mnist_cnn.MnistCNNConfig(n_filters_1=4, n_filters_2=8)
+        params = mnist_cnn.init(jax.random.PRNGKey(0), cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1), (3, 28, 28, 1))
+        logits = mnist_cnn.apply(params, cfg, x)
+        assert logits.shape == (3, 10)
+        flat = mnist_cnn.apply(params, cfg, x.reshape(3, 784))
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(flat), atol=1e-6)
+
+    def test_dropout_only_when_training(self):
+        cfg = mnist_cnn.MnistCNNConfig(n_filters_1=4, n_filters_2=8)
+        params = mnist_cnn.init(jax.random.PRNGKey(0), cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 28, 28, 1))
+        key = jax.random.PRNGKey(5)
+        eval1 = mnist_cnn.apply(params, cfg, x, training=False, dropout_key=key)
+        eval2 = mnist_cnn.apply(params, cfg, x, training=False, dropout_key=key)
+        np.testing.assert_allclose(np.asarray(eval1), np.asarray(eval2))
+        tr1 = mnist_cnn.apply(params, cfg, x, training=True, dropout_key=key)
+        tr2 = mnist_cnn.apply(
+            params, cfg, x, training=True, dropout_key=jax.random.PRNGKey(6)
+        )
+        assert not np.allclose(np.asarray(tr1), np.asarray(tr2))
+
+
+class TestGPT:
+    def setup_method(self):
+        self.cfg = gpt.GPTConfig.tiny()
+        self.params = gpt.init(jax.random.PRNGKey(0), self.cfg)
+
+    def test_stacked_blocks_shape(self):
+        qkv = self.params["blocks"]["attn_qkv"]["kernel"]
+        assert qkv.shape == (2, 64, 192)  # [L, D, 3D]
+
+    def test_forward_shape_and_dtype(self):
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        logits = gpt.apply(self.params, self.cfg, tokens)
+        assert logits.shape == (2, 16, self.cfg.vocab_size)
+        assert logits.dtype == jnp.float32
+
+    def test_causality(self):
+        t1 = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0, 256)
+        t2 = t1.at[0, -1].set((t1[0, -1] + 1) % 256)
+        l1 = gpt.apply(self.params, self.cfg, t1)
+        l2 = gpt.apply(self.params, self.cfg, t2)
+        np.testing.assert_allclose(np.asarray(l1[:, :-1]), np.asarray(l2[:, :-1]),
+                                   atol=1e-4)
+
+    def test_loss_decreases(self):
+        tokens = jax.random.randint(jax.random.PRNGKey(3), (4, 32), 0, 256)
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+
+        @jax.jit
+        def step(p):
+            loss, g = jax.value_and_grad(gpt.loss_fn)(p, self.cfg, inputs, targets)
+            return jax.tree.map(lambda w, gw: w - 0.1 * gw, p, g), loss
+
+        params = self.params
+        params, first = step(params)
+        for _ in range(10):
+            params, loss = step(params)
+        assert float(loss) < float(first)
+
+    def test_sharded_forward_matches_single(self):
+        mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+        tokens = jax.random.randint(jax.random.PRNGKey(4), (8, 16), 0, 256)
+        expect = gpt.apply(self.params, self.cfg, tokens)
+
+        shardings = gpt.GPT_SHARDING_RULES.shardings_for(self.params, mesh)
+        sharded_params = shard_put(self.params, shardings)
+        sharded_tokens = shard_put(
+            tokens, NamedSharding(mesh, batch_spec(extra_dims=1))
+        )
+
+        @jax.jit
+        def fwd(p, t):
+            return gpt.apply(p, self.cfg, t)
+
+        got = fwd(sharded_params, sharded_tokens)
+        np.testing.assert_allclose(np.asarray(expect), np.asarray(got),
+                                   atol=2e-2, rtol=2e-2)
+
+    def test_blockwise_attention_config(self):
+        cfg = gpt.GPTConfig(vocab_size=256, n_layers=2, d_model=64, n_heads=4,
+                            d_ff=128, max_seq_len=128, remat=False,
+                            blockwise_attention=True, attention_block_size=16)
+        tokens = jax.random.randint(jax.random.PRNGKey(5), (2, 32), 0, 256)
+        base = gpt.apply(self.params, self.cfg, tokens)
+        blocked = gpt.apply(self.params, cfg, tokens)
+        # bf16 compute: different summation order → small noise
+        np.testing.assert_allclose(np.asarray(base), np.asarray(blocked),
+                                   atol=1e-2, rtol=1e-2)
+
+    def test_dropout_active_only_in_training(self):
+        cfg = gpt.GPTConfig(vocab_size=256, n_layers=2, d_model=64, n_heads=4,
+                            d_ff=128, max_seq_len=128, remat=False, dropout=0.5)
+        params = gpt.init(jax.random.PRNGKey(0), cfg)
+        tokens = jnp.zeros((1, 8), jnp.int32)
+        key = jax.random.PRNGKey(9)
+        e1 = gpt.apply(params, cfg, tokens)
+        e2 = gpt.apply(params, cfg, tokens, training=False, dropout_key=key)
+        np.testing.assert_allclose(np.asarray(e1), np.asarray(e2))
+        t1 = gpt.apply(params, cfg, tokens, training=True, dropout_key=key)
+        assert not np.allclose(np.asarray(e1), np.asarray(t1))
+
+    def test_param_count(self):
+        n = gpt.param_count(self.params)
+        assert n > 50_000  # tiny but real
