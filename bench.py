@@ -1,0 +1,97 @@
+"""Headline benchmark: GPT training throughput (samples/sec/chip).
+
+North-star metric from BASELINE.md: trial throughput in samples/sec/chip with
+loss parity for the mnist + GPT baseline configs. The reference publishes no
+absolute numbers (BASELINE.json ``published: {}``), so ``vs_baseline`` is
+reported against 1.0 until a reference measurement exists.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Runs on whatever jax.devices() provides (the real TPU chip under axon; CPU
+falls back to a tiny config so the harness still completes).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    import optax
+
+    from determined_clone_tpu.models import gpt
+    from determined_clone_tpu.parallel import single_device_mesh
+    from determined_clone_tpu.training.train_step import (
+        create_train_state,
+        make_train_step,
+    )
+
+    device = jax.devices()[0]
+    on_tpu = device.platform != "cpu"
+
+    if on_tpu:
+        # GPT-2-small-ish: saturates a v5e chip's MXU at bf16.
+        cfg = gpt.GPTConfig(
+            vocab_size=50304, n_layers=12, d_model=768, n_heads=12,
+            d_ff=3072, max_seq_len=1024, remat=True,
+        )
+        batch, seq, timed_steps = 8, 1024, 10
+    else:
+        cfg = gpt.GPTConfig(
+            vocab_size=1024, n_layers=2, d_model=128, n_heads=4,
+            d_ff=512, max_seq_len=128, remat=False,
+        )
+        batch, seq, timed_steps = 4, 128, 3
+
+    params = gpt.init(jax.random.PRNGKey(0), cfg)
+    tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+    state = create_train_state(params, tx, jax.random.PRNGKey(1))
+    state = jax.device_put(state, device)
+
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (batch, seq + 1), 0,
+                                cfg.vocab_size)
+    tokens = jax.device_put(tokens, device)
+
+    def loss_fn(p, b, rng):
+        return gpt.loss_fn(p, cfg, b[:, :-1], b[:, 1:]), {}
+
+    step = make_train_step(loss_fn, tx)
+
+    # Warmup: compile + one executed step.
+    state, metrics = step(state, tokens)
+    jax.block_until_ready(metrics["loss"])
+    state, metrics = step(state, tokens)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(timed_steps):
+        state, metrics = step(state, tokens)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = batch * timed_steps / dt
+    n_params = gpt.param_count(params)
+    loss = float(metrics["loss"])
+
+    print(json.dumps({
+        "metric": "gpt_train_throughput",
+        "value": round(samples_per_sec, 3),
+        "unit": "samples/sec/chip",
+        "vs_baseline": 1.0,
+        "detail": {
+            "model_params": n_params,
+            "batch": batch,
+            "seq_len": seq,
+            "platform": device.platform,
+            "final_loss": round(loss, 4),
+            "tokens_per_sec": round(samples_per_sec * seq, 1),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, ".")
+    main()

@@ -1,0 +1,56 @@
+"""Core API (≈ harness/determined/core — SURVEY.md §2.3)."""
+from determined_clone_tpu.core._checkpoint import (
+    CheckpointContext,
+    CheckpointRegistry,
+    LocalCheckpointRegistry,
+    NullCheckpointRegistry,
+)
+from determined_clone_tpu.core._context import Context, init
+from determined_clone_tpu.core._distributed import (
+    DistributedContext,
+    DistributedError,
+)
+from determined_clone_tpu.core._preempt import (
+    FilePreemptionSource,
+    NeverPreempt,
+    PreemptContext,
+    PreemptMode,
+    PreemptionSource,
+)
+from determined_clone_tpu.core._searcher import (
+    LocalSearcherSource,
+    SearcherContext,
+    SearcherOperation,
+    SearcherOperationSource,
+)
+from determined_clone_tpu.core._serialization import load_pytree, save_pytree
+from determined_clone_tpu.core._train import (
+    LocalMetricsBackend,
+    MetricsBackend,
+    TrainContext,
+)
+
+__all__ = [
+    "CheckpointContext",
+    "CheckpointRegistry",
+    "LocalCheckpointRegistry",
+    "NullCheckpointRegistry",
+    "Context",
+    "init",
+    "DistributedContext",
+    "DistributedError",
+    "FilePreemptionSource",
+    "NeverPreempt",
+    "PreemptContext",
+    "PreemptMode",
+    "PreemptionSource",
+    "LocalSearcherSource",
+    "SearcherContext",
+    "SearcherOperation",
+    "SearcherOperationSource",
+    "load_pytree",
+    "save_pytree",
+    "LocalMetricsBackend",
+    "MetricsBackend",
+    "TrainContext",
+]

@@ -1,0 +1,201 @@
+"""CheckpointContext — distributed checkpoint save/restore + registry.
+
+Equivalent of the reference's CheckpointContext
+(harness/determined/core/_checkpoint.py:171-722): upload/download/
+store_path/restore_path/delete with **sharded** uploads (every rank writes
+its files, manifests merged via the control plane) and metadata JSON.
+
+The registry (which checkpoints exist, their metadata/resources) is reported
+to the master when on-cluster; the LocalRegistry keeps the same record in a
+JSONL next to the storage for off-cluster runs — the reference's
+"Dummy/off-cluster" pattern, but persistent.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+import uuid
+from typing import Any, Dict, Iterator, List, Optional
+
+from determined_clone_tpu.core._distributed import DistributedContext
+from determined_clone_tpu.storage.base import StorageManager
+
+METADATA_FILE = "metadata.json"
+
+
+class CheckpointRegistry:
+    """Record of reported checkpoints. Subclasses: local JSONL or master REST."""
+
+    def report(self, record: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def report_deleted(self, storage_id: str) -> None:
+        raise NotImplementedError
+
+    def list(self) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+
+class LocalCheckpointRegistry(CheckpointRegistry):
+    def __init__(self, path: str) -> None:
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def report(self, record: Dict[str, Any]) -> None:
+        with open(self.path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+    def report_deleted(self, storage_id: str) -> None:
+        self.report({"storage_id": storage_id, "deleted": True})
+
+    def list(self) -> List[Dict[str, Any]]:
+        if not os.path.exists(self.path):
+            return []
+        records: Dict[str, Dict[str, Any]] = {}
+        with open(self.path) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("deleted"):
+                    records.pop(rec["storage_id"], None)
+                else:
+                    records[rec["storage_id"]] = rec
+        return list(records.values())
+
+
+class NullCheckpointRegistry(CheckpointRegistry):
+    def report(self, record: Dict[str, Any]) -> None:
+        pass
+
+    def report_deleted(self, storage_id: str) -> None:
+        pass
+
+    def list(self) -> List[Dict[str, Any]]:
+        return []
+
+
+class CheckpointContext:
+    def __init__(self, dist: DistributedContext, storage: StorageManager,
+                 registry: Optional[CheckpointRegistry] = None, *,
+                 trial_id: Optional[int] = None) -> None:
+        self._dist = dist
+        self._storage = storage
+        self._registry = registry or NullCheckpointRegistry()
+        self._trial_id = trial_id
+
+    # -- save ---------------------------------------------------------------
+
+    def upload(self, ckpt_dir: str, metadata: Optional[Dict[str, Any]] = None,
+               *, shard: bool = False) -> str:
+        """Upload a checkpoint directory; returns storage_id.
+
+        shard=False: chief-only upload (all ranks may call; only chief acts).
+        shard=True: every rank uploads its own files; the file manifests are
+        merged across ranks (conflicting relative paths are an error, except
+        ``metadata.json`` which only the chief writes) — the semantics of the
+        reference's _upload_sharded/merge_resources
+        (core/_checkpoint.py:280,127).
+        """
+        storage_id = self._dist.broadcast(
+            str(uuid.uuid4()) if self._dist.is_chief else None
+        )
+        if shard:
+            my_files = _relative_files(ckpt_dir) if ckpt_dir else []
+            my_files = [f for f in my_files if f != METADATA_FILE or self._dist.is_chief]
+            all_files = self._dist.allgather(my_files)
+            _check_shard_conflicts(all_files)
+            if ckpt_dir:
+                self._write_metadata(ckpt_dir, metadata)
+                upload_files = my_files + (
+                    [METADATA_FILE] if self._dist.is_chief else []
+                )
+                self._storage.upload(ckpt_dir, storage_id, paths=sorted(set(upload_files)))
+        else:
+            if self._dist.is_chief:
+                self._write_metadata(ckpt_dir, metadata)
+                self._storage.upload(ckpt_dir, storage_id)
+        self._dist.barrier()
+        if self._dist.is_chief:
+            self._registry.report({
+                "storage_id": storage_id,
+                "trial_id": self._trial_id,
+                "metadata": metadata or {},
+                "time": time.time(),
+                "resources": self._storage.list_files(storage_id),
+            })
+        return storage_id
+
+    @contextlib.contextmanager
+    def store_path(self, metadata: Optional[Dict[str, Any]] = None, *,
+                   shard: bool = False) -> Iterator[tuple]:
+        """Yield (local_dir, holder); write files into local_dir, and after
+        the with-block exits cleanly the upload runs and
+        ``holder["storage_id"]`` carries the new checkpoint id. (The id
+        cannot exist earlier: it is allocated collectively at upload time.)"""
+        import shutil
+        import tempfile
+
+        tmp = tempfile.mkdtemp()
+        try:
+            storage_id_holder: Dict[str, str] = {}
+            yield tmp, storage_id_holder
+            storage_id_holder["storage_id"] = self.upload(
+                tmp, metadata, shard=shard
+            )
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def _write_metadata(self, ckpt_dir: str, metadata: Optional[Dict[str, Any]]) -> None:
+        if not self._dist.is_chief:
+            return
+        meta = dict(metadata or {})
+        meta.setdefault("trial_id", self._trial_id)
+        with open(os.path.join(ckpt_dir, METADATA_FILE), "w") as f:
+            json.dump(meta, f, indent=1)
+
+    # -- restore ------------------------------------------------------------
+
+    def download(self, storage_id: str, ckpt_dir: str) -> None:
+        self._storage.download(storage_id, ckpt_dir)
+
+    @contextlib.contextmanager
+    def restore_path(self, storage_id: str) -> Iterator[str]:
+        with self._storage.restore_path(storage_id) as path:
+            yield path
+
+    def get_metadata(self, storage_id: str) -> Dict[str, Any]:
+        with self.restore_path(storage_id) as path:
+            mpath = os.path.join(path, METADATA_FILE)
+            if os.path.exists(mpath):
+                with open(mpath) as f:
+                    return json.load(f)
+        return {}
+
+    # -- delete -------------------------------------------------------------
+
+    def delete(self, storage_id: str) -> None:
+        if self._dist.is_chief:
+            self._storage.delete(storage_id)
+            self._registry.report_deleted(storage_id)
+        self._dist.barrier()
+
+
+def _relative_files(base: str) -> List[str]:
+    out = []
+    for root, _, files in os.walk(base):
+        for f in files:
+            out.append(os.path.relpath(os.path.join(root, f), base))
+    return sorted(out)
+
+
+def _check_shard_conflicts(all_files: List[List[str]]) -> None:
+    seen: Dict[str, int] = {}
+    for rank, files in enumerate(all_files):
+        for f in files:
+            if f in seen:
+                raise ValueError(
+                    f"sharded checkpoint conflict: {f!r} written by both "
+                    f"rank {seen[f]} and rank {rank}"
+                )
+            seen[f] = rank

@@ -1,0 +1,129 @@
+"""core.Context and core.init() — the Core API entry point.
+
+Equivalent of the reference's core.init/Context
+(harness/determined/core/_context.py:183-320): bundles distributed, train,
+checkpoint, preempt and searcher contexts. Off-cluster (no master) every
+component gets a local fallback, so the same trial code runs managed and
+unmanaged — the reference's Dummy-context design, kept.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import tempfile
+from typing import Any, Iterator, Optional
+
+from determined_clone_tpu.config.experiment import (
+    CheckpointStorageConfig,
+    ExperimentConfig,
+)
+from determined_clone_tpu.core._checkpoint import (
+    CheckpointContext,
+    LocalCheckpointRegistry,
+)
+from determined_clone_tpu.core._distributed import DistributedContext
+from determined_clone_tpu.core._preempt import (
+    FilePreemptionSource,
+    NeverPreempt,
+    PreemptContext,
+    PreemptionSource,
+)
+from determined_clone_tpu.core._searcher import (
+    LocalSearcherSource,
+    SearcherContext,
+    SearcherOperationSource,
+)
+from determined_clone_tpu.core._train import (
+    LocalMetricsBackend,
+    MetricsBackend,
+    TrainContext,
+)
+from determined_clone_tpu.storage import base as storage_base
+
+
+class Context:
+    def __init__(self, *, distributed: DistributedContext, train: TrainContext,
+                 checkpoint: CheckpointContext, preempt: PreemptContext,
+                 searcher: SearcherContext,
+                 info: Optional[Any] = None) -> None:
+        self.distributed = distributed
+        self.train = train
+        self.checkpoint = checkpoint
+        self.preempt = preempt
+        self.searcher = searcher
+        self.info = info
+
+    def close(self) -> None:
+        self.preempt.close()
+        self.distributed.close()
+
+    def __enter__(self) -> "Context":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+@contextlib.contextmanager
+def init(
+    *,
+    config: Optional[ExperimentConfig] = None,
+    distributed: Optional[DistributedContext] = None,
+    storage_path: Optional[str] = None,
+    metrics_backend: Optional[MetricsBackend] = None,
+    preemption_source: Optional[PreemptionSource] = None,
+    searcher_source: Optional[SearcherOperationSource] = None,
+    trial_id: Optional[int] = None,
+) -> Iterator[Context]:
+    """Build a Context. With no arguments this is fully local: single rank,
+    tmpdir checkpoint storage, JSONL metrics — the unmanaged mode."""
+    config = config or ExperimentConfig.from_dict({})
+    dist = distributed or DistributedContext.single()
+
+    cleanup_dir: Optional[tempfile.TemporaryDirectory] = None
+    if config.checkpoint_storage is not None:
+        storage = storage_base.build(config.checkpoint_storage)
+        registry_base = (
+            config.checkpoint_storage.host_path
+            or config.checkpoint_storage.container_path or "."
+        )
+    else:
+        if storage_path is None:
+            cleanup_dir = tempfile.TemporaryDirectory(prefix="dct-ckpt-")
+            storage_path = cleanup_dir.name
+        storage = storage_base.build(
+            CheckpointStorageConfig(type="shared_fs", host_path=storage_path)
+        )
+        registry_base = storage_path
+
+    registry = LocalCheckpointRegistry(
+        os.path.join(registry_base, "checkpoints.jsonl")
+    )
+    checkpoint = CheckpointContext(dist, storage, registry, trial_id=trial_id)
+
+    backend = metrics_backend or LocalMetricsBackend()
+    train = TrainContext(
+        backend,
+        is_chief=dist.is_chief,
+        metric=config.searcher.metric,
+        smaller_is_better=config.searcher.smaller_is_better,
+    )
+
+    source = preemption_source
+    if source is None:
+        flag = os.environ.get("DCT_PREEMPT_FILE")
+        source = FilePreemptionSource(flag) if flag else NeverPreempt()
+    preempt = PreemptContext(dist, source).start()
+
+    if searcher_source is None:
+        searcher_source = LocalSearcherSource(config.searcher.max_length)
+    searcher = SearcherContext(searcher_source, is_chief=dist.is_chief)
+
+    ctx = Context(distributed=dist, train=train, checkpoint=checkpoint,
+                  preempt=preempt, searcher=searcher)
+    try:
+        yield ctx
+    finally:
+        ctx.close()
+        if cleanup_dir is not None:
+            cleanup_dir.cleanup()

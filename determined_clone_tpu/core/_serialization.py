@@ -1,0 +1,146 @@
+"""Pytree checkpoint serialization — distributed-sharded by design.
+
+Each host writes exactly its addressable shard data: for every leaf, the
+local device shards' (index, block) pairs go into ``shard-{host}.npz`` with
+an index manifest in ``manifest-{host}.json``. Restore reassembles global
+arrays from whichever blocks any host wrote (replicated blocks overwrite
+identically) and device_puts them onto target shardings. A single-host save
+degenerates to one full npz — same format.
+
+This is the checkpoint-payload analogue of the reference's sharded
+CheckpointContext uploads (core/_checkpoint.py:280): per-rank files, merged
+manifest; orbax-style async saving is a planned optimization on top.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+MANIFEST_RE = re.compile(r"manifest-(\d+)\.json$")
+
+
+def _flat_key(path: str) -> str:
+    return path.replace("/", ".")
+
+
+def _index_to_slices(index: Tuple[slice, ...], shape: Tuple[int, ...]
+                     ) -> List[List[int]]:
+    """Normalize a shard index (tuple of slices) to [[start, stop], ...]."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def save_pytree(ckpt_dir: str, tree: Any, *, host_id: int = 0) -> None:
+    """Save this host's addressable view of ``tree`` under ckpt_dir."""
+    from determined_clone_tpu.parallel.sharding import tree_paths_and_leaves
+
+    os.makedirs(ckpt_dir, exist_ok=True)
+    arrays: Dict[str, np.ndarray] = {}
+    manifest: Dict[str, Any] = {"leaves": {}, "format": 2, "host": host_id}
+    for path, leaf in tree_paths_and_leaves(tree):
+        key = _flat_key(path)
+        entry: Dict[str, Any] = {"path": path}
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            entry["global_shape"] = list(leaf.shape)
+            entry["dtype"] = str(leaf.dtype)
+            entry["blocks"] = []
+            seen_indices = set()
+            for i, shard in enumerate(leaf.addressable_shards):
+                norm = tuple(map(tuple, _index_to_slices(shard.index, leaf.shape)))
+                if norm in seen_indices:
+                    continue  # replicated within host: store once
+                seen_indices.add(norm)
+                bkey = f"{key}#%d" % i
+                arrays[bkey] = np.asarray(shard.data)
+                entry["blocks"].append(
+                    {"key": bkey, "index": [list(p) for p in norm]}
+                )
+        else:
+            arr = np.asarray(jax.device_get(leaf))
+            arrays[key] = arr
+            entry["global_shape"] = list(arr.shape)
+            entry["dtype"] = str(arr.dtype)
+            entry["blocks"] = [{
+                "key": key,
+                "index": [[0, d] for d in arr.shape],
+            }]
+        manifest["leaves"][key] = entry
+    np.savez(os.path.join(ckpt_dir, f"shard-{host_id}.npz"), **arrays)
+    with open(os.path.join(ckpt_dir, f"manifest-{host_id}.json"), "w") as f:
+        json.dump(manifest, f)
+
+
+def load_pytree(ckpt_dir: str, like: Any, *, shardings: Optional[Any] = None) -> Any:
+    """Load a checkpoint into the structure of ``like``. With ``shardings``
+    (congruent pytree of NamedShardings), leaves go straight onto devices —
+    the resume path for sharded training."""
+    from determined_clone_tpu.parallel.sharding import tree_paths_and_leaves
+
+    manifests = []
+    data: Dict[str, np.ndarray] = {}
+    for fname in sorted(os.listdir(ckpt_dir)):
+        if MANIFEST_RE.search(fname):
+            with open(os.path.join(ckpt_dir, fname)) as f:
+                manifests.append(json.load(f))
+        elif fname.startswith("shard-") and fname.endswith(".npz"):
+            with np.load(os.path.join(ckpt_dir, fname)) as z:
+                for k in z.files:
+                    data[k] = z[k]
+    if not manifests:
+        raise FileNotFoundError(f"no checkpoint manifests in {ckpt_dir}")
+
+    # merge per-host manifests: same leaf key → union of blocks
+    leaves_meta: Dict[str, Dict[str, Any]] = {}
+    for m in manifests:
+        for key, entry in m["leaves"].items():
+            if key in leaves_meta:
+                leaves_meta[key]["blocks"].extend(entry["blocks"])
+            else:
+                leaves_meta[key] = {**entry, "blocks": list(entry["blocks"])}
+
+    paths = [p for p, _ in tree_paths_and_leaves(like)]
+    flat_like, treedef = jax.tree_util.tree_flatten(like)
+    out_leaves = []
+    for path, ref in zip(paths, flat_like):
+        key = _flat_key(path)
+        if key not in leaves_meta:
+            raise KeyError(f"checkpoint missing leaf {path!r}")
+        entry = leaves_meta[key]
+        shape = tuple(entry["global_shape"])
+        ref_shape = tuple(getattr(ref, "shape", ()))
+        if shape != ref_shape:
+            raise ValueError(
+                f"checkpoint leaf {path!r} has shape {shape}, expected {ref_shape}"
+            )
+        arr = np.empty(shape, dtype=np.dtype(entry["dtype"]))
+        filled = np.zeros(shape, dtype=bool) if entry["blocks"] else None
+        for block in entry["blocks"]:
+            if block["key"] not in data:
+                raise KeyError(
+                    f"checkpoint leaf {path!r}: missing block {block['key']!r} "
+                    f"(incomplete shard set?)"
+                )
+            idx = tuple(slice(a, b) for a, b in block["index"])
+            arr[idx] = data[block["key"]]
+            filled[idx] = True
+        if filled is not None and not bool(filled.all()):
+            raise ValueError(
+                f"checkpoint leaf {path!r} is missing data blocks "
+                f"(saved from fewer hosts than the array spanned?)"
+            )
+        out_leaves.append(arr)
+    tree = jax.tree_util.tree_unflatten(treedef, out_leaves)
+    if shardings is not None:
+        tree = jax.device_put(tree, shardings)
+    else:
+        tree = jax.tree.map(jax.numpy.asarray, tree)
+    return tree

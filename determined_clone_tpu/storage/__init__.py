@@ -1,0 +1,18 @@
+"""Checkpoint storage backends (≈ harness/determined/common/storage)."""
+from determined_clone_tpu.storage.base import (
+    DirectoryStorageManager,
+    GCSStorageManager,
+    S3StorageManager,
+    SharedFSStorageManager,
+    StorageManager,
+    build,
+)
+
+__all__ = [
+    "DirectoryStorageManager",
+    "GCSStorageManager",
+    "S3StorageManager",
+    "SharedFSStorageManager",
+    "StorageManager",
+    "build",
+]

@@ -1,0 +1,246 @@
+"""Checkpoint storage backends.
+
+Equivalent of the reference's StorageManager hierarchy
+(harness/determined/common/storage/base.py:26 + s3/gcs/azure/shared_fs/
+directory impls): upload/download/delete a checkpoint directory by UUID,
+plus ``store_path``/``restore_path`` context managers that give trial code a
+local directory and handle the transfer.
+
+Round-1 backends: shared_fs and directory (posix). gcs is implemented over
+``gcsfs``-less HTTP... not available in this image — the GCS/S3 classes are
+present but gated: they raise a clear error unless their client library
+exists (the reference similarly imports boto3/google-cloud lazily).
+"""
+from __future__ import annotations
+
+import abc
+import contextlib
+import os
+import shutil
+from typing import Dict, Iterator, List, Optional
+
+from determined_clone_tpu.config.experiment import CheckpointStorageConfig
+
+
+class StorageManager(abc.ABC):
+    """Store checkpoint directories keyed by storage_id (uuid)."""
+
+    @abc.abstractmethod
+    def upload(self, src_dir: str, storage_id: str,
+               paths: Optional[List[str]] = None) -> None:
+        """Upload files under src_dir (optionally only ``paths``)."""
+
+    @abc.abstractmethod
+    def download(self, storage_id: str, dst_dir: str,
+                 paths: Optional[List[str]] = None) -> None:
+        ...
+
+    @abc.abstractmethod
+    def delete(self, storage_id: str) -> None:
+        ...
+
+    @abc.abstractmethod
+    def list_files(self, storage_id: str) -> Dict[str, int]:
+        """{relative_path: size_bytes} for one checkpoint."""
+
+    @contextlib.contextmanager
+    def store_path(self, storage_id: str, base_tmp: Optional[str] = None
+                   ) -> Iterator[str]:
+        """Yield a local dir; upload its contents on clean exit."""
+        import tempfile
+
+        tmp = tempfile.mkdtemp(dir=base_tmp)
+        try:
+            yield tmp
+            self.upload(tmp, storage_id)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    @contextlib.contextmanager
+    def restore_path(self, storage_id: str, base_tmp: Optional[str] = None
+                     ) -> Iterator[str]:
+        """Yield a local dir containing the downloaded checkpoint."""
+        import tempfile
+
+        tmp = tempfile.mkdtemp(dir=base_tmp)
+        try:
+            self.download(storage_id, tmp)
+            yield tmp
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+class SharedFSStorageManager(StorageManager):
+    """Checkpoints on a shared filesystem (GCS-fuse mount, NFS, …) — the
+    default for TPU-VM pods where all hosts see the same mount."""
+
+    def __init__(self, host_path: str, storage_path: Optional[str] = None) -> None:
+        self.base = os.path.join(host_path, storage_path) if storage_path else host_path
+
+    def _dir(self, storage_id: str) -> str:
+        # storage_id comes from the platform (uuid), but never trust a path
+        # component: reject separators so an id can't escape the base dir.
+        if not storage_id or "/" in storage_id or storage_id in (".", ".."):
+            raise ValueError(f"invalid storage_id {storage_id!r}")
+        return os.path.join(self.base, storage_id)
+
+    def upload(self, src_dir: str, storage_id: str,
+               paths: Optional[List[str]] = None) -> None:
+        dst = self._dir(storage_id)
+        os.makedirs(dst, exist_ok=True)
+        for rel in paths if paths is not None else _walk_relative(src_dir):
+            src = os.path.join(src_dir, rel)
+            out = os.path.join(dst, rel)
+            os.makedirs(os.path.dirname(out), exist_ok=True)
+            shutil.copy2(src, out)
+
+    def download(self, storage_id: str, dst_dir: str,
+                 paths: Optional[List[str]] = None) -> None:
+        src = self._dir(storage_id)
+        if not os.path.isdir(src):
+            raise FileNotFoundError(f"checkpoint {storage_id} not found in {self.base}")
+        for rel in paths if paths is not None else _walk_relative(src):
+            s = os.path.join(src, rel)
+            out = os.path.join(dst_dir, rel)
+            os.makedirs(os.path.dirname(out), exist_ok=True)
+            shutil.copy2(s, out)
+
+    def delete(self, storage_id: str) -> None:
+        shutil.rmtree(self._dir(storage_id), ignore_errors=True)
+
+    def list_files(self, storage_id: str) -> Dict[str, int]:
+        d = self._dir(storage_id)
+        if not os.path.isdir(d):
+            return {}
+        return {
+            rel: os.path.getsize(os.path.join(d, rel))
+            for rel in _walk_relative(d)
+        }
+
+
+class DirectoryStorageManager(SharedFSStorageManager):
+    """Plain local-directory storage (the reference's `directory` type)."""
+
+    def __init__(self, container_path: str) -> None:
+        super().__init__(container_path)
+
+
+class GCSStorageManager(StorageManager):  # pragma: no cover - gated on client lib
+    """GCS backend; requires google-cloud-storage (not in this image)."""
+
+    def __init__(self, bucket: str, prefix: Optional[str] = None) -> None:
+        try:
+            from google.cloud import storage as gcs  # type: ignore
+
+            self.client = gcs.Client()
+        except Exception as e:
+            raise RuntimeError(
+                "checkpoint_storage type 'gcs' needs google-cloud-storage and "
+                "application-default credentials; on TPU VMs a shared_fs "
+                "gcsfuse mount is the zero-config alternative"
+            ) from e
+        self.bucket = self.client.bucket(bucket)
+        self.prefix = (prefix or "").strip("/")
+
+    def _key(self, storage_id: str, rel: str) -> str:
+        parts = [p for p in (self.prefix, storage_id, rel) if p]
+        return "/".join(parts)
+
+    def upload(self, src_dir, storage_id, paths=None):
+        for rel in paths if paths is not None else _walk_relative(src_dir):
+            self.bucket.blob(self._key(storage_id, rel)).upload_from_filename(
+                os.path.join(src_dir, rel)
+            )
+
+    def download(self, storage_id, dst_dir, paths=None):
+        it = self.client.list_blobs(self.bucket, prefix=self._key(storage_id, ""))
+        for blob in it:
+            rel = blob.name.split(f"{storage_id}/", 1)[1]
+            if paths is not None and rel not in paths:
+                continue
+            out = os.path.join(dst_dir, rel)
+            os.makedirs(os.path.dirname(out), exist_ok=True)
+            blob.download_to_filename(out)
+
+    def delete(self, storage_id):
+        for blob in self.client.list_blobs(self.bucket,
+                                           prefix=self._key(storage_id, "")):
+            blob.delete()
+
+    def list_files(self, storage_id):
+        return {
+            blob.name.split(f"{storage_id}/", 1)[1]: blob.size
+            for blob in self.client.list_blobs(
+                self.bucket, prefix=self._key(storage_id, "")
+            )
+        }
+
+
+class S3StorageManager(StorageManager):  # pragma: no cover - gated on client lib
+    """S3 backend; requires boto3 (not in this image)."""
+
+    def __init__(self, bucket: str, prefix: Optional[str] = None) -> None:
+        try:
+            import boto3  # type: ignore
+        except ImportError as e:
+            raise RuntimeError(
+                "checkpoint_storage type 's3' requires boto3 (not installed)"
+            ) from e
+        self.s3 = boto3.client("s3")
+        self.bucket_name = bucket
+        self.prefix = (prefix or "").strip("/")
+
+    def _key(self, storage_id: str, rel: str) -> str:
+        parts = [p for p in (self.prefix, storage_id, rel) if p]
+        return "/".join(parts)
+
+    def upload(self, src_dir, storage_id, paths=None):
+        for rel in paths if paths is not None else _walk_relative(src_dir):
+            self.s3.upload_file(os.path.join(src_dir, rel), self.bucket_name,
+                                self._key(storage_id, rel))
+
+    def download(self, storage_id, dst_dir, paths=None):
+        resp = self.s3.list_objects_v2(Bucket=self.bucket_name,
+                                       Prefix=self._key(storage_id, ""))
+        for item in resp.get("Contents", []):
+            rel = item["Key"].split(f"{storage_id}/", 1)[1]
+            if paths is not None and rel not in paths:
+                continue
+            out = os.path.join(dst_dir, rel)
+            os.makedirs(os.path.dirname(out), exist_ok=True)
+            self.s3.download_file(self.bucket_name, item["Key"], out)
+
+    def delete(self, storage_id):
+        resp = self.s3.list_objects_v2(Bucket=self.bucket_name,
+                                       Prefix=self._key(storage_id, ""))
+        for item in resp.get("Contents", []):
+            self.s3.delete_object(Bucket=self.bucket_name, Key=item["Key"])
+
+    def list_files(self, storage_id):
+        resp = self.s3.list_objects_v2(Bucket=self.bucket_name,
+                                       Prefix=self._key(storage_id, ""))
+        return {
+            item["Key"].split(f"{storage_id}/", 1)[1]: item["Size"]
+            for item in resp.get("Contents", [])
+        }
+
+
+def _walk_relative(base: str) -> List[str]:
+    out = []
+    for root, _, files in os.walk(base):
+        for f in files:
+            out.append(os.path.relpath(os.path.join(root, f), base))
+    return sorted(out)
+
+
+def build(cfg: CheckpointStorageConfig) -> StorageManager:
+    """Factory from the checkpoint_storage config union."""
+    if cfg.type == "shared_fs":
+        return SharedFSStorageManager(cfg.host_path, cfg.storage_path)
+    if cfg.type == "directory":
+        return DirectoryStorageManager(cfg.container_path)
+    if cfg.type == "gcs":
+        return GCSStorageManager(cfg.bucket, cfg.prefix)
+    if cfg.type == "s3":
+        return S3StorageManager(cfg.bucket, cfg.prefix)
+    raise ValueError(f"unknown storage type {cfg.type!r}")

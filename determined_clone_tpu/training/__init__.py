@@ -1,0 +1,23 @@
+"""Trial API + Trainer (≈ harness/determined/pytorch)."""
+from determined_clone_tpu.training.metrics import MetricAccumulator
+from determined_clone_tpu.training.train_step import (
+    TrainState,
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+    state_shardings,
+)
+from determined_clone_tpu.training.trainer import Trainer
+from determined_clone_tpu.training.trial import JaxTrial, TrialContext
+
+__all__ = [
+    "MetricAccumulator",
+    "TrainState",
+    "create_train_state",
+    "make_eval_step",
+    "make_train_step",
+    "state_shardings",
+    "Trainer",
+    "JaxTrial",
+    "TrialContext",
+]

@@ -1,0 +1,51 @@
+"""Metric accumulation without host syncs.
+
+The reference reduces metrics eagerly per batch (pytorch _reducer.py); under
+XLA that would force a device→host transfer every step. Here metrics stay on
+device: scalars are appended to a running (sum, count) device accumulator and
+only converted to floats at reporting boundaries.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class MetricAccumulator:
+    """Running mean of per-batch scalar metrics, device-side."""
+
+    def __init__(self) -> None:
+        self._sums: Dict[str, jax.Array] = {}
+        self._counts: Dict[str, int] = {}
+
+    def add(self, metrics: Dict[str, jax.Array]) -> None:
+        for k, v in metrics.items():
+            if k in self._sums:
+                self._sums[k] = self._sums[k] + v
+                self._counts[k] += 1
+            else:
+                self._sums[k] = v
+                self._counts[k] = 1
+
+    def result(self) -> Dict[str, float]:
+        """Host sync point: returns means and resets."""
+        out = {
+            k: float(np.asarray(jax.device_get(s))) / self._counts[k]
+            for k, s in self._sums.items()
+        }
+        self._sums.clear()
+        self._counts.clear()
+        return out
+
+    def __len__(self) -> int:
+        return len(self._sums)
+
+
+def mean_over_batches(per_batch: List[Dict[str, jax.Array]]) -> Dict[str, float]:
+    acc = MetricAccumulator()
+    for m in per_batch:
+        acc.add(m)
+    return acc.result()

@@ -1,0 +1,250 @@
+"""Trainer — the training loop (≈ _PyTorchTrialController + Trainer.fit,
+harness/determined/pytorch/_pytorch_trial.py:183,631 and _trainer.py:83).
+
+Loop shape mirrors the reference's searcher-driven boundaries
+(_train_with_boundaries :695): train in scheduling_unit chunks, report
+training metrics per chunk, validate/checkpoint on period boundaries,
+cooperate with preemption — but each batch is one jitted XLA program and
+metrics stay on device until a boundary (no per-batch host syncs).
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding
+
+from determined_clone_tpu.config.length import Length
+from determined_clone_tpu.core._serialization import load_pytree, save_pytree
+from determined_clone_tpu.training.metrics import MetricAccumulator
+from determined_clone_tpu.training.train_step import (
+    TrainState,
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+    state_shardings,
+)
+from determined_clone_tpu.training.trial import JaxTrial
+
+CKPT_STATE_DIR = "state"
+
+
+class Trainer:
+    def __init__(self, trial: JaxTrial) -> None:
+        self.trial = trial
+        self.context = trial.context
+        self.config = trial.context.config
+        self.core = trial.context.core
+        self.mesh = trial.context.mesh
+
+    # -- length resolution --------------------------------------------------
+
+    def _to_batches(self, length: Optional[Any], default: int = 0) -> int:
+        if length is None:
+            return default
+        if isinstance(length, int):
+            return length
+        if isinstance(length, Length):
+            return length.to_batches(
+                self.trial.global_batch_size, self.config.records_per_epoch
+            )
+        raise TypeError(f"cannot resolve training length {length!r}")
+
+    # -- checkpoint save/restore -------------------------------------------
+
+    def _save(self, state: TrainState, batches_trained: int,
+              reason: str) -> str:
+        """Every host writes its addressable shard files; sharded upload
+        merges the manifests (multi-host pjit state is never fully
+        addressable on one host)."""
+        dist = self.core.distributed
+        ck = self.core.checkpoint
+        sharded = dist.size > 1
+        with ck.store_path(
+            metadata={
+                "steps_completed": batches_trained,
+                "reason": reason,
+                "global_batch_size": self.trial.global_batch_size,
+            },
+            shard=sharded,
+        ) as (path, holder):
+            save_pytree(f"{path}/{CKPT_STATE_DIR}", state, host_id=dist.rank)
+        return holder.get("storage_id", "")
+
+    def _restore(self, storage_id: str, like: TrainState,
+                 shardings: TrainState) -> tuple:
+        ck = self.core.checkpoint
+        with ck.restore_path(storage_id) as path:
+            state = load_pytree(f"{path}/{CKPT_STATE_DIR}", like,
+                                shardings=shardings)
+            mpath = f"{path}/metadata.json"
+            meta: dict = {}
+            if os.path.exists(mpath):
+                with open(mpath) as f:
+                    import json
+
+                    meta = json.load(f)
+        return state, int(meta.get("steps_completed", 0))
+
+    # -- the loop -----------------------------------------------------------
+
+    def fit(self, latest_checkpoint: Optional[str] = None) -> Dict[str, Any]:
+        trial, config = self.trial, self.config
+        dist = self.core.distributed
+        mesh = self.mesh
+
+        rng = jax.random.PRNGKey(config.experiment_seed)
+        init_rng, state_rng = jax.random.split(rng)
+        params = trial.initial_params(init_rng)
+        tx = trial.optimizer()
+        state = create_train_state(params, tx, state_rng)
+        shardings = state_shardings(state, mesh, trial.sharding_rules())
+
+        data_iter = iter(trial.training_data())
+        try:
+            first_batch = next(data_iter)
+        except StopIteration:
+            raise RuntimeError("training_data() yielded no batches") from None
+        batch_sharding = jax.tree.map(
+            lambda spec: NamedSharding(mesh, spec),
+            trial.batch_spec(first_batch),
+        )
+
+        batches_trained = 0
+        if latest_checkpoint:
+            state, batches_trained = self._restore(latest_checkpoint, state,
+                                                   shardings)
+        else:
+            state = jax.device_put(state, shardings)
+
+        train_step = make_train_step(
+            trial.loss, tx, mesh=mesh, state_sharding=shardings,
+            batch_sharding=batch_sharding,
+        )
+        eval_step = make_eval_step(
+            trial.eval_metrics, state_sharding=shardings,
+            batch_sharding=batch_sharding,
+        )
+
+        sched_unit = config.scheduling_unit
+        val_period = self._to_batches(config.min_validation_period, 0)
+        ckpt_period = self._to_batches(config.min_checkpoint_period, 0)
+        policy = config.checkpoint_policy
+        smaller = config.searcher.smaller_is_better
+        searcher_metric = config.searcher.metric
+
+        def batches() -> Iterator[Any]:
+            yield first_batch
+            yield from data_iter
+            while True:  # repeat dataset
+                yield from iter(trial.training_data())
+
+        batch_gen = batches()
+        # skip already-trained batches on restore so data order lines up
+        for _ in range(batches_trained):
+            next(batch_gen)
+
+        acc = MetricAccumulator()
+        last_val: Dict[str, float] = {}
+        best_val: Optional[float] = None
+        last_val_at = batches_trained
+        last_ckpt_at = batches_trained
+        preempted = False
+        result: Dict[str, Any] = {}
+
+        def validate() -> Dict[str, float]:
+            vdata = trial.validation_data()
+            if vdata is None:
+                return {}
+            vacc = MetricAccumulator()
+            for vbatch in vdata:
+                vbatch = jax.device_put(vbatch, batch_sharding)
+                vacc.add(eval_step(state, vbatch))
+            metrics = vacc.result() if len(vacc) else {}
+            if metrics:
+                self.core.train.report_validation_metrics(batches_trained, metrics)
+            return metrics
+
+        for op in self.core.searcher.operations():
+            if op.length is None:
+                raise RuntimeError(
+                    "searcher.max_length is not set: the searcher operation "
+                    "has no training target. Set searcher.max_length in the "
+                    "experiment config (e.g. {'batches': 1000}) or provide a "
+                    "searcher_source."
+                )
+            target = self._to_batches(op.length, 0)
+            while batches_trained < target and not preempted:
+                chunk_end = min(
+                    target,
+                    (batches_trained // sched_unit + 1) * sched_unit,
+                )
+                t0 = time.perf_counter()
+                n0 = batches_trained
+                while batches_trained < chunk_end:
+                    batch = jax.device_put(next(batch_gen), batch_sharding)
+                    state, metrics = train_step(state, batch)
+                    acc.add(metrics)
+                    batches_trained += 1
+                # ---- reporting boundary (one host sync per chunk) ----
+                train_metrics = acc.result()
+                dt = time.perf_counter() - t0
+                train_metrics["batches_per_second"] = (batches_trained - n0) / dt
+                train_metrics["samples_per_second"] = (
+                    (batches_trained - n0) * trial.global_batch_size / dt
+                )
+                self.core.train.report_training_metrics(batches_trained,
+                                                        train_metrics)
+                op.report_progress(batches_trained)
+
+                if val_period and batches_trained - last_val_at >= val_period:
+                    last_val = validate()
+                    last_val_at = batches_trained
+                    if searcher_metric in last_val:
+                        v = last_val[searcher_metric]
+                        is_best = best_val is None or (
+                            v < best_val if smaller else v > best_val
+                        )
+                        if is_best:
+                            best_val = v
+                            if policy == "best":
+                                self._save(state, batches_trained, "best")
+                                last_ckpt_at = batches_trained
+
+                if ckpt_period and batches_trained - last_ckpt_at >= ckpt_period:
+                    if policy != "none":
+                        self._save(state, batches_trained, "periodic")
+                    last_ckpt_at = batches_trained
+
+                if self.core.preempt.should_preempt():
+                    preempted = True
+
+            if preempted:
+                self._save(state, batches_trained, "preemption")
+                self.core.train.report_early_exit("preempted")
+                break
+
+            # op complete: ensure a fresh validation at the boundary
+            final_val = validate()
+            if final_val:
+                last_val = final_val
+                if searcher_metric in final_val:
+                    v = final_val[searcher_metric]
+                    if best_val is None or (v < best_val if smaller else v > best_val):
+                        best_val = v
+            op.complete(last_val.get(searcher_metric, float("nan")))
+
+        if not preempted and policy != "none" and batches_trained > last_ckpt_at:
+            self._save(state, batches_trained, "final")
+
+        result.update(
+            batches_trained=batches_trained,
+            last_validation=last_val,
+            best_validation=best_val,
+            preempted=preempted,
+        )
+        self._final_state = state
+        return result

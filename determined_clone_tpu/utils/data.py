@@ -1,0 +1,96 @@
+"""Data utilities: in-memory datasets, batch iterators, MNIST loading.
+
+The reference wraps torch DataLoaders (harness/determined/pytorch/_data.py,
+samplers.py); here data reaches the device as whole global batches that
+``device_put`` scatters across the mesh's (dp, fsdp) axes. Determinism comes
+from seeding the shuffle with (seed, epoch) — the reference's
+reproducibility.experiment_seed contract.
+
+No egress in the build environment, so ``synthetic_mnist`` provides a
+deterministic learnable stand-in (class-prototype images + noise); real
+MNIST IDX files are loaded when a path is available.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+
+_PROTO_SEED = 1234  # class prototypes are fixed across splits
+
+
+def synthetic_mnist(n: int = 8192, seed: int = 0, image: bool = False
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """A learnable 10-class stand-in for MNIST: each class is a fixed random
+    prototype in 784-d (shared across train/val splits), samples are
+    prototype + gaussian noise. ``seed`` only varies the samples. Separable
+    enough that the reference's 0.97-accuracy gate
+    (e2e_tests/tests/nightly/test_convergence.py:25) is meaningful."""
+    protos = np.random.RandomState(_PROTO_SEED).randn(10, 784).astype(np.float32)
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, size=n).astype(np.int32)
+    x = protos[labels] + 0.9 * rng.randn(n, 784).astype(np.float32)
+    if image:
+        x = x.reshape(n, 28, 28, 1)
+    return x, labels
+
+
+def load_mnist_idx(data_dir: str, split: str = "train", image: bool = False
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """Load MNIST from IDX files (raw or .gz) if present."""
+    prefix = "train" if split == "train" else "t10k"
+    imgs = _read_idx(os.path.join(data_dir, f"{prefix}-images-idx3-ubyte"))
+    labels = _read_idx(os.path.join(data_dir, f"{prefix}-labels-idx1-ubyte"))
+    x = imgs.astype(np.float32) / 255.0
+    y = labels.astype(np.int32)
+    if image:
+        x = x.reshape(-1, 28, 28, 1)
+    else:
+        x = x.reshape(-1, 784)
+    return x, y
+
+
+def _read_idx(path: str) -> np.ndarray:
+    opener = open
+    if not os.path.exists(path) and os.path.exists(path + ".gz"):
+        path, opener = path + ".gz", gzip.open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        shape = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(shape)
+
+
+def mnist_dataset(data_dir: Optional[str] = None, split: str = "train",
+                  image: bool = False, synthetic_n: int = 8192,
+                  seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Real MNIST if data_dir has IDX files, else the synthetic stand-in."""
+    if data_dir:
+        try:
+            return load_mnist_idx(data_dir, split, image)
+        except FileNotFoundError:
+            pass
+    return synthetic_mnist(
+        synthetic_n if split == "train" else max(1024, synthetic_n // 8),
+        seed=seed if split == "train" else seed + 1,
+        image=image,
+    )
+
+
+def batch_iterator(x: np.ndarray, y: np.ndarray, batch_size: int, *,
+                   seed: int = 0, epoch: int = 0, shuffle: bool = True,
+                   drop_remainder: bool = True
+                   ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Deterministic shuffled batches of (x, y)."""
+    n = len(x)
+    idx = np.arange(n)
+    if shuffle:
+        np.random.RandomState((seed * 1_000_003 + epoch) % (2**31)).shuffle(idx)
+    end = n - (n % batch_size) if drop_remainder else n
+    for i in range(0, end, batch_size):
+        sel = idx[i:i + batch_size]
+        yield x[sel], y[sel]

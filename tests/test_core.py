@@ -1,0 +1,290 @@
+"""Core API tests. Multi-rank logic runs as N threads with real
+DistributedContext objects — the reference's in-process gang simulation
+(harness/tests/parallel.py:15-60)."""
+import json
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from determined_clone_tpu import core
+from determined_clone_tpu.config import CheckpointStorageConfig, ExperimentConfig
+from determined_clone_tpu.core import (
+    DistributedContext,
+    LocalMetricsBackend,
+    PreemptContext,
+    FilePreemptionSource,
+    load_pytree,
+    save_pytree,
+)
+from determined_clone_tpu.storage import SharedFSStorageManager, build
+
+
+def run_gang(size, fn):
+    """Run fn(dist_ctx) on `size` threads; return results by rank."""
+    ctxs = DistributedContext.make_local_group(size)
+    with ThreadPoolExecutor(max_workers=size) as pool:
+        return list(pool.map(fn, ctxs))
+
+
+class TestDistributedContext:
+    def test_single(self):
+        d = DistributedContext.single()
+        assert d.is_chief and d.allgather("x") == ["x"]
+        assert d.broadcast("y") == "y"
+        assert d.gather("z") == ["z"]
+
+    def test_allgather(self):
+        out = run_gang(4, lambda d: d.allgather(d.rank * 10))
+        assert all(o == [0, 10, 20, 30] for o in out)
+
+    def test_gather_chief_only(self):
+        out = run_gang(3, lambda d: d.gather(f"r{d.rank}"))
+        assert out[0] == ["r0", "r1", "r2"]
+        assert out[1] is None and out[2] is None
+
+    def test_broadcast(self):
+        out = run_gang(4, lambda d: d.broadcast("c" if d.is_chief else None))
+        assert out == ["c"] * 4
+
+    def test_multiple_rounds(self):
+        def fn(d):
+            a = d.allgather(d.rank)
+            b = d.broadcast(sum(a) if d.is_chief else None)
+            d.barrier()
+            return b
+
+        assert run_gang(4, fn) == [6, 6, 6, 6]
+
+    def test_bad_rank(self):
+        with pytest.raises(core.DistributedError):
+            DistributedContext(rank=5, size=2)
+
+    def test_tcp_transport(self):
+        # real sockets on localhost: chief + 2 workers
+        import random
+
+        port = random.randint(20000, 40000)
+
+        def fn(rank):
+            d = DistributedContext.from_tcp("127.0.0.1", port, rank, 3)
+            try:
+                got = d.allgather(f"rank{rank}")
+                bc = d.broadcast("hello" if rank == 0 else None)
+                return got, bc
+            finally:
+                d.close()
+
+        with ThreadPoolExecutor(max_workers=3) as pool:
+            futs = [pool.submit(fn, r) for r in range(3)]
+            results = [f.result(timeout=30) for f in futs]
+        for got, bc in results:
+            assert got == ["rank0", "rank1", "rank2"]
+            assert bc == "hello"
+
+
+class TestStorage:
+    def test_shared_fs_roundtrip(self, tmp_path):
+        mgr = SharedFSStorageManager(str(tmp_path / "store"))
+        src = tmp_path / "src"
+        (src / "sub").mkdir(parents=True)
+        (src / "a.txt").write_text("alpha")
+        (src / "sub" / "b.txt").write_text("beta")
+        mgr.upload(str(src), "ckpt1")
+        assert set(mgr.list_files("ckpt1")) == {"a.txt", "sub/b.txt"}
+        dst = tmp_path / "dst"
+        dst.mkdir()
+        mgr.download("ckpt1", str(dst))
+        assert (dst / "sub" / "b.txt").read_text() == "beta"
+        mgr.delete("ckpt1")
+        assert mgr.list_files("ckpt1") == {}
+
+    def test_storage_id_escape_rejected(self, tmp_path):
+        mgr = SharedFSStorageManager(str(tmp_path))
+        with pytest.raises(ValueError):
+            mgr.upload(str(tmp_path), "../escape")
+
+    def test_store_restore_path(self, tmp_path):
+        mgr = SharedFSStorageManager(str(tmp_path / "store"))
+        with mgr.store_path("cp") as d:
+            with open(os.path.join(d, "w.txt"), "w") as f:
+                f.write("data")
+        with mgr.restore_path("cp") as d:
+            assert open(os.path.join(d, "w.txt")).read() == "data"
+
+    def test_build_factory_gates_cloud(self):
+        with pytest.raises(RuntimeError, match="gcs"):
+            build(CheckpointStorageConfig(type="gcs", bucket="b"))
+
+
+class TestSerialization:
+    def test_roundtrip(self, tmp_path):
+        tree = {"a": jnp.arange(6.0).reshape(2, 3), "b": {"c": jnp.ones((4,))}}
+        save_pytree(str(tmp_path), tree)
+        like = {"a": jnp.zeros((2, 3)), "b": {"c": jnp.zeros((4,))}}
+        got = load_pytree(str(tmp_path), like)
+        np.testing.assert_allclose(np.asarray(got["a"]), np.asarray(tree["a"]))
+        np.testing.assert_allclose(np.asarray(got["b"]["c"]), 1.0)
+
+    def test_shape_mismatch_rejected(self, tmp_path):
+        save_pytree(str(tmp_path), {"a": jnp.zeros((2,))})
+        with pytest.raises(ValueError, match="shape"):
+            load_pytree(str(tmp_path), {"a": jnp.zeros((3,))})
+
+    def test_missing_leaf_rejected(self, tmp_path):
+        save_pytree(str(tmp_path), {"a": jnp.zeros((2,))})
+        with pytest.raises(KeyError):
+            load_pytree(str(tmp_path), {"a": jnp.zeros((2,)), "b": jnp.zeros((1,))})
+
+    def test_restore_onto_shardings(self, tmp_path):
+        from determined_clone_tpu.parallel import MeshSpec, ShardingRules, make_mesh
+
+        tree = {"w": jnp.arange(64.0 * 8).reshape(64, 8)}
+        save_pytree(str(tmp_path), tree)
+        mesh = make_mesh(MeshSpec(dp=2, fsdp=4))
+        sh = ShardingRules().shardings_for(tree, mesh)
+        got = load_pytree(str(tmp_path), tree, shardings=sh)
+        assert got["w"].sharding == sh["w"]
+        np.testing.assert_allclose(np.asarray(got["w"]), np.asarray(tree["w"]))
+
+
+class TestCheckpointContext:
+    def test_sharded_upload_merges(self, tmp_path):
+        store_dir = str(tmp_path / "store")
+
+        def fn(d):
+            mgr = SharedFSStorageManager(store_dir)
+            ck = core.CheckpointContext(d, mgr)
+            src = tmp_path / f"src{d.rank}"
+            src.mkdir()
+            (src / f"shard-{d.rank}.bin").write_text(f"data{d.rank}")
+            return ck.upload(str(src), {"step": 7}, shard=True)
+
+        ids = run_gang(3, fn)
+        assert len(set(ids)) == 1  # same storage_id everywhere
+        mgr = SharedFSStorageManager(store_dir)
+        files = set(mgr.list_files(ids[0]))
+        assert {"shard-0.bin", "shard-1.bin", "shard-2.bin"} <= files
+        assert "metadata.json" in files
+
+    def test_sharded_conflict_rejected(self, tmp_path):
+        store_dir = str(tmp_path / "store")
+
+        def fn(d):
+            mgr = SharedFSStorageManager(store_dir)
+            ck = core.CheckpointContext(d, mgr)
+            src = tmp_path / f"c{d.rank}"
+            src.mkdir()
+            (src / "same.bin").write_text("x")  # every rank writes same name
+            try:
+                ck.upload(str(src), shard=True)
+                return None
+            except ValueError as e:
+                return str(e)
+
+        out = run_gang(2, fn)
+        assert any(o and "conflict" in o for o in out)
+
+    def test_registry_and_delete(self, tmp_path):
+        d = DistributedContext.single()
+        mgr = SharedFSStorageManager(str(tmp_path / "store"))
+        reg = core.LocalCheckpointRegistry(str(tmp_path / "reg.jsonl"))
+        ck = core.CheckpointContext(d, mgr, reg, trial_id=3)
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "f.bin").write_text("x")
+        sid = ck.upload(str(src), {"acc": 0.9})
+        recs = reg.list()
+        assert len(recs) == 1 and recs[0]["trial_id"] == 3
+        assert ck.get_metadata(sid)["acc"] == 0.9
+        ck.delete(sid)
+        assert reg.list() == []
+
+
+class TestPreemption:
+    def test_file_source(self, tmp_path):
+        flag = tmp_path / "preempt"
+        d = DistributedContext.single()
+        p = PreemptContext(d, FilePreemptionSource(str(flag)),
+                           poll_interval=0.05).start()
+        assert not p.should_preempt()
+        flag.write_text("")
+        import time
+
+        deadline = time.time() + 5
+        while not p.should_preempt() and time.time() < deadline:
+            time.sleep(0.05)
+        assert p.should_preempt()
+        p.close()
+
+    def test_chief_decision_broadcast(self, tmp_path):
+        flag = tmp_path / "preempt"
+        flag.write_text("")
+
+        def fn(d):
+            src = FilePreemptionSource(str(flag)) if d.is_chief else None
+            p = PreemptContext(d, src, poll_interval=0.05).start()
+            try:
+                import time
+
+                deadline = time.time() + 5
+                while time.time() < deadline:
+                    if p.should_preempt():
+                        return True
+                    time.sleep(0.05)
+                return False
+            finally:
+                p.close()
+
+        assert run_gang(2, fn) == [True, True]
+
+    def test_signal(self):
+        d = DistributedContext.single()
+        p = PreemptContext(d).start()
+        assert not p.should_preempt()
+        p.signal()
+        assert p.should_preempt()
+        p.close()
+
+
+class TestTrainContext:
+    def test_metrics_and_best(self, tmp_path):
+        backend = LocalMetricsBackend(str(tmp_path / "metrics.jsonl"))
+        t = core.TrainContext(backend, metric="loss", smaller_is_better=True)
+        t.report_training_metrics(10, {"loss": jnp.float32(1.5)})
+        t.report_validation_metrics(10, {"loss": 1.2})
+        t.report_validation_metrics(20, {"loss": 0.8})
+        t.report_validation_metrics(30, {"loss": 0.9})
+        assert t.get_experiment_best_validation() == 0.8
+        lines = open(tmp_path / "metrics.jsonl").read().strip().split("\n")
+        assert len(lines) == 4
+        rec = json.loads(lines[0])
+        assert rec["group"] == "training" and rec["metrics"]["loss"] == 1.5
+
+    def test_nan_metrics_stay_json(self, tmp_path):
+        backend = LocalMetricsBackend()
+        t = core.TrainContext(backend)
+        t.report_training_metrics(1, {"loss": float("nan")})
+        assert backend.records[0]["metrics"]["loss"] == "nan"
+
+
+class TestContextInit:
+    def test_local_init_end_to_end(self, tmp_path):
+        cfg = ExperimentConfig.from_dict({
+            "searcher": {"name": "single", "metric": "loss",
+                         "max_length": {"batches": 5}},
+            "checkpoint_storage": {"type": "shared_fs",
+                                   "host_path": str(tmp_path)},
+        })
+        with core.init(config=cfg, trial_id=1) as ctx:
+            assert ctx.distributed.size == 1
+            ops = list(ctx.searcher.operations())
+            assert len(ops) == 1
+            ops[0].complete(0.5)
+            assert ops[0].completed
+            ctx.train.report_training_metrics(1, {"loss": 1.0})
+            assert not ctx.preempt.should_preempt()
