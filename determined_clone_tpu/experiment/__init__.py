@@ -1,0 +1,8 @@
+"""Experiment orchestration (≈ master/internal/experiment.go, local mode)."""
+from determined_clone_tpu.experiment.runner import (
+    ExperimentResult,
+    LocalExperimentRunner,
+    TrialRecord,
+)
+
+__all__ = ["ExperimentResult", "LocalExperimentRunner", "TrialRecord"]

@@ -1,0 +1,207 @@
+"""Local experiment runner — searcher-driven multi-trial orchestration.
+
+The single-process counterpart of the reference's experiment orchestrator
+(master/internal/experiment.go:751 processOperations + trial.go): consumes
+searcher operations, runs trials, feeds validation results back, snapshots
+searcher state for crash-consistency. The C++ master implements this same
+loop for the cluster; this runner is the off-cluster / single-host mode
+(≈ det experiment create --local).
+
+Trials pause/resume between ValidateAfter boundaries via checkpoints — the
+same mechanism the cluster uses when ASHA pauses a trial and later promotes
+it on a different slice.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Type
+
+from determined_clone_tpu import core as core_mod
+from determined_clone_tpu.config.experiment import ExperimentConfig
+from determined_clone_tpu.config.length import Length
+from determined_clone_tpu.searcher import (
+    Close,
+    Create,
+    Searcher,
+    Shutdown,
+    ValidateAfter,
+    build_method,
+)
+from determined_clone_tpu.training.trainer import Trainer
+from determined_clone_tpu.training.trial import JaxTrial, TrialContext
+
+
+@dataclasses.dataclass
+class TrialRecord:
+    request_id: int
+    hparams: Dict[str, Any]
+    units_done: int = 0
+    latest_checkpoint: Optional[str] = None
+    last_metric: Optional[float] = None
+    best_metric: Optional[float] = None
+    state: str = "active"  # active | paused | completed | errored
+    restarts: int = 0
+    metrics_path: Optional[str] = None
+
+
+@dataclasses.dataclass
+class ExperimentResult:
+    trials: Dict[int, TrialRecord]
+    best_trial: Optional[TrialRecord]
+    shutdown: bool
+
+    @property
+    def n_trials(self) -> int:
+        return len(self.trials)
+
+
+class LocalExperimentRunner:
+    def __init__(self, config: ExperimentConfig,
+                 trial_cls: Type[JaxTrial], *,
+                 storage_path: str,
+                 mesh: Optional[Any] = None,
+                 max_events: int = 10_000) -> None:
+        self.config = config
+        self.trial_cls = trial_cls
+        self.storage_path = storage_path
+        self.mesh = mesh
+        self.max_events = max_events
+        self.engine = Searcher(build_method(
+            config.searcher, config.hyperparameters, seed=config.experiment_seed
+        ))
+        self.trials: Dict[int, TrialRecord] = {}
+        self._snapshot_path = os.path.join(storage_path, "experiment_snapshot.json")
+
+    # -- crash consistency (≈ master/internal/restore.go) -------------------
+
+    def _snapshot(self) -> None:
+        snap = {
+            "searcher": self.engine.snapshot(),
+            "trials": {
+                str(rid): dataclasses.asdict(t) for rid, t in self.trials.items()
+            },
+        }
+        tmp = self._snapshot_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(snap, f)
+        os.replace(tmp, self._snapshot_path)
+
+    def _units_to_length(self, units: int) -> Length:
+        ml = self.config.searcher.max_length
+        unit = ml.unit if ml is not None else None
+        if unit is None:
+            return Length.batches(units)
+        return Length(unit, units)
+
+    # -- one training leg ---------------------------------------------------
+
+    def _run_to(self, rec: TrialRecord, target_units: int) -> float:
+        """Train trial ``rec`` up to cumulative target_units; return the
+        searcher metric from its final validation."""
+        cfg = self.config
+        metrics_backend = core_mod.LocalMetricsBackend(
+            os.path.join(self.storage_path, f"trial-{rec.request_id}-metrics.jsonl")
+        )
+        rec.metrics_path = metrics_backend.path
+        searcher_source = core_mod.LocalSearcherSource(
+            self._units_to_length(target_units)
+        )
+        with core_mod.init(
+            config=cfg,
+            storage_path=self.storage_path,
+            metrics_backend=metrics_backend,
+            searcher_source=searcher_source,
+            trial_id=rec.request_id,
+        ) as cctx:
+            tctx = TrialContext(config=cfg, hparams=rec.hparams, core=cctx,
+                                mesh=self.mesh)
+            trial = self.trial_cls(tctx)
+            trainer = Trainer(trial)
+            result = trainer.fit(latest_checkpoint=rec.latest_checkpoint)
+        rec.units_done = target_units
+        reg = core_mod.LocalCheckpointRegistry(self._registry_path())
+        mine = [r for r in reg.list() if r.get("trial_id") == rec.request_id]
+        if mine:
+            rec.latest_checkpoint = mine[-1]["storage_id"]
+        metric_name = cfg.searcher.metric
+        last_val = result.get("last_validation") or {}
+        if metric_name in last_val:
+            return float(last_val[metric_name])
+        raise RuntimeError(
+            f"trial {rec.request_id} reported no searcher metric "
+            f"{metric_name!r} (validation metrics: {sorted(last_val) or 'none'}). "
+            f"Check searcher.metric against the trial's eval_metrics keys and "
+            f"that validation_data()/min_validation_period are set."
+        )
+
+    def _registry_path(self) -> str:
+        """The checkpoint registry lives next to the checkpoint storage —
+        same resolution as core.init (core/_context.py)."""
+        cs = self.config.checkpoint_storage
+        base = self.storage_path
+        if cs is not None:
+            base = cs.host_path or cs.container_path or self.storage_path
+        return os.path.join(base, "checkpoints.jsonl")
+
+    # -- the orchestration loop --------------------------------------------
+
+    def run(self) -> ExperimentResult:
+        queue = list(self.engine.initial_operations())
+        events = 0
+        shutdown = False
+        while queue and events < self.max_events:
+            events += 1
+            op = queue.pop(0)
+            if isinstance(op, Create):
+                self.trials[op.request_id] = TrialRecord(
+                    op.request_id, op.hparams
+                )
+                queue.extend(self.engine.trial_created(op.request_id))
+            elif isinstance(op, ValidateAfter):
+                rec = self.trials[op.request_id]
+                if rec.state in ("completed", "errored"):
+                    continue
+                rec.state = "active"
+                try:
+                    metric = self._run_to(rec, op.length)
+                except Exception as e:  # trial failure → searcher event
+                    rec.restarts += 1
+                    if rec.restarts > self.config.max_restarts:
+                        rec.state = "errored"
+                        queue.extend(self.engine.trial_exited_early(
+                            op.request_id, f"error: {e}"
+                        ))
+                        self._snapshot()
+                        continue
+                    queue.insert(0, op)  # retry from latest checkpoint
+                    continue
+                rec.last_metric = metric
+                smaller = self.config.searcher.smaller_is_better
+                if rec.best_metric is None or (
+                    metric < rec.best_metric if smaller else metric > rec.best_metric
+                ):
+                    rec.best_metric = metric
+                rec.state = "paused"
+                queue.extend(self.engine.validation_completed(
+                    op.request_id, metric, op.length
+                ))
+                self._snapshot()
+            elif isinstance(op, Close):
+                rec = self.trials.get(op.request_id)
+                if rec and rec.state != "completed":
+                    rec.state = "completed"
+                    queue.extend(self.engine.trial_closed(op.request_id))
+                self._snapshot()
+            elif isinstance(op, Shutdown):
+                shutdown = True
+                break
+
+        smaller = self.config.searcher.smaller_is_better
+        scored = [t for t in self.trials.values() if t.best_metric is not None]
+        best = None
+        if scored:
+            best = (min if smaller else max)(scored, key=lambda t: t.best_metric)
+        return ExperimentResult(trials=self.trials, best_trial=best,
+                                shutdown=shutdown)

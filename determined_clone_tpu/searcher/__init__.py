@@ -1,0 +1,59 @@
+"""Hyperparameter search engine (≈ master/pkg/searcher — SURVEY.md §2.1)."""
+from determined_clone_tpu.config.experiment import SearcherConfig
+from determined_clone_tpu.config.hyperparameters import HyperparameterSpace
+from determined_clone_tpu.searcher.adaptive import AdaptiveASHASearch
+from determined_clone_tpu.searcher.asha import ASHASearch
+from determined_clone_tpu.searcher.base import (
+    Close,
+    Create,
+    Operation,
+    Searcher,
+    SearchMethod,
+    Shutdown,
+    ValidateAfter,
+)
+from determined_clone_tpu.searcher.methods import (
+    GridSearch,
+    RandomSearch,
+    SingleSearch,
+)
+from determined_clone_tpu.searcher.simulate import SimResult, SimTrial, simulate
+
+
+def build_method(config: SearcherConfig, space: HyperparameterSpace,
+                 seed: int = 0) -> SearchMethod:
+    """Factory over the searcher union (≈ expconf searcher_config.go:16-28)."""
+    if config.name == "single":
+        return SingleSearch(config, space, seed)
+    if config.name == "random":
+        return RandomSearch(config, space, seed)
+    if config.name == "grid":
+        return GridSearch(config, space, seed)
+    if config.name == "asha":
+        return ASHASearch(config, space, seed)
+    if config.name == "adaptive_asha":
+        return AdaptiveASHASearch(config, space, seed)
+    raise ValueError(
+        f"searcher {config.name!r} has no built-in method "
+        f"(custom searchers attach via the custom-search event queue)"
+    )
+
+
+__all__ = [
+    "AdaptiveASHASearch",
+    "ASHASearch",
+    "Close",
+    "Create",
+    "GridSearch",
+    "Operation",
+    "RandomSearch",
+    "Searcher",
+    "SearchMethod",
+    "Shutdown",
+    "SimResult",
+    "SimTrial",
+    "SingleSearch",
+    "ValidateAfter",
+    "build_method",
+    "simulate",
+]

@@ -1,0 +1,140 @@
+"""End-to-end experiment orchestration: searcher ops drive real (tiny)
+training runs with pause/resume via checkpoints."""
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from determined_clone_tpu.config import ExperimentConfig
+from determined_clone_tpu.experiment import LocalExperimentRunner
+from determined_clone_tpu.parallel import MeshSpec, make_mesh
+from determined_clone_tpu.training import JaxTrial
+
+
+class QuadraticTrial(JaxTrial):
+    """loss = (w - lr*10)^2 + lr — optimum depends on the lr hparam, so the
+    searcher has signal: smaller lr ends with smaller final loss."""
+
+    def initial_params(self, rng):
+        return {"w": jnp.zeros(())}
+
+    def optimizer(self):
+        return optax.sgd(0.3)
+
+    def loss(self, params, batch, rng):
+        lr = self.context.get_hparam("lr", 0.5)
+        loss = (params["w"] - 1.0) ** 2 + lr
+        return loss, {}
+
+    def training_data(self):
+        for _ in range(128):
+            yield np.zeros((2, 1), np.float32)
+
+    def validation_data(self):
+        return [np.zeros((2, 1), np.float32)]
+
+    @property
+    def global_batch_size(self):
+        return 2
+
+
+def base_config(tmp_path, searcher):
+    return ExperimentConfig.from_dict({
+        "searcher": searcher,
+        "scheduling_unit": 2,
+        "checkpoint_storage": {"type": "shared_fs", "host_path": str(tmp_path)},
+        "hyperparameters": {"lr": {"type": "double", "minval": 0.1,
+                                   "maxval": 1.0}},
+        "max_restarts": 1,
+    })
+
+
+def test_random_search_end_to_end(tmp_path):
+    cfg = base_config(tmp_path, {
+        "name": "random", "metric": "loss", "max_trials": 3,
+        "max_length": {"batches": 4}, "max_concurrent_trials": 2,
+    })
+    mesh = make_mesh(MeshSpec(dp=1), jax.devices()[:1])
+    runner = LocalExperimentRunner(cfg, QuadraticTrial,
+                                   storage_path=str(tmp_path), mesh=mesh)
+    result = runner.run()
+    assert result.shutdown
+    assert result.n_trials == 3
+    assert all(t.state == "completed" for t in result.trials.values())
+    # best trial should be the one with smallest lr (loss floor = lr)
+    lrs = {rid: t.hparams["lr"] for rid, t in result.trials.items()}
+    assert result.best_trial.request_id == min(lrs, key=lrs.get)
+    # experiment snapshot written (crash consistency)
+    assert os.path.exists(tmp_path / "experiment_snapshot.json")
+    # per-trial metrics recorded
+    assert os.path.exists(result.best_trial.metrics_path)
+
+
+def test_asha_pauses_and_promotes_via_checkpoints(tmp_path):
+    cfg = base_config(tmp_path, {
+        "name": "asha", "metric": "loss", "max_trials": 6,
+        "num_rungs": 2, "divisor": 3, "max_length": {"batches": 6},
+        "max_concurrent_trials": 6,
+    })
+    mesh = make_mesh(MeshSpec(dp=1), jax.devices()[:1])
+    runner = LocalExperimentRunner(cfg, QuadraticTrial,
+                                   storage_path=str(tmp_path), mesh=mesh)
+    result = runner.run()
+    assert result.shutdown
+    assert result.n_trials == 6
+    units = sorted(t.units_done for t in result.trials.values())
+    assert units[0] == 2          # rung 0 = 6 / 3
+    assert units[-1] == 6         # someone reached the top rung
+    promoted = [t for t in result.trials.values() if t.units_done == 6]
+    # promoted trials resumed from their rung-0 checkpoint
+    assert all(t.latest_checkpoint for t in promoted)
+
+
+class FlakyTrial(QuadraticTrial):
+    """Fails on first attempt, succeeds after restart (reference fixture
+    style: e2e failure-injection, managed_cluster.py)."""
+
+    _failed = {}
+
+    def training_data(self):
+        marker = self.context.core  # one failure per core ctx
+        if not FlakyTrial._failed.get("done"):
+            FlakyTrial._failed["done"] = True
+            raise RuntimeError("injected failure")
+        return super().training_data()
+
+
+def test_max_restarts_recovers(tmp_path):
+    FlakyTrial._failed = {}
+    cfg = base_config(tmp_path, {
+        "name": "single", "metric": "loss", "max_length": {"batches": 4},
+    })
+    mesh = make_mesh(MeshSpec(dp=1), jax.devices()[:1])
+    runner = LocalExperimentRunner(cfg, FlakyTrial,
+                                   storage_path=str(tmp_path), mesh=mesh)
+    result = runner.run()
+    assert result.shutdown
+    t = list(result.trials.values())[0]
+    assert t.state == "completed"
+    assert t.restarts == 1
+
+
+def test_exhausted_restarts_marks_errored(tmp_path):
+    class AlwaysFails(QuadraticTrial):
+        def training_data(self):
+            raise RuntimeError("always broken")
+
+    cfg = base_config(tmp_path, {
+        "name": "single", "metric": "loss", "max_length": {"batches": 4},
+    })
+    mesh = make_mesh(MeshSpec(dp=1), jax.devices()[:1])
+    runner = LocalExperimentRunner(cfg, AlwaysFails,
+                                   storage_path=str(tmp_path), mesh=mesh)
+    result = runner.run()
+    t = list(result.trials.values())[0]
+    assert t.state == "errored"
+    assert t.restarts == cfg.max_restarts + 1

@@ -1,0 +1,210 @@
+"""Searcher behavior tests via simulation — the reference's asha_test.go /
+simulate.go strategy."""
+import json
+
+import pytest
+
+from determined_clone_tpu.config import ExperimentConfig, SearcherConfig
+from determined_clone_tpu.config.hyperparameters import HyperparameterSpace
+from determined_clone_tpu.searcher import (
+    ASHASearch,
+    AdaptiveASHASearch,
+    GridSearch,
+    RandomSearch,
+    Searcher,
+    SingleSearch,
+    build_method,
+    simulate,
+)
+
+SPACE = HyperparameterSpace({
+    "lr": {"type": "log", "minval": -4, "maxval": -1, "count": 4},
+    "width": {"type": "int", "minval": 8, "maxval": 64, "count": 3},
+})
+
+
+def cfg(**kw):
+    base = {"name": "single", "metric": "loss", "max_length": {"batches": 64}}
+    base.update(kw)
+    return SearcherConfig.from_dict(base)
+
+
+def good_lr_metric(hparams, units):
+    """Lower loss for lr near 1e-2 and more training."""
+    import math
+
+    lr = hparams["lr"]
+    dist = abs(math.log10(lr) + 2.0)
+    return dist + 1.0 / (1 + units / 8)
+
+
+class TestSingle:
+    def test_one_trial_to_max_length(self):
+        r = simulate(SingleSearch(cfg(), SPACE), good_lr_metric)
+        assert r.shutdown
+        assert r.n_trials == 1
+        assert list(r.units_by_trial().values()) == [64]
+
+
+class TestRandom:
+    def test_max_trials_created_all_full_length(self):
+        c = cfg(name="random", max_trials=7, max_concurrent_trials=3)
+        r = simulate(RandomSearch(c, SPACE), good_lr_metric)
+        assert r.shutdown
+        assert r.n_trials == 7
+        assert all(u == 64 for u in r.units_by_trial().values())
+        assert r.max_concurrent_seen <= 3
+
+    def test_errored_trial_replaced_and_search_completes(self):
+        from determined_clone_tpu.searcher import Searcher
+
+        c = cfg(name="random", max_trials=3, max_concurrent_trials=1)
+        engine = Searcher(RandomSearch(c, SPACE))
+        from determined_clone_tpu.searcher.base import Create, Shutdown, ValidateAfter
+
+        queue = list(engine.initial_operations())
+        shutdown = False
+        errored_once = False
+        events = 0
+        while queue and events < 100:
+            events += 1
+            op = queue.pop(0)
+            if isinstance(op, Create):
+                queue.extend(engine.trial_created(op.request_id))
+            elif isinstance(op, ValidateAfter):
+                if not errored_once:
+                    errored_once = True
+                    queue.extend(engine.trial_exited_early(op.request_id, "err"))
+                else:
+                    queue.extend(engine.validation_completed(op.request_id, 1.0, op.length))
+            elif isinstance(op, Shutdown):
+                shutdown = True
+        assert shutdown  # failure did not wedge the search
+
+    def test_distinct_hparams(self):
+        c = cfg(name="random", max_trials=5)
+        r = simulate(RandomSearch(c, SPACE), good_lr_metric)
+        lrs = {t.hparams["lr"] for t in r.trials.values()}
+        assert len(lrs) == 5
+
+
+class TestGrid:
+    def test_enumerates_grid(self):
+        space = HyperparameterSpace({
+            "a": {"type": "categorical", "vals": [1, 2]},
+            "b": {"type": "categorical", "vals": ["x", "y", "z"]},
+        })
+        c = cfg(name="grid", max_trials=100)
+        r = simulate(GridSearch(c, space), lambda hp, u: float(hp["a"]))
+        assert r.shutdown
+        assert r.n_trials == 6
+        combos = {(t.hparams["a"], t.hparams["b"]) for t in r.trials.values()}
+        assert len(combos) == 6
+
+
+class TestASHA:
+    def test_rung_structure_and_early_stopping(self):
+        c = cfg(name="asha", max_trials=16, divisor=4, num_rungs=3,
+                max_length={"batches": 64}, max_concurrent_trials=4)
+        method = ASHASearch(c, SPACE, seed=1)
+        assert method.rung_targets == [4, 16, 64]
+        r = simulate(method, good_lr_metric)
+        assert r.shutdown
+        assert r.n_trials == 16
+        units = sorted(r.units_by_trial().values())
+        # most trials stop early; only ~1/divisor^2 reach the top rung
+        assert units[0] == 4
+        n_top = sum(1 for u in units if u == 64)
+        assert 1 <= n_top <= 6
+        # total budget far below max_trials * max_length
+        assert sum(units) < 16 * 64 * 0.5
+
+    def test_promotes_good_trials(self):
+        # async ASHA can't guarantee the global best is promoted (quota is
+        # taken by whoever is best among *arrived* trials), but every
+        # top-rung trial must be better than the median of its cohort.
+        c = cfg(name="asha", max_trials=12, divisor=3, num_rungs=3,
+                max_length={"batches": 27}, max_concurrent_trials=12)
+        r = simulate(ASHASearch(c, SPACE, seed=3), good_lr_metric)
+        scores = sorted(good_lr_metric(t.hparams, 27) for t in r.trials.values())
+        median = scores[len(scores) // 2]
+        top_units = max(r.units_by_trial().values())
+        top_trials = [t for t in r.trials.values()
+                      if t.trained_units == top_units]
+        assert top_trials
+        assert all(good_lr_metric(t.hparams, 27) < median for t in top_trials)
+
+    def test_stopping_variant(self):
+        c = cfg(name="asha", max_trials=12, divisor=3, num_rungs=3,
+                max_length={"batches": 27}, stop_once=True,
+                max_concurrent_trials=4)
+        r = simulate(ASHASearch(c, SPACE, seed=5), good_lr_metric)
+        assert r.shutdown
+        assert r.n_trials == 12
+
+    def test_smaller_is_better_false(self):
+        c = cfg(name="asha", max_trials=9, divisor=3, num_rungs=2,
+                smaller_is_better=False, max_length={"batches": 9},
+                max_concurrent_trials=9)
+        # maximize: higher is better; trial with highest metric promotes
+        r = simulate(ASHASearch(c, SPACE, seed=7),
+                     lambda hp, u: hp["lr"])
+        best = max(r.trials.values(), key=lambda t: t.hparams["lr"])
+        assert best.trained_units == max(r.units_by_trial().values())
+
+    def test_snapshot_restore_midway(self):
+        c = cfg(name="asha", max_trials=8, divisor=2, num_rungs=3,
+                max_length={"batches": 16}, max_concurrent_trials=2)
+        m1 = ASHASearch(c, SPACE, seed=11)
+        e1 = Searcher(m1)
+        ops = list(e1.initial_operations())
+        # process a few events
+        created = [o for o in ops if type(o).__name__ == "Create"]
+        for o in created:
+            ops.extend(e1.trial_created(o.request_id))
+        snap = json.loads(json.dumps(e1.snapshot()))  # survives JSON
+
+        m2 = ASHASearch(c, SPACE, seed=11)
+        e2 = Searcher(m2)
+        e2.restore(snap)
+        assert e2.next_id == e1.next_id
+        assert m2.created == m1.created
+        assert m2.rung_targets == m1.rung_targets
+
+
+class TestAdaptiveASHA:
+    @pytest.mark.parametrize("mode,expected_brackets", [
+        ("aggressive", 1), ("standard", 3), ("conservative", 4),
+    ])
+    def test_bracket_counts(self, mode, expected_brackets):
+        c = cfg(name="adaptive_asha", max_trials=16, num_rungs=4,
+                max_length={"batches": 64}, mode=mode)
+        m = AdaptiveASHASearch(c, SPACE)
+        assert len(m.brackets) == expected_brackets
+
+    def test_budget_split_and_completion(self):
+        c = cfg(name="adaptive_asha", max_trials=16, num_rungs=3,
+                divisor=4, max_length={"batches": 64}, mode="standard",
+                max_concurrent_trials=6)
+        r = simulate(AdaptiveASHASearch(c, SPACE, seed=13), good_lr_metric)
+        assert r.shutdown
+        assert r.n_trials == 16
+
+    def test_aggressive_equals_asha(self):
+        c = cfg(name="adaptive_asha", max_trials=8, num_rungs=3, divisor=4,
+                max_length={"batches": 64}, mode="aggressive",
+                max_concurrent_trials=4)
+        r = simulate(AdaptiveASHASearch(c, SPACE, seed=17), good_lr_metric)
+        assert r.shutdown and r.n_trials == 8
+
+
+class TestFactory:
+    def test_build_all(self):
+        for name in ("single", "random", "grid", "asha", "adaptive_asha"):
+            c = cfg(name=name, max_trials=4, max_length={"batches": 8})
+            m = build_method(c, SPACE)
+            assert m is not None
+
+    def test_custom_unbuildable(self):
+        with pytest.raises(ValueError, match="custom"):
+            build_method(cfg(name="custom"), SPACE)
