@@ -1,0 +1,301 @@
+// determined-clone-tpu agent — TPU-VM node daemon.
+//
+// C++ equivalent of the reference agent (agent/cmd/determined-agent,
+// agent/internal/agent.go): detects TPU chips, registers with the master,
+// heartbeats (HTTP long-poll replaces the reference websocket — same
+// reconnect-with-backoff semantics, agent.go:330), launches task processes
+// (process runner first; container runtimes are a later layer), forwards
+// exit events and log batches.
+//
+// TPU detection (replaces nvidia-smi/rocm-smi parsing, detect/detect.go:19):
+//   1. DCT_AGENT_SLOTS / DCT_AGENT_TOPOLOGY env (explicit + artificial slots
+//      for tests — detect.go:39's trick)
+//   2. /dev/accel* device files (TPU VM runtime)
+//   3. fallback: 0 chips (cpu-only agent, zero-slot aux tasks)
+#include <dirent.h>
+#include <signal.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <map>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "../../master/src/http.h"
+#include "../../master/src/json.h"
+
+namespace dct {
+namespace {
+
+struct AgentConfig {
+  std::string master_host = "127.0.0.1";
+  int master_port = 8080;
+  std::string id;
+  std::string resource_pool = "default";
+  int slots = -1;           // -1 = autodetect
+  std::string topology;
+  double heartbeat_sec = 1.0;
+  std::string work_dir = ".";
+};
+
+int detect_tpu_chips(std::string* topology) {
+  if (const char* env = std::getenv("DCT_AGENT_SLOTS")) {
+    if (const char* topo = std::getenv("DCT_AGENT_TOPOLOGY")) *topology = topo;
+    return std::atoi(env);
+  }
+  int count = 0;
+  if (DIR* dev = ::opendir("/dev")) {
+    while (dirent* entry = ::readdir(dev)) {
+      if (std::strncmp(entry->d_name, "accel", 5) == 0) ++count;
+    }
+    ::closedir(dev);
+  }
+  if (count > 0 && topology->empty()) {
+    const char* gen = std::getenv("PALLAS_AXON_TPU_GEN");
+    *topology = std::string(gen ? gen : "tpu") + "-" + std::to_string(count);
+  }
+  return count;
+}
+
+struct RunningTask {
+  pid_t pid = 0;
+  std::string allocation_id;
+  std::string log_path;
+  bool preempt_sent = false;
+};
+
+class Agent {
+ public:
+  explicit Agent(AgentConfig config) : config_(std::move(config)) {}
+
+  int run() {
+    if (config_.id.empty()) {
+      char host[256] = "agent";
+      ::gethostname(host, sizeof(host));
+      config_.id = std::string(host) + "-" + std::to_string(::getpid());
+    }
+    if (config_.slots < 0) {
+      config_.slots = detect_tpu_chips(&config_.topology);
+    }
+    std::cerr << "[agent] id=" << config_.id << " slots=" << config_.slots
+              << " topology=" << config_.topology << std::endl;
+
+    // register with reconnect+backoff (≈ agent.go:246,330)
+    int backoff_ms = 500;
+    while (true) {
+      if (register_with_master()) break;
+      std::cerr << "[agent] master unreachable; retrying in "
+                << backoff_ms << "ms" << std::endl;
+      std::this_thread::sleep_for(std::chrono::milliseconds(backoff_ms));
+      backoff_ms = std::min(backoff_ms * 2, 15000);
+    }
+
+    while (true) {
+      reap_tasks();
+      if (!heartbeat()) {
+        // lost master: back off, re-register (reservations survive on the
+        // master until its agent_timeout — the amnesia window)
+        std::this_thread::sleep_for(std::chrono::seconds(1));
+        register_with_master();
+        continue;
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(
+          static_cast<int>(config_.heartbeat_sec * 1000)));
+    }
+  }
+
+ private:
+  bool register_with_master() {
+    Json body = Json::object();
+    char host[256] = "127.0.0.1";
+    ::gethostname(host, sizeof(host));
+    body.set("id", config_.id).set("slots", config_.slots)
+        .set("topology", config_.topology)
+        .set("resource_pool", config_.resource_pool)
+        .set("address", std::string(host));
+    auto resp = http_request(config_.master_host, config_.master_port, "POST",
+                             "/api/v1/agents/register", body.dump(), 10);
+    return resp && resp->status == 200;
+  }
+
+  bool heartbeat() {
+    Json running = Json::array();
+    for (const auto& [aid, task] : tasks_) running.push_back(aid);
+    Json body = Json::object();
+    body.set("running", running);
+    auto resp = http_request(
+        config_.master_host, config_.master_port, "POST",
+        "/api/v1/agents/" + config_.id + "/heartbeat", body.dump(), 10);
+    if (!resp || resp->status != 200) return false;
+    Json j = Json::parse(resp->body);
+    for (const auto& cmd : j["commands"].elements()) {
+      const std::string& type = cmd["type"].as_string();
+      if (type == "start") {
+        start_task(cmd);
+      } else if (type == "preempt") {
+        preempt_task(cmd["allocation_id"].as_string());
+      } else if (type == "kill") {
+        kill_task(cmd["allocation_id"].as_string());
+      }
+    }
+    return true;
+  }
+
+  void start_task(const Json& cmd) {
+    const std::string& alloc_id = cmd["allocation_id"].as_string();
+    if (tasks_.count(alloc_id)) return;  // duplicate start
+
+    std::string log_path =
+        config_.work_dir + "/task-" + alloc_id + ".log";
+    pid_t pid = ::fork();
+    if (pid == 0) {
+      // child: run the harness entrypoint with the task env
+      // (≈ container Entrypoint + DET_* env, tasks/task.go:236)
+      ::setenv("DCT_MASTER_HOST", config_.master_host.c_str(), 1);
+      ::setenv("DCT_MASTER_PORT",
+               std::to_string(config_.master_port).c_str(), 1);
+      ::setenv("DCT_ALLOCATION_ID", alloc_id.c_str(), 1);
+      ::setenv("DCT_AGENT_ID", config_.id.c_str(), 1);
+      ::setenv("DCT_SLOTS", std::to_string(cmd["slots"].as_int()).c_str(), 1);
+      ::setenv("DCT_RANK", std::to_string(cmd["rank"].as_int()).c_str(), 1);
+      ::setenv("DCT_WORLD_SIZE",
+               std::to_string(cmd["world_size"].as_int()).c_str(), 1);
+      if (cmd.has("trial")) {
+        ::setenv("DCT_TRIAL_ID",
+                 std::to_string(cmd["trial"]["id"].as_int()).c_str(), 1);
+        ::setenv("DCT_EXPERIMENT_ID",
+                 std::to_string(cmd["trial"]["experiment_id"].as_int()).c_str(),
+                 1);
+        ::setenv("DCT_HPARAMS", cmd["trial"]["hparams"].dump().c_str(), 1);
+        ::setenv("DCT_TARGET_UNITS",
+                 std::to_string(cmd["trial"]["target_units"].as_int()).c_str(),
+                 1);
+        ::setenv("DCT_LATEST_CHECKPOINT",
+                 cmd["trial"]["latest_checkpoint"].as_string().c_str(), 1);
+        ::setenv("DCT_EXPERIMENT_CONFIG", cmd["config"].dump().c_str(), 1);
+      }
+      // stdout/stderr → log file (shipped to master on exit; live shipping
+      // is the harness's log-batch POST)
+      FILE* log = ::freopen(log_path.c_str(), "a", stdout);
+      (void)log;
+      ::dup2(::fileno(stdout), ::fileno(stderr));
+
+      std::string entrypoint = cmd["spec"]["entrypoint"].as_string();
+      if (entrypoint.empty()) {
+        std::cerr << "no entrypoint for " << alloc_id << std::endl;
+        std::_Exit(80);
+      }
+      ::execlp("python", "python", "-m", "determined_clone_tpu.exec.trial",
+               entrypoint.c_str(), nullptr);
+      std::cerr << "execlp failed: " << std::strerror(errno) << std::endl;
+      std::_Exit(81);
+    }
+    if (pid > 0) {
+      tasks_[alloc_id] = RunningTask{pid, alloc_id, log_path, false};
+      send_event(alloc_id, "running", 0, "");
+      std::cerr << "[agent] started " << alloc_id << " pid=" << pid << std::endl;
+    }
+  }
+
+  void preempt_task(const std::string& alloc_id) {
+    auto it = tasks_.find(alloc_id);
+    if (it == tasks_.end() || it->second.preempt_sent) return;
+    // cooperative: harness polls the preempt endpoint; SIGTERM is the
+    // belt-and-braces (exec/launch.py:18's SLURM SIGTERM semantics)
+    ::kill(it->second.pid, SIGTERM);
+    it->second.preempt_sent = true;
+  }
+
+  void kill_task(const std::string& alloc_id) {
+    auto it = tasks_.find(alloc_id);
+    if (it == tasks_.end()) return;
+    ::kill(it->second.pid, SIGKILL);
+  }
+
+  void reap_tasks() {
+    for (auto it = tasks_.begin(); it != tasks_.end();) {
+      int status = 0;
+      pid_t done = ::waitpid(it->second.pid, &status, WNOHANG);
+      if (done == it->second.pid) {
+        int exit_code = WIFEXITED(status) ? WEXITSTATUS(status)
+                                          : 128 + WTERMSIG(status);
+        ship_logs(it->second);
+        send_event(it->first, "exited", exit_code,
+                   exit_code ? "task failed" : "");
+        std::cerr << "[agent] task " << it->first << " exited "
+                  << exit_code << std::endl;
+        it = tasks_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+
+  void ship_logs(const RunningTask& task) {
+    std::ifstream in(task.log_path);
+    if (!in.good()) return;
+    Json logs = Json::array();
+    std::string line;
+    int count = 0;
+    while (std::getline(in, line) && count < 5000) {
+      logs.push_back(line);
+      ++count;
+    }
+    Json body = Json::object();
+    body.set("logs", logs);
+    http_request(config_.master_host, config_.master_port, "POST",
+                 "/api/v1/allocations/" + task.allocation_id + "/logs",
+                 body.dump(), 10);
+  }
+
+  void send_event(const std::string& alloc_id, const std::string& event,
+                  int exit_code, const std::string& error) {
+    Json body = Json::object();
+    body.set("allocation_id", alloc_id).set("event", event)
+        .set("exit_code", exit_code).set("error", error);
+    http_request(config_.master_host, config_.master_port, "POST",
+                 "/api/v1/agents/" + config_.id + "/task_event", body.dump(),
+                 10);
+  }
+
+  AgentConfig config_;
+  std::map<std::string, RunningTask> tasks_;
+};
+
+}  // namespace
+}  // namespace dct
+
+int main(int argc, char** argv) {
+  dct::AgentConfig config;
+  for (int i = 1; i < argc; ++i) {
+    if (!std::strcmp(argv[i], "--master-host") && i + 1 < argc) {
+      config.master_host = argv[++i];
+    } else if (!std::strcmp(argv[i], "--master-port") && i + 1 < argc) {
+      config.master_port = std::atoi(argv[++i]);
+    } else if (!std::strcmp(argv[i], "--id") && i + 1 < argc) {
+      config.id = argv[++i];
+    } else if (!std::strcmp(argv[i], "--resource-pool") && i + 1 < argc) {
+      config.resource_pool = argv[++i];
+    } else if (!std::strcmp(argv[i], "--slots") && i + 1 < argc) {
+      config.slots = std::atoi(argv[++i]);
+    } else if (!std::strcmp(argv[i], "--topology") && i + 1 < argc) {
+      config.topology = argv[++i];
+    } else if (!std::strcmp(argv[i], "--work-dir") && i + 1 < argc) {
+      config.work_dir = argv[++i];
+    } else if (!std::strcmp(argv[i], "--help")) {
+      std::cout << "usage: dct-agent [--master-host H] [--master-port P] "
+                   "[--id ID] [--resource-pool POOL] [--slots N] "
+                   "[--topology T] [--work-dir DIR]\n";
+      return 0;
+    }
+  }
+  dct::Agent agent(config);
+  return agent.run();
+}

@@ -1,0 +1,103 @@
+"""REST client to the master (≈ determined.common.api.Session + the
+generated bindings.py — hand-written against the master's JSON API instead
+of swagger codegen)."""
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional
+
+
+class MasterError(RuntimeError):
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(f"master returned {status}: {message}")
+        self.status = status
+
+
+class MasterSession:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8080, *,
+                 timeout: float = 70.0, retries: int = 3) -> None:
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self.retries = retries
+
+    @property
+    def base_url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def request(self, method: str, path: str,
+                body: Optional[Dict[str, Any]] = None, *,
+                retryable: Optional[bool] = None) -> Dict[str, Any]:
+        """``retryable`` controls transport-error retries. Default: GETs are
+        retried, POSTs are not — a POST the master already processed must not
+        be silently duplicated (create_experiment, completed_op). Idempotent
+        POSTs (heartbeat, rendezvous, register) opt in."""
+        if retryable is None:
+            retryable = method == "GET"
+        attempts = self.retries if retryable else 1
+        data = json.dumps(body).encode() if body is not None else None
+        last_err: Optional[Exception] = None
+        for attempt in range(attempts):
+            req = urllib.request.Request(
+                self.base_url + path, data=data, method=method,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    payload = resp.read().decode()
+                    return json.loads(payload) if payload else {}
+            except urllib.error.HTTPError as e:
+                detail = e.read().decode(errors="replace")
+                try:
+                    detail = json.loads(detail).get("error", detail)
+                except Exception:
+                    pass
+                raise MasterError(e.code, detail) from None
+            except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
+                last_err = e
+                time.sleep(min(2.0 ** attempt * 0.2, 5.0))
+        raise MasterError(0, f"master unreachable at {self.base_url}: {last_err}")
+
+    def get(self, path: str) -> Dict[str, Any]:
+        return self.request("GET", path)
+
+    def post(self, path: str, body: Optional[Dict[str, Any]] = None, *,
+             retryable: bool = False) -> Dict[str, Any]:
+        return self.request("POST", path, body or {}, retryable=retryable)
+
+    # -- convenience wrappers ----------------------------------------------
+
+    def master_info(self) -> Dict[str, Any]:
+        return self.get("/api/v1/master")
+
+    def create_experiment(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        return self.post("/api/v1/experiments", {"config": config})["experiment"]
+
+    def list_experiments(self) -> list:
+        return self.get("/api/v1/experiments")["experiments"]
+
+    def get_experiment(self, exp_id: int) -> Dict[str, Any]:
+        return self.get(f"/api/v1/experiments/{exp_id}")
+
+    def kill_experiment(self, exp_id: int) -> Dict[str, Any]:
+        return self.post(f"/api/v1/experiments/{exp_id}/kill")
+
+    def get_trial(self, trial_id: int) -> Dict[str, Any]:
+        return self.get(f"/api/v1/trials/{trial_id}")["trial"]
+
+    def trial_metrics(self, trial_id: int, limit: int = 1000) -> list:
+        return self.get(f"/api/v1/trials/{trial_id}/metrics?limit={limit}")[
+            "metrics"]
+
+    def list_agents(self) -> list:
+        return self.get("/api/v1/agents")["agents"]
+
+    def job_queue(self) -> list:
+        return self.get("/api/v1/job-queue")["queue"]
+
+    def task_logs(self, allocation_id: str, limit: int = 1000) -> list:
+        return self.get(
+            f"/api/v1/allocations/{allocation_id}/logs?limit={limit}")["logs"]

@@ -73,6 +73,7 @@ def init(
     metrics_backend: Optional[MetricsBackend] = None,
     preemption_source: Optional[PreemptionSource] = None,
     searcher_source: Optional[SearcherOperationSource] = None,
+    checkpoint_registry: Optional[Any] = None,
     trial_id: Optional[int] = None,
 ) -> Iterator[Context]:
     """Build a Context. With no arguments this is fully local: single rank,
@@ -96,7 +97,7 @@ def init(
         )
         registry_base = storage_path
 
-    registry = LocalCheckpointRegistry(
+    registry = checkpoint_registry or LocalCheckpointRegistry(
         os.path.join(registry_base, "checkpoints.jsonl")
     )
     checkpoint = CheckpointContext(dist, storage, registry, trial_id=trial_id)

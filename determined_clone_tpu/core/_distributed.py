@@ -113,23 +113,33 @@ class _LocalTransport(_Transport):
 
 
 class _ChiefTransport(_Transport):
-    """Chief side: accepts one socket per worker, orchestrates rounds."""
+    """Chief side: accepts one socket per worker, orchestrates rounds.
+
+    Binds eagerly (``port`` may be 0 → ephemeral, see ``.port``) but accepts
+    lazily on the first collective — so the chief can bind, advertise its
+    port through the master rendezvous, and only then expect workers.
+    """
 
     def __init__(self, port: int, size: int, timeout: float = 300.0) -> None:
         self.size = size
+        self.timeout = timeout
         self.server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.server.bind(("0.0.0.0", port))
         self.server.listen(size)
         self.server.settimeout(timeout)
+        self.port = self.server.getsockname()[1]
         self.workers: dict = {}
-        for _ in range(size - 1):
+
+    def _accept_all(self) -> None:
+        while len(self.workers) < self.size - 1:
             conn, _ = self.server.accept()
-            conn.settimeout(timeout)
+            conn.settimeout(self.timeout)
             hello = _recv_msg(conn)
             self.workers[hello["rank"]] = conn
 
     def leader_exchange(self, obj: Any) -> List[Any]:
+        self._accept_all()
         contributions = {0: obj}
         for rank, conn in self.workers.items():
             contributions[rank] = _recv_msg(conn)

@@ -1,0 +1,106 @@
+"""Master-backed Core API components — the on-cluster counterparts of the
+local fallbacks (≈ the real vs Dummy context split in the reference,
+core/_train.py DummyTrainContext etc.)."""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator
+
+from determined_clone_tpu.api.client import MasterSession
+from determined_clone_tpu.core._checkpoint import CheckpointRegistry
+from determined_clone_tpu.core._preempt import PreemptionSource
+from determined_clone_tpu.core._searcher import (
+    SearcherOperation,
+    SearcherOperationSource,
+)
+from determined_clone_tpu.core._train import MetricsBackend
+
+
+class MasterMetricsBackend(MetricsBackend):
+    """POSTs metric batches to /trials/:id/metrics
+    (≈ ReportTrialMetrics, api_trials.go:1330)."""
+
+    def __init__(self, session: MasterSession, trial_id: int) -> None:
+        self.session = session
+        self.trial_id = trial_id
+
+    def report(self, group: str, steps_completed: int,
+               metrics: Dict[str, Any]) -> None:
+        self.session.post(f"/api/v1/trials/{self.trial_id}/metrics", {
+            "group": group,
+            "steps_completed": steps_completed,
+            "metrics": metrics,
+        })
+
+
+class MasterCheckpointRegistry(CheckpointRegistry):
+    """Reports checkpoints to the master's registry
+    (≈ core/_checkpoint.py:687 chief report → db)."""
+
+    def __init__(self, session: MasterSession, trial_id: int) -> None:
+        self.session = session
+        self.trial_id = trial_id
+
+    def report(self, record: Dict[str, Any]) -> None:
+        self.session.post(f"/api/v1/trials/{self.trial_id}/checkpoints", {
+            "uuid": record["storage_id"],
+            "metadata": record.get("metadata", {}),
+            "resources": record.get("resources", {}),
+        })
+
+    def report_deleted(self, storage_id: str) -> None:
+        pass  # master-side GC handles registry deletion
+
+    def list(self):
+        exp = self.session.get_trial(self.trial_id)["experiment_id"]
+        return self.session.get(
+            f"/api/v1/experiments/{exp}/checkpoints")["checkpoints"]
+
+
+class MasterPreemptionSource(PreemptionSource):
+    """Polls /allocations/:id/preempt (the reference long-polls 60 s,
+    core/_preempt.py:54; plain polling against the C++ master is cheap)."""
+
+    def __init__(self, session: MasterSession, allocation_id: str) -> None:
+        self.session = session
+        self.allocation_id = allocation_id
+
+    def poll(self) -> bool:
+        resp = self.session.get(
+            f"/api/v1/allocations/{self.allocation_id}/preempt")
+        return bool(resp.get("preempt"))
+
+
+class MasterSearcherSource(SearcherOperationSource):
+    """Streams searcher targets from the master: each GET of
+    /trials/:id/searcher/operation yields the current cumulative target;
+    completion POSTs feed the master's search method
+    (≈ SearcherContext.operations, core/_searcher.py:209)."""
+
+    def __init__(self, session: MasterSession, trial_id: int) -> None:
+        self.session = session
+        self.trial_id = trial_id
+
+    def operations(self, is_chief: bool) -> Iterator[SearcherOperation]:
+        seen_target = -1
+        while True:
+            op = self.session.get(
+                f"/api/v1/trials/{self.trial_id}/searcher/operation")
+            if op.get("closed"):
+                return
+            target = int(op.get("target_units", 0))
+            if target <= seen_target or not op.get("has_work", False):
+                # no new work: the trial leg is over (paused); the process
+                # exits and a future promotion re-launches it
+                return
+            seen_target = target
+
+            def complete(metric: float, _target=target) -> None:
+                self.session.post(
+                    f"/api/v1/trials/{self.trial_id}/searcher/completed_op",
+                    {"metric": metric, "units": _target},
+                )
+
+            yield SearcherOperation(
+                target, is_chief=is_chief,
+                complete_cb=complete if is_chief else None,
+            )

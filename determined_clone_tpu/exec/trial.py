@@ -1,0 +1,199 @@
+"""In-task trial entrypoint — what the agent execs for a trial leg.
+
+≈ the reference's in-container chain (entrypoint.sh → prep_container →
+determined.exec.harness, SURVEY.md §3.1-3.2), collapsed: ClusterInfo from
+DCT_* env (≈ _info.py:23), master rendezvous (≈ prep_container.py:203),
+jax.distributed init for multi-host gangs, master-backed Core API contexts,
+then Trainer.fit on the user's JaxTrial class.
+
+Usage (by the agent): python -m determined_clone_tpu.exec.trial module:Class
+"""
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import json
+import os
+import socket
+import sys
+import time
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    """≈ det.get_cluster_info() (harness/determined/_info.py:23-137)."""
+
+    master_host: str
+    master_port: int
+    allocation_id: str
+    trial_id: int
+    experiment_id: int
+    rank: int
+    world_size: int
+    slots: int
+    hparams: Dict[str, Any]
+    target_units: int
+    latest_checkpoint: Optional[str]
+    experiment_config: Dict[str, Any]
+
+    @staticmethod
+    def from_env() -> "ClusterInfo":
+        def need(name: str) -> str:
+            v = os.environ.get(name)
+            if v is None:
+                raise RuntimeError(f"missing required env var {name}")
+            return v
+
+        return ClusterInfo(
+            master_host=os.environ.get("DCT_MASTER_HOST", "127.0.0.1"),
+            master_port=int(os.environ.get("DCT_MASTER_PORT", "8080")),
+            allocation_id=need("DCT_ALLOCATION_ID"),
+            trial_id=int(need("DCT_TRIAL_ID")),
+            experiment_id=int(os.environ.get("DCT_EXPERIMENT_ID", "0")),
+            rank=int(os.environ.get("DCT_RANK", "0")),
+            world_size=int(os.environ.get("DCT_WORLD_SIZE", "1")),
+            slots=int(os.environ.get("DCT_SLOTS", "1")),
+            hparams=json.loads(os.environ.get("DCT_HPARAMS", "{}")),
+            target_units=int(os.environ.get("DCT_TARGET_UNITS", "0")),
+            latest_checkpoint=os.environ.get("DCT_LATEST_CHECKPOINT") or None,
+            experiment_config=json.loads(
+                os.environ.get("DCT_EXPERIMENT_CONFIG", "{}")),
+        )
+
+
+def resolve_entrypoint(entrypoint: str):
+    """'pkg.module:ClassName' → class. The model-def directory (cwd) is on
+    sys.path, like the reference's context-dir download + import."""
+    if ":" not in entrypoint:
+        raise RuntimeError(
+            f"entrypoint {entrypoint!r} must look like 'module:TrialClass'"
+        )
+    module_name, class_name = entrypoint.split(":", 1)
+    if "" == module_name:
+        raise RuntimeError("entrypoint module is empty")
+    sys.path.insert(0, os.getcwd())
+    module = importlib.import_module(module_name)
+    return getattr(module, class_name)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("0.0.0.0", 0))
+        return s.getsockname()[1]
+
+
+def do_rendezvous(session, info: ClusterInfo, addr: str) -> list:
+    """Register our address; poll until the whole gang is present
+    (≈ task/rendezvous.go:94-187). Returns member addresses rank-ordered;
+    member[0] carries the jax coordinator + control-plane ports."""
+    deadline = time.time() + 300
+    while True:
+        resp = session.post(
+            f"/api/v1/allocations/{info.allocation_id}/rendezvous",
+            {"rank": info.rank, "address": addr},
+            retryable=True,  # idempotent re-registration
+        )
+        if resp.get("ready"):
+            return list(resp.get("members", []))
+        if time.time() > deadline:
+            raise RuntimeError(
+                f"rendezvous timed out: {len(resp.get('members', []))}/"
+                f"{resp.get('world_size')} members present"
+            )
+        time.sleep(0.5)
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print("usage: python -m determined_clone_tpu.exec.trial module:Class",
+              file=sys.stderr)
+        return 2
+
+    from determined_clone_tpu import core
+    from determined_clone_tpu.api.client import MasterSession
+    from determined_clone_tpu.config.experiment import ExperimentConfig
+    from determined_clone_tpu.config.length import Length, Unit
+    from determined_clone_tpu.core._master_backed import (
+        MasterCheckpointRegistry,
+        MasterMetricsBackend,
+        MasterPreemptionSource,
+        MasterSearcherSource,
+    )
+    from determined_clone_tpu.training import Trainer, TrialContext
+
+    info = ClusterInfo.from_env()
+    session = MasterSession(info.master_host, info.master_port)
+    config = ExperimentConfig.from_dict(info.experiment_config)
+    trial_cls = resolve_entrypoint(argv[0])
+
+    # Ports are chosen ephemerally and advertised via rendezvous so that
+    # concurrent gangs sharing a host never collide. member[0] format:
+    # "host:jax_port:ctrl_port".
+    chief_transport = None
+    if info.world_size > 1 and info.rank == 0:
+        from determined_clone_tpu.core._distributed import _ChiefTransport
+
+        chief_transport = _ChiefTransport(0, info.world_size)
+        addr = f"{socket.gethostname()}:{_free_port()}:{chief_transport.port}"
+    else:
+        addr = f"{socket.gethostname()}:0:0"
+
+    members = do_rendezvous(session, info, addr)
+    if info.world_size > 1:
+        # multi-host gang: rank 0's host is the XLA coordinator
+        # (SURVEY.md §2.8 plane 1: jax.distributed over ICI/DCN)
+        import jax
+
+        chief_host, jax_port, ctrl_port = members[0].rsplit(":", 2)
+        jax.distributed.initialize(
+            coordinator_address=f"{chief_host}:{jax_port}",
+            num_processes=info.world_size,
+            process_id=info.rank,
+        )
+        if info.rank == 0:
+            dist = core.DistributedContext(
+                rank=0, size=info.world_size, transport=chief_transport,
+            )
+        else:
+            dist = core.DistributedContext.from_tcp(
+                chief_host, int(ctrl_port), info.rank, info.world_size
+            )
+    else:
+        dist = core.DistributedContext.single()
+
+    # searcher targets arrive in max_length units; wrap for the trainer
+    unit = (config.searcher.max_length.unit
+            if config.searcher.max_length is not None else Unit.BATCHES)
+
+    class UnitWrappingSource(MasterSearcherSource):
+        def operations(self, is_chief):
+            for op in super().operations(is_chief):
+                op.length = Length(unit, int(op.length))
+                yield op
+
+    exit_code = 0
+    with core.init(
+        config=config,
+        distributed=dist,
+        metrics_backend=MasterMetricsBackend(session, info.trial_id),
+        preemption_source=MasterPreemptionSource(session, info.allocation_id),
+        searcher_source=UnitWrappingSource(session, info.trial_id),
+        checkpoint_registry=MasterCheckpointRegistry(session, info.trial_id),
+        trial_id=info.trial_id,
+    ) as cctx:
+        tctx = TrialContext(config=config, hparams=info.hparams, core=cctx)
+        trial = trial_cls(tctx)
+        trainer = Trainer(trial)
+        try:
+            result = trainer.fit(latest_checkpoint=info.latest_checkpoint)
+            print(f"[trial] leg finished: {result}", flush=True)
+        except Exception as e:  # noqa: BLE001 - report, then fail the task
+            print(f"[trial] FAILED: {type(e).__name__}: {e}", flush=True)
+            exit_code = 1
+    return exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,286 @@
+#include "http.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <sstream>
+
+namespace dct {
+namespace {
+
+int hex_val(char c) {
+  if (c >= '0' && c <= '9') return c - '0';
+  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+  if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+  return -1;
+}
+
+std::string url_decode(const std::string& s) {
+  std::string out;
+  for (size_t i = 0; i < s.size(); ++i) {
+    int hi, lo;
+    if (s[i] == '%' && i + 2 < s.size() && (hi = hex_val(s[i + 1])) >= 0 &&
+        (lo = hex_val(s[i + 2])) >= 0) {
+      out += static_cast<char>(hi * 16 + lo);
+      i += 2;
+    } else if (s[i] == '+') {
+      out += ' ';
+    } else {
+      out += s[i];
+    }
+  }
+  return out;
+}
+
+bool read_line(int fd, std::string& line, std::string& buffer) {
+  while (true) {
+    auto pos = buffer.find("\r\n");
+    if (pos != std::string::npos) {
+      line = buffer.substr(0, pos);
+      buffer.erase(0, pos + 2);
+      return true;
+    }
+    char chunk[4096];
+    ssize_t n = ::recv(fd, chunk, sizeof(chunk), 0);
+    if (n <= 0) return false;
+    buffer.append(chunk, n);
+    if (buffer.size() > 64 * 1024 * 1024) return false;  // header bomb
+  }
+}
+
+bool read_exact(int fd, size_t len, std::string& out, std::string& buffer) {
+  while (buffer.size() < len) {
+    char chunk[65536];
+    ssize_t n = ::recv(fd, chunk, sizeof(chunk), 0);
+    if (n <= 0) return false;
+    buffer.append(chunk, n);
+  }
+  out = buffer.substr(0, len);
+  buffer.erase(0, len);
+  return true;
+}
+
+bool send_all(int fd, const std::string& data) {
+  size_t sent = 0;
+  while (sent < data.size()) {
+    ssize_t n = ::send(fd, data.data() + sent, data.size() - sent, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    sent += n;
+  }
+  return true;
+}
+
+const char* status_text(int code) {
+  switch (code) {
+    case 200: return "OK";
+    case 201: return "Created";
+    case 204: return "No Content";
+    case 400: return "Bad Request";
+    case 404: return "Not Found";
+    case 405: return "Method Not Allowed";
+    case 409: return "Conflict";
+    case 500: return "Internal Server Error";
+    default: return "Unknown";
+  }
+}
+
+}  // namespace
+
+void HttpServer::start(int port) {
+  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) throw std::runtime_error("socket() failed");
+  int one = 1;
+  ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    throw std::runtime_error("bind() failed on port " + std::to_string(port));
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+  port_ = ntohs(addr.sin_port);
+  if (::listen(listen_fd_, 128) < 0) throw std::runtime_error("listen() failed");
+  running_ = true;
+  accept_thread_ = std::thread([this] { accept_loop(); });
+}
+
+void HttpServer::stop() {
+  if (!running_.exchange(false)) return;
+  ::shutdown(listen_fd_, SHUT_RDWR);
+  ::close(listen_fd_);
+  {
+    // wake worker threads blocked in recv() on live connections
+    std::lock_guard<std::mutex> lock(conn_mu_);
+    for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+  }
+  if (accept_thread_.joinable()) accept_thread_.join();
+  for (auto& w : workers_) {
+    if (w.joinable()) w.join();
+  }
+  workers_.clear();
+}
+
+void HttpServer::accept_loop() {
+  while (running_) {
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) {
+      if (!running_) break;
+      continue;
+    }
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    // idle keep-alive connections must not block shutdown: bounded recv
+    timeval tv{120, 0};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    {
+      std::lock_guard<std::mutex> lock(conn_mu_);
+      conn_fds_.insert(fd);
+    }
+    workers_.emplace_back([this, fd] {
+      serve_connection(fd);
+      std::lock_guard<std::mutex> lock(conn_mu_);
+      conn_fds_.erase(fd);
+    });
+    // opportunistic reaping of finished threads is skipped: connections are
+    // few (CLI, agents, harness) and joined at stop()
+  }
+}
+
+void HttpServer::serve_connection(int fd) {
+  std::string buffer;
+  while (running_) {
+    std::string request_line;
+    if (!read_line(fd, request_line, buffer)) break;
+    if (request_line.empty()) continue;
+
+    HttpRequest req;
+    {
+      std::istringstream rl(request_line);
+      std::string target, version;
+      rl >> req.method >> target >> version;
+      auto qpos = target.find('?');
+      if (qpos != std::string::npos) {
+        std::string qs = target.substr(qpos + 1);
+        target = target.substr(0, qpos);
+        std::istringstream qstream(qs);
+        std::string pair;
+        while (std::getline(qstream, pair, '&')) {
+          auto eq = pair.find('=');
+          if (eq != std::string::npos) {
+            req.query[url_decode(pair.substr(0, eq))] =
+                url_decode(pair.substr(eq + 1));
+          }
+        }
+      }
+      req.path = url_decode(target);
+    }
+    {
+      std::istringstream pstream(req.path);
+      std::string part;
+      while (std::getline(pstream, part, '/')) {
+        if (!part.empty()) req.path_parts.push_back(part);
+      }
+    }
+
+    bool keep_alive = true;
+    while (true) {
+      std::string header;
+      if (!read_line(fd, header, buffer)) { keep_alive = false; break; }
+      if (header.empty()) break;
+      auto colon = header.find(':');
+      if (colon == std::string::npos) continue;
+      std::string key = header.substr(0, colon);
+      for (auto& c : key) c = static_cast<char>(::tolower(c));
+      std::string value = header.substr(colon + 1);
+      while (!value.empty() && value.front() == ' ') value.erase(0, 1);
+      req.headers[key] = value;
+    }
+    if (!keep_alive) break;
+
+    auto cl = req.headers.find("content-length");
+    if (cl != req.headers.end()) {
+      size_t len = 0;
+      try {
+        len = std::stoul(cl->second);
+      } catch (const std::exception&) {
+        break;  // malformed Content-Length: drop the connection
+      }
+      if (len > 256 * 1024 * 1024) break;  // oversized body
+      if (!read_exact(fd, len, req.body, buffer)) break;
+    }
+    auto conn = req.headers.find("connection");
+    if (conn != req.headers.end() && conn->second == "close") keep_alive = false;
+
+    HttpResponse resp;
+    try {
+      resp = handler_(req);
+    } catch (const std::exception& e) {
+      resp = HttpResponse::json(
+          500, std::string("{\"error\":\"") + e.what() + "\"}");
+    }
+
+    std::ostringstream out;
+    out << "HTTP/1.1 " << resp.status << ' ' << status_text(resp.status)
+        << "\r\nContent-Type: " << resp.content_type
+        << "\r\nContent-Length: " << resp.body.size()
+        << "\r\nConnection: " << (keep_alive ? "keep-alive" : "close")
+        << "\r\n\r\n" << resp.body;
+    if (!send_all(fd, out.str())) break;
+    if (!keep_alive) break;
+  }
+  ::close(fd);
+}
+
+std::optional<HttpClientResponse> http_request(
+    const std::string& host, int port, const std::string& method,
+    const std::string& path, const std::string& body, int timeout_sec) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return std::nullopt;
+  timeval tv{timeout_sec, 0};
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    ::close(fd);
+    return std::nullopt;
+  }
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    ::close(fd);
+    return std::nullopt;
+  }
+  std::ostringstream out;
+  out << method << ' ' << path << " HTTP/1.1\r\nHost: " << host
+      << "\r\nContent-Type: application/json\r\nContent-Length: "
+      << body.size() << "\r\nConnection: close\r\n\r\n" << body;
+  if (!send_all(fd, out.str())) { ::close(fd); return std::nullopt; }
+
+  std::string data;
+  char chunk[65536];
+  while (true) {
+    ssize_t n = ::recv(fd, chunk, sizeof(chunk), 0);
+    if (n <= 0) break;
+    data.append(chunk, n);
+  }
+  ::close(fd);
+
+  auto header_end = data.find("\r\n\r\n");
+  if (header_end == std::string::npos) return std::nullopt;
+  HttpClientResponse resp;
+  {
+    std::istringstream rl(data.substr(0, data.find("\r\n")));
+    std::string version;
+    rl >> version >> resp.status;
+  }
+  resp.body = data.substr(header_end + 4);
+  return resp;
+}
+
+}  // namespace dct

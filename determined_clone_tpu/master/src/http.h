@@ -1,0 +1,84 @@
+// Small HTTP/1.1 server + client.
+//
+// Serves the master's REST API (the role grpc-gateway + echo play in the
+// reference, master/internal/core.go) and carries the agent↔master protocol
+// (HTTP long-poll where the reference uses a websocket,
+// agent/internal/agent.go:268 — same reconnect semantics, simpler wire).
+// Thread-per-connection with keep-alive: the API's perf gate (p95 < 1 s at
+// 25 VUs, performance/src/api_performance_tests.ts) needs nothing fancier.
+#pragma once
+
+#include <atomic>
+#include <functional>
+#include <map>
+#include <mutex>
+#include <optional>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace dct {
+
+struct HttpRequest {
+  std::string method;
+  std::string path;                      // without query string
+  std::map<std::string, std::string> query;
+  std::map<std::string, std::string> headers;  // lower-cased keys
+  std::string body;
+  std::vector<std::string> path_parts;   // split on '/'
+};
+
+struct HttpResponse {
+  int status = 200;
+  std::string content_type = "application/json";
+  std::string body;
+
+  static HttpResponse json(int status, const std::string& body) {
+    HttpResponse r;
+    r.status = status;
+    r.body = body;
+    return r;
+  }
+};
+
+using HttpHandler = std::function<HttpResponse(const HttpRequest&)>;
+
+class HttpServer {
+ public:
+  explicit HttpServer(HttpHandler handler) : handler_(std::move(handler)) {}
+  ~HttpServer() { stop(); }
+
+  // Binds and starts the accept loop on a background thread.
+  // port 0 → ephemeral; port() returns the bound port.
+  void start(int port);
+  void stop();
+  int port() const { return port_; }
+
+ private:
+  void accept_loop();
+  void serve_connection(int fd);
+
+  HttpHandler handler_;
+  std::atomic<bool> running_{false};
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::thread accept_thread_;
+  std::vector<std::thread> workers_;
+  std::mutex conn_mu_;
+  std::set<int> conn_fds_;
+};
+
+// Blocking HTTP client (agent→master, harness→master, CLI smoke tests).
+struct HttpClientResponse {
+  int status = 0;
+  std::string body;
+};
+
+// Returns nullopt on connect/transport error.
+std::optional<HttpClientResponse> http_request(
+    const std::string& host, int port, const std::string& method,
+    const std::string& path, const std::string& body = "",
+    int timeout_sec = 70);
+
+}  // namespace dct

@@ -1,0 +1,262 @@
+#include "json.h"
+
+#include <cctype>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+
+namespace dct {
+namespace {
+
+struct Parser {
+  const char* p;
+  const char* end;
+
+  [[noreturn]] void fail(const std::string& msg) {
+    throw std::runtime_error("json parse error: " + msg);
+  }
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) ++p;
+  }
+
+  char peek() {
+    if (p >= end) fail("unexpected end of input");
+    return *p;
+  }
+
+  void expect(char c) {
+    if (p >= end || *p != c) fail(std::string("expected '") + c + "'");
+    ++p;
+  }
+
+  bool consume(const char* lit) {
+    size_t n = std::strlen(lit);
+    if (static_cast<size_t>(end - p) >= n && std::memcmp(p, lit, n) == 0) {
+      p += n;
+      return true;
+    }
+    return false;
+  }
+
+  Json parse_value() {
+    skip_ws();
+    char c = peek();
+    switch (c) {
+      case '{': return parse_object();
+      case '[': return parse_array();
+      case '"': return Json(parse_string());
+      case 't': if (consume("true")) return Json(true); fail("bad literal");
+      case 'f': if (consume("false")) return Json(false); fail("bad literal");
+      case 'n': if (consume("null")) return Json(nullptr); fail("bad literal");
+      default: return parse_number();
+    }
+  }
+
+  Json parse_object() {
+    expect('{');
+    JsonObject obj;
+    skip_ws();
+    if (peek() == '}') { ++p; return Json(std::move(obj)); }
+    while (true) {
+      skip_ws();
+      std::string key = parse_string();
+      skip_ws();
+      expect(':');
+      obj[std::move(key)] = parse_value();
+      skip_ws();
+      char c = peek();
+      if (c == ',') { ++p; continue; }
+      if (c == '}') { ++p; break; }
+      fail("expected ',' or '}' in object");
+    }
+    return Json(std::move(obj));
+  }
+
+  Json parse_array() {
+    expect('[');
+    JsonArray arr;
+    skip_ws();
+    if (peek() == ']') { ++p; return Json(std::move(arr)); }
+    while (true) {
+      arr.push_back(parse_value());
+      skip_ws();
+      char c = peek();
+      if (c == ',') { ++p; continue; }
+      if (c == ']') { ++p; break; }
+      fail("expected ',' or ']' in array");
+    }
+    return Json(std::move(arr));
+  }
+
+  std::string parse_string() {
+    expect('"');
+    std::string out;
+    while (true) {
+      if (p >= end) fail("unterminated string");
+      char c = *p++;
+      if (c == '"') break;
+      if (c == '\\') {
+        if (p >= end) fail("bad escape");
+        char e = *p++;
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            if (end - p < 4) fail("bad \\u escape");
+            unsigned code = 0;
+            for (int i = 0; i < 4; ++i) {
+              char h = *p++;
+              code <<= 4;
+              if (h >= '0' && h <= '9') code |= h - '0';
+              else if (h >= 'a' && h <= 'f') code |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') code |= h - 'A' + 10;
+              else fail("bad \\u escape");
+            }
+            // surrogate pair → one codepoint
+            if (code >= 0xD800 && code <= 0xDBFF && end - p >= 6 &&
+                p[0] == '\\' && p[1] == 'u') {
+              unsigned lo = 0;
+              const char* q = p + 2;
+              bool ok = true;
+              for (int i = 0; i < 4; ++i) {
+                char h = q[i];
+                lo <<= 4;
+                if (h >= '0' && h <= '9') lo |= h - '0';
+                else if (h >= 'a' && h <= 'f') lo |= h - 'a' + 10;
+                else if (h >= 'A' && h <= 'F') lo |= h - 'A' + 10;
+                else { ok = false; break; }
+              }
+              if (ok && lo >= 0xDC00 && lo <= 0xDFFF) {
+                code = 0x10000 + ((code - 0xD800) << 10) + (lo - 0xDC00);
+                p += 6;
+              }
+            }
+            // utf-8 encode
+            if (code < 0x80) {
+              out += static_cast<char>(code);
+            } else if (code < 0x800) {
+              out += static_cast<char>(0xC0 | (code >> 6));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            } else if (code < 0x10000) {
+              out += static_cast<char>(0xE0 | (code >> 12));
+              out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            } else {
+              out += static_cast<char>(0xF0 | (code >> 18));
+              out += static_cast<char>(0x80 | ((code >> 12) & 0x3F));
+              out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            }
+            break;
+          }
+          default: fail("bad escape");
+        }
+      } else {
+        out += c;
+      }
+    }
+    return out;
+  }
+
+  Json parse_number() {
+    const char* start = p;
+    if (p < end && (*p == '-' || *p == '+')) ++p;
+    while (p < end && (std::isdigit(*p) || *p == '.' || *p == 'e' ||
+                       *p == 'E' || *p == '-' || *p == '+')) {
+      ++p;
+    }
+    if (p == start) fail("invalid number");
+    std::string text(start, p - start);
+    try {
+      return Json(std::stod(text));
+    } catch (...) {
+      fail("invalid number '" + text + "'");
+    }
+  }
+};
+
+void write_escaped(std::ostringstream& out, const std::string& s) {
+  out << '"';
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out << "\\\""; break;
+      case '\\': out << "\\\\"; break;
+      case '\b': out << "\\b"; break;
+      case '\f': out << "\\f"; break;
+      case '\n': out << "\\n"; break;
+      case '\r': out << "\\r"; break;
+      case '\t': out << "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out << buf;
+        } else {
+          out << static_cast<char>(c);
+        }
+    }
+  }
+  out << '"';
+}
+
+}  // namespace
+
+Json Json::parse(const std::string& text) {
+  Parser parser{text.data(), text.data() + text.size()};
+  Json v = parser.parse_value();
+  parser.skip_ws();
+  if (parser.p != parser.end) parser.fail("trailing characters");
+  return v;
+}
+
+void Json::write(std::ostringstream& out) const {
+  switch (type_) {
+    case Type::Null: out << "null"; break;
+    case Type::Bool: out << (bool_ ? "true" : "false"); break;
+    case Type::Number: {
+      if (std::isfinite(num_) && num_ == std::floor(num_) &&
+          std::fabs(num_) < 9.0e15) {
+        out << static_cast<int64_t>(num_);
+      } else if (std::isfinite(num_)) {
+        char buf[32];
+        std::snprintf(buf, sizeof(buf), "%.17g", num_);
+        out << buf;
+      } else {
+        out << "null";  // NaN/Inf are not representable in JSON
+      }
+      break;
+    }
+    case Type::String: write_escaped(out, str_); break;
+    case Type::Array: {
+      out << '[';
+      for (size_t i = 0; i < arr_.size(); ++i) {
+        if (i) out << ',';
+        arr_[i].write(out);
+      }
+      out << ']';
+      break;
+    }
+    case Type::Object: {
+      out << '{';
+      bool first = true;
+      for (const auto& [k, v] : obj_) {
+        if (!first) out << ',';
+        first = false;
+        write_escaped(out, k);
+        out << ':';
+        v.write(out);
+      }
+      out << '}';
+      break;
+    }
+  }
+}
+
+}  // namespace dct

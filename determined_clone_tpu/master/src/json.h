@@ -1,0 +1,113 @@
+// Minimal JSON value type + parser/serializer for the master's REST API.
+//
+// The reference master speaks protobuf/grpc-gateway JSON via generated code
+// (proto/..., master/internal/api_*.go); this master is REST/JSON-first with
+// a small hand-rolled core instead of a codegen pipeline — one wire format,
+// no generator step.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace dct {
+
+class Json;
+using JsonArray = std::vector<Json>;
+// std::map keeps key order deterministic (stable serialization for tests
+// and content hashing).
+using JsonObject = std::map<std::string, Json>;
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Json() : type_(Type::Null) {}
+  Json(std::nullptr_t) : type_(Type::Null) {}
+  Json(bool b) : type_(Type::Bool), bool_(b) {}
+  Json(int v) : type_(Type::Number), num_(v) {}
+  Json(int64_t v) : type_(Type::Number), num_(static_cast<double>(v)) {}
+  Json(double v) : type_(Type::Number), num_(v) {}
+  Json(const char* s) : type_(Type::String), str_(s) {}
+  Json(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Json(JsonArray a) : type_(Type::Array), arr_(std::move(a)) {}
+  Json(JsonObject o) : type_(Type::Object), obj_(std::move(o)) {}
+
+  static Json array() { return Json(JsonArray{}); }
+  static Json object() { return Json(JsonObject{}); }
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_bool() const { return type_ == Type::Bool; }
+  bool is_number() const { return type_ == Type::Number; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_object() const { return type_ == Type::Object; }
+
+  bool as_bool(bool dflt = false) const {
+    return is_bool() ? bool_ : dflt;
+  }
+  double as_number(double dflt = 0) const {
+    return is_number() ? num_ : dflt;
+  }
+  int64_t as_int(int64_t dflt = 0) const {
+    return is_number() ? static_cast<int64_t>(num_) : dflt;
+  }
+  const std::string& as_string() const {
+    static const std::string empty;
+    return is_string() ? str_ : empty;
+  }
+
+  // object access
+  const Json& operator[](const std::string& key) const {
+    static const Json null_json;
+    if (!is_object()) return null_json;
+    auto it = obj_.find(key);
+    return it == obj_.end() ? null_json : it->second;
+  }
+  Json& set(const std::string& key, Json value) {
+    if (!is_object()) { type_ = Type::Object; obj_.clear(); }
+    obj_[key] = std::move(value);
+    return *this;
+  }
+  bool has(const std::string& key) const {
+    return is_object() && obj_.count(key) > 0;
+  }
+  const JsonObject& items() const { return obj_; }
+
+  // array access
+  const JsonArray& elements() const { return arr_; }
+  void push_back(Json v) {
+    if (!is_array()) { type_ = Type::Array; arr_.clear(); }
+    arr_.push_back(std::move(v));
+  }
+  size_t size() const {
+    if (is_array()) return arr_.size();
+    if (is_object()) return obj_.size();
+    return 0;
+  }
+
+  std::string dump() const {
+    std::ostringstream out;
+    write(out);
+    return out.str();
+  }
+
+  // Throws std::runtime_error on malformed input.
+  static Json parse(const std::string& text);
+
+ private:
+  void write(std::ostringstream& out) const;
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0;
+  std::string str_;
+  JsonArray arr_;
+  JsonObject obj_;
+};
+
+}  // namespace dct

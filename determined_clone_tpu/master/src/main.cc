@@ -1,0 +1,56 @@
+// determined-clone-tpu master binary (≈ master/cmd/determined-master/main.go:9).
+#include <chrono>
+#include <csignal>
+#include <cstdlib>
+#include <cstring>
+#include <iostream>
+#include <thread>
+
+#include "master.h"
+
+namespace {
+// async-signal-safe: the handler only sets a flag; the main thread does the
+// actual (mutex/join-heavy) shutdown
+volatile std::sig_atomic_t g_stop = 0;
+void handle_signal(int) { g_stop = 1; }
+}  // namespace
+
+int main(int argc, char** argv) {
+  dct::MasterConfig config;
+  for (int i = 1; i < argc; ++i) {
+    if (!std::strcmp(argv[i], "--port") && i + 1 < argc) {
+      config.port = std::atoi(argv[++i]);
+    } else if (!std::strcmp(argv[i], "--data-dir") && i + 1 < argc) {
+      config.data_dir = argv[++i];
+    } else if (!std::strcmp(argv[i], "--scheduler") && i + 1 < argc) {
+      config.default_pool.type = argv[++i];
+    } else if (!std::strcmp(argv[i], "--agent-timeout") && i + 1 < argc) {
+      config.agent_timeout_sec = std::atof(argv[++i]);
+    } else if (!std::strcmp(argv[i], "--help")) {
+      std::cout << "usage: dct-master [--port N] [--data-dir DIR] "
+                   "[--scheduler fifo|priority|fair_share] "
+                   "[--agent-timeout SEC]\n";
+      return 0;
+    }
+  }
+  // env overrides (≈ viper env config in the reference)
+  if (const char* p = std::getenv("DCT_MASTER_PORT")) config.port = std::atoi(p);
+  if (const char* d = std::getenv("DCT_MASTER_DATA_DIR")) config.data_dir = d;
+
+  dct::Master master(config);
+  std::signal(SIGINT, handle_signal);
+  std::signal(SIGTERM, handle_signal);
+  try {
+    master.start();
+  } catch (const std::exception& e) {
+    std::cerr << "dct-master failed to start: " << e.what() << std::endl;
+    return 1;
+  }
+  std::cout << "dct-master listening on port " << master.port()
+            << " (data dir: " << config.data_dir << ")" << std::endl;
+  while (!g_stop) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(200));
+  }
+  master.stop();  // final snapshot save
+  return 0;
+}

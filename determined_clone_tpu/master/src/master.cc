@@ -1,0 +1,487 @@
+#include "master.h"
+
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <fstream>
+#include <sstream>
+
+namespace dct {
+
+double now_sec() {
+  return std::chrono::duration<double>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+namespace {
+
+void mkdirs(const std::string& path) {
+  std::string cur;
+  std::istringstream stream(path);
+  std::string part;
+  if (!path.empty() && path[0] == '/') cur = "/";
+  while (std::getline(stream, part, '/')) {
+    if (part.empty()) continue;
+    cur += part + "/";
+    ::mkdir(cur.c_str(), 0755);
+  }
+}
+
+}  // namespace
+
+Master::Master(MasterConfig config) : config_(std::move(config)) {
+  server_ = std::make_unique<HttpServer>(
+      [this](const HttpRequest& req) { return handle(req); });
+}
+
+Master::~Master() { stop(); }
+
+void Master::start() {
+  mkdirs(config_.data_dir);
+  load_snapshot();
+  // restore (≈ restoreNonTerminalExperiments, core.go:772 + reattach):
+  // Running allocations KEEP their reservations — reconnecting agents
+  // re-report them via heartbeat and the tasks carry on; if the agent never
+  // returns, the agent-timeout path requeues them. Only Pulling allocations
+  // (assigned but possibly never started) are requeued immediately.
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    for (auto& [id, alloc] : allocations_) {
+      if (alloc.state == RunState::Pulling) {
+        alloc.state = RunState::Queued;
+        alloc.reservations.clear();
+        alloc.rendezvous.clear();
+      }
+    }
+  }
+  running_ = true;
+  server_->start(config_.port);
+  tick_thread_ = std::thread([this] {
+    while (running_) {
+      {
+        std::lock_guard<std::mutex> lock(mu_);
+        tick_locked();
+        if (dirty_) save_snapshot_locked();
+      }
+      std::this_thread::sleep_for(
+          std::chrono::milliseconds(static_cast<int>(
+              config_.tick_interval_sec * 1000)));
+    }
+  });
+}
+
+void Master::stop() {
+  if (!running_.exchange(false)) {
+    if (server_) server_->stop();
+    return;
+  }
+  server_->stop();
+  if (tick_thread_.joinable()) tick_thread_.join();
+  std::lock_guard<std::mutex> lock(mu_);
+  save_snapshot_locked();
+}
+
+// ---------------------------------------------------------------------------
+// persistence
+// ---------------------------------------------------------------------------
+
+void Master::save_snapshot_locked() {
+  Json exps = Json::array();
+  for (const auto& [id, e] : experiments_) {
+    Experiment copy = e;
+    auto mit = methods_.find(id);
+    if (mit != methods_.end()) copy.searcher_snapshot = mit->second->snapshot();
+    exps.push_back(copy.to_json());
+  }
+  Json trials = Json::array();
+  for (const auto& [id, t] : trials_) trials.push_back(t.to_json());
+  Json allocs = Json::array();
+  for (const auto& [id, a] : allocations_) allocs.push_back(a.to_json());
+  Json agents = Json::array();
+  for (const auto& [id, a] : agents_) agents.push_back(a.to_json());
+  Json ckpts = Json::array();
+  for (const auto& c : checkpoints_) ckpts.push_back(c.to_json());
+  Json req_map = Json::object();
+  for (const auto& [eid, m] : request_to_trial_) {
+    Json inner = Json::object();
+    for (const auto& [rid, tid] : m) inner.set(std::to_string(rid), tid);
+    req_map.set(std::to_string(eid), inner);
+  }
+  Json snap = Json::object();
+  snap.set("next_experiment_id", next_experiment_id_)
+      .set("next_trial_id", next_trial_id_)
+      .set("experiments", exps).set("trials", trials)
+      .set("allocations", allocs).set("agents", agents)
+      .set("checkpoints", ckpts).set("request_to_trial", req_map);
+
+  std::string path = config_.data_dir + "/snapshot.json";
+  std::string tmp = path + ".tmp";
+  {
+    std::ofstream out(tmp);
+    out << snap.dump();
+  }
+  ::rename(tmp.c_str(), path.c_str());
+  dirty_ = false;
+}
+
+void Master::load_snapshot() {
+  std::ifstream in(config_.data_dir + "/snapshot.json");
+  if (!in.good()) return;
+  std::stringstream buf;
+  buf << in.rdbuf();
+  Json snap;
+  try {
+    snap = Json::parse(buf.str());
+  } catch (const std::exception&) {
+    return;  // corrupt snapshot: start fresh rather than crash-loop
+  }
+  std::lock_guard<std::mutex> lock(mu_);
+  next_experiment_id_ = snap["next_experiment_id"].as_int(1);
+  next_trial_id_ = snap["next_trial_id"].as_int(1);
+  for (const auto& e : snap["experiments"].elements()) {
+    Experiment exp = Experiment::from_json(e);
+    int64_t id = exp.id;
+    experiments_[id] = std::move(exp);
+  }
+  for (const auto& t : snap["trials"].elements()) {
+    Trial trial = Trial::from_json(t);
+    trials_[trial.id] = std::move(trial);
+  }
+  for (const auto& a : snap["allocations"].elements()) {
+    Allocation alloc = Allocation::from_json(a);
+    allocations_[alloc.id] = std::move(alloc);
+  }
+  for (const auto& a : snap["agents"].elements()) {
+    Agent agent = Agent::from_json(a);
+    agents_[agent.id] = std::move(agent);
+  }
+  for (const auto& c : snap["checkpoints"].elements()) {
+    checkpoints_.push_back(CheckpointRecord::from_json(c));
+  }
+  for (const auto& [eid, inner] : snap["request_to_trial"].items()) {
+    for (const auto& [rid, tid] : inner.items()) {
+      request_to_trial_[std::stoll(eid)][std::stoll(rid)] = tid.as_int();
+    }
+  }
+  // rebuild searcher methods from snapshots
+  for (auto& [id, exp] : experiments_) {
+    if (exp.state == RunState::Completed || exp.state == RunState::Errored ||
+        exp.state == RunState::Canceled) {
+      continue;
+    }
+    method_for(exp);
+  }
+}
+
+void Master::append_jsonl(const std::string& file, const Json& record) {
+  std::ofstream out(config_.data_dir + "/" + file, std::ios::app);
+  out << record.dump() << "\n";
+}
+
+std::vector<Json> Master::read_jsonl(const std::string& file, size_t limit,
+                                     size_t offset) {
+  std::ifstream in(config_.data_dir + "/" + file);
+  std::vector<Json> out;
+  std::string line;
+  size_t index = 0;
+  while (std::getline(in, line)) {
+    if (line.empty()) continue;
+    if (index++ < offset) continue;
+    try {
+      out.push_back(Json::parse(line));
+    } catch (const std::exception&) {
+    }
+    if (out.size() >= limit) break;
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// orchestration
+// ---------------------------------------------------------------------------
+
+SearchMethodCpp* Master::method_for(Experiment& exp) {
+  auto it = methods_.find(exp.id);
+  if (it != methods_.end()) return it->second.get();
+  const Json& cfg = exp.config;
+  uint64_t seed = 0;
+  if (cfg["reproducibility"].is_object()) {
+    seed = static_cast<uint64_t>(
+        cfg["reproducibility"]["experiment_seed"].as_int(0));
+  }
+  auto method = build_search_method(cfg["searcher"], cfg["hyperparameters"],
+                                    seed + static_cast<uint64_t>(exp.id));
+  if (!exp.searcher_snapshot.is_null() && exp.searcher_snapshot.is_object() &&
+      exp.searcher_snapshot.size() > 0) {
+    method->restore(exp.searcher_snapshot);
+  }
+  auto* raw = method.get();
+  methods_[exp.id] = std::move(method);
+  return raw;
+}
+
+void Master::apply_search_ops(Experiment& exp, std::vector<SearchOp> ops) {
+  auto* method = method_for(exp);
+  // breadth-first processing to keep create/created ordering (adaptive asha
+  // routes by FIFO)
+  std::vector<SearchOp> queue = std::move(ops);
+  size_t head = 0;
+  while (head < queue.size()) {
+    SearchOp op = queue[head++];
+    switch (op.kind) {
+      case SearchOp::Kind::Create: {
+        int64_t rid = op.request_id >= 0 ? op.request_id
+                                         : exp.next_request_id;
+        exp.next_request_id = std::max(exp.next_request_id, rid + 1);
+        Trial trial;
+        trial.id = next_trial_id_++;
+        trial.experiment_id = exp.id;
+        trial.request_id = rid;
+        trial.hparams = op.hparams;
+        trial.created_at = now_sec();
+        trials_[trial.id] = trial;
+        request_to_trial_[exp.id][rid] = trial.id;
+        auto more = method->on_trial_created(rid);
+        queue.insert(queue.end(), more.begin(), more.end());
+        break;
+      }
+      case SearchOp::Kind::ValidateAfter: {
+        auto tit = request_to_trial_[exp.id].find(op.request_id);
+        if (tit == request_to_trial_[exp.id].end()) break;
+        Trial& trial = trials_[tit->second];
+        if (trial.state == RunState::Completed ||
+            trial.state == RunState::Errored) {
+          break;
+        }
+        trial.target_units = op.units;
+        queue_trial_leg(trial);
+        break;
+      }
+      case SearchOp::Kind::Close: {
+        auto tit = request_to_trial_[exp.id].find(op.request_id);
+        if (tit == request_to_trial_[exp.id].end()) break;
+        Trial& trial = trials_[tit->second];
+        if (trial.state != RunState::Errored) {
+          trial.state = RunState::Completed;
+          trial.ended_at = now_sec();
+        }
+        break;
+      }
+      case SearchOp::Kind::Shutdown: {
+        finish_experiment(exp,
+                          op.failure ? RunState::Errored : RunState::Completed);
+        break;
+      }
+    }
+  }
+  exp.searcher_snapshot = method->snapshot();
+  dirty_ = true;
+}
+
+void Master::queue_trial_leg(Trial& trial) {
+  // one live allocation per trial
+  for (const auto& [id, a] : allocations_) {
+    if (a.trial_id == trial.id && a.state != RunState::Completed &&
+        a.state != RunState::Errored && a.state != RunState::Canceled) {
+      return;  // already queued/running; harness picks up the new target
+    }
+  }
+  const Experiment& exp = experiments_[trial.experiment_id];
+  const Json& resources = exp.config["resources"];
+  Allocation alloc;
+  alloc.id = "trial-" + std::to_string(trial.id) + "." +
+             std::to_string(trial.restarts);
+  alloc.trial_id = trial.id;
+  alloc.task_type = "trial";
+  alloc.state = RunState::Queued;
+  alloc.slots = static_cast<int>(resources["slots_per_trial"].as_int(1));
+  alloc.priority = static_cast<int>(resources["priority"].as_int(42));
+  alloc.resource_pool = resources["resource_pool"].as_string().empty()
+                            ? "default"
+                            : resources["resource_pool"].as_string();
+  alloc.topology = resources["topology"].as_string();
+  alloc.queued_at = now_sec();
+  alloc.spec.set("entrypoint", exp.config["entrypoint"]);
+  alloc.spec.set("experiment_id", trial.experiment_id);
+  alloc.spec.set("trial_id", trial.id);
+  allocations_[alloc.id] = alloc;
+  trial.state = RunState::Queued;
+  dirty_ = true;
+}
+
+void Master::finish_experiment(Experiment& exp, RunState state,
+                               const std::string& error) {
+  exp.state = state;
+  exp.ended_at = now_sec();
+  exp.error = error;
+  // cancel queued allocations of this experiment's trials
+  for (auto& [id, alloc] : allocations_) {
+    if (alloc.trial_id == 0) continue;
+    auto tit = trials_.find(alloc.trial_id);
+    if (tit == trials_.end() || tit->second.experiment_id != exp.id) continue;
+    if (alloc.state == RunState::Queued) alloc.state = RunState::Canceled;
+    if (alloc.state == RunState::Running) alloc.preempt_requested = true;
+  }
+  dirty_ = true;
+}
+
+void Master::on_task_done(const std::string& alloc_id, int exit_code,
+                          const std::string& error) {
+  auto ait = allocations_.find(alloc_id);
+  if (ait == allocations_.end()) return;
+  Allocation& alloc = ait->second;
+  bool failed = exit_code != 0;
+  alloc.state = failed ? RunState::Errored : RunState::Completed;
+  dirty_ = true;
+  if (alloc.trial_id == 0) return;
+  auto tit = trials_.find(alloc.trial_id);
+  if (tit == trials_.end()) return;
+  Trial& trial = tit->second;
+  Experiment& exp = experiments_[trial.experiment_id];
+
+  if (trial.state == RunState::Completed || trial.state == RunState::Errored) {
+    return;
+  }
+  if (failed) {
+    // trial restart logic (≈ trial.go:531 handleAllocationExit)
+    const Json& cfg = exp.config;
+    int max_restarts = static_cast<int>(cfg["max_restarts"].as_int(5));
+    trial.restarts += 1;
+    if (trial.restarts <= max_restarts &&
+        exp.state == RunState::Running) {
+      queue_trial_leg(trial);  // resumes from latest_checkpoint
+    } else {
+      trial.state = RunState::Errored;
+      trial.ended_at = now_sec();
+      trial.error = error.empty() ? ("exit code " + std::to_string(exit_code))
+                                  : error;
+      if (exp.state == RunState::Running) {
+        apply_search_ops(
+            exp, method_for(exp)->on_trial_exited_early(trial.request_id));
+      }
+    }
+  } else {
+    // clean exit: if the searcher has no outstanding target the trial pauses
+    if (trial.units_done >= trial.target_units &&
+        trial.state != RunState::Completed) {
+      trial.state = RunState::Paused;
+    }
+  }
+}
+
+void Master::tick_locked() {
+  double now = now_sec();
+
+  // agent liveness: reconnect-with-amnesia (≈ agent.go:330): a timed-out
+  // agent's reservations are released and its allocations requeued
+  for (auto& [aid, agent] : agents_) {
+    if (!agent.enabled) continue;
+    if (agent.last_heartbeat > 0 &&
+        now - agent.last_heartbeat > config_.agent_timeout_sec) {
+      agent.enabled = false;
+      for (auto& [id, alloc] : allocations_) {
+        if (alloc.reservations.count(aid) &&
+            (alloc.state == RunState::Running ||
+             alloc.state == RunState::Pulling)) {
+          alloc.state = RunState::Queued;
+          alloc.reservations.clear();
+          alloc.rendezvous.clear();
+          if (alloc.trial_id) {
+            auto tit = trials_.find(alloc.trial_id);
+            if (tit != trials_.end()) tit->second.state = RunState::Queued;
+          }
+        }
+      }
+      dirty_ = true;
+    }
+  }
+
+  // group by pool and schedule (≈ resource_pool.go:360 schedulerTick)
+  std::map<std::string, std::vector<Agent>> pool_agents;
+  for (const auto& [aid, agent] : agents_) {
+    if (agent.enabled) pool_agents[agent.resource_pool].push_back(agent);
+  }
+  std::map<std::string, std::map<std::string, int>> pool_free;
+  for (const auto& [pool, agents] : pool_agents) {
+    for (const auto& a : agents) pool_free[pool][a.id] = a.slots;
+  }
+  std::map<std::string, std::vector<Allocation>> pool_pending, pool_running;
+  std::map<std::string, int> share_usage;
+  std::map<std::string, std::string> owner_of;
+  for (const auto& [id, alloc] : allocations_) {
+    std::string owner = alloc.task_type;
+    if (alloc.trial_id) {
+      auto tit = trials_.find(alloc.trial_id);
+      if (tit != trials_.end()) {
+        owner = "exp-" + std::to_string(tit->second.experiment_id);
+      }
+    }
+    owner_of[id] = owner;
+    if (alloc.state == RunState::Queued) {
+      pool_pending[alloc.resource_pool].push_back(alloc);
+    } else if (alloc.state == RunState::Running ||
+               alloc.state == RunState::Pulling) {
+      pool_running[alloc.resource_pool].push_back(alloc);
+      share_usage[owner] += alloc.slots;
+      for (const auto& [aid, n] : alloc.reservations) {
+        pool_free[alloc.resource_pool][aid] -= n;
+      }
+    }
+  }
+
+  for (auto& [pool, pending] : pool_pending) {
+    auto decision = schedule_pool(
+        config_.default_pool, pool_agents[pool], pool_free[pool], pending,
+        pool_running[pool], share_usage, owner_of);
+    for (const auto& [alloc_id, fit] : decision.assignments) {
+      // reservation only; start commands are derived from state at each
+      // heartbeat (idempotent re-send — a lost response cannot strand the
+      // allocation in Pulling)
+      Allocation& alloc = allocations_[alloc_id];
+      alloc.reservations = fit;
+      alloc.state = RunState::Pulling;
+      alloc.world_size = static_cast<int>(fit.size());
+      if (alloc.trial_id) {
+        auto tit = trials_.find(alloc.trial_id);
+        if (tit != trials_.end()) tit->second.state = RunState::Pulling;
+      }
+      dirty_ = true;
+    }
+    for (const auto& victim : decision.preemptions) {
+      Allocation& alloc = allocations_[victim];
+      if (!alloc.preempt_requested) {
+        alloc.preempt_requested = true;
+        dirty_ = true;
+      }
+    }
+  }
+}
+
+Json Master::allocation_start_command(const Allocation& alloc,
+                                      const std::string& agent_id) {
+  Json cmd = Json::object();
+  cmd.set("type", "start");
+  cmd.set("allocation_id", alloc.id);
+  cmd.set("task_type", alloc.task_type);
+  cmd.set("slots", alloc.reservations.count(agent_id)
+                       ? alloc.reservations.at(agent_id) : 0);
+  cmd.set("world_size", alloc.world_size);
+  cmd.set("spec", alloc.spec);
+  if (alloc.trial_id) {
+    auto tit = trials_.find(alloc.trial_id);
+    if (tit != trials_.end()) {
+      const Trial& t = tit->second;
+      Json trial = Json::object();
+      trial.set("id", t.id).set("experiment_id", t.experiment_id)
+          .set("hparams", t.hparams).set("target_units", t.target_units)
+          .set("latest_checkpoint", t.latest_checkpoint);
+      cmd.set("trial", trial);
+      cmd.set("config", experiments_[t.experiment_id].config);
+    }
+  }
+  return cmd;
+}
+
+}  // namespace dct

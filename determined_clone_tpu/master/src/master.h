@@ -1,0 +1,91 @@
+// The master: API server + experiment orchestration + scheduling + registry.
+//
+// C++ equivalent of the reference control plane (master/internal/core.go:879
+// Master.Run): REST API (≈ the grpc-gateway surface), experiment → searcher →
+// trial → allocation orchestration (experiment.go, trial.go, task/), gang
+// scheduler over agents (rm/agentrm), persistence via atomic JSON snapshot +
+// per-trial JSONL metric/log files (in place of Postgres).
+#pragma once
+
+#include <atomic>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "http.h"
+#include "json.h"
+#include "model.h"
+#include "scheduler.h"
+#include "searcher.h"
+
+namespace dct {
+
+struct MasterConfig {
+  int port = 8080;
+  std::string data_dir = "master_data";
+  PoolPolicy default_pool;
+  double agent_timeout_sec = 60;   // heartbeat "amnesia" window
+  double tick_interval_sec = 0.5;  // ≈ resource_pool.go:62 schedulerTick
+};
+
+class Master {
+ public:
+  explicit Master(MasterConfig config);
+  ~Master();
+
+  void start();           // boot: restore snapshot, start HTTP + tick loop
+  void stop();
+  int port() const { return server_->port(); }
+
+  // exposed for unit tests
+  HttpResponse handle(const HttpRequest& req);
+
+ private:
+  // -- orchestration (holding lock) --
+  void apply_search_ops(Experiment& exp, std::vector<SearchOp> ops);
+  SearchMethodCpp* method_for(Experiment& exp);
+  void queue_trial_leg(Trial& trial);
+  void finish_experiment(Experiment& exp, RunState state,
+                         const std::string& error = "");
+  void on_task_done(const std::string& alloc_id, int exit_code,
+                    const std::string& error);
+  void tick_locked();
+  Json allocation_start_command(const Allocation& alloc,
+                                const std::string& agent_id);
+
+  // -- persistence --
+  void save_snapshot_locked();
+  void load_snapshot();
+  void append_jsonl(const std::string& file, const Json& record);
+  std::vector<Json> read_jsonl(const std::string& file, size_t limit,
+                               size_t offset = 0);
+
+  // -- routes --
+  HttpResponse route(const HttpRequest& req);
+
+  MasterConfig config_;
+  std::unique_ptr<HttpServer> server_;
+  std::thread tick_thread_;
+  std::atomic<bool> running_{false};
+
+  std::mutex mu_;
+  int64_t next_experiment_id_ = 1;
+  int64_t next_trial_id_ = 1;
+  std::map<int64_t, Experiment> experiments_;
+  std::map<int64_t, Trial> trials_;
+  std::map<std::string, Allocation> allocations_;
+  std::map<std::string, Agent> agents_;
+  std::vector<CheckpointRecord> checkpoints_;
+  // live searcher methods (rebuilt from snapshots on restore)
+  std::map<int64_t, std::unique_ptr<SearchMethodCpp>> methods_;
+  // experiment request_id -> global trial id
+  std::map<int64_t, std::map<int64_t, int64_t>> request_to_trial_;
+  bool dirty_ = false;
+};
+
+double now_sec();
+
+}  // namespace dct

@@ -1,0 +1,275 @@
+// Domain model for the master: experiments, trials, agents, allocations.
+//
+// ≈ the reference's DB row structs + in-memory actors (master/pkg/model,
+// master/internal/experiment.go:59, trial.go:61, task/allocation.go:96) —
+// collapsed into plain structs with JSON (de)serialization; the Store
+// persists them via WAL + snapshot instead of Postgres.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <optional>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "json.h"
+
+namespace dct {
+
+// -- lifecycle states (≈ determined experiment/trial/allocation states) -----
+
+enum class RunState {
+  Queued, Pulling, Running, Paused, Completed, Errored, Canceled,
+};
+
+inline const char* to_string(RunState s) {
+  switch (s) {
+    case RunState::Queued: return "QUEUED";
+    case RunState::Pulling: return "PULLING";
+    case RunState::Running: return "RUNNING";
+    case RunState::Paused: return "PAUSED";
+    case RunState::Completed: return "COMPLETED";
+    case RunState::Errored: return "ERRORED";
+    case RunState::Canceled: return "CANCELED";
+  }
+  return "UNKNOWN";
+}
+
+inline RunState run_state_from(const std::string& s) {
+  if (s == "QUEUED") return RunState::Queued;
+  if (s == "PULLING") return RunState::Pulling;
+  if (s == "RUNNING") return RunState::Running;
+  if (s == "PAUSED") return RunState::Paused;
+  if (s == "COMPLETED") return RunState::Completed;
+  if (s == "ERRORED") return RunState::Errored;
+  if (s == "CANCELED") return RunState::Canceled;
+  return RunState::Queued;
+}
+
+struct Experiment {
+  int64_t id = 0;
+  std::string name;
+  Json config;             // full experiment config (validated client-side too)
+  RunState state = RunState::Queued;
+  int64_t next_request_id = 0;  // searcher request ids
+  Json searcher_snapshot;       // crash-consistent searcher state
+  std::string owner = "admin";
+  std::string workspace = "Uncategorized";
+  std::string project = "Uncategorized";
+  double created_at = 0;
+  double ended_at = 0;
+  std::string error;
+
+  Json to_json() const {
+    Json j = Json::object();
+    j.set("id", id).set("name", name).set("config", config)
+        .set("state", to_string(state))
+        .set("next_request_id", next_request_id)
+        .set("searcher_snapshot", searcher_snapshot)
+        .set("owner", owner).set("workspace", workspace)
+        .set("project", project).set("created_at", created_at)
+        .set("ended_at", ended_at).set("error", error);
+    return j;
+  }
+  static Experiment from_json(const Json& j) {
+    Experiment e;
+    e.id = j["id"].as_int();
+    e.name = j["name"].as_string();
+    e.config = j["config"];
+    e.state = run_state_from(j["state"].as_string());
+    e.next_request_id = j["next_request_id"].as_int();
+    e.searcher_snapshot = j["searcher_snapshot"];
+    e.owner = j["owner"].as_string();
+    e.workspace = j["workspace"].as_string();
+    e.project = j["project"].as_string();
+    e.created_at = j["created_at"].as_number();
+    e.ended_at = j["ended_at"].as_number();
+    e.error = j["error"].as_string();
+    return e;
+  }
+};
+
+struct Trial {
+  int64_t id = 0;            // global trial id
+  int64_t experiment_id = 0;
+  int64_t request_id = 0;    // searcher request id within the experiment
+  Json hparams;
+  RunState state = RunState::Queued;
+  int64_t target_units = 0;   // current cumulative searcher target
+  int64_t units_done = 0;
+  int restarts = 0;
+  std::string latest_checkpoint;
+  double best_metric = 0;
+  bool has_metric = false;
+  double created_at = 0;
+  double ended_at = 0;
+  std::string error;
+
+  Json to_json() const {
+    Json j = Json::object();
+    j.set("id", id).set("experiment_id", experiment_id)
+        .set("request_id", request_id).set("hparams", hparams)
+        .set("state", to_string(state))
+        .set("target_units", target_units).set("units_done", units_done)
+        .set("restarts", restarts)
+        .set("latest_checkpoint", latest_checkpoint)
+        .set("best_metric", best_metric).set("has_metric", has_metric)
+        .set("created_at", created_at).set("ended_at", ended_at)
+        .set("error", error);
+    return j;
+  }
+  static Trial from_json(const Json& j) {
+    Trial t;
+    t.id = j["id"].as_int();
+    t.experiment_id = j["experiment_id"].as_int();
+    t.request_id = j["request_id"].as_int();
+    t.hparams = j["hparams"];
+    t.state = run_state_from(j["state"].as_string());
+    t.target_units = j["target_units"].as_int();
+    t.units_done = j["units_done"].as_int();
+    t.restarts = static_cast<int>(j["restarts"].as_int());
+    t.latest_checkpoint = j["latest_checkpoint"].as_string();
+    t.best_metric = j["best_metric"].as_number();
+    t.has_metric = j["has_metric"].as_bool();
+    t.created_at = j["created_at"].as_number();
+    t.ended_at = j["ended_at"].as_number();
+    t.error = j["error"].as_string();
+    return t;
+  }
+};
+
+// A TPU-VM node daemon's registration. Slots are chips; topology names the
+// slice shape (e.g. "v5e-8") — the scheduler treats same-topology slots on
+// one agent as ICI-contiguous (replaces the reference's flat GPU slot model,
+// agent/internal/detect/detect.go).
+struct Agent {
+  std::string id;
+  std::string resource_pool = "default";
+  int slots = 0;
+  std::string topology;      // e.g. "v5e-8", "cpu"
+  std::string address;       // host:port the harness can reach
+  double last_heartbeat = 0;
+  bool enabled = true;
+  std::set<std::string> blocked_by;  // experiment ids that blocklisted this node
+
+  Json to_json() const {
+    Json blocked = Json::array();
+    for (const auto& b : blocked_by) blocked.push_back(b);
+    Json j = Json::object();
+    j.set("id", id).set("resource_pool", resource_pool).set("slots", slots)
+        .set("topology", topology).set("address", address)
+        .set("last_heartbeat", last_heartbeat).set("enabled", enabled)
+        .set("blocked_by", blocked);
+    return j;
+  }
+  static Agent from_json(const Json& j) {
+    Agent a;
+    a.id = j["id"].as_string();
+    a.resource_pool = j["resource_pool"].as_string();
+    a.slots = static_cast<int>(j["slots"].as_int());
+    a.topology = j["topology"].as_string();
+    a.address = j["address"].as_string();
+    a.last_heartbeat = j["last_heartbeat"].as_number();
+    a.enabled = j["enabled"].as_bool(true);
+    for (const auto& b : j["blocked_by"].elements()) {
+      a.blocked_by.insert(b.as_string());
+    }
+    return a;
+  }
+};
+
+// One gang run of a trial leg (or an NTSC task): reserved slots on agents,
+// rendezvous, preemption flag. ≈ master/internal/task/allocation.go:96.
+struct Allocation {
+  std::string id;            // "trial-<id>.<attempt>" or "task-<uuid>"
+  int64_t trial_id = 0;      // 0 for non-trial tasks
+  std::string task_type = "trial";  // trial | command | notebook | tensorboard | shell
+  RunState state = RunState::Queued;
+  int slots = 0;
+  int priority = 42;
+  std::string resource_pool = "default";
+  std::string topology;      // requested slice shape ("" = any)
+  double queued_at = 0;
+  // agent_id -> slots reserved
+  std::map<std::string, int> reservations;
+  // rendezvous: rank -> address
+  std::map<int, std::string> rendezvous;
+  int world_size = 0;        // processes expected (num agents in gang)
+  bool preempt_requested = false;
+  Json spec;                 // what to run (entrypoint, env, ...)
+
+  bool scheduled() const { return !reservations.empty(); }
+
+  Json to_json() const {
+    Json res = Json::object();
+    for (const auto& [aid, n] : reservations) res.set(aid, n);
+    Json rdv = Json::object();
+    for (const auto& [rank, addr] : rendezvous) {
+      rdv.set(std::to_string(rank), addr);
+    }
+    Json j = Json::object();
+    j.set("id", id).set("trial_id", trial_id).set("task_type", task_type)
+        .set("state", to_string(state)).set("slots", slots)
+        .set("priority", priority).set("resource_pool", resource_pool)
+        .set("topology", topology).set("queued_at", queued_at)
+        .set("reservations", res).set("rendezvous", rdv)
+        .set("world_size", world_size)
+        .set("preempt_requested", preempt_requested).set("spec", spec);
+    return j;
+  }
+  static Allocation from_json(const Json& j) {
+    Allocation a;
+    a.id = j["id"].as_string();
+    a.trial_id = j["trial_id"].as_int();
+    a.task_type = j["task_type"].as_string();
+    a.state = run_state_from(j["state"].as_string());
+    a.slots = static_cast<int>(j["slots"].as_int());
+    a.priority = static_cast<int>(j["priority"].as_int());
+    a.resource_pool = j["resource_pool"].as_string();
+    a.topology = j["topology"].as_string();
+    a.queued_at = j["queued_at"].as_number();
+    for (const auto& [aid, n] : j["reservations"].items()) {
+      a.reservations[aid] = static_cast<int>(n.as_int());
+    }
+    for (const auto& [rank, addr] : j["rendezvous"].items()) {
+      a.rendezvous[std::stoi(rank)] = addr.as_string();
+    }
+    a.world_size = static_cast<int>(j["world_size"].as_int());
+    a.preempt_requested = j["preempt_requested"].as_bool();
+    a.spec = j["spec"];
+    return a;
+  }
+};
+
+struct CheckpointRecord {
+  std::string uuid;
+  int64_t trial_id = 0;
+  int64_t experiment_id = 0;
+  Json metadata;
+  Json resources;
+  double reported_at = 0;
+  bool deleted = false;
+
+  Json to_json() const {
+    Json j = Json::object();
+    j.set("uuid", uuid).set("trial_id", trial_id)
+        .set("experiment_id", experiment_id).set("metadata", metadata)
+        .set("resources", resources).set("reported_at", reported_at)
+        .set("deleted", deleted);
+    return j;
+  }
+  static CheckpointRecord from_json(const Json& j) {
+    CheckpointRecord c;
+    c.uuid = j["uuid"].as_string();
+    c.trial_id = j["trial_id"].as_int();
+    c.experiment_id = j["experiment_id"].as_int();
+    c.metadata = j["metadata"];
+    c.resources = j["resources"];
+    c.reported_at = j["reported_at"].as_number();
+    c.deleted = j["deleted"].as_bool();
+    return c;
+  }
+};
+
+}  // namespace dct

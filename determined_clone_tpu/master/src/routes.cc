@@ -1,0 +1,430 @@
+// REST API routes — the master's public surface.
+//
+// Covers the workhorse subset of the reference's 217-RPC service
+// (proto/src/determined/api/v1/api.proto:79): experiments, trials, metrics,
+// searcher ops, checkpoints, agents, allocations (rendezvous/preemption),
+// task logs, job queue, master info.
+#include <algorithm>
+#include <set>
+
+#include "master.h"
+
+namespace dct {
+namespace {
+
+Json error_json(const std::string& msg) {
+  Json j = Json::object();
+  j.set("error", msg);
+  return j;
+}
+
+HttpResponse ok_json(const Json& j) { return HttpResponse::json(200, j.dump()); }
+HttpResponse bad_request(const std::string& msg) {
+  return HttpResponse::json(400, error_json(msg).dump());
+}
+HttpResponse not_found(const std::string& msg) {
+  return HttpResponse::json(404, error_json(msg).dump());
+}
+
+}  // namespace
+
+HttpResponse Master::handle(const HttpRequest& req) {
+  try {
+    return route(req);
+  } catch (const std::exception& e) {
+    return HttpResponse::json(500, error_json(e.what()).dump());
+  }
+}
+
+HttpResponse Master::route(const HttpRequest& req) {
+  const auto& parts = req.path_parts;  // e.g. {"api","v1","experiments","3"}
+  if (parts.size() < 2 || parts[0] != "api" || parts[1] != "v1") {
+    return not_found("unknown path " + req.path);
+  }
+  std::lock_guard<std::mutex> lock(mu_);
+  const std::string& root = parts.size() > 2 ? parts[2] : "";
+
+  // ---- master info -------------------------------------------------------
+  if (root == "master" && req.method == "GET") {
+    Json j = Json::object();
+    j.set("version", "0.1.0").set("cluster_name", "dct")
+        .set("agents", static_cast<int64_t>(agents_.size()))
+        .set("experiments", static_cast<int64_t>(experiments_.size()));
+    return ok_json(j);
+  }
+
+  // ---- experiments -------------------------------------------------------
+  if (root == "experiments") {
+    if (parts.size() == 3 && req.method == "POST") {
+      Json body = Json::parse(req.body);
+      const Json& config = body["config"];
+      if (!config.is_object()) return bad_request("missing config object");
+      Experiment exp;
+      exp.id = next_experiment_id_++;
+      exp.name = config["name"].as_string().empty() ? "unnamed"
+                                                    : config["name"].as_string();
+      exp.config = config;
+      exp.state = RunState::Running;
+      exp.created_at = now_sec();
+      if (config["workspace"].is_string() && !config["workspace"].as_string().empty())
+        exp.workspace = config["workspace"].as_string();
+      if (config["project"].is_string() && !config["project"].as_string().empty())
+        exp.project = config["project"].as_string();
+      int64_t id = exp.id;
+      experiments_[id] = std::move(exp);
+      Experiment& stored = experiments_[id];
+      try {
+        apply_search_ops(stored, method_for(stored)->initial_operations());
+      } catch (const std::exception& e) {
+        experiments_.erase(id);
+        methods_.erase(id);
+        return bad_request(std::string("invalid experiment config: ") + e.what());
+      }
+      dirty_ = true;
+      Json j = Json::object();
+      j.set("experiment", experiments_[id].to_json());
+      return HttpResponse::json(201, j.dump());
+    }
+    if (parts.size() == 3 && req.method == "GET") {
+      Json arr = Json::array();
+      for (const auto& [id, e] : experiments_) arr.push_back(e.to_json());
+      Json j = Json::object();
+      j.set("experiments", arr);
+      return ok_json(j);
+    }
+    if (parts.size() >= 4) {
+      int64_t id = std::stoll(parts[3]);
+      auto it = experiments_.find(id);
+      if (it == experiments_.end()) return not_found("no experiment " + parts[3]);
+      Experiment& exp = it->second;
+      if (parts.size() == 4 && req.method == "GET") {
+        Json j = Json::object();
+        j.set("experiment", exp.to_json());
+        Json trials = Json::array();
+        for (const auto& [tid, t] : trials_) {
+          if (t.experiment_id == id) trials.push_back(t.to_json());
+        }
+        j.set("trials", trials);
+        auto mit = methods_.find(id);
+        if (mit != methods_.end()) j.set("progress", mit->second->progress());
+        return ok_json(j);
+      }
+      if (parts.size() == 5 && parts[4] == "kill" && req.method == "POST") {
+        if (exp.state == RunState::Running || exp.state == RunState::Queued) {
+          finish_experiment(exp, RunState::Canceled);
+        }
+        return ok_json(exp.to_json());
+      }
+      if (parts.size() == 5 && parts[4] == "checkpoints" && req.method == "GET") {
+        Json arr = Json::array();
+        for (const auto& c : checkpoints_) {
+          if (c.experiment_id == id && !c.deleted) arr.push_back(c.to_json());
+        }
+        Json j = Json::object();
+        j.set("checkpoints", arr);
+        return ok_json(j);
+      }
+    }
+  }
+
+  // ---- trials ------------------------------------------------------------
+  if (root == "trials" && parts.size() >= 4) {
+    int64_t id = std::stoll(parts[3]);
+    auto it = trials_.find(id);
+    if (it == trials_.end()) return not_found("no trial " + parts[3]);
+    Trial& trial = it->second;
+    Experiment& exp = experiments_[trial.experiment_id];
+
+    if (parts.size() == 4 && req.method == "GET") {
+      Json j = Json::object();
+      j.set("trial", trial.to_json());
+      return ok_json(j);
+    }
+    // report metrics (≈ ReportTrialMetrics api_trials.go:1330)
+    if (parts.size() == 5 && parts[4] == "metrics") {
+      if (req.method == "POST") {
+        Json body = Json::parse(req.body);
+        body.set("time", now_sec());
+        append_jsonl("trial-" + std::to_string(id) + "-metrics.jsonl", body);
+        if (body["group"].as_string() == "training" &&
+            body.has("steps_completed")) {
+          // monotonic: a restarted leg resuming from an older checkpoint
+          // must not move searcher progress backwards
+          trial.units_done =
+              std::max(trial.units_done, body["steps_completed"].as_int());
+          dirty_ = true;
+        }
+        return ok_json(Json::object());
+      }
+      if (req.method == "GET") {
+        size_t limit = 1000;
+        auto lim = req.query.find("limit");
+        if (lim != req.query.end()) limit = std::stoul(lim->second);
+        Json arr = Json::array();
+        for (auto& rec : read_jsonl(
+                 "trial-" + std::to_string(id) + "-metrics.jsonl", limit)) {
+          arr.push_back(rec);
+        }
+        Json j = Json::object();
+        j.set("metrics", arr);
+        return ok_json(j);
+      }
+    }
+    // searcher operation poll + completion (≈ SearcherContext +
+    // CompleteTrialSearcherValidation api_trials.go:1248)
+    if (parts.size() == 6 && parts[4] == "searcher") {
+      if (parts[5] == "operation" && req.method == "GET") {
+        Json j = Json::object();
+        bool closed = trial.state == RunState::Completed ||
+                      trial.state == RunState::Errored ||
+                      exp.state != RunState::Running;
+        j.set("closed", closed);
+        j.set("target_units", trial.target_units);
+        j.set("units_done", trial.units_done);
+        j.set("has_work", !closed && trial.units_done < trial.target_units);
+        return ok_json(j);
+      }
+      if (parts[5] == "completed_op" && req.method == "POST") {
+        Json body = Json::parse(req.body);
+        double metric = body["metric"].as_number();
+        int64_t units = body["units"].as_int(trial.target_units);
+        trial.units_done = std::max(trial.units_done, units);
+        bool smaller = true;
+        if (exp.config["searcher"].has("smaller_is_better")) {
+          smaller = exp.config["searcher"]["smaller_is_better"].as_bool(true);
+        }
+        if (!trial.has_metric ||
+            (smaller ? metric < trial.best_metric
+                     : metric > trial.best_metric)) {
+          trial.best_metric = metric;
+          trial.has_metric = true;
+        }
+        if (exp.state == RunState::Running) {
+          apply_search_ops(exp, method_for(exp)->on_validation_completed(
+                                    trial.request_id, metric, units));
+        }
+        Json j = Json::object();
+        j.set("trial", trial.to_json());
+        return ok_json(j);
+      }
+    }
+    // checkpoint report (≈ core/_checkpoint.py:687 chief report)
+    if (parts.size() == 5 && parts[4] == "checkpoints" && req.method == "POST") {
+      Json body = Json::parse(req.body);
+      CheckpointRecord rec;
+      rec.uuid = body["uuid"].as_string();
+      rec.trial_id = id;
+      rec.experiment_id = trial.experiment_id;
+      rec.metadata = body["metadata"];
+      rec.resources = body["resources"];
+      rec.reported_at = now_sec();
+      if (rec.uuid.empty()) return bad_request("checkpoint uuid required");
+      checkpoints_.push_back(rec);
+      trial.latest_checkpoint = rec.uuid;
+      dirty_ = true;
+      return ok_json(rec.to_json());
+    }
+  }
+
+  // ---- checkpoints -------------------------------------------------------
+  if (root == "checkpoints" && parts.size() == 4 && req.method == "GET") {
+    for (const auto& c : checkpoints_) {
+      if (c.uuid == parts[3] && !c.deleted) return ok_json(c.to_json());
+    }
+    return not_found("no checkpoint " + parts[3]);
+  }
+
+  // ---- agents ------------------------------------------------------------
+  if (root == "agents") {
+    if (parts.size() == 3 && req.method == "GET") {
+      Json arr = Json::array();
+      for (const auto& [id, a] : agents_) arr.push_back(a.to_json());
+      Json j = Json::object();
+      j.set("agents", arr);
+      return ok_json(j);
+    }
+    if (parts.size() == 4 && parts[3] == "register" && req.method == "POST") {
+      Json body = Json::parse(req.body);
+      const std::string& aid = body["id"].as_string();
+      if (aid.empty()) return bad_request("agent id required");
+      Agent& agent = agents_[aid];
+      bool reconnect = !agent.id.empty();
+      agent.id = aid;
+      agent.slots = static_cast<int>(body["slots"].as_int());
+      agent.topology = body["topology"].as_string();
+      agent.address = body["address"].as_string();
+      if (!body["resource_pool"].as_string().empty()) {
+        agent.resource_pool = body["resource_pool"].as_string();
+      }
+      agent.enabled = true;
+      agent.last_heartbeat = now_sec();
+      dirty_ = true;
+      Json j = Json::object();
+      j.set("agent", agent.to_json());
+      j.set("reconnect", reconnect);
+      return ok_json(j);
+    }
+    if (parts.size() == 5 && parts[4] == "heartbeat" && req.method == "POST") {
+      const std::string& aid = parts[3];
+      auto it = agents_.find(aid);
+      if (it == agents_.end()) return not_found("unregistered agent " + aid);
+      it->second.last_heartbeat = now_sec();
+      it->second.enabled = true;
+      Json body = req.body.empty() ? Json::object() : Json::parse(req.body);
+      std::set<std::string> reported;
+      for (const auto& r : body["running"].elements()) {
+        reported.insert(r.as_string());
+      }
+      // Commands are DERIVED from state each heartbeat (idempotent): a lost
+      // response re-sends on the next beat; duplicate starts are no-ops on
+      // the agent. This doubles as master-restart reattach (manager.go:76).
+      Json commands = Json::array();
+      for (auto& [alloc_id, alloc] : allocations_) {
+        bool mine = alloc.reservations.count(aid) > 0;
+        bool terminal = alloc.state == RunState::Completed ||
+                        alloc.state == RunState::Errored ||
+                        alloc.state == RunState::Canceled;
+        if (mine && alloc.state == RunState::Pulling &&
+            !reported.count(alloc_id)) {
+          Json cmd = allocation_start_command(alloc, aid);
+          int rank = 0;
+          for (const auto& [agent_id, n] : alloc.reservations) {
+            if (agent_id == aid) break;
+            ++rank;
+          }
+          cmd.set("rank", rank);
+          commands.push_back(cmd);
+        } else if (mine && alloc.state == RunState::Running &&
+                   alloc.preempt_requested && reported.count(alloc_id)) {
+          Json cmd = Json::object();
+          cmd.set("type", "preempt");
+          cmd.set("allocation_id", alloc_id);
+          commands.push_back(cmd);
+        } else if (!mine && reported.count(alloc_id) &&
+                   alloc.state == RunState::Queued &&
+                   alloc.reservations.empty()) {
+          // post-restart adoption: the agent still runs a task the restored
+          // master requeued — take it back instead of double-scheduling
+          alloc.reservations[aid] = alloc.slots;
+          alloc.state = RunState::Running;
+          if (alloc.world_size == 0) alloc.world_size = 1;
+          if (alloc.trial_id && trials_.count(alloc.trial_id)) {
+            trials_[alloc.trial_id].state = RunState::Running;
+          }
+          dirty_ = true;
+        } else if (reported.count(alloc_id) && terminal) {
+          Json cmd = Json::object();
+          cmd.set("type", "kill");
+          cmd.set("allocation_id", alloc_id);
+          commands.push_back(cmd);
+        }
+      }
+      // tasks the agent reports that the master has no record of: zombies
+      for (const auto& rid : reported) {
+        if (!allocations_.count(rid)) {
+          Json cmd = Json::object();
+          cmd.set("type", "kill");
+          cmd.set("allocation_id", rid);
+          commands.push_back(cmd);
+        }
+      }
+      Json j = Json::object();
+      j.set("commands", commands);
+      return ok_json(j);
+    }
+    if (parts.size() == 5 && parts[4] == "task_event" && req.method == "POST") {
+      Json body = Json::parse(req.body);
+      const std::string& alloc_id = body["allocation_id"].as_string();
+      const std::string& event = body["event"].as_string();
+      auto ait = allocations_.find(alloc_id);
+      if (ait == allocations_.end()) return not_found("no allocation " + alloc_id);
+      if (event == "running") {
+        ait->second.state = RunState::Running;
+        if (ait->second.trial_id) {
+          trials_[ait->second.trial_id].state = RunState::Running;
+        }
+        dirty_ = true;
+      } else if (event == "exited") {
+        on_task_done(alloc_id, static_cast<int>(body["exit_code"].as_int()),
+                     body["error"].as_string());
+      }
+      return ok_json(Json::object());
+    }
+  }
+
+  // ---- allocations: rendezvous / preemption / logs -----------------------
+  if (root == "allocations" && parts.size() >= 5) {
+    const std::string& alloc_id = parts[3];
+    auto it = allocations_.find(alloc_id);
+    if (it == allocations_.end()) return not_found("no allocation " + alloc_id);
+    Allocation& alloc = it->second;
+
+    // rendezvous (≈ task/rendezvous.go:94: all members register, then all
+    // receive the full member list; rank 0's host is the jax coordinator)
+    if (parts[4] == "rendezvous") {
+      if (req.method == "POST") {
+        Json body = Json::parse(req.body);
+        int rank = static_cast<int>(body["rank"].as_int());
+        alloc.rendezvous[rank] = body["address"].as_string();
+        dirty_ = true;
+      }
+      bool ready = static_cast<int>(alloc.rendezvous.size()) >=
+                   std::max(1, alloc.world_size);
+      Json members = Json::array();
+      for (const auto& [rank, addr] : alloc.rendezvous) members.push_back(addr);
+      Json j = Json::object();
+      j.set("ready", ready).set("members", members)
+          .set("world_size", alloc.world_size);
+      return ok_json(j);
+    }
+    if (parts[4] == "preempt" && req.method == "GET") {
+      Json j = Json::object();
+      j.set("preempt", alloc.preempt_requested);
+      return ok_json(j);
+    }
+    if (parts[4] == "logs") {
+      if (req.method == "POST") {
+        // batched task logs (≈ postTaskLogs core.go:863 → tasklogger)
+        Json body = Json::parse(req.body);
+        for (const auto& line : body["logs"].elements()) {
+          Json rec = Json::object();
+          rec.set("allocation_id", alloc_id).set("time", now_sec())
+              .set("log", line);
+          append_jsonl("task-" + alloc_id + "-logs.jsonl", rec);
+        }
+        return ok_json(Json::object());
+      }
+      if (req.method == "GET") {
+        size_t limit = 1000;
+        auto lim = req.query.find("limit");
+        if (lim != req.query.end()) limit = std::stoul(lim->second);
+        Json arr = Json::array();
+        for (auto& rec : read_jsonl("task-" + alloc_id + "-logs.jsonl", limit)) {
+          arr.push_back(rec);
+        }
+        Json j = Json::object();
+        j.set("logs", arr);
+        return ok_json(j);
+      }
+    }
+  }
+
+  // ---- job queue (≈ jobservice) ------------------------------------------
+  if (root == "job-queue" && req.method == "GET") {
+    Json arr = Json::array();
+    for (const auto& [id, alloc] : allocations_) {
+      if (alloc.state == RunState::Queued || alloc.state == RunState::Pulling ||
+          alloc.state == RunState::Running) {
+        Json j = alloc.to_json();
+        arr.push_back(j);
+      }
+    }
+    Json j = Json::object();
+    j.set("queue", arr);
+    return ok_json(j);
+  }
+
+  return not_found("unknown route " + req.method + " " + req.path);
+}
+
+}  // namespace dct

@@ -1,0 +1,54 @@
+// Gang scheduler over TPU agents.
+//
+// ≈ the reference agentrm (master/internal/rm/agentrm): resource pools with
+// pluggable policies — fifo, priority (with preemption), fair-share — and
+// all-or-nothing gang fitting. The fitting is slice-topology-aware where the
+// reference's is count-based (fitting.go:71): a gang either takes whole
+// agents (each agent's chips are one ICI domain) or a chip subset of a
+// single agent; it never splits across partial agents, because cross-agent
+// partial gangs would put gradient collectives on DCN between arbitrary
+// chip subsets.
+#pragma once
+
+#include <map>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "model.h"
+
+namespace dct {
+
+struct SchedulerDecision {
+  // allocation id -> (agent id -> slots)
+  std::map<std::string, std::map<std::string, int>> assignments;
+  // allocation ids to preempt (priority policy)
+  std::vector<std::string> preemptions;
+};
+
+struct PoolPolicy {
+  std::string type = "priority";  // fifo | priority | fair_share
+  bool preemption_enabled = true;
+};
+
+// agents: all agents of the pool (enabled, with free slot counts precomputed
+// by the caller from running reservations).
+// pending: allocations waiting, running: allocations holding reservations.
+// share_usage: owner key (experiment id / task type) -> slots currently held
+// (fair-share input).
+SchedulerDecision schedule_pool(
+    const PoolPolicy& policy,
+    const std::vector<Agent>& agents,
+    std::map<std::string, int> free_slots,  // agent id -> free chips
+    std::vector<Allocation> pending,        // copy: gets sorted
+    const std::vector<Allocation>& running,
+    const std::map<std::string, int>& share_usage,
+    const std::map<std::string, std::string>& owner_of_alloc);
+
+// Gang fit for one allocation. Returns agent->slots or nullopt.
+std::optional<std::map<std::string, int>> find_fit(
+    const Allocation& alloc, const std::vector<Agent>& agents,
+    const std::map<std::string, int>& free_slots,
+    const std::string& experiment_key);
+
+}  // namespace dct

@@ -1,0 +1,688 @@
+#include "searcher.h"
+
+#include <algorithm>
+#include <cmath>
+#include <deque>
+#include <set>
+
+namespace dct {
+namespace {
+
+bool is_hp_leaf(const Json& node) {
+  if (!node.is_object()) return true;
+  const std::string& t = node["type"].as_string();
+  return t == "const" || t == "int" || t == "double" || t == "log" ||
+         t == "categorical";
+}
+
+Json sample_leaf(const Json& hp, std::mt19937_64& rng) {
+  if (!hp.is_object()) return hp;
+  const std::string& t = hp["type"].as_string();
+  if (t == "const") return hp["val"];
+  if (t == "int") {
+    int64_t lo = hp["minval"].as_int(), hi = hp["maxval"].as_int();
+    std::uniform_int_distribution<int64_t> d(lo, hi);
+    return Json(d(rng));
+  }
+  if (t == "double") {
+    std::uniform_real_distribution<double> d(hp["minval"].as_number(),
+                                             hp["maxval"].as_number());
+    return Json(d(rng));
+  }
+  if (t == "log") {
+    double base = hp.has("base") ? hp["base"].as_number() : 10.0;
+    std::uniform_real_distribution<double> d(hp["minval"].as_number(),
+                                             hp["maxval"].as_number());
+    return Json(std::pow(base, d(rng)));
+  }
+  if (t == "categorical") {
+    const auto& vals = hp["vals"].elements();
+    if (vals.empty()) return Json();
+    std::uniform_int_distribution<size_t> d(0, vals.size() - 1);
+    return vals[d(rng)];
+  }
+  return hp;  // unknown dict → const
+}
+
+std::vector<Json> grid_leaf(const Json& hp) {
+  if (!hp.is_object()) return {hp};
+  const std::string& t = hp["type"].as_string();
+  if (t == "const") return {hp["val"]};
+  if (t == "categorical") {
+    return {hp["vals"].elements().begin(), hp["vals"].elements().end()};
+  }
+  if (t == "int") {
+    int64_t lo = hp["minval"].as_int(), hi = hp["maxval"].as_int();
+    int64_t count = hp.has("count") ? hp["count"].as_int() : (hi - lo + 1);
+    count = std::min(count, hi - lo + 1);
+    std::vector<Json> out;
+    if (count <= 1) return {Json(lo)};
+    for (int64_t i = 0; i < count; ++i) {
+      double v = lo + static_cast<double>(i) * (hi - lo) / (count - 1);
+      out.push_back(Json(static_cast<int64_t>(std::llround(v))));
+    }
+    return out;
+  }
+  if (t == "double" || t == "log") {
+    if (!hp.has("count")) {
+      throw std::runtime_error(t + " hyperparameter needs `count` for grid");
+    }
+    int64_t count = hp["count"].as_int();
+    double lo = hp["minval"].as_number(), hi = hp["maxval"].as_number();
+    double base = hp.has("base") ? hp["base"].as_number() : 10.0;
+    std::vector<Json> out;
+    for (int64_t i = 0; i < count; ++i) {
+      double v = count == 1 ? lo : lo + i * (hi - lo) / (count - 1);
+      out.push_back(Json(t == "log" ? std::pow(base, v) : v));
+    }
+    return out;
+  }
+  return {hp};
+}
+
+void grid_walk(const Json& space, std::vector<std::pair<std::string, std::vector<Json>>>& axes,
+               const std::string& prefix) {
+  for (const auto& [key, node] : space.items()) {
+    std::string path = prefix.empty() ? key : prefix + "\x1f" + key;
+    if (node.is_object() && !is_hp_leaf(node)) {
+      grid_walk(node, axes, path);
+    } else {
+      axes.emplace_back(path, grid_leaf(node));
+    }
+  }
+}
+
+void set_nested(Json& obj, const std::string& path, const Json& value) {
+  size_t sep = path.find('\x1f');
+  if (sep == std::string::npos) {
+    obj.set(path, value);
+    return;
+  }
+  std::string head = path.substr(0, sep);
+  Json child = obj.has(head) ? obj[head] : Json::object();
+  set_nested(child, path.substr(sep + 1), value);
+  obj.set(head, child);
+}
+
+}  // namespace
+
+Json sample_hparams(const Json& space, std::mt19937_64& rng) {
+  if (!space.is_object()) return Json::object();
+  Json out = Json::object();
+  for (const auto& [key, node] : space.items()) {
+    if (node.is_object() && !is_hp_leaf(node)) {
+      out.set(key, sample_hparams(node, rng));
+    } else {
+      out.set(key, sample_leaf(node, rng));
+    }
+  }
+  return out;
+}
+
+std::vector<Json> grid_hparams(const Json& space) {
+  std::vector<std::pair<std::string, std::vector<Json>>> axes;
+  if (space.is_object()) grid_walk(space, axes, "");
+  std::vector<Json> points;
+  size_t total = 1;
+  for (auto& [_, vals] : axes) total *= std::max<size_t>(1, vals.size());
+  std::vector<size_t> idx(axes.size(), 0);
+  for (size_t n = 0; n < total; ++n) {
+    Json point = Json::object();
+    for (size_t i = 0; i < axes.size(); ++i) {
+      if (!axes[i].second.empty()) {
+        set_nested(point, axes[i].first, axes[i].second[idx[i]]);
+      }
+    }
+    points.push_back(point);
+    for (size_t i = axes.size(); i-- > 0;) {
+      if (++idx[i] < axes[i].second.size()) break;
+      idx[i] = 0;
+    }
+  }
+  return points;
+}
+
+namespace {
+
+int64_t config_max_units(const Json& cfg) {
+  const Json& ml = cfg["max_length"];
+  if (ml.is_number()) return ml.as_int();
+  if (ml.is_object()) {
+    for (const char* unit : {"batches", "records", "epochs"}) {
+      if (ml.has(unit)) return ml[unit].as_int();
+    }
+  }
+  if (cfg.has("max_time")) return cfg["max_time"].as_int();
+  throw std::runtime_error("searcher requires max_length (or max_time)");
+}
+
+// ---------------------------------------------------------------------------
+
+class SingleSearchCpp : public SearchMethodCpp {
+ public:
+  SingleSearchCpp(const Json& cfg, Json space, uint64_t seed)
+      : space_(std::move(space)), rng_(seed), max_units_(config_max_units(cfg)) {}
+
+  std::vector<SearchOp> initial_operations() override {
+    return {SearchOp::create(sample_hparams(space_, rng_))};
+  }
+  std::vector<SearchOp> on_trial_created(int64_t rid) override {
+    return {SearchOp::validate_after(rid, max_units_)};
+  }
+  std::vector<SearchOp> on_validation_completed(int64_t rid, double,
+                                                int64_t) override {
+    done_ = true;
+    return {SearchOp::close(rid), SearchOp::shutdown()};
+  }
+  std::vector<SearchOp> on_trial_exited_early(int64_t) override {
+    done_ = true;
+    return {SearchOp::shutdown(true)};
+  }
+  double progress() const override { return done_ ? 1.0 : 0.0; }
+  Json snapshot() const override {
+    Json j = Json::object();
+    j.set("done", done_);
+    return j;
+  }
+  void restore(const Json& snap) override { done_ = snap["done"].as_bool(); }
+
+ private:
+  Json space_;
+  std::mt19937_64 rng_;
+  int64_t max_units_;
+  bool done_ = false;
+};
+
+// ---------------------------------------------------------------------------
+
+class RandomSearchCpp : public SearchMethodCpp {
+ public:
+  RandomSearchCpp(const Json& cfg, Json space, uint64_t seed, bool grid)
+      : space_(std::move(space)), rng_(seed),
+        max_units_(config_max_units(cfg)) {
+    if (grid) {
+      points_ = grid_hparams(space_);
+      int64_t cap = cfg["max_trials"].as_int(0);
+      if (cap > 1 && static_cast<int64_t>(points_.size()) > cap) {
+        points_.resize(cap);
+      }
+      max_trials_ = static_cast<int64_t>(points_.size());
+    } else {
+      max_trials_ = std::max<int64_t>(1, cfg["max_trials"].as_int(1));
+    }
+    max_concurrent_ = cfg["max_concurrent_trials"].as_int(16);
+    if (max_concurrent_ <= 0) max_concurrent_ = max_trials_;
+    max_concurrent_ = std::min(max_concurrent_, max_trials_);
+  }
+
+  std::vector<SearchOp> initial_operations() override {
+    std::vector<SearchOp> ops;
+    for (int64_t i = 0; i < max_concurrent_; ++i) ops.push_back(next_create());
+    return ops;
+  }
+  std::vector<SearchOp> on_trial_created(int64_t rid) override {
+    return {SearchOp::validate_after(rid, max_units_)};
+  }
+  std::vector<SearchOp> on_validation_completed(int64_t rid, double,
+                                                int64_t) override {
+    ++completed_;
+    std::vector<SearchOp> ops{SearchOp::close(rid)};
+    refill(ops);
+    return ops;
+  }
+  std::vector<SearchOp> on_trial_exited_early(int64_t) override {
+    ++completed_;
+    std::vector<SearchOp> ops;
+    refill(ops);
+    return ops;
+  }
+  double progress() const override {
+    return static_cast<double>(completed_) / std::max<int64_t>(1, max_trials_);
+  }
+  Json snapshot() const override {
+    Json j = Json::object();
+    j.set("created", created_).set("completed", completed_);
+    return j;
+  }
+  void restore(const Json& snap) override {
+    created_ = snap["created"].as_int();
+    completed_ = snap["completed"].as_int();
+  }
+
+ private:
+  SearchOp next_create() {
+    Json hp = points_.empty()
+                  ? sample_hparams(space_, rng_)
+                  : points_[static_cast<size_t>(created_) % points_.size()];
+    ++created_;
+    return SearchOp::create(std::move(hp));
+  }
+  void refill(std::vector<SearchOp>& ops) {
+    if (created_ < max_trials_) {
+      ops.push_back(next_create());
+    } else if (completed_ >= max_trials_) {
+      ops.push_back(SearchOp::shutdown());
+    }
+  }
+
+  Json space_;
+  std::mt19937_64 rng_;
+  int64_t max_units_;
+  int64_t max_trials_ = 1;
+  int64_t max_concurrent_ = 16;
+  int64_t created_ = 0;
+  int64_t completed_ = 0;
+  std::vector<Json> points_;  // grid mode
+};
+
+// ---------------------------------------------------------------------------
+
+class AshaSearchCpp : public SearchMethodCpp {
+ public:
+  AshaSearchCpp(const Json& cfg, Json space, uint64_t seed,
+                std::optional<int> num_rungs_override = std::nullopt,
+                std::optional<int64_t> max_trials_override = std::nullopt,
+                std::optional<int64_t> max_concurrent_override = std::nullopt)
+      : space_(std::move(space)), rng_(seed) {
+    max_units_ = config_max_units(cfg);
+    divisor_ = std::max<int64_t>(2, cfg["divisor"].as_int(4));
+    num_rungs_ = num_rungs_override.value_or(
+        static_cast<int>(cfg["num_rungs"].as_int(5)));
+    max_trials_ = max_trials_override.value_or(
+        std::max<int64_t>(1, cfg["max_trials"].as_int(1)));
+    max_concurrent_ = max_concurrent_override.value_or(
+        cfg["max_concurrent_trials"].as_int(16));
+    max_concurrent_ = std::max<int64_t>(
+        1, std::min(max_concurrent_, max_trials_));
+    smaller_is_better_ = cfg.has("smaller_is_better")
+                             ? cfg["smaller_is_better"].as_bool(true)
+                             : true;
+    stop_once_ = cfg["stop_once"].as_bool(false);
+
+    rung_targets_.resize(num_rungs_);
+    for (int r = 0; r < num_rungs_; ++r) {
+      double denom = std::pow(static_cast<double>(divisor_),
+                              num_rungs_ - 1 - r);
+      rung_targets_[r] = std::max<int64_t>(
+          1, static_cast<int64_t>(std::llround(max_units_ / denom)));
+    }
+    for (int r = 1; r < num_rungs_; ++r) {
+      if (rung_targets_[r] <= rung_targets_[r - 1]) {
+        rung_targets_[r] = rung_targets_[r - 1] + 1;
+      }
+    }
+    rung_targets_[num_rungs_ - 1] =
+        std::max(rung_targets_[num_rungs_ - 1], max_units_);
+    rungs_.resize(num_rungs_);
+    promoted_.resize(num_rungs_);
+  }
+
+  std::vector<SearchOp> initial_operations() override {
+    std::vector<SearchOp> ops;
+    for (int64_t i = 0; i < std::min(max_concurrent_, max_trials_); ++i) {
+      ops.push_back(create_trial());
+    }
+    return ops;
+  }
+
+  std::vector<SearchOp> on_trial_created(int64_t rid) override {
+    ++started_;
+    trial_rung_[rid] = 0;
+    return {SearchOp::validate_after(rid, rung_targets_[0])};
+  }
+
+  std::vector<SearchOp> on_validation_completed(int64_t rid, double metric,
+                                                int64_t units) override {
+    int r = rung_of(units);
+    trial_rung_[rid] = r;
+    rungs_[r].push_back({signed_metric(metric), rid});
+    std::vector<SearchOp> ops;
+
+    if (r == num_rungs_ - 1) {
+      closed_.insert(rid);
+      ops.push_back(SearchOp::close(rid));
+      if (created_ < max_trials_) ops.push_back(create_trial());
+    } else if (stop_once_) {
+      auto records = sorted_rung(r);
+      size_t rank = 0;
+      for (; rank < records.size(); ++rank) {
+        if (records[rank].second == rid) break;
+      }
+      size_t keep = std::max<size_t>(1, records.size() / divisor_);
+      if (rank < keep) {
+        trial_rung_[rid] = r + 1;
+        ops.push_back(SearchOp::validate_after(rid, rung_targets_[r + 1]));
+      } else {
+        closed_.insert(rid);
+        ops.push_back(SearchOp::close(rid));
+        if (created_ < max_trials_) ops.push_back(create_trial());
+      }
+    } else {
+      auto promotions = promote(r);
+      bool self_promoted = false;
+      for (const auto& op : promotions) {
+        if (op.request_id == rid) self_promoted = true;
+        ops.push_back(op);
+      }
+      if (created_ < max_trials_ && !self_promoted) {
+        ops.push_back(create_trial());
+      }
+    }
+    finish_if_done(ops);
+    return ops;
+  }
+
+  std::vector<SearchOp> on_trial_exited_early(int64_t rid) override {
+    closed_.insert(rid);
+    std::vector<SearchOp> ops;
+    if (created_ < max_trials_) ops.push_back(create_trial());
+    finish_if_done(ops);
+    return ops;
+  }
+
+  double progress() const override {
+    return done_ ? 1.0
+                 : std::min(0.99, static_cast<double>(closed_.size()) /
+                                      std::max<int64_t>(1, max_trials_));
+  }
+
+  Json snapshot() const override {
+    Json rungs = Json::array();
+    for (const auto& rung : rungs_) {
+      Json rj = Json::array();
+      for (const auto& [m, rid] : rung) {
+        Json rec = Json::array();
+        rec.push_back(m);
+        rec.push_back(rid);
+        rj.push_back(rec);
+      }
+      rungs.push_back(rj);
+    }
+    Json promoted = Json::array();
+    for (const auto& p : promoted_) {
+      Json pj = Json::array();
+      for (int64_t rid : p) pj.push_back(rid);
+      promoted.push_back(pj);
+    }
+    Json trial_rung = Json::object();
+    for (const auto& [rid, r] : trial_rung_) {
+      trial_rung.set(std::to_string(rid), r);
+    }
+    Json closed = Json::array();
+    for (int64_t rid : closed_) closed.push_back(rid);
+    Json j = Json::object();
+    j.set("created", created_).set("started", started_)
+        .set("rungs", rungs).set("promoted", promoted)
+        .set("trial_rung", trial_rung).set("closed", closed)
+        .set("done", done_);
+    return j;
+  }
+
+  void restore(const Json& snap) override {
+    created_ = snap["created"].as_int();
+    started_ = snap["started"].as_int();
+    done_ = snap["done"].as_bool();
+    rungs_.assign(num_rungs_, {});
+    const auto& rungs = snap["rungs"].elements();
+    for (size_t r = 0; r < rungs.size() && r < rungs_.size(); ++r) {
+      for (const auto& rec : rungs[r].elements()) {
+        rungs_[r].push_back(
+            {rec.elements()[0].as_number(), rec.elements()[1].as_int()});
+      }
+    }
+    promoted_.assign(num_rungs_, {});
+    const auto& promoted = snap["promoted"].elements();
+    for (size_t r = 0; r < promoted.size() && r < promoted_.size(); ++r) {
+      for (const auto& rid : promoted[r].elements()) {
+        promoted_[r].insert(rid.as_int());
+      }
+    }
+    trial_rung_.clear();
+    for (const auto& [rid, r] : snap["trial_rung"].items()) {
+      trial_rung_[std::stoll(rid)] = static_cast<int>(r.as_int());
+    }
+    closed_.clear();
+    for (const auto& rid : snap["closed"].elements()) {
+      closed_.insert(rid.as_int());
+    }
+  }
+
+  const std::vector<int64_t>& rung_targets() const { return rung_targets_; }
+
+ private:
+  double signed_metric(double m) const {
+    return smaller_is_better_ ? m : -m;
+  }
+  int rung_of(int64_t units) const {
+    for (int r = 0; r < num_rungs_; ++r) {
+      if (units <= rung_targets_[r]) return r;
+    }
+    return num_rungs_ - 1;
+  }
+  SearchOp create_trial() {
+    ++created_;
+    return SearchOp::create(sample_hparams(space_, rng_));
+  }
+  std::vector<std::pair<double, int64_t>> sorted_rung(int r) const {
+    auto records = rungs_[r];
+    std::sort(records.begin(), records.end());
+    return records;
+  }
+  std::vector<SearchOp> promote(int r) {
+    std::vector<SearchOp> ops;
+    if (r >= num_rungs_ - 1) return ops;
+    auto records = sorted_rung(r);
+    size_t allowed = records.size() / divisor_;
+    while (promoted_[r].size() < allowed) {
+      std::optional<int64_t> candidate;
+      for (const auto& [m, rid] : records) {
+        if (!promoted_[r].count(rid) && !closed_.count(rid)) {
+          candidate = rid;
+          break;
+        }
+      }
+      if (!candidate) break;
+      promoted_[r].insert(*candidate);
+      trial_rung_[*candidate] = r + 1;
+      ops.push_back(SearchOp::validate_after(*candidate, rung_targets_[r + 1]));
+    }
+    return ops;
+  }
+  void finish_if_done(std::vector<SearchOp>& ops) {
+    if (done_ || created_ < max_trials_ || started_ < created_) return;
+    std::vector<int64_t> live;
+    for (const auto& [rid, r] : trial_rung_) {
+      if (closed_.count(rid)) continue;
+      bool reported = false;
+      for (const auto& [m, rec_rid] : rungs_[r]) {
+        if (rec_rid == rid) { reported = true; break; }
+      }
+      if (!reported) return;  // still pending → not done
+      live.push_back(rid);
+    }
+    std::sort(live.begin(), live.end());
+    for (int64_t rid : live) {
+      closed_.insert(rid);
+      ops.push_back(SearchOp::close(rid));
+    }
+    ops.push_back(SearchOp::shutdown());
+    done_ = true;
+  }
+
+  Json space_;
+  std::mt19937_64 rng_;
+  int64_t max_units_;
+  int64_t divisor_;
+  int num_rungs_;
+  int64_t max_trials_;
+  int64_t max_concurrent_;
+  bool smaller_is_better_;
+  bool stop_once_;
+  std::vector<int64_t> rung_targets_;
+  std::vector<std::vector<std::pair<double, int64_t>>> rungs_;
+  std::vector<std::set<int64_t>> promoted_;
+  std::map<int64_t, int> trial_rung_;
+  std::set<int64_t> closed_;
+  int64_t created_ = 0;
+  int64_t started_ = 0;
+  bool done_ = false;
+};
+
+// ---------------------------------------------------------------------------
+
+class AdaptiveAshaCpp : public SearchMethodCpp {
+ public:
+  AdaptiveAshaCpp(const Json& cfg, Json space, uint64_t seed) {
+    int num_rungs = static_cast<int>(cfg["num_rungs"].as_int(5));
+    std::string mode = cfg["mode"].as_string().empty()
+                           ? "standard" : cfg["mode"].as_string();
+    std::vector<int> rung_counts;
+    if (cfg["bracket_rungs"].is_array()) {
+      for (const auto& r : cfg["bracket_rungs"].elements()) {
+        rung_counts.push_back(static_cast<int>(r.as_int()));
+      }
+    } else if (mode == "aggressive") {
+      rung_counts = {num_rungs};
+    } else if (mode == "conservative") {
+      for (int r = num_rungs; r >= 1; --r) rung_counts.push_back(r);
+    } else {
+      for (int r = num_rungs; r >= std::max(1, num_rungs - 2); --r) {
+        rung_counts.push_back(r);
+      }
+    }
+    int64_t max_trials = std::max<int64_t>(1, cfg["max_trials"].as_int(1));
+    int64_t n = static_cast<int64_t>(rung_counts.size());
+    int64_t base = max_trials / n, rem = max_trials % n;
+    int64_t conc = cfg["max_concurrent_trials"].as_int(16);
+    conc = std::max<int64_t>(n, conc);
+    int64_t conc_base = conc / n, conc_rem = conc % n;
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t trials = base + (i < rem ? 1 : 0);
+      if (trials == 0) continue;
+      int64_t c = conc_base + (i < conc_rem ? 1 : 0);
+      brackets_.push_back(std::make_unique<AshaSearchCpp>(
+          cfg, space, seed + static_cast<uint64_t>(i),
+          rung_counts[static_cast<size_t>(i)], trials,
+          std::min(c, trials)));
+    }
+  }
+
+  std::vector<SearchOp> initial_operations() override {
+    std::vector<SearchOp> ops;
+    for (size_t i = 0; i < brackets_.size(); ++i) {
+      route(i, brackets_[i]->initial_operations(), ops);
+    }
+    return ops;
+  }
+  std::vector<SearchOp> on_trial_created(int64_t rid) override {
+    if (pending_.empty()) {
+      throw std::runtime_error("adaptive asha: unexpected trial_created");
+    }
+    size_t i = pending_.front();
+    pending_.pop_front();
+    owner_[rid] = i;
+    std::vector<SearchOp> ops;
+    route(i, brackets_[i]->on_trial_created(rid), ops);
+    return ops;
+  }
+  std::vector<SearchOp> on_validation_completed(int64_t rid, double metric,
+                                                int64_t units) override {
+    size_t i = owner_.at(rid);
+    std::vector<SearchOp> ops;
+    route(i, brackets_[i]->on_validation_completed(rid, metric, units), ops);
+    return ops;
+  }
+  std::vector<SearchOp> on_trial_exited_early(int64_t rid) override {
+    size_t i = owner_.at(rid);
+    std::vector<SearchOp> ops;
+    route(i, brackets_[i]->on_trial_exited_early(rid), ops);
+    return ops;
+  }
+  double progress() const override {
+    if (brackets_.empty()) return 1.0;
+    double sum = 0;
+    for (const auto& b : brackets_) sum += b->progress();
+    return sum / static_cast<double>(brackets_.size());
+  }
+  Json snapshot() const override {
+    Json bj = Json::array();
+    for (const auto& b : brackets_) bj.push_back(b->snapshot());
+    Json owner = Json::object();
+    for (const auto& [rid, i] : owner_) {
+      owner.set(std::to_string(rid), static_cast<int64_t>(i));
+    }
+    Json pending = Json::array();
+    for (size_t i : pending_) pending.push_back(static_cast<int64_t>(i));
+    Json shut = Json::array();
+    for (size_t i : shut_) shut.push_back(static_cast<int64_t>(i));
+    Json j = Json::object();
+    j.set("brackets", bj).set("owner", owner).set("pending", pending)
+        .set("shut", shut);
+    return j;
+  }
+  void restore(const Json& snap) override {
+    const auto& bj = snap["brackets"].elements();
+    for (size_t i = 0; i < brackets_.size() && i < bj.size(); ++i) {
+      brackets_[i]->restore(bj[i]);
+    }
+    owner_.clear();
+    for (const auto& [rid, i] : snap["owner"].items()) {
+      owner_[std::stoll(rid)] = static_cast<size_t>(i.as_int());
+    }
+    pending_.clear();
+    for (const auto& i : snap["pending"].elements()) {
+      pending_.push_back(static_cast<size_t>(i.as_int()));
+    }
+    shut_.clear();
+    for (const auto& i : snap["shut"].elements()) {
+      shut_.insert(static_cast<size_t>(i.as_int()));
+    }
+  }
+
+ private:
+  void route(size_t bracket, std::vector<SearchOp> in,
+             std::vector<SearchOp>& out) {
+    for (auto& op : in) {
+      if (op.kind == SearchOp::Kind::Create) {
+        pending_.push_back(bracket);
+        out.push_back(std::move(op));
+      } else if (op.kind == SearchOp::Kind::Shutdown) {
+        shut_.insert(bracket);
+        if (shut_.size() == brackets_.size()) out.push_back(std::move(op));
+      } else {
+        out.push_back(std::move(op));
+      }
+    }
+  }
+
+  std::vector<std::unique_ptr<AshaSearchCpp>> brackets_;
+  std::map<int64_t, size_t> owner_;
+  std::deque<size_t> pending_;
+  std::set<size_t> shut_;
+};
+
+}  // namespace
+
+std::unique_ptr<SearchMethodCpp> build_search_method(
+    const Json& cfg, const Json& space, uint64_t seed) {
+  const std::string& name =
+      cfg["name"].as_string().empty() ? "single" : cfg["name"].as_string();
+  if (name == "single") {
+    return std::make_unique<SingleSearchCpp>(cfg, space, seed);
+  }
+  if (name == "random") {
+    return std::make_unique<RandomSearchCpp>(cfg, space, seed, false);
+  }
+  if (name == "grid") {
+    return std::make_unique<RandomSearchCpp>(cfg, space, seed, true);
+  }
+  if (name == "asha") {
+    return std::make_unique<AshaSearchCpp>(cfg, space, seed);
+  }
+  if (name == "adaptive_asha") {
+    return std::make_unique<AdaptiveAshaCpp>(cfg, space, seed);
+  }
+  throw std::runtime_error("unknown searcher name '" + name + "'");
+}
+
+}  // namespace dct

@@ -1,0 +1,68 @@
+// Hyperparameter search inside the master.
+//
+// C++ home of the search engine (reference: Go master/pkg/searcher — per the
+// native-component checklist, SURVEY.md §2.9 it belongs in the master, not
+// the Python harness). Protocol identical to the Python engine
+// (determined_clone_tpu/searcher/base.py): methods emit Create /
+// ValidateAfter / Close / Shutdown operations; state snapshots to JSON.
+// Methods: single, random, grid, ASHA (promote + stopping variants),
+// adaptive ASHA (bracket tournament).
+#pragma once
+
+#include <memory>
+#include <optional>
+#include <random>
+#include <vector>
+
+#include "json.h"
+
+namespace dct {
+
+struct SearchOp {
+  enum class Kind { Create, ValidateAfter, Close, Shutdown } kind;
+  int64_t request_id = -1;   // Create: -1 = engine assigns
+  Json hparams;              // Create
+  int64_t units = 0;         // ValidateAfter: cumulative target
+  bool failure = false;      // Shutdown
+
+  static SearchOp create(Json hparams) {
+    return {Kind::Create, -1, std::move(hparams), 0, false};
+  }
+  static SearchOp validate_after(int64_t rid, int64_t units) {
+    return {Kind::ValidateAfter, rid, Json(), units, false};
+  }
+  static SearchOp close(int64_t rid) {
+    return {Kind::Close, rid, Json(), 0, false};
+  }
+  static SearchOp shutdown(bool failure = false) {
+    return {Kind::Shutdown, -1, Json(), 0, failure};
+  }
+};
+
+// Samples one assignment from an hparam-space JSON
+// (same union as config/hyperparameters.py: const/int/double/log/categorical,
+// nested objects; bare values are consts).
+Json sample_hparams(const Json& space, std::mt19937_64& rng);
+// Full cartesian grid (throws std::runtime_error if a double/log hparam
+// lacks "count").
+std::vector<Json> grid_hparams(const Json& space);
+
+class SearchMethodCpp {
+ public:
+  virtual ~SearchMethodCpp() = default;
+  virtual std::vector<SearchOp> initial_operations() = 0;
+  virtual std::vector<SearchOp> on_trial_created(int64_t rid) = 0;
+  virtual std::vector<SearchOp> on_validation_completed(
+      int64_t rid, double metric, int64_t units) = 0;
+  virtual std::vector<SearchOp> on_trial_exited_early(int64_t rid) = 0;
+  virtual double progress() const = 0;
+  virtual Json snapshot() const = 0;
+  virtual void restore(const Json& snap) = 0;
+};
+
+// Factory from the searcher config JSON (name/metric/max_trials/max_length/
+// divisor/num_rungs/mode/...). Throws std::runtime_error on unknown name.
+std::unique_ptr<SearchMethodCpp> build_search_method(
+    const Json& searcher_config, const Json& hparam_space, uint64_t seed);
+
+}  // namespace dct

@@ -1,0 +1,486 @@
+// C++ unit tests: json, searcher, scheduler, master API (in-process).
+// Run under ASan+UBSan via `make test` (the reference runs Go tests with
+// -race; sanitizers are the C++ analogue, SURVEY.md §5.2).
+#include <cassert>
+#include <cmath>
+#include <cstdio>
+#include <iostream>
+#include <set>
+
+#include "../src/json.h"
+#include "../src/master.h"
+#include "../src/scheduler.h"
+#include "../src/searcher.h"
+
+using namespace dct;
+
+static int tests_run = 0;
+#define CHECK(cond)                                                       \
+  do {                                                                    \
+    ++tests_run;                                                          \
+    if (!(cond)) {                                                        \
+      std::cerr << __FILE__ << ":" << __LINE__ << " CHECK failed: " #cond \
+                << std::endl;                                             \
+      std::abort();                                                       \
+    }                                                                     \
+  } while (0)
+
+// ---------------------------------------------------------------------------
+
+void test_json() {
+  Json j = Json::parse(R"({"a": 1, "b": [true, null, "x\n\"y"], "c": {"d": 2.5}})");
+  CHECK(j["a"].as_int() == 1);
+  CHECK(j["b"].elements().size() == 3);
+  CHECK(j["b"].elements()[0].as_bool());
+  CHECK(j["b"].elements()[2].as_string() == "x\n\"y");
+  CHECK(std::abs(j["c"]["d"].as_number() - 2.5) < 1e-12);
+  // roundtrip
+  Json again = Json::parse(j.dump());
+  CHECK(again.dump() == j.dump());
+  // unicode escapes
+  Json u = Json::parse(R"("Aé€")");
+  CHECK(u.as_string() == "A\xc3\xa9\xe2\x82\xac");
+  // errors
+  bool threw = false;
+  try { Json::parse("{\"a\": }"); } catch (const std::exception&) { threw = true; }
+  CHECK(threw);
+  threw = false;
+  try { Json::parse("[1,2"); } catch (const std::exception&) { threw = true; }
+  CHECK(threw);
+  // big ints survive
+  Json big = Json::parse("{\"v\": 1234567890123}");
+  CHECK(big["v"].as_int() == 1234567890123);
+  CHECK(big.dump() == "{\"v\":1234567890123}");
+}
+
+// ---------------------------------------------------------------------------
+
+Json searcher_cfg(const char* extra) {
+  return Json::parse(std::string(R"({"name":"single","metric":"loss",)") +
+                     R"("max_length":{"batches":64})" + extra + "}");
+}
+
+void test_hparam_sampling() {
+  Json space = Json::parse(R"({
+    "lr": {"type": "log", "minval": -4, "maxval": -1},
+    "width": {"type": "int", "minval": 8, "maxval": 64},
+    "act": {"type": "categorical", "vals": ["relu", "gelu"]},
+    "nested": {"dropout": {"type": "double", "minval": 0.0, "maxval": 0.5}},
+    "const_v": 7
+  })");
+  std::mt19937_64 rng(42);
+  for (int i = 0; i < 50; ++i) {
+    Json s = sample_hparams(space, rng);
+    double lr = s["lr"].as_number();
+    CHECK(lr >= 1e-4 - 1e-12 && lr <= 1e-1 + 1e-12);
+    int64_t w = s["width"].as_int();
+    CHECK(w >= 8 && w <= 64);
+    const std::string& act = s["act"].as_string();
+    CHECK(act == "relu" || act == "gelu");
+    double d = s["nested"]["dropout"].as_number();
+    CHECK(d >= 0.0 && d <= 0.5);
+    CHECK(s["const_v"].as_int() == 7);
+  }
+  Json grid_space = Json::parse(R"({
+    "a": {"type": "categorical", "vals": [1, 2, 3]},
+    "b": {"type": "double", "minval": 0.0, "maxval": 1.0, "count": 2}
+  })");
+  auto points = grid_hparams(grid_space);
+  CHECK(points.size() == 6);
+  std::set<std::string> distinct;
+  for (const auto& p : points) distinct.insert(p.dump());
+  CHECK(distinct.size() == 6);
+}
+
+// drive a method to completion against a synthetic metric
+struct SimOutcome {
+  std::map<int64_t, int64_t> units;
+  std::map<int64_t, Json> hparams;
+  bool shutdown = false;
+};
+
+SimOutcome drive(SearchMethodCpp* method,
+                 double (*metric)(const Json&, int64_t)) {
+  SimOutcome out;
+  int64_t next_id = 0;
+  std::vector<SearchOp> queue = method->initial_operations();
+  std::set<int64_t> closed;
+  size_t head = 0;
+  int guard = 0;
+  while (head < queue.size() && ++guard < 100000) {
+    SearchOp op = queue[head++];
+    if (op.kind == SearchOp::Kind::Create) {
+      int64_t rid = next_id++;
+      out.hparams[rid] = op.hparams;
+      auto more = method->on_trial_created(rid);
+      queue.insert(queue.end(), more.begin(), more.end());
+    } else if (op.kind == SearchOp::Kind::ValidateAfter) {
+      if (closed.count(op.request_id)) continue;
+      out.units[op.request_id] = std::max(out.units[op.request_id], op.units);
+      double m = metric(out.hparams[op.request_id], op.units);
+      auto more = method->on_validation_completed(op.request_id, m, op.units);
+      queue.insert(queue.end(), more.begin(), more.end());
+    } else if (op.kind == SearchOp::Kind::Close) {
+      closed.insert(op.request_id);
+    } else if (op.kind == SearchOp::Kind::Shutdown) {
+      out.shutdown = true;
+      break;
+    }
+  }
+  return out;
+}
+
+double lr_metric(const Json& hp, int64_t units) {
+  double lr = hp["lr"].as_number();
+  return std::abs(std::log10(lr) + 2.0) + 1.0 / (1.0 + units / 8.0);
+}
+
+void test_search_methods() {
+  Json space = Json::parse(
+      R"({"lr": {"type": "log", "minval": -4, "maxval": -1}})");
+
+  {  // single
+    auto m = build_search_method(searcher_cfg(""), space, 1);
+    auto out = drive(m.get(), lr_metric);
+    CHECK(out.shutdown);
+    CHECK(out.units.size() == 1);
+    CHECK(out.units[0] == 64);
+  }
+  {  // random
+    auto m = build_search_method(
+        searcher_cfg(R"(,"name":"random","max_trials":7,"max_concurrent_trials":3)"),
+        space, 2);
+    auto out = drive(m.get(), lr_metric);
+    CHECK(out.shutdown);
+    CHECK(out.hparams.size() == 7);
+    for (auto& [rid, u] : out.units) CHECK(u == 64);
+  }
+  {  // grid
+    Json gspace = Json::parse(
+        R"({"lr": {"type": "log", "minval": -4, "maxval": -1, "count": 5}})");
+    auto m = build_search_method(
+        searcher_cfg(R"(,"name":"grid","max_trials":100)"), gspace, 3);
+    auto out = drive(m.get(), lr_metric);
+    CHECK(out.shutdown);
+    CHECK(out.hparams.size() == 5);
+  }
+  {  // asha: early stopping structure
+    auto m = build_search_method(
+        searcher_cfg(
+            R"(,"name":"asha","max_trials":16,"divisor":4,"num_rungs":3,"max_concurrent_trials":4)"),
+        space, 4);
+    auto out = drive(m.get(), lr_metric);
+    CHECK(out.shutdown);
+    CHECK(out.hparams.size() == 16);
+    int64_t total = 0, top = 0;
+    for (auto& [rid, u] : out.units) {
+      total += u;
+      if (u == 64) ++top;
+    }
+    CHECK(top >= 1 && top <= 6);
+    CHECK(total < 16 * 64 / 2);
+  }
+  {  // adaptive asha
+    auto m = build_search_method(
+        searcher_cfg(
+            R"(,"name":"adaptive_asha","max_trials":12,"divisor":4,"num_rungs":3,"mode":"standard","max_concurrent_trials":6)"),
+        space, 5);
+    auto out = drive(m.get(), lr_metric);
+    CHECK(out.shutdown);
+    CHECK(out.hparams.size() == 12);
+  }
+  {  // snapshot roundtrip mid-run
+    auto cfg = searcher_cfg(
+        R"(,"name":"asha","max_trials":8,"divisor":2,"num_rungs":3,"max_concurrent_trials":2)");
+    auto m1 = build_search_method(cfg, space, 6);
+    auto ops = m1->initial_operations();
+    int64_t rid = 0;
+    for (auto& op : ops) {
+      if (op.kind == SearchOp::Kind::Create) m1->on_trial_created(rid++);
+    }
+    Json snap = Json::parse(m1->snapshot().dump());
+    auto m2 = build_search_method(cfg, space, 6);
+    m2->restore(snap);
+    CHECK(m2->snapshot().dump() == m1->snapshot().dump());
+  }
+  {  // unknown searcher name
+    bool threw = false;
+    try {
+      build_search_method(Json::parse(R"({"name":"bogus"})"), space, 0);
+    } catch (const std::exception&) {
+      threw = true;
+    }
+    CHECK(threw);
+  }
+}
+
+// ---------------------------------------------------------------------------
+
+Agent make_agent(const std::string& id, int slots, const std::string& topo) {
+  Agent a;
+  a.id = id;
+  a.slots = slots;
+  a.topology = topo;
+  a.enabled = true;
+  return a;
+}
+
+Allocation make_alloc(const std::string& id, int slots, int priority = 42,
+                      double queued_at = 0) {
+  Allocation a;
+  a.id = id;
+  a.slots = slots;
+  a.priority = priority;
+  a.queued_at = queued_at;
+  a.state = RunState::Queued;
+  return a;
+}
+
+void test_scheduler() {
+  std::vector<Agent> agents = {
+      make_agent("a1", 8, "v5e-8"), make_agent("a2", 8, "v5e-8"),
+      make_agent("a3", 4, "v5e-4")};
+  std::map<std::string, int> free = {{"a1", 8}, {"a2", 8}, {"a3", 4}};
+
+  {  // single-agent fit prefers minimal surplus (4-chip job → a3)
+    auto fit = find_fit(make_alloc("x", 4), agents, free, "");
+    CHECK(fit);
+    CHECK(fit->count("a3") == 1);
+  }
+  {  // whole-slice fit
+    auto fit = find_fit(make_alloc("x", 8), agents, free, "");
+    CHECK(fit);
+    CHECK(fit->size() == 1 && fit->begin()->second == 8);
+  }
+  {  // multi-agent gang: 16 chips = both v5e-8 agents
+    auto fit = find_fit(make_alloc("x", 16), agents, free, "");
+    CHECK(fit);
+    CHECK(fit->size() == 2 && fit->count("a1") && fit->count("a2"));
+  }
+  {  // unfittable
+    auto fit = find_fit(make_alloc("x", 32), agents, free, "");
+    CHECK(!fit);
+  }
+  {  // topology constraint
+    Allocation a = make_alloc("x", 4);
+    a.topology = "v5e-8";
+    auto fit = find_fit(a, agents, free, "");
+    CHECK(fit && fit->count("a3") == 0);  // must land on a v5e-8 agent
+  }
+  {  // blocked node excluded (logpattern)
+    std::vector<Agent> blocked = agents;
+    blocked[2].blocked_by.insert("exp-1");
+    auto fit = find_fit(make_alloc("x", 4), blocked, free, "exp-1");
+    CHECK(fit && fit->count("a3") == 0);
+  }
+  {  // zero-slot task lands on least-loaded agent
+    auto fit = find_fit(make_alloc("x", 0), agents, free, "");
+    CHECK(fit && fit->begin()->second == 0);
+  }
+  {  // priority scheduling + preemption
+    PoolPolicy pol;
+    pol.type = "priority";
+    Allocation running = make_alloc("low", 8, 60, 1);
+    running.state = RunState::Running;
+    running.reservations = {{"a1", 8}};
+    std::map<std::string, int> free2 = {{"a1", 0}, {"a2", 8}, {"a3", 4}};
+    // high-priority 16-chip gang can't fit → preempt the low-priority job
+    auto dec = schedule_pool(pol, agents, free2,
+                             {make_alloc("high", 16, 10, 2)}, {running}, {},
+                             {});
+    CHECK(dec.assignments.empty());
+    CHECK(dec.preemptions.size() == 1 && dec.preemptions[0] == "low");
+  }
+  {  // fifo ordering respected
+    PoolPolicy pol;
+    pol.type = "fifo";
+    auto dec = schedule_pool(pol, agents, free,
+                             {make_alloc("b", 8, 42, 2.0),
+                              make_alloc("a", 8, 42, 1.0),
+                              make_alloc("c", 8, 42, 3.0)},
+                             {}, {}, {});
+    CHECK(dec.assignments.count("a") && dec.assignments.count("b"));
+    CHECK(!dec.assignments.count("c"));  // only two v5e-8 agents
+  }
+  {  // fair share: owner with less usage goes first
+    PoolPolicy pol;
+    pol.type = "fair_share";
+    std::map<std::string, int> usage = {{"exp-1", 16}, {"exp-2", 0}};
+    std::map<std::string, std::string> owners = {{"e1", "exp-1"},
+                                                 {"e2", "exp-2"}};
+    std::map<std::string, int> free3 = {{"a1", 8}};
+    std::vector<Agent> one = {make_agent("a1", 8, "v5e-8")};
+    auto dec = schedule_pool(pol, one, free3,
+                             {make_alloc("e1", 8, 42, 1.0),
+                              make_alloc("e2", 8, 42, 2.0)},
+                             {}, usage, owners);
+    CHECK(dec.assignments.count("e2"));  // less-used owner wins
+    CHECK(!dec.assignments.count("e1"));
+  }
+}
+
+// ---------------------------------------------------------------------------
+
+HttpRequest make_req(const std::string& method, const std::string& path,
+                     const std::string& body = "") {
+  HttpRequest r;
+  r.method = method;
+  r.path = path;
+  r.body = body;
+  std::istringstream stream(path);
+  std::string part;
+  while (std::getline(stream, part, '/')) {
+    if (!part.empty()) r.path_parts.push_back(part);
+  }
+  return r;
+}
+
+void test_master_api() {
+  MasterConfig config;
+  config.port = 0;
+  config.data_dir = "/tmp/dct-master-test";
+  ::system("rm -rf /tmp/dct-master-test");
+  Master master(config);  // not start()ed: handle() directly (no tick thread)
+
+  // create experiment
+  auto resp = master.handle(make_req("POST", "/api/v1/experiments", R"({
+    "config": {
+      "name": "t", "entrypoint": "model:Trial",
+      "searcher": {"name": "random", "metric": "loss", "max_trials": 2,
+                    "max_length": {"batches": 8}, "max_concurrent_trials": 2},
+      "resources": {"slots_per_trial": 4},
+      "hyperparameters": {"lr": {"type": "double", "minval": 0.1, "maxval": 1.0}}
+    }})"));
+  CHECK(resp.status == 201);
+  Json exp = Json::parse(resp.body)["experiment"];
+  CHECK(exp["id"].as_int() == 1);
+  CHECK(exp["state"].as_string() == "RUNNING");
+
+  // two trials were created by the searcher
+  resp = master.handle(make_req("GET", "/api/v1/experiments/1"));
+  CHECK(resp.status == 200);
+  Json detail = Json::parse(resp.body);
+  CHECK(detail["trials"].elements().size() == 2);
+  int64_t t1 = detail["trials"].elements()[0]["id"].as_int();
+  CHECK(detail["trials"].elements()[0]["target_units"].as_int() == 8);
+
+  // register an agent and heartbeat: should receive a start command after a
+  // manual tick (invoked via the public start? we call handle-only mode, so
+  // scheduling happens in tick; emulate by registering + ticking through
+  // heartbeat)
+  resp = master.handle(make_req("POST", "/api/v1/agents/register",
+                                R"({"id": "ag1", "slots": 8, "topology": "v5e-8"})"));
+  CHECK(resp.status == 200);
+
+  // no tick thread running: call tick via a heartbeat-triggered path —
+  // Master::handle doesn't tick, so run one manual master with start()
+  // for the full flow test below instead. Here check queue state:
+  resp = master.handle(make_req("GET", "/api/v1/job-queue"));
+  CHECK(Json::parse(resp.body)["queue"].elements().size() == 2);
+
+  // report metrics + searcher completion for trial 1
+  resp = master.handle(make_req(
+      "POST", "/api/v1/trials/" + std::to_string(t1) + "/metrics",
+      R"({"group": "training", "steps_completed": 8, "metrics": {"loss": 0.5}})"));
+  CHECK(resp.status == 200);
+  resp = master.handle(make_req(
+      "POST", "/api/v1/trials/" + std::to_string(t1) + "/searcher/completed_op",
+      R"({"metric": 0.5, "units": 8})"));
+  CHECK(resp.status == 200);
+  CHECK(Json::parse(resp.body)["trial"]["state"].as_string() == "COMPLETED");
+
+  // checkpoint report
+  resp = master.handle(make_req(
+      "POST", "/api/v1/trials/" + std::to_string(t1) + "/checkpoints",
+      R"({"uuid": "ck-1", "metadata": {"steps_completed": 8}, "resources": {}})"));
+  CHECK(resp.status == 200);
+  resp = master.handle(make_req("GET", "/api/v1/checkpoints/ck-1"));
+  CHECK(resp.status == 200);
+  CHECK(Json::parse(resp.body)["trial_id"].as_int() == t1);
+
+  // searcher operation poll for remaining trial
+  resp = master.handle(make_req("GET", "/api/v1/experiments/1"));
+  detail = Json::parse(resp.body);
+  int64_t t2 = 0;
+  for (const auto& t : detail["trials"].elements()) {
+    if (t["state"].as_string() != "COMPLETED") t2 = t["id"].as_int();
+  }
+  CHECK(t2 != 0);
+  resp = master.handle(make_req(
+      "GET", "/api/v1/trials/" + std::to_string(t2) + "/searcher/operation"));
+  Json op = Json::parse(resp.body);
+  CHECK(!op["closed"].as_bool());
+  CHECK(op["target_units"].as_int() == 8);
+
+  // complete second trial → experiment completes
+  resp = master.handle(make_req(
+      "POST", "/api/v1/trials/" + std::to_string(t2) + "/searcher/completed_op",
+      R"({"metric": 0.4, "units": 8})"));
+  CHECK(resp.status == 200);
+  resp = master.handle(make_req("GET", "/api/v1/experiments/1"));
+  CHECK(Json::parse(resp.body)["experiment"]["state"].as_string() ==
+        "COMPLETED");
+
+  // unknown routes 404
+  resp = master.handle(make_req("GET", "/api/v1/nonsense"));
+  CHECK(resp.status == 404);
+  resp = master.handle(make_req("GET", "/api/v1/trials/999"));
+  CHECK(resp.status == 404);
+  // malformed body 400/500-contained
+  resp = master.handle(make_req("POST", "/api/v1/experiments", "{broken"));
+  CHECK(resp.status >= 400);
+}
+
+void test_master_snapshot_restore() {
+  ::system("rm -rf /tmp/dct-master-test2");
+  MasterConfig config;
+  config.port = 0;
+  config.data_dir = "/tmp/dct-master-test2";
+  {
+    Master master(config);
+    master.start();
+    auto resp = master.handle(make_req("POST", "/api/v1/experiments", R"({
+      "config": {
+        "name": "persist", "entrypoint": "m:T",
+        "searcher": {"name": "single", "metric": "loss",
+                      "max_length": {"batches": 4}},
+        "hyperparameters": {"lr": 0.1}
+      }})"));
+    CHECK(resp.status == 201);
+    master.stop();
+  }
+  {
+    Master master(config);
+    master.start();
+    auto resp = master.handle(make_req("GET", "/api/v1/experiments/1"));
+    CHECK(resp.status == 200);
+    Json detail = Json::parse(resp.body);
+    CHECK(detail["experiment"]["name"].as_string() == "persist");
+    CHECK(detail["trials"].elements().size() == 1);
+    // searcher still live: completing the op completes the experiment
+    int64_t tid = detail["trials"].elements()[0]["id"].as_int();
+    resp = master.handle(make_req(
+        "POST",
+        "/api/v1/trials/" + std::to_string(tid) + "/searcher/completed_op",
+        R"({"metric": 1.0, "units": 4})"));
+    CHECK(resp.status == 200);
+    resp = master.handle(make_req("GET", "/api/v1/experiments/1"));
+    CHECK(Json::parse(resp.body)["experiment"]["state"].as_string() ==
+          "COMPLETED");
+    master.stop();
+  }
+}
+
+int run_all() {
+  test_json();
+  test_hparam_sampling();
+  test_search_methods();
+  test_scheduler();
+  test_master_api();
+  test_master_snapshot_restore();
+  std::cout << "all C++ unit tests passed (" << tests_run << " checks)"
+            << std::endl;
+  return 0;
+}
+
+int main() { return run_all(); }

@@ -1,0 +1,215 @@
+"""Cluster e2e: C++ master + C++ agent + real Python trial processes.
+
+The reference's devcluster-style test (tools/devcluster.yaml,
+e2e_tests/tests/cluster/managed_cluster.py): boot master+agent from source,
+submit experiments over the API, assert scheduling/training/restart behavior.
+"""
+import json
+import os
+import shutil
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+MASTER_DIR = REPO / "determined_clone_tpu" / "master"
+MASTER_BIN = MASTER_DIR / "build" / "dct-master"
+AGENT_BIN = MASTER_DIR / "build" / "dct-agent"
+
+TRIAL_MODULE = '''
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from determined_clone_tpu.training import JaxTrial
+
+
+class Trial(JaxTrial):
+    def initial_params(self, rng):
+        return {"w": jnp.zeros(())}
+
+    def optimizer(self):
+        return optax.sgd(self.context.get_hparam("lr", 0.2))
+
+    def loss(self, params, batch, rng):
+        return (params["w"] - 2.0) ** 2, {}
+
+    def training_data(self):
+        for _ in range(64):
+            yield np.zeros((2, 1), np.float32)
+
+    def validation_data(self):
+        return [np.zeros((2, 1), np.float32)]
+
+    @property
+    def global_batch_size(self):
+        return 2
+'''
+
+
+def build_binaries():
+    if MASTER_BIN.exists() and AGENT_BIN.exists():
+        return True
+    r = subprocess.run(["make", "-C", str(MASTER_DIR)], capture_output=True)
+    return r.returncode == 0
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    if not build_binaries():
+        pytest.skip("C++ master/agent build unavailable")
+    tmp = tmp_path_factory.mktemp("cluster")
+    workdir = tmp / "agent-work"
+    workdir.mkdir()
+    (workdir / "model_def.py").write_text(TRIAL_MODULE)
+
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    env = {
+        **os.environ,
+        "PALLAS_AXON_POOL_IPS": "",       # no TPU tunnel in subprocesses
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": str(REPO),
+        "DCT_AGENT_SLOTS": "1",           # artificial slot (detect.go:39 trick)
+        "DCT_AGENT_TOPOLOGY": "v5e-1",
+    }
+    master = subprocess.Popen(
+        [str(MASTER_BIN), "--port", str(port), "--data-dir",
+         str(tmp / "master-data")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+    )
+    agent = subprocess.Popen(
+        [str(AGENT_BIN), "--master-port", str(port), "--id", "test-agent",
+         "--work-dir", str(workdir)],
+        cwd=str(workdir),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+    )
+
+    from determined_clone_tpu.api.client import MasterSession
+
+    session = MasterSession("127.0.0.1", port, timeout=10, retries=20)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            if session.list_agents():
+                break
+        except Exception:
+            time.sleep(0.3)
+    else:
+        master.kill()
+        agent.kill()
+        pytest.fail("cluster did not come up")
+
+    yield {"session": session, "tmp": tmp, "port": port,
+           "master": master, "agent": agent, "workdir": workdir}
+
+    agent.kill()
+    master.kill()
+    agent.wait(timeout=10)
+    master.wait(timeout=10)
+
+
+def wait_for(predicate, timeout=120, interval=0.5, desc="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        result = predicate()
+        if result:
+            return result
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {desc}")
+
+
+def exp_config(cluster, searcher, hparams=None, name="e2e"):
+    return {
+        "name": name,
+        "entrypoint": "model_def:Trial",
+        "searcher": searcher,
+        "resources": {"slots_per_trial": 1},
+        "scheduling_unit": 2,
+        "checkpoint_storage": {"type": "shared_fs",
+                               "host_path": str(cluster["tmp"] / "ckpts")},
+        "hyperparameters": hparams or {"lr": 0.2},
+        "max_restarts": 1,
+    }
+
+
+def test_master_and_agent_register(cluster):
+    agents = cluster["session"].list_agents()
+    assert len(agents) == 1
+    assert agents[0]["slots"] == 1
+    assert agents[0]["topology"] == "v5e-1"
+    info = cluster["session"].master_info()
+    assert info["agents"] == 1
+
+
+def test_single_experiment_trains_to_completion(cluster):
+    session = cluster["session"]
+    exp = session.create_experiment(exp_config(cluster, {
+        "name": "single", "metric": "loss", "max_length": {"batches": 6},
+    }))
+    detail = wait_for(
+        lambda: (lambda d: d if d["experiment"]["state"] == "COMPLETED" else None)(
+            session.get_experiment(exp["id"])),
+        desc="experiment completion", timeout=180,
+    )
+    trials = detail["trials"]
+    assert len(trials) == 1
+    t = trials[0]
+    assert t["state"] == "COMPLETED"
+    assert t["units_done"] >= 6
+    assert t["has_metric"]
+    # metrics made it to the master
+    metrics = session.trial_metrics(t["id"])
+    groups = {m["group"] for m in metrics}
+    assert "training" in groups and "validation" in groups
+    # checkpoint was reported and linked
+    assert t["latest_checkpoint"]
+    ckpts = session.get(f"/api/v1/experiments/{exp['id']}/checkpoints")[
+        "checkpoints"]
+    assert any(c["uuid"] == t["latest_checkpoint"] for c in ckpts)
+    # task logs shipped by the agent on exit (arrives after process reap)
+    logs = wait_for(
+        lambda: [l for l in session.task_logs(f"trial-{t['id']}.0")
+                 if "leg finished" in json.dumps(l)] or None,
+        desc="task logs shipped", timeout=30,
+    )
+    assert logs
+
+
+def test_random_search_multiple_trials(cluster):
+    session = cluster["session"]
+    exp = session.create_experiment(exp_config(cluster, {
+        "name": "random", "metric": "loss", "max_trials": 2,
+        "max_length": {"batches": 4}, "max_concurrent_trials": 1,
+    }, hparams={"lr": {"type": "double", "minval": 0.1, "maxval": 0.3}},
+        name="e2e-random"))
+    detail = wait_for(
+        lambda: (lambda d: d if d["experiment"]["state"] == "COMPLETED" else None)(
+            session.get_experiment(exp["id"])),
+        desc="random search completion", timeout=300,
+    )
+    assert len(detail["trials"]) == 2
+    assert all(t["state"] == "COMPLETED" for t in detail["trials"])
+    lrs = {t["hparams"]["lr"] for t in detail["trials"]}
+    assert len(lrs) == 2
+
+
+def test_kill_experiment(cluster):
+    session = cluster["session"]
+    exp = session.create_experiment(exp_config(cluster, {
+        "name": "single", "metric": "loss", "max_length": {"batches": 10_000},
+    }, name="e2e-kill"))
+    session.kill_experiment(exp["id"])
+    detail = wait_for(
+        lambda: (lambda d: d if d["experiment"]["state"] in
+                 ("CANCELED", "COMPLETED") else None)(
+            session.get_experiment(exp["id"])),
+        desc="experiment cancel", timeout=60,
+    )
+    assert detail["experiment"]["state"] == "CANCELED"
