@@ -38,6 +38,7 @@ from determined_clone_tpu.ops.layers import (
     softmax_cross_entropy,
     trunc_normal,
 )
+from determined_clone_tpu.ops.moe import moe_ffn
 from determined_clone_tpu.parallel.sharding import ShardingRules
 
 Params = Dict[str, Any]
@@ -58,6 +59,14 @@ class GPTConfig:
     blockwise_attention: bool = False  # streaming attention for long seqs
     attention_block_size: int = 512
     tie_embeddings: bool = True
+    # MoE (expert parallel over the ep mesh axis; 0 = dense FFN).
+    moe_experts: int = 0
+    moe_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    # GPipe pipeline over the pp mesh axis (0/1 = no pipelining). Takes
+    # effect when apply/loss_fn receive a mesh whose pp axis is > 1.
+    pipeline_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -73,17 +82,28 @@ class GPTConfig:
 # Megatron-style TP rules + explicit fsdp specs. Column-parallel up-projections
 # shard the output dim on tp; row-parallel down-projections shard the input dim
 # (XLA inserts the all-reduce the megatron pattern implies). Stacked block
-# leaves have a leading [L] layer dim, never sharded (pp slices it instead).
-GPT_SHARDING_RULES = ShardingRules(rules=[
-    (r"embed/table$",            P("tp", "fsdp")),       # [V, D] vocab-parallel
-    (r"blocks/.*attn_qkv/kernel$",  P(None, "fsdp", "tp")),  # [L, D, 3D] column
-    (r"blocks/.*attn_out/kernel$",  P(None, "tp", "fsdp")),  # [L, D, D]  row
-    (r"blocks/.*mlp_up/kernel$",    P(None, "fsdp", "tp")),  # [L, D, F]  column
-    (r"blocks/.*mlp_down/kernel$",  P(None, "tp", "fsdp")),  # [L, F, D]  row
-    (r"blocks/.*(bias|scale)$",     P()),
-    (r"lm_head/kernel$",         P("fsdp", "tp")),       # [D, V]
-    (r"final_norm/",             P()),
-])
+# leaves have a leading [L] layer dim; with ``pipelined=True`` that dim is
+# sliced over the pp axis (one contiguous run of layers per stage).
+def sharding_rules(pipelined: bool = False) -> ShardingRules:
+    lead = "pp" if pipelined else None
+    return ShardingRules(rules=[
+        (r"embed/table$",            P("tp", "fsdp")),       # [V, D] vocab-parallel
+        (r"blocks/.*attn_qkv/kernel$",  P(lead, "fsdp", "tp")),  # [L, D, 3D] column
+        (r"blocks/.*attn_out/kernel$",  P(lead, "tp", "fsdp")),  # [L, D, D]  row
+        (r"blocks/.*mlp_up/kernel$",    P(lead, "fsdp", "tp")),  # [L, D, F]  column
+        (r"blocks/.*mlp_down/kernel$",  P(lead, "tp", "fsdp")),  # [L, F, D]  row
+        (r"blocks/moe/router/kernel$",  P(lead)),               # [L, D, E] small
+        (r"blocks/moe/up/kernel$",      P(lead, "ep", "fsdp", "tp")),   # [L,E,D,F]
+        (r"blocks/moe/down/kernel$",    P(lead, "ep", "tp", "fsdp")),   # [L,E,F,D]
+        (r"blocks/moe/.*bias$",         P(lead, "ep")),         # [L, E, ·]
+        (r"blocks/.*(bias|scale)$",     P(lead)),
+        (r"lm_head/kernel$",         P("fsdp", "tp")),       # [D, V]
+        (r"final_norm/",             P()),
+    ])
+
+
+GPT_SHARDING_RULES = sharding_rules(pipelined=False)
+GPT_PP_SHARDING_RULES = sharding_rules(pipelined=True)
 
 # Activation specs: batch over (dp, fsdp), sequence over sp, heads/features over tp.
 TOKENS_SPEC = P(("dp", "fsdp"), "sp")
@@ -99,22 +119,31 @@ def init(key: jax.Array, cfg: GPTConfig) -> Params:
     def stacked(k, shape, stddev=0.02):
         return trunc_normal(k, (L, *shape), stddev=stddev, dtype=dt)
 
+    blocks: Params = {
+        "ln1": {"scale": jnp.ones((L, D), dt), "bias": jnp.zeros((L, D), dt)},
+        "attn_qkv": {"kernel": stacked(keys[1], (D, 3 * D)),
+                     "bias": jnp.zeros((L, 3 * D), dt)},
+        "attn_out": {"kernel": stacked(keys[2], (D, D),
+                                       stddev=0.02 / (2 * L) ** 0.5),
+                     "bias": jnp.zeros((L, D), dt)},
+        "ln2": {"scale": jnp.ones((L, D), dt), "bias": jnp.zeros((L, D), dt)},
+    }
+    if cfg.moe_experts > 0:
+        from determined_clone_tpu.ops.moe import moe_init
+
+        blocks["moe"] = jax.vmap(
+            lambda k: moe_init(k, cfg.moe_experts, D, F, dtype=dt,
+                               out_stddev=0.02 / (2 * L) ** 0.5)
+        )(jax.random.split(keys[3], L))
+    else:
+        blocks["mlp_up"] = {"kernel": stacked(keys[3], (D, F)),
+                            "bias": jnp.zeros((L, F), dt)}
+        blocks["mlp_down"] = {"kernel": stacked(keys[4], (F, D),
+                                                stddev=0.02 / (2 * L) ** 0.5),
+                              "bias": jnp.zeros((L, D), dt)}
     params: Params = {
         "embed": embedding_init(keys[0], cfg.vocab_size, D, dtype=dt),
-        "blocks": {
-            "ln1": {"scale": jnp.ones((L, D), dt), "bias": jnp.zeros((L, D), dt)},
-            "attn_qkv": {"kernel": stacked(keys[1], (D, 3 * D)),
-                         "bias": jnp.zeros((L, 3 * D), dt)},
-            "attn_out": {"kernel": stacked(keys[2], (D, D),
-                                           stddev=0.02 / (2 * L) ** 0.5),
-                         "bias": jnp.zeros((L, D), dt)},
-            "ln2": {"scale": jnp.ones((L, D), dt), "bias": jnp.zeros((L, D), dt)},
-            "mlp_up": {"kernel": stacked(keys[3], (D, F)),
-                       "bias": jnp.zeros((L, F), dt)},
-            "mlp_down": {"kernel": stacked(keys[4], (F, D),
-                                           stddev=0.02 / (2 * L) ** 0.5),
-                         "bias": jnp.zeros((L, D), dt)},
-        },
+        "blocks": blocks,
         "final_norm": layernorm_init(D, dtype=dt),
     }
     if not cfg.tie_embeddings:
@@ -123,8 +152,9 @@ def init(key: jax.Array, cfg: GPTConfig) -> Params:
 
 
 def _block(cfg: GPTConfig, block_params: Params, x: jax.Array,
-           positions: jax.Array, dropout_key: Optional[jax.Array]) -> jax.Array:
-    """One pre-LN transformer block. x: [B, T, D] in compute dtype."""
+           positions: jax.Array, dropout_key: Optional[jax.Array]):
+    """One pre-LN transformer block. x: [B, T, D] in compute dtype.
+    Returns (x, aux) — aux is the MoE load-balancing loss (0 for dense)."""
     B, T, D = x.shape
     H, hd = cfg.n_heads, cfg.head_dim
     k_attn = k_mlp = None
@@ -146,19 +176,33 @@ def _block(cfg: GPTConfig, block_params: Params, x: jax.Array,
     x = x + dropout(k_attn, attn, cfg.dropout, training=k_attn is not None)
 
     h = layernorm(block_params["ln2"], x)
-    h = dense(block_params["mlp_up"], h, compute_dtype=cfg.compute_dtype)
-    h = jax.nn.gelu(h, approximate=True)
-    h = dense(block_params["mlp_down"], h, compute_dtype=cfg.compute_dtype)
-    return x + dropout(k_mlp, h, cfg.dropout, training=k_mlp is not None)
+    if cfg.moe_experts > 0:
+        h, aux = moe_ffn(block_params["moe"], h, k=cfg.moe_k,
+                         capacity_factor=cfg.moe_capacity_factor,
+                         compute_dtype=cfg.compute_dtype)
+    else:
+        h = dense(block_params["mlp_up"], h, compute_dtype=cfg.compute_dtype)
+        h = jax.nn.gelu(h, approximate=True)
+        h = dense(block_params["mlp_down"], h, compute_dtype=cfg.compute_dtype)
+        aux = jnp.zeros((), jnp.float32)
+    x = x + dropout(k_mlp, h, cfg.dropout, training=k_mlp is not None)
+    return x, aux
 
 
-def apply(params: Params, cfg: GPTConfig, tokens: jax.Array, *,
-          training: bool = False,
-          dropout_key: Optional[jax.Array] = None) -> jax.Array:
-    """Forward pass → logits [B, T, V] (fp32). tokens: int32 [B, T].
+def _forward(params: Params, cfg: GPTConfig, tokens: jax.Array, *,
+             training: bool = False,
+             dropout_key: Optional[jax.Array] = None,
+             mesh: Optional[Any] = None):
+    """Forward pass → (logits [B, T, V] fp32, aux scalar). tokens: int32 [B, T].
 
     Dropout is active only when ``training`` and ``dropout_key`` are given and
     ``cfg.dropout > 0``; per-layer keys are split outside the scan.
+
+    With a mesh whose ``pp`` axis is > 1 and ``cfg.pipeline_microbatches > 1``,
+    the block stack runs as a GPipe pipeline (parallel/pipeline.py): layers are
+    sliced over pp, activations rotate the stage ring. (In that mode per-layer
+    dropout keys are shared across microbatches — masks repeat across
+    microbatches of one step; statistically harmless.)
     """
     B, T = tokens.shape
     positions = jnp.arange(T)
@@ -176,34 +220,84 @@ def apply(params: Params, cfg: GPTConfig, tokens: jax.Array, *,
             block_fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
         )
 
-    if layer_keys is not None:
+    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    if pp > 1 and cfg.pipeline_microbatches > 1:
+        from determined_clone_tpu.parallel.pipeline import pipeline_apply
+
+        M = cfg.pipeline_microbatches
+        stacked: Params = {"blocks": params["blocks"]}
+        if layer_keys is not None:
+            stacked["keys"] = layer_keys
+
+        def stage_fn(local, carrier):
+            has_keys = "keys" in local
+            xs = (local["blocks"], local["keys"]) if has_keys else local["blocks"]
+
+            def body(carry, inp):
+                h, aux = carry
+                lp, key = inp if has_keys else (inp, None)
+                h, a = block_fn(lp, h, key)
+                # Spread the scalar aux over the microbatch's batch rows so the
+                # carrier keeps its [mb] shape; summing recovers the total.
+                return (h, aux + a / h.shape[0]), None
+
+            (h, aux), _ = jax.lax.scan(body, (carrier["x"], carrier["aux"]), xs)
+            return {"x": h, "aux": aux}
+
+        carrier = {"x": x, "aux": jnp.zeros((B,), jnp.float32)}
+        out = pipeline_apply(stage_fn, stacked, carrier, mesh=mesh,
+                             num_microbatches=M)
+        x = out["x"]
+        aux_total = jnp.sum(out["aux"]) / M  # mean over microbatches
+    elif layer_keys is not None:
         def scan_body(x, inputs):
             layer_params, key = inputs
-            return block_fn(layer_params, x, key), None
-        x, _ = jax.lax.scan(scan_body, x, (params["blocks"], layer_keys))
+            x, aux = block_fn(layer_params, x, key)
+            return x, aux
+        x, aux_stack = jax.lax.scan(scan_body, x, (params["blocks"], layer_keys))
+        aux_total = jnp.sum(aux_stack)
     else:
         def scan_body(x, layer_params):
-            return block_fn(layer_params, x, None), None
-        x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+            x, aux = block_fn(layer_params, x, None)
+            return x, aux
+        x, aux_stack = jax.lax.scan(scan_body, x, params["blocks"])
+        aux_total = jnp.sum(aux_stack)
+
     x = layernorm(params["final_norm"], x)
     if cfg.tie_embeddings:
         logits = x.astype(jnp.float32) @ params["embed"]["table"].astype(jnp.float32).T
     else:
         logits = dense(params["lm_head"], x, compute_dtype=jnp.float32)
-    return logits.astype(jnp.float32)
+    return logits.astype(jnp.float32), aux_total
+
+
+def apply(params: Params, cfg: GPTConfig, tokens: jax.Array, *,
+          training: bool = False,
+          dropout_key: Optional[jax.Array] = None,
+          mesh: Optional[Any] = None) -> jax.Array:
+    """Forward pass → logits [B, T, V] (fp32); see ``_forward``."""
+    logits, _ = _forward(params, cfg, tokens, training=training,
+                         dropout_key=dropout_key, mesh=mesh)
+    return logits
 
 
 def loss_fn(params: Params, cfg: GPTConfig, tokens: jax.Array,
             targets: jax.Array, mask: Optional[jax.Array] = None, *,
             training: bool = False,
-            dropout_key: Optional[jax.Array] = None) -> jax.Array:
-    """Mean next-token cross-entropy. targets/mask: [B, T]."""
-    logits = apply(params, cfg, tokens, training=training, dropout_key=dropout_key)
+            dropout_key: Optional[jax.Array] = None,
+            mesh: Optional[Any] = None) -> jax.Array:
+    """Mean next-token cross-entropy (+ MoE aux loss). targets/mask: [B, T]."""
+    logits, aux = _forward(params, cfg, tokens, training=training,
+                           dropout_key=dropout_key, mesh=mesh)
     per_tok = softmax_cross_entropy(logits, targets)
     if mask is not None:
         maskf = mask.astype(jnp.float32)
-        return jnp.sum(per_tok * maskf) / jnp.maximum(jnp.sum(maskf), 1.0)
-    return jnp.mean(per_tok)
+        ce = jnp.sum(per_tok * maskf) / jnp.maximum(jnp.sum(maskf), 1.0)
+    else:
+        ce = jnp.mean(per_tok)
+    if cfg.moe_experts > 0:
+        ce = ce + cfg.moe_aux_weight * aux
+    return ce
 
 
 def param_count(params: Params) -> int:

@@ -1,4 +1,4 @@
 """Functional NN ops and Pallas TPU kernels."""
-from determined_clone_tpu.ops import attention, layers
+from determined_clone_tpu.ops import attention, layers, moe
 
-__all__ = ["attention", "layers"]
+__all__ = ["attention", "layers", "moe"]

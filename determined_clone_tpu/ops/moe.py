@@ -1,0 +1,116 @@
+"""Mixture-of-Experts FFN with expert parallelism over the mesh's ``ep`` axis.
+
+Absent in the reference (SURVEY.md §2.7: "Expert parallel (EP / MoE) — ❌
+absent"); first-class here. The design is the TPU-idiomatic einsum-dispatch
+form (Switch-Transformer style): routing is expressed as dense one-hot
+dispatch/combine tensors so every op is a static-shaped einsum the MXU can
+tile — no gather/scatter, no dynamic shapes. When expert weights carry an
+``ep`` PartitionSpec, XLA lowers the dispatch einsum to an all-to-all over the
+ep axis automatically.
+
+Capacity semantics: each expert processes at most C = ceil(tokens/E ·
+capacity_factor) tokens; overflow tokens fall through the residual connection
+(standard drop-token behavior). The router adds the load-balancing auxiliary
+loss E · Σ_e f_e·P_e from the Switch paper.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from determined_clone_tpu.ops.layers import trunc_normal
+
+Params = Dict[str, Any]
+
+
+def moe_init(key: jax.Array, n_experts: int, d_model: int, d_ff: int,
+             dtype=jnp.float32, out_stddev: float = 0.02) -> Params:
+    """Expert-stacked FFN params: leading [E] expert dim (sharded over ep)."""
+    k_r, k_up, k_dn = jax.random.split(key, 3)
+    return {
+        "router": {"kernel": trunc_normal(k_r, (d_model, n_experts),
+                                          stddev=0.02, dtype=dtype)},
+        "up": {"kernel": trunc_normal(k_up, (n_experts, d_model, d_ff),
+                                      stddev=0.02, dtype=dtype),
+               "bias": jnp.zeros((n_experts, d_ff), dtype)},
+        "down": {"kernel": trunc_normal(k_dn, (n_experts, d_ff, d_model),
+                                        stddev=out_stddev, dtype=dtype),
+                 "bias": jnp.zeros((n_experts, d_model), dtype)},
+    }
+
+
+def expert_capacity(n_tokens: int, n_experts: int,
+                    capacity_factor: float) -> int:
+    return max(1, math.ceil(n_tokens / n_experts * capacity_factor))
+
+
+def moe_ffn(
+    params: Params,
+    x: jax.Array,
+    *,
+    k: int = 2,
+    capacity_factor: float = 1.25,
+    compute_dtype=jnp.bfloat16,
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k routed expert FFN. x: [B, T, D] → ([B, T, D], aux_loss scalar).
+
+    All shapes static: dispatch/combine are [N, E, C] one-hot tensors, expert
+    compute is batched einsum over the [E] dim (ep-shardable).
+    """
+    B, T, D = x.shape
+    N = B * T
+    E = params["router"]["kernel"].shape[-1]
+    C = expert_capacity(N, E, capacity_factor)
+    k = min(k, E)
+
+    tokens = x.reshape(N, D)
+    # Router in fp32 for a stable softmax.
+    logits = tokens.astype(jnp.float32) @ params["router"]["kernel"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                       # [N, E]
+
+    # Top-k choices, processed in priority order so earlier choices claim
+    # capacity first (running per-expert token counts carry between choices).
+    top_probs, top_idx = jax.lax.top_k(probs, k)                  # [N, k]
+    # Renormalize the chosen gates so combine weights sum to 1 per token.
+    top_probs = top_probs / jnp.maximum(
+        jnp.sum(top_probs, axis=-1, keepdims=True), 1e-9)
+
+    dispatch = jnp.zeros((N, E, C), jnp.bool_)
+    combine = jnp.zeros((N, E, C), jnp.float32)
+    counts = jnp.zeros((E,), jnp.int32)                           # claimed slots
+    for i in range(k):
+        mask_i = jax.nn.one_hot(top_idx[:, i], E, dtype=jnp.int32)   # [N, E]
+        pos_i = jnp.cumsum(mask_i, axis=0) - mask_i + counts[None, :]
+        pos = jnp.sum(pos_i * mask_i, axis=-1)                    # [N] slot per token
+        keep = pos < C
+        counts = counts + jnp.sum(mask_i, axis=0)
+        onehot_pos = jax.nn.one_hot(pos, C, dtype=jnp.float32)    # [N, C]
+        d_i = (mask_i.astype(jnp.float32)[:, :, None] * onehot_pos[:, None, :]
+               * keep.astype(jnp.float32)[:, None, None])
+        dispatch = dispatch | (d_i > 0)
+        combine = combine + d_i * top_probs[:, i][:, None, None]
+
+    # Dispatch → expert compute → combine. XLA turns the E-dim contractions
+    # into an all-to-all when up/down kernels are sharded over ep.
+    xe = jnp.einsum("nec,nd->ecd", dispatch.astype(compute_dtype),
+                    tokens.astype(compute_dtype))                 # [E, C, D]
+    h = jnp.einsum("ecd,edf->ecf", xe,
+                   params["up"]["kernel"].astype(compute_dtype))
+    h = h + params["up"]["bias"].astype(compute_dtype)[:, None, :]
+    h = jax.nn.gelu(h, approximate=True)
+    ye = jnp.einsum("ecf,efd->ecd", h,
+                    params["down"]["kernel"].astype(compute_dtype))
+    ye = ye + params["down"]["bias"].astype(compute_dtype)[:, None, :]
+    y = jnp.einsum("nec,ecd->nd", combine.astype(compute_dtype), ye)
+
+    # Switch load-balancing loss: E · Σ_e (dispatch fraction · router prob).
+    # First-choice assignment fractions, as in the paper.
+    first = jax.nn.one_hot(top_idx[:, 0], E, dtype=jnp.float32)
+    f = jnp.mean(first, axis=0)
+    p = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(f * p)
+
+    return y.reshape(B, T, D).astype(x.dtype), aux.astype(jnp.float32)

@@ -11,6 +11,11 @@ from determined_clone_tpu.parallel.mesh import (
     mesh_axis_size,
     single_device_mesh,
 )
+from determined_clone_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_bubble_fraction,
+    pipeline_stage_spec,
+)
 from determined_clone_tpu.parallel.sharding import (
     ShardingRules,
     batch_spec,
@@ -28,6 +33,9 @@ __all__ = [
     "make_mesh",
     "mesh_axis_size",
     "single_device_mesh",
+    "pipeline_apply",
+    "pipeline_bubble_fraction",
+    "pipeline_stage_spec",
     "ShardingRules",
     "batch_spec",
     "batch_seq_spec",

@@ -1,0 +1,177 @@
+"""Pipeline parallelism over the mesh's ``pp`` axis — GPipe-style microbatching.
+
+The reference gets pipeline parallelism by launching DeepSpeed and wrapping its
+``PipelineParallelGrid`` topology (harness/determined/pytorch/deepspeed/_mpu.py:38,
+SURVEY.md §2.7). Here PP is a mesh axis like any other: model blocks keep their
+stacked ``[L, ...]`` leading layer dim, the layer dim is sharded over ``pp``, and
+a ``jax.shard_map`` that is *manual only over pp* (every other axis — dp/fsdp/
+tp/sp/ep — stays under the automatic partitioner) rotates activations around
+the stage ring with ``lax.ppermute`` while each stage runs its local layers.
+
+Schedule: GPipe. For M microbatches and P stages the loop runs M + P - 1 ticks;
+tick t has stage s working on microbatch t - s (when in range), so the steady
+state keeps every stage busy and the bubble is the usual (P-1)/(M+P-1) fraction.
+The whole schedule is one ``lax.scan`` — one compiled tick body, reverse-mode
+differentiable, no Python-level unrolling.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+StageFn = Callable[[Any, Any], Any]
+
+
+def pipeline_stage_spec() -> P:
+    """PartitionSpec for stacked-layer params entering the pipeline: the
+    leading [L] layer dim is split over pp into per-stage slices."""
+    return P("pp")
+
+
+def pipeline_apply(
+    stage_fn: StageFn,
+    stacked_params: Any,
+    x: Any,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis_name: str = "pp",
+) -> Any:
+    """Run carrier ``x`` through all pipeline stages of a stacked-layer model.
+
+    ``stage_fn(local_params, x_mb) -> y_mb`` applies ONE stage's layers: it
+    receives the stage's slice of ``stacked_params`` (leading dim L/P) and one
+    microbatch of the carrier, and must preserve the carrier's structure,
+    shapes, and dtypes (residual-stream semantics — true of transformer
+    blocks; side outputs like MoE aux losses ride along as extra leaves).
+
+    ``stacked_params`` is any pytree whose every leaf has a leading layer dim
+    divisible by the pp size. ``x`` is a pytree whose every leaf has a leading
+    batch dim ``B`` divisible by ``num_microbatches``; leaves are split into
+    microbatches along dim 0.
+
+    Inside the shard_map only ``pp`` is manual; dp/fsdp/tp/sp/ep sharding of
+    the batch and params keeps flowing through XLA's automatic partitioner, so
+    PP composes with every other axis.
+    """
+    n_stages = mesh.shape[axis_name]
+    if n_stages == 1:
+        return stage_fn(stacked_params, x)
+    M = num_microbatches
+    for path, leaf in jax.tree_util.tree_flatten_with_path(x)[0]:
+        if leaf.ndim == 0:
+            raise ValueError(
+                f"carrier leaf {jax.tree_util.keystr(path)} is 0-d; every "
+                f"carrier leaf needs a leading batch dim to split into "
+                f"microbatches (carry scalars as [B]-shaped rows instead)"
+            )
+        if leaf.shape[0] % M != 0:
+            raise ValueError(
+                f"carrier leaf batch dim {leaf.shape[0]} not divisible by "
+                f"num_microbatches={M}"
+            )
+
+    # XLA's CPU backend check-fails on sub-f32 psums over a manual axis while
+    # other axes stay auto ("Invalid binary instruction opcode copy" — hit by
+    # both the output-collect psum and the implicit boundary psum that the
+    # transpose inserts for replicated inputs). On CPU (tests, driver dryrun)
+    # transport the carrier in f32 and hand stage_fn its original dtypes; on
+    # TPU keep the carrier's own dtypes (bf16 ring transport at full rate).
+    widen_cpu = jax.default_backend() == "cpu"
+    carrier_dtypes = jax.tree.map(lambda a: a.dtype, x)
+
+    def to_wire(tree):
+        if not widen_cpu:
+            return tree
+        return jax.tree.map(
+            lambda a: a.astype(jnp.float32)
+            if jnp.issubdtype(a.dtype, jnp.inexact) else a,
+            tree,
+        )
+
+    def from_wire(tree):
+        if not widen_cpu:
+            return tree
+        return jax.tree.map(lambda a, dt: a.astype(dt), tree, carrier_dtypes)
+
+    user_stage_fn = stage_fn
+
+    def stage_fn(local, carrier):  # noqa: F811 — wire-dtype adapter
+        return to_wire(user_stage_fn(local, from_wire(carrier)))
+
+    def pipelined(params_local: Any, x_full: Any) -> Any:
+        stage = jax.lax.axis_index(axis_name)
+        mb = jax.tree.map(
+            lambda a: a.reshape(M, a.shape[0] // M, *a.shape[1:]), x_full
+        )
+        ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            recv, outbuf = carry
+            # Stage 0 feeds fresh microbatches; others consume the ring.
+            x_mb = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, jnp.clip(t, 0, M - 1), 0, keepdims=False
+                ),
+                mb,
+            )
+            inp = jax.tree.map(
+                lambda fresh, r: jnp.where(stage == 0, fresh, r), x_mb, recv
+            )
+            out = stage_fn(params_local, inp)
+            send = jax.tree.map(
+                lambda a: jax.lax.ppermute(a, axis_name, ring), out
+            )
+            # Only the last stage's writes are kept (masked after the scan).
+            # Clipping makes early garbage land in slot 0, overwritten at
+            # t = P-1 by the real microbatch 0 (t ascending ⇒ last write wins).
+            slot = jnp.clip(t - (n_stages - 1), 0, M - 1)
+            outbuf = jax.tree.map(
+                lambda buf, o: jax.lax.dynamic_update_index_in_dim(
+                    buf, o, slot, 0
+                ),
+                outbuf, out,
+            )
+            return (send, outbuf), None
+
+        # The carry becomes pp-varying after the first ppermute; mark the
+        # zero-init that way up front so the scan's carry type is stable.
+        def varying_zeros(a):
+            return jax.lax.pcast(a, (axis_name,), to="varying")
+
+        init = (
+            jax.tree.map(lambda a: varying_zeros(jnp.zeros_like(a[0])), mb),
+            jax.tree.map(lambda a: varying_zeros(jnp.zeros_like(a)), mb),
+        )
+        (_, outbuf), _ = jax.lax.scan(tick, init, jnp.arange(M + n_stages - 1))
+        # Valid outputs live on the last stage; psum replicates them across pp
+        # (cheap at [B, ...] size, and makes the result pp-invariant).
+        def collect(a):
+            masked = jnp.where(stage == n_stages - 1, a, jnp.zeros_like(a))
+            return jax.lax.psum(masked, axis_name)
+
+        outbuf = jax.tree.map(collect, outbuf)
+        return jax.tree.map(
+            lambda a: a.reshape(a.shape[0] * a.shape[1], *a.shape[2:]), outbuf
+        )
+
+    out = jax.shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=(
+            jax.tree.map(lambda _: pipeline_stage_spec(), stacked_params),
+            jax.tree.map(lambda _: P(), x),
+        ),
+        out_specs=jax.tree.map(lambda _: P(), x),
+        axis_names=frozenset({axis_name}),
+    )(stacked_params, to_wire(x))
+    return from_wire(out)
+
+
+def pipeline_bubble_fraction(num_microbatches: int, n_stages: int) -> float:
+    """Idle fraction of the GPipe schedule — exposed for the autotuner."""
+    return (n_stages - 1) / (num_microbatches + n_stages - 1)

@@ -81,7 +81,9 @@ class TestDistributedContext:
 
         with ThreadPoolExecutor(max_workers=3) as pool:
             futs = [pool.submit(fn, r) for r in range(3)]
-            results = [f.result(timeout=30) for f in futs]
+            # generous timeout: the suite's XLA compiles can starve these
+            # threads on a loaded box; only a hang should fail this
+            results = [f.result(timeout=120) for f in futs]
         for got, bc in results:
             assert got == ["rank0", "rank1", "rank2"]
             assert bc == "hello"
