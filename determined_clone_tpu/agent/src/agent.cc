@@ -183,10 +183,29 @@ class Agent {
       }
       // stdout/stderr → log file (shipped to master on exit; live shipping
       // is the harness's log-batch POST)
+      ::setenv("DCT_TASK_TYPE", cmd["task_type"].as_string().c_str(), 1);
+      if (cmd["spec"]["env"].is_object()) {
+        for (const auto& [k, v] : cmd["spec"]["env"].items()) {
+          ::setenv(k.c_str(), v.as_string().c_str(), 1);
+        }
+      }
       FILE* log = ::freopen(log_path.c_str(), "a", stdout);
       (void)log;
       ::dup2(::fileno(stdout), ::fileno(stderr));
 
+      // NTSC tasks carry an explicit argv (≈ the reference's generic task
+      // container spec, tasks/task_command.go); trials exec the harness.
+      const Json& argv = cmd["spec"]["argv"];
+      if (argv.is_array() && argv.size() > 0) {
+        std::vector<std::string> args;
+        for (const auto& e : argv.elements()) args.push_back(e.as_string());
+        std::vector<char*> cargs;
+        for (auto& a : args) cargs.push_back(a.data());
+        cargs.push_back(nullptr);
+        ::execvp(cargs[0], cargs.data());
+        std::cerr << "execvp failed: " << std::strerror(errno) << std::endl;
+        std::_Exit(81);
+      }
       std::string entrypoint = cmd["spec"]["entrypoint"].as_string();
       if (entrypoint.empty()) {
         std::cerr << "no entrypoint for " << alloc_id << std::endl;

@@ -101,3 +101,28 @@ class MasterSession:
     def task_logs(self, allocation_id: str, limit: int = 1000) -> list:
         return self.get(
             f"/api/v1/allocations/{allocation_id}/logs?limit={limit}")["logs"]
+
+    # -- NTSC tasks (notebooks/shells/commands/tensorboards) ---------------
+
+    def create_task(self, task_type: str, **kwargs: Any) -> Dict[str, Any]:
+        """kwargs: name, cmd (argv, command type), slots, resource_pool,
+        priority, idle_timeout, env, experiment_ids (tensorboard)."""
+        body = {"type": task_type, **kwargs}
+        return self.post("/api/v1/tasks", body)["task"]
+
+    def list_tasks(self, task_type: Optional[str] = None) -> list:
+        path = "/api/v1/tasks"
+        if task_type:
+            path += f"?type={task_type}"
+        return self.get(path)["tasks"]
+
+    def get_task(self, task_id: str) -> Dict[str, Any]:
+        return self.get(f"/api/v1/tasks/{task_id}")["task"]
+
+    def kill_task(self, task_id: str) -> Dict[str, Any]:
+        return self.post(f"/api/v1/tasks/{task_id}/kill")["task"]
+
+    def proxy(self, task_id: str, path: str, method: str = "GET",
+              body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Reach a task's HTTP app through the master's reverse proxy."""
+        return self.request(method, f"/proxy/{task_id}{path}", body)

@@ -1,0 +1,183 @@
+"""Generic NTSC task server — notebooks, shells, tensorboards.
+
+≈ the reference's non-trial task containers (master/internal/command/
+command.go builds the spec; the container runs jupyter/sshd/tensorboard and
+the harness registers a proxy address, prep_container.py:231). Here one
+runner covers the built-in types with a small HTTP app served behind the
+master's reverse proxy (/proxy/<task_id>/...):
+
+- ``shell``:       POST /exec {"cmd": [...]} → {stdout, stderr, code}
+                   (the det-shell remote-exec capability without sshd)
+- ``notebook``:    execs jupyter if installed (DCT_NOTEBOOK_REAL=1), else
+                   serves a landing page + the same /exec surface
+- ``tensorboard``: GET /data → metric history for the requested
+                   experiments, fetched live from the master (the reference
+                   TB task fetches tfevents from checkpoint storage;
+                   tfevents fetching is wired in tensorboard/fetchers)
+
+Usage (by the agent, argv built master-side in routes.cc "tasks"):
+    python -m determined_clone_tpu.exec.task <mode> [--experiment-ids 1,2]
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List
+
+
+def _master() -> "MasterSession":
+    from determined_clone_tpu.api.client import MasterSession
+
+    return MasterSession(
+        host=os.environ.get("DCT_MASTER_HOST", "127.0.0.1"),
+        port=int(os.environ.get("DCT_MASTER_PORT", "8080")),
+    )
+
+
+def local_address() -> str:
+    """The local interface address the master can reach us on: the one this
+    host uses to talk to the master (loopback when the master is local)."""
+    master_host = os.environ.get("DCT_MASTER_HOST", "127.0.0.1")
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect((master_host,
+                       int(os.environ.get("DCT_MASTER_PORT", "8080"))))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+def register_proxy(addr: str, port: int) -> None:
+    """Tell the master where to reverse-proxy this task's HTTP traffic."""
+    alloc_id = os.environ["DCT_ALLOCATION_ID"]
+    _master().request(
+        "POST", f"/api/v1/allocations/{alloc_id}/proxy",
+        {"address": f"{addr}:{port}"}, retryable=True,
+    )
+
+
+def fetch_tb_data(experiment_ids: List[int]) -> Dict[str, Any]:
+    """Metric history per trial for each experiment, from the master."""
+    session = _master()
+    out: Dict[str, Any] = {}
+    for eid in experiment_ids:
+        try:
+            detail = session.request("GET", f"/api/v1/experiments/{eid}")
+        except Exception as exc:  # experiment may be gone
+            out[str(eid)] = {"error": str(exc)}
+            continue
+        trials = {}
+        for trial in detail.get("trials", []):
+            tid = trial["id"]
+            metrics = session.request(
+                "GET", f"/api/v1/trials/{tid}/metrics?limit=10000")
+            trials[str(tid)] = metrics.get("metrics", [])
+        out[str(eid)] = {"trials": trials}
+    return out
+
+
+class TaskHandler(BaseHTTPRequestHandler):
+    mode = "shell"
+    experiment_ids: List[int] = []
+
+    def _send(self, status: int, payload: Dict[str, Any]) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        print("[task]", fmt % args, flush=True)
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        if self.path.rstrip("/") in ("", "/"):
+            self._send(200, {
+                "task": os.environ.get("DCT_ALLOCATION_ID", ""),
+                "mode": self.mode,
+                "endpoints": ["/exec (POST)", "/data (GET, tensorboard)"],
+            })
+            return
+        if self.path.startswith("/data") and self.mode == "tensorboard":
+            self._send(200, {"experiments": fetch_tb_data(self.experiment_ids)})
+            return
+        self._send(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self) -> None:  # noqa: N802
+        length = int(self.headers.get("Content-Length", "0"))
+        try:
+            body = json.loads(self.rfile.read(length) or b"{}")
+        except json.JSONDecodeError:
+            self._send(400, {"error": "invalid json"})
+            return
+        if self.path.startswith("/exec"):
+            cmd = body.get("cmd")
+            if not isinstance(cmd, list) or not cmd:
+                self._send(400, {"error": "cmd must be a non-empty argv list"})
+                return
+            try:
+                proc = subprocess.run(
+                    [str(c) for c in cmd], capture_output=True, text=True,
+                    timeout=float(body.get("timeout", 60)),
+                )
+                self._send(200, {
+                    "stdout": proc.stdout, "stderr": proc.stderr,
+                    "code": proc.returncode,
+                })
+            except subprocess.TimeoutExpired:
+                self._send(200, {"stdout": "", "stderr": "timeout", "code": -1})
+            return
+        self._send(404, {"error": f"no route {self.path}"})
+
+
+def main(argv: List[str]) -> int:
+    mode = argv[0] if argv else "shell"
+    experiment_ids: List[int] = []
+    if "--experiment-ids" in argv:
+        raw = argv[argv.index("--experiment-ids") + 1]
+        experiment_ids = [int(x) for x in raw.split(",") if x]
+
+    addr = local_address()
+
+    if mode == "notebook" and os.environ.get("DCT_NOTEBOOK_REAL") == "1":
+        # hand off to a real jupyter server: pick a port, register the proxy
+        # address BEFORE exec replaces this process, then bind jupyter to it
+        with socket.socket() as s:
+            s.bind((addr, 0))
+            port = s.getsockname()[1]
+        register_proxy(addr, port)
+        os.execvp("jupyter", ["jupyter", "lab", "--no-browser",
+                              f"--ip={addr}", f"--port={port}"])
+
+    handler = type("Handler", (TaskHandler,), {
+        "mode": mode, "experiment_ids": experiment_ids,
+    })
+    # bind only the interface registered with the master — /exec must not be
+    # reachable except through the master's authenticated proxy path
+    server = ThreadingHTTPServer((addr, 0), handler)
+    port = server.server_address[1]
+    print(f"[task] {mode} server on {addr}:{port}", flush=True)
+
+    register_proxy(addr, port)
+
+    # graceful preemption: the agent SIGTERMs on preempt/kill
+    def stop(signum: int, frame: Any) -> None:
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, stop)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -112,6 +112,7 @@ void Master::save_snapshot_locked() {
   Json snap = Json::object();
   snap.set("next_experiment_id", next_experiment_id_)
       .set("next_trial_id", next_trial_id_)
+      .set("next_task_id", next_task_id_)
       .set("experiments", exps).set("trials", trials)
       .set("allocations", allocs).set("agents", agents)
       .set("checkpoints", ckpts).set("request_to_trial", req_map);
@@ -140,6 +141,7 @@ void Master::load_snapshot() {
   std::lock_guard<std::mutex> lock(mu_);
   next_experiment_id_ = snap["next_experiment_id"].as_int(1);
   next_trial_id_ = snap["next_trial_id"].as_int(1);
+  next_task_id_ = snap["next_task_id"].as_int(1);
   for (const auto& e : snap["experiments"].elements()) {
     Experiment exp = Experiment::from_json(e);
     int64_t id = exp.id;
@@ -333,7 +335,12 @@ void Master::on_task_done(const std::string& alloc_id, int exit_code,
   if (ait == allocations_.end()) return;
   Allocation& alloc = ait->second;
   bool failed = exit_code != 0;
-  alloc.state = failed ? RunState::Errored : RunState::Completed;
+  alloc.exit_code = exit_code;
+  if (alloc.state != RunState::Canceled) {
+    // a killed/idle-reaped task stays CANCELED; its SIGKILL exit is not an
+    // error (≈ the reference's aborted-allocation classification)
+    alloc.state = failed ? RunState::Errored : RunState::Completed;
+  }
   dirty_ = true;
   if (alloc.trial_id == 0) return;
   auto tit = trials_.find(alloc.trial_id);
@@ -373,6 +380,18 @@ void Master::on_task_done(const std::string& alloc_id, int exit_code,
 
 void Master::tick_locked() {
   double now = now_sec();
+
+  // idle watcher: NTSC tasks with an idle_timeout and no recent proxy
+  // activity are reaped (≈ master/internal/task/idle/watcher.go)
+  for (auto& [id, alloc] : allocations_) {
+    if (alloc.trial_id == 0 && alloc.state == RunState::Running &&
+        alloc.idle_timeout_sec > 0 &&
+        now - std::max(alloc.last_activity, alloc.queued_at) >
+            alloc.idle_timeout_sec) {
+      alloc.state = RunState::Canceled;  // heartbeat derives the kill
+      dirty_ = true;
+    }
+  }
 
   // agent liveness: reconnect-with-amnesia (≈ agent.go:330): a timed-out
   // agent's reservations are released and its allocations requeued

@@ -65,6 +65,10 @@ class Master {
 
   // -- routes --
   HttpResponse route(const HttpRequest& req);
+  // /proxy/:allocID/* — reverse proxy to a running task's registered
+  // address (≈ master/internal/proxy/proxy.go). Forwards OUTSIDE the
+  // master lock; only the address lookup locks.
+  HttpResponse proxy_route(const HttpRequest& req);
 
   MasterConfig config_;
   std::unique_ptr<HttpServer> server_;
@@ -74,6 +78,7 @@ class Master {
   std::mutex mu_;
   int64_t next_experiment_id_ = 1;
   int64_t next_trial_id_ = 1;
+  int64_t next_task_id_ = 1;
   std::map<int64_t, Experiment> experiments_;
   std::map<int64_t, Trial> trials_;
   std::map<std::string, Allocation> allocations_;

@@ -198,6 +198,14 @@ struct Allocation {
   int world_size = 0;        // processes expected (num agents in gang)
   bool preempt_requested = false;
   Json spec;                 // what to run (entrypoint, env, ...)
+  // -- NTSC task fields (≈ master/internal/command/command.go) --
+  std::string name;          // display name for non-trial tasks
+  std::string owner = "admin";
+  std::string proxy_address;   // host:port registered by the task
+                               // (≈ prep_container.py:231 proxy regs)
+  double idle_timeout_sec = 0; // kill idle NTSC tasks (task/idle/watcher.go)
+  double last_activity = 0;    // updated on proxy hits
+  int exit_code = 0;
 
   bool scheduled() const { return !reservations.empty(); }
 
@@ -215,7 +223,11 @@ struct Allocation {
         .set("topology", topology).set("queued_at", queued_at)
         .set("reservations", res).set("rendezvous", rdv)
         .set("world_size", world_size)
-        .set("preempt_requested", preempt_requested).set("spec", spec);
+        .set("preempt_requested", preempt_requested).set("spec", spec)
+        .set("name", name).set("owner", owner)
+        .set("proxy_address", proxy_address)
+        .set("idle_timeout_sec", idle_timeout_sec)
+        .set("last_activity", last_activity).set("exit_code", exit_code);
     return j;
   }
   static Allocation from_json(const Json& j) {
@@ -238,6 +250,12 @@ struct Allocation {
     a.world_size = static_cast<int>(j["world_size"].as_int());
     a.preempt_requested = j["preempt_requested"].as_bool();
     a.spec = j["spec"];
+    a.name = j["name"].as_string();
+    a.owner = j["owner"].as_string().empty() ? "admin" : j["owner"].as_string();
+    a.proxy_address = j["proxy_address"].as_string();
+    a.idle_timeout_sec = j["idle_timeout_sec"].as_number();
+    a.last_activity = j["last_activity"].as_number();
+    a.exit_code = static_cast<int>(j["exit_code"].as_int());
     return a;
   }
 };

@@ -5,6 +5,7 @@
 // searcher ops, checkpoints, agents, allocations (rendezvous/preemption),
 // task logs, job queue, master info.
 #include <algorithm>
+#include <cctype>
 #include <set>
 
 #include "master.h"
@@ -26,14 +27,80 @@ HttpResponse not_found(const std::string& msg) {
   return HttpResponse::json(404, error_json(msg).dump());
 }
 
+std::string url_encode(const std::string& s) {
+  static const char* hex = "0123456789ABCDEF";
+  std::string out;
+  for (unsigned char c : s) {
+    if (std::isalnum(c) || c == '-' || c == '_' || c == '.' || c == '~') {
+      out += static_cast<char>(c);
+    } else {
+      out += '%';
+      out += hex[c >> 4];
+      out += hex[c & 0xF];
+    }
+  }
+  return out;
+}
+
 }  // namespace
 
 HttpResponse Master::handle(const HttpRequest& req) {
   try {
+    if (req.path_parts.size() >= 2 && req.path_parts[0] == "proxy") {
+      return proxy_route(req);
+    }
     return route(req);
   } catch (const std::exception& e) {
     return HttpResponse::json(500, error_json(e.what()).dump());
   }
+}
+
+HttpResponse Master::proxy_route(const HttpRequest& req) {
+  const std::string& alloc_id = req.path_parts[1];
+  std::string address;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = allocations_.find(alloc_id);
+    if (it == allocations_.end()) return not_found("no allocation " + alloc_id);
+    if (it->second.proxy_address.empty()) {
+      return HttpResponse::json(
+          502, error_json("task has not registered a proxy address").dump());
+    }
+    address = it->second.proxy_address;
+    it->second.last_activity = now_sec();
+    dirty_ = true;  // persists activity across master restarts (idle watcher)
+  }
+  std::string host = address;
+  int port = 80;
+  auto colon = address.rfind(':');
+  if (colon != std::string::npos) {
+    host = address.substr(0, colon);
+    try {
+      port = std::stoi(address.substr(colon + 1));
+    } catch (const std::exception&) {
+      return HttpResponse::json(
+          502, error_json("invalid proxy address " + address).dump());
+    }
+  }
+  // re-encode: path_parts/query were url-decoded by the server (http.cc)
+  std::string path;
+  for (size_t i = 2; i < req.path_parts.size(); ++i) {
+    path += "/" + url_encode(req.path_parts[i]);
+  }
+  if (path.empty()) path = "/";
+  if (!req.query.empty()) {
+    std::string qs;
+    for (const auto& [k, v] : req.query) {
+      qs += (qs.empty() ? "?" : "&") + url_encode(k) + "=" + url_encode(v);
+    }
+    path += qs;
+  }
+  auto resp = http_request(host, port, req.method, path, req.body, 30);
+  if (!resp) {
+    return HttpResponse::json(
+        502, error_json("task at " + address + " unreachable").dump());
+  }
+  return HttpResponse::json(resp->status, resp->body);
 }
 
 HttpResponse Master::route(const HttpRequest& req) {
@@ -234,6 +301,112 @@ HttpResponse Master::route(const HttpRequest& req) {
     return not_found("no checkpoint " + parts[3]);
   }
 
+  // ---- NTSC tasks: notebooks/shells/commands/tensorboards ----------------
+  // (≈ master/internal/command/command_service.go + api_{notebook,shell,
+  //  tensorboard,command}.go, collapsed onto the shared allocation path)
+  if (root == "tasks") {
+    if (parts.size() == 3 && req.method == "POST") {
+      Json body = Json::parse(req.body);
+      std::string type = body["type"].as_string();
+      if (type.empty()) type = "command";
+      if (type != "command" && type != "notebook" && type != "shell" &&
+          type != "tensorboard") {
+        return bad_request("unknown task type " + type);
+      }
+      Allocation alloc;
+      alloc.id = "task-" + type + "-" + std::to_string(next_task_id_++);
+      alloc.task_type = type;
+      alloc.trial_id = 0;
+      alloc.name = body["name"].as_string().empty() ? alloc.id
+                                                    : body["name"].as_string();
+      if (!body["owner"].as_string().empty()) {
+        alloc.owner = body["owner"].as_string();
+      }
+      alloc.state = RunState::Queued;
+      alloc.slots = static_cast<int>(body["slots"].as_int(0));
+      alloc.priority = static_cast<int>(body["priority"].as_int(42));
+      alloc.resource_pool = body["resource_pool"].as_string().empty()
+                                ? "default"
+                                : body["resource_pool"].as_string();
+      alloc.idle_timeout_sec = body["idle_timeout"].as_number(0);
+      alloc.queued_at = now_sec();
+      alloc.last_activity = alloc.queued_at;
+      // the agent execs spec.argv directly; built-in task types run the
+      // generic harness task server (determined_clone_tpu/exec/task.py)
+      Json argv = Json::array();
+      if (type == "command") {
+        if (!body["cmd"].is_array() || body["cmd"].size() == 0) {
+          return bad_request("command task requires cmd argv array");
+        }
+        for (const auto& e : body["cmd"].elements()) {
+          if (!e.is_string() || e.as_string().empty()) {
+            return bad_request("cmd argv elements must be non-empty strings");
+          }
+        }
+        argv = body["cmd"];
+      } else {
+        argv.push_back("python");
+        argv.push_back("-m");
+        argv.push_back("determined_clone_tpu.exec.task");
+        argv.push_back(type);
+        if (type == "tensorboard" && body["experiment_ids"].is_array()) {
+          std::string ids;
+          for (const auto& e : body["experiment_ids"].elements()) {
+            if (!ids.empty()) ids += ",";
+            ids += std::to_string(e.as_int());
+          }
+          argv.push_back("--experiment-ids");
+          argv.push_back(ids);
+        }
+      }
+      alloc.spec.set("argv", argv);
+      if (body["env"].is_object()) alloc.spec.set("env", body["env"]);
+      std::string id = alloc.id;
+      allocations_[id] = std::move(alloc);
+      dirty_ = true;
+      Json j = Json::object();
+      j.set("task", allocations_[id].to_json());
+      return HttpResponse::json(201, j.dump());
+    }
+    if (parts.size() == 3 && req.method == "GET") {
+      auto type_filter = req.query.find("type");
+      Json arr = Json::array();
+      for (const auto& [id, a] : allocations_) {
+        if (a.trial_id != 0 || a.task_type == "trial") continue;
+        if (type_filter != req.query.end() &&
+            a.task_type != type_filter->second) {
+          continue;
+        }
+        arr.push_back(a.to_json());
+      }
+      Json j = Json::object();
+      j.set("tasks", arr);
+      return ok_json(j);
+    }
+    if (parts.size() >= 4) {
+      auto it = allocations_.find(parts[3]);
+      if (it == allocations_.end() || it->second.task_type == "trial") {
+        return not_found("no task " + parts[3]);
+      }
+      Allocation& alloc = it->second;
+      if (parts.size() == 4 && req.method == "GET") {
+        Json j = Json::object();
+        j.set("task", alloc.to_json());
+        return ok_json(j);
+      }
+      if (parts.size() == 5 && parts[4] == "kill" && req.method == "POST") {
+        if (alloc.state == RunState::Queued || alloc.state == RunState::Pulling ||
+            alloc.state == RunState::Running) {
+          alloc.state = RunState::Canceled;  // heartbeat derives the kill
+          dirty_ = true;
+        }
+        Json j = Json::object();
+        j.set("task", alloc.to_json());
+        return ok_json(j);
+      }
+    }
+  }
+
   // ---- agents ------------------------------------------------------------
   if (root == "agents") {
     if (parts.size() == 3 && req.method == "GET") {
@@ -380,6 +553,32 @@ HttpResponse Master::route(const HttpRequest& req) {
     if (parts[4] == "preempt" && req.method == "GET") {
       Json j = Json::object();
       j.set("preempt", alloc.preempt_requested);
+      return ok_json(j);
+    }
+    // proxy address registration (≈ prep_container.py:231 proxy regs)
+    if (parts[4] == "proxy") {
+      if (req.method == "POST") {
+        Json body = Json::parse(req.body);
+        const std::string& addr = body["address"].as_string();
+        // validate now so proxying can't hit a malformed address later
+        auto colon = addr.rfind(':');
+        bool valid = colon != std::string::npos && colon > 0 &&
+                     colon + 1 < addr.size();
+        if (valid) {
+          for (size_t i = colon + 1; i < addr.size(); ++i) {
+            if (!std::isdigit(static_cast<unsigned char>(addr[i]))) {
+              valid = false;
+              break;
+            }
+          }
+        }
+        if (!valid) return bad_request("proxy address must be host:port");
+        alloc.proxy_address = addr;
+        alloc.last_activity = now_sec();
+        dirty_ = true;
+      }
+      Json j = Json::object();
+      j.set("address", alloc.proxy_address);
       return ok_json(j);
     }
     if (parts[4] == "logs") {
