@@ -6,8 +6,14 @@ from __future__ import annotations
 import json
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Any, Dict, Optional
+
+
+def _q(segment: Any) -> str:
+    """Percent-encode one URL path segment (names may contain spaces etc.)."""
+    return urllib.parse.quote(str(segment), safe="")
 
 
 class MasterError(RuntimeError):
@@ -23,6 +29,7 @@ class MasterSession:
         self.port = port
         self.timeout = timeout
         self.retries = retries
+        self.token: Optional[str] = None  # set by login()
 
     @property
     def base_url(self) -> str:
@@ -41,9 +48,12 @@ class MasterSession:
         data = json.dumps(body).encode() if body is not None else None
         last_err: Optional[Exception] = None
         for attempt in range(attempts):
+            headers = {"Content-Type": "application/json"}
+            if self.token:
+                headers["Authorization"] = f"Bearer {self.token}"
             req = urllib.request.Request(
                 self.base_url + path, data=data, method=method,
-                headers={"Content-Type": "application/json"},
+                headers=headers,
             )
             try:
                 with urllib.request.urlopen(req, timeout=self.timeout) as resp:
@@ -113,7 +123,7 @@ class MasterSession:
     def list_tasks(self, task_type: Optional[str] = None) -> list:
         path = "/api/v1/tasks"
         if task_type:
-            path += f"?type={task_type}"
+            path += f"?type={_q(task_type)}"
         return self.get(path)["tasks"]
 
     def get_task(self, task_id: str) -> Dict[str, Any]:
@@ -126,3 +136,78 @@ class MasterSession:
               body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         """Reach a task's HTTP app through the master's reverse proxy."""
         return self.request(method, f"/proxy/{task_id}{path}", body)
+
+    # -- auth / users ------------------------------------------------------
+
+    def login(self, username: str, password: str = "") -> Dict[str, Any]:
+        out = self.post("/api/v1/auth/login",
+                        {"username": username, "password": password})
+        self.token = out["token"]
+        return out["user"]
+
+    def logout(self) -> None:
+        self.post("/api/v1/auth/logout")
+        self.token = None
+
+    def whoami(self) -> Dict[str, Any]:
+        return self.get("/api/v1/auth/me")["user"]
+
+    def create_user(self, username: str, password: str = "", *,
+                    admin: bool = False) -> Dict[str, Any]:
+        return self.post("/api/v1/users", {
+            "username": username, "password": password, "admin": admin,
+        })["user"]
+
+    def list_users(self) -> list:
+        return self.get("/api/v1/users")["users"]
+
+    # -- workspaces / projects ---------------------------------------------
+
+    def create_workspace(self, name: str) -> Dict[str, Any]:
+        return self.post("/api/v1/workspaces", {"name": name})["workspace"]
+
+    def list_workspaces(self) -> list:
+        return self.get("/api/v1/workspaces")["workspaces"]
+
+    def get_workspace(self, workspace_id: int) -> Dict[str, Any]:
+        return self.get(f"/api/v1/workspaces/{workspace_id}")
+
+    def create_project(self, workspace_id: int, name: str,
+                       description: str = "") -> Dict[str, Any]:
+        return self.post(f"/api/v1/workspaces/{workspace_id}/projects",
+                         {"name": name, "description": description})["project"]
+
+    # -- model registry ----------------------------------------------------
+
+    def create_model(self, name: str, **kwargs: Any) -> Dict[str, Any]:
+        return self.post("/api/v1/models", {"name": name, **kwargs})["model"]
+
+    def get_model(self, name_or_id: Any) -> Dict[str, Any]:
+        return self.get(f"/api/v1/models/{_q(name_or_id)}")["model"]
+
+    def list_models(self, name: Optional[str] = None) -> list:
+        path = "/api/v1/models"
+        if name:
+            path += f"?name={_q(name)}"
+        return self.get(path)["models"]
+
+    def register_model_version(self, model: Any, checkpoint_uuid: str,
+                               **kwargs: Any) -> Dict[str, Any]:
+        return self.post(f"/api/v1/models/{_q(model)}/versions",
+                         {"checkpoint_uuid": checkpoint_uuid, **kwargs})[
+            "version"]
+
+    # -- templates / webhooks ----------------------------------------------
+
+    def set_template(self, name: str, config: Dict[str, Any]) -> None:
+        self.post("/api/v1/templates", {"name": name, "config": config})
+
+    def list_templates(self) -> list:
+        return self.get("/api/v1/templates")["templates"]
+
+    def create_webhook(self, url: str, triggers: Optional[list] = None,
+                       webhook_type: str = "default") -> Dict[str, Any]:
+        return self.post("/api/v1/webhooks", {
+            "url": url, "triggers": triggers or [],
+            "webhook_type": webhook_type,
+        })["webhook"]

@@ -26,10 +26,12 @@ int main(int argc, char** argv) {
       config.default_pool.type = argv[++i];
     } else if (!std::strcmp(argv[i], "--agent-timeout") && i + 1 < argc) {
       config.agent_timeout_sec = std::atof(argv[++i]);
+    } else if (!std::strcmp(argv[i], "--auth-required")) {
+      config.auth_required = true;
     } else if (!std::strcmp(argv[i], "--help")) {
       std::cout << "usage: dct-master [--port N] [--data-dir DIR] "
                    "[--scheduler fifo|priority|fair_share] "
-                   "[--agent-timeout SEC]\n";
+                   "[--agent-timeout SEC] [--auth-required]\n";
       return 0;
     }
   }

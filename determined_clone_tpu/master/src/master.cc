@@ -41,6 +41,10 @@ Master::~Master() { stop(); }
 void Master::start() {
   mkdirs(config_.data_dir);
   load_snapshot();
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    bootstrap_users_locked();
+  }
   // restore (≈ restoreNonTerminalExperiments, core.go:772 + reattach):
   // Running allocations KEEP their reservations — reconnecting agents
   // re-report them via heartbeat and the tasks carry on; if the agent never
@@ -109,13 +113,36 @@ void Master::save_snapshot_locked() {
     for (const auto& [rid, tid] : m) inner.set(std::to_string(rid), tid);
     req_map.set(std::to_string(eid), inner);
   }
+  Json users = Json::array();
+  for (const auto& [id, u] : users_) users.push_back(u.to_json(false));
+  Json sessions = Json::array();
+  for (const auto& [tok, s] : sessions_) sessions.push_back(s.to_json());
+  Json workspaces = Json::array();
+  for (const auto& [id, w] : workspaces_) workspaces.push_back(w.to_json());
+  Json projects = Json::array();
+  for (const auto& [id, p] : projects_) projects.push_back(p.to_json());
+  Json models = Json::array();
+  for (const auto& [id, m] : models_) models.push_back(m.to_json());
+  Json templates = Json::object();
+  for (const auto& [name, cfg] : templates_) templates.set(name, cfg);
+  Json webhooks = Json::array();
+  for (const auto& [id, w] : webhooks_) webhooks.push_back(w.to_json());
   Json snap = Json::object();
   snap.set("next_experiment_id", next_experiment_id_)
       .set("next_trial_id", next_trial_id_)
       .set("next_task_id", next_task_id_)
+      .set("next_user_id", next_user_id_)
+      .set("next_workspace_id", next_workspace_id_)
+      .set("next_project_id", next_project_id_)
+      .set("next_model_id", next_model_id_)
+      .set("next_webhook_id", next_webhook_id_)
       .set("experiments", exps).set("trials", trials)
       .set("allocations", allocs).set("agents", agents)
-      .set("checkpoints", ckpts).set("request_to_trial", req_map);
+      .set("checkpoints", ckpts).set("request_to_trial", req_map)
+      .set("users", users).set("sessions", sessions)
+      .set("workspaces", workspaces).set("projects", projects)
+      .set("models", models).set("templates", templates)
+      .set("webhooks", webhooks);
 
   std::string path = config_.data_dir + "/snapshot.json";
   std::string tmp = path + ".tmp";
@@ -166,6 +193,38 @@ void Master::load_snapshot() {
     for (const auto& [rid, tid] : inner.items()) {
       request_to_trial_[std::stoll(eid)][std::stoll(rid)] = tid.as_int();
     }
+  }
+  next_user_id_ = snap["next_user_id"].as_int(1);
+  next_workspace_id_ = snap["next_workspace_id"].as_int(1);
+  next_project_id_ = snap["next_project_id"].as_int(1);
+  next_model_id_ = snap["next_model_id"].as_int(1);
+  next_webhook_id_ = snap["next_webhook_id"].as_int(1);
+  for (const auto& u : snap["users"].elements()) {
+    User user = User::from_json(u);
+    users_[user.id] = std::move(user);
+  }
+  for (const auto& s : snap["sessions"].elements()) {
+    SessionToken tok = SessionToken::from_json(s);
+    sessions_[tok.token] = std::move(tok);
+  }
+  for (const auto& w : snap["workspaces"].elements()) {
+    Workspace ws = Workspace::from_json(w);
+    workspaces_[ws.id] = std::move(ws);
+  }
+  for (const auto& p : snap["projects"].elements()) {
+    Project proj = Project::from_json(p);
+    projects_[proj.id] = std::move(proj);
+  }
+  for (const auto& m : snap["models"].elements()) {
+    RegisteredModel model = RegisteredModel::from_json(m);
+    models_[model.id] = std::move(model);
+  }
+  for (const auto& [name, cfg] : snap["templates"].items()) {
+    templates_[name] = cfg;
+  }
+  for (const auto& w : snap["webhooks"].elements()) {
+    Webhook hook = Webhook::from_json(w);
+    webhooks_[hook.id] = std::move(hook);
   }
   // rebuild searcher methods from snapshots
   for (auto& [id, exp] : experiments_) {
@@ -318,6 +377,7 @@ void Master::finish_experiment(Experiment& exp, RunState state,
   exp.state = state;
   exp.ended_at = now_sec();
   exp.error = error;
+  fire_webhooks(exp);  // async, detached (≈ webhooks/shipper.go)
   // cancel queued allocations of this experiment's trials
   for (auto& [id, alloc] : allocations_) {
     if (alloc.trial_id == 0) continue;
@@ -380,6 +440,17 @@ void Master::on_task_done(const std::string& alloc_id, int exit_code,
 
 void Master::tick_locked() {
   double now = now_sec();
+
+  // expired-session sweep: dead tokens must not accumulate in memory or in
+  // every snapshot write
+  for (auto it = sessions_.begin(); it != sessions_.end();) {
+    if (it->second.expires_at < now) {
+      it = sessions_.erase(it);
+      dirty_ = true;
+    } else {
+      ++it;
+    }
+  }
 
   // idle watcher: NTSC tasks with an idle_timeout and no recent proxy
   // activity are reaped (≈ master/internal/task/idle/watcher.go)

@@ -18,6 +18,7 @@
 #include "http.h"
 #include "json.h"
 #include "model.h"
+#include "platform.h"
 #include "scheduler.h"
 #include "searcher.h"
 
@@ -29,6 +30,11 @@ struct MasterConfig {
   PoolPolicy default_pool;
   double agent_timeout_sec = 60;   // heartbeat "amnesia" window
   double tick_interval_sec = 0.5;  // ≈ resource_pool.go:62 schedulerTick
+  // when true, user-facing routes (experiments/tasks/registry/...) require a
+  // Bearer token from /api/v1/auth/login; the agent + data planes stay open
+  // (the reference gives those their own allocation tokens)
+  bool auth_required = false;
+  double session_ttl_sec = 7 * 24 * 3600;
 };
 
 class Master {
@@ -69,6 +75,22 @@ class Master {
   // address (≈ master/internal/proxy/proxy.go). Forwards OUTSIDE the
   // master lock; only the address lookup locks.
   HttpResponse proxy_route(const HttpRequest& req);
+  // platform-breadth routes: auth/users, workspaces/projects, model
+  // registry, templates, webhooks (routes_platform.cc). Returns nullopt when
+  // the path is not one of its roots.
+  std::optional<HttpResponse> route_platform(const HttpRequest& req);
+
+  // -- platform helpers (routes_platform.cc) --
+  User* current_user(const HttpRequest& req);   // nullptr if no valid token
+  void bootstrap_users_locked();
+  Workspace& ensure_workspace(const std::string& name,
+                              const std::string& owner);
+  void ensure_project(const std::string& name, int64_t workspace_id,
+                      const std::string& owner);
+  // fires matching webhooks for a terminal experiment (detached threads)
+  void fire_webhooks(const Experiment& exp);
+  // merges a named template under the config (throws on unknown template)
+  Json resolve_template(const Json& config);
 
   MasterConfig config_;
   std::unique_ptr<HttpServer> server_;
@@ -88,6 +110,19 @@ class Master {
   std::map<int64_t, std::unique_ptr<SearchMethodCpp>> methods_;
   // experiment request_id -> global trial id
   std::map<int64_t, std::map<int64_t, int64_t>> request_to_trial_;
+  // -- platform breadth (platform.h) --
+  int64_t next_user_id_ = 1;
+  int64_t next_workspace_id_ = 1;
+  int64_t next_project_id_ = 1;
+  int64_t next_model_id_ = 1;
+  int64_t next_webhook_id_ = 1;
+  std::map<int64_t, User> users_;
+  std::map<std::string, SessionToken> sessions_;
+  std::map<int64_t, Workspace> workspaces_;
+  std::map<int64_t, Project> projects_;
+  std::map<int64_t, RegisteredModel> models_;
+  std::map<std::string, Json> templates_;
+  std::map<int64_t, Webhook> webhooks_;
   bool dirty_ = false;
 };
 

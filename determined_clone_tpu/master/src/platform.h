@@ -1,0 +1,223 @@
+// Platform-breadth domain model: users/sessions, workspaces/projects,
+// model registry, config templates, webhooks.
+//
+// ≈ the reference's master/internal/{user,workspace,project,model,templates,
+// webhooks} DB models, collapsed into snapshot-persisted structs the same
+// way model.h does for experiments/trials.
+#pragma once
+
+#include <algorithm>
+#include <cstdint>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "json.h"
+
+namespace dct {
+
+// ≈ master/internal/user (sessions live in sessions_ on the Master)
+struct User {
+  int64_t id = 0;
+  std::string username;
+  std::string password_hash;  // salted FNV-1a (dev-grade, like det's default
+                              // empty-password bootstrap users)
+  bool admin = false;
+  bool active = true;
+  std::string display_name;
+
+  Json to_json(bool redact = true) const {
+    Json j = Json::object();
+    j.set("id", id).set("username", username).set("admin", admin)
+        .set("active", active).set("display_name", display_name);
+    if (!redact) j.set("password_hash", password_hash);
+    return j;
+  }
+  static User from_json(const Json& j) {
+    User u;
+    u.id = j["id"].as_int();
+    u.username = j["username"].as_string();
+    u.password_hash = j["password_hash"].as_string();
+    u.admin = j["admin"].as_bool();
+    u.active = j["active"].as_bool(true);
+    u.display_name = j["display_name"].as_string();
+    return u;
+  }
+};
+
+struct SessionToken {
+  std::string token;
+  int64_t user_id = 0;
+  double expires_at = 0;
+
+  Json to_json() const {
+    Json j = Json::object();
+    j.set("token", token).set("user_id", user_id)
+        .set("expires_at", expires_at);
+    return j;
+  }
+  static SessionToken from_json(const Json& j) {
+    SessionToken s;
+    s.token = j["token"].as_string();
+    s.user_id = j["user_id"].as_int();
+    s.expires_at = j["expires_at"].as_number();
+    return s;
+  }
+};
+
+// ≈ master/internal/workspace
+struct Workspace {
+  int64_t id = 0;
+  std::string name;
+  std::string owner = "admin";
+  bool archived = false;
+  bool immutable = false;  // the bootstrap "Uncategorized" workspace
+
+  Json to_json() const {
+    Json j = Json::object();
+    j.set("id", id).set("name", name).set("owner", owner)
+        .set("archived", archived).set("immutable", immutable);
+    return j;
+  }
+  static Workspace from_json(const Json& j) {
+    Workspace w;
+    w.id = j["id"].as_int();
+    w.name = j["name"].as_string();
+    w.owner = j["owner"].as_string();
+    w.archived = j["archived"].as_bool();
+    w.immutable = j["immutable"].as_bool();
+    return w;
+  }
+};
+
+// ≈ master/internal/project
+struct Project {
+  int64_t id = 0;
+  std::string name;
+  int64_t workspace_id = 0;
+  std::string owner = "admin";
+  std::string description;
+  bool archived = false;
+
+  Json to_json() const {
+    Json j = Json::object();
+    j.set("id", id).set("name", name).set("workspace_id", workspace_id)
+        .set("owner", owner).set("description", description)
+        .set("archived", archived);
+    return j;
+  }
+  static Project from_json(const Json& j) {
+    Project p;
+    p.id = j["id"].as_int();
+    p.name = j["name"].as_string();
+    p.workspace_id = j["workspace_id"].as_int();
+    p.owner = j["owner"].as_string();
+    p.description = j["description"].as_string();
+    p.archived = j["archived"].as_bool();
+    return p;
+  }
+};
+
+// ≈ master/internal/model (registry, not an ML model)
+struct ModelVersion {
+  int64_t version = 0;
+  std::string checkpoint_uuid;
+  std::string name;
+  std::string comment;
+  double created_at = 0;
+
+  Json to_json() const {
+    Json j = Json::object();
+    j.set("version", version).set("checkpoint_uuid", checkpoint_uuid)
+        .set("name", name).set("comment", comment)
+        .set("created_at", created_at);
+    return j;
+  }
+  static ModelVersion from_json(const Json& j) {
+    ModelVersion v;
+    v.version = j["version"].as_int();
+    v.checkpoint_uuid = j["checkpoint_uuid"].as_string();
+    v.name = j["name"].as_string();
+    v.comment = j["comment"].as_string();
+    v.created_at = j["created_at"].as_number();
+    return v;
+  }
+};
+
+struct RegisteredModel {
+  int64_t id = 0;
+  std::string name;
+  std::string description;
+  Json metadata;
+  Json labels;  // array of strings
+  std::string workspace = "Uncategorized";
+  std::string owner = "admin";
+  bool archived = false;
+  double created_at = 0;
+  std::vector<ModelVersion> versions;
+  // monotonic: a deleted latest version's number is never reused (a consumer
+  // that recorded "model m vN" must never resolve to a different checkpoint)
+  int64_t next_version = 1;
+
+  Json to_json() const {
+    Json vs = Json::array();
+    for (const auto& v : versions) vs.push_back(v.to_json());
+    Json j = Json::object();
+    j.set("id", id).set("name", name).set("description", description)
+        .set("metadata", metadata).set("labels", labels)
+        .set("workspace", workspace).set("owner", owner)
+        .set("archived", archived).set("created_at", created_at)
+        .set("versions", vs).set("next_version", next_version);
+    return j;
+  }
+  static RegisteredModel from_json(const Json& j) {
+    RegisteredModel m;
+    m.id = j["id"].as_int();
+    m.name = j["name"].as_string();
+    m.description = j["description"].as_string();
+    m.metadata = j["metadata"];
+    m.labels = j["labels"];
+    m.workspace = j["workspace"].as_string();
+    m.owner = j["owner"].as_string();
+    m.archived = j["archived"].as_bool();
+    m.created_at = j["created_at"].as_number();
+    for (const auto& v : j["versions"].elements()) {
+      m.versions.push_back(ModelVersion::from_json(v));
+    }
+    m.next_version = j["next_version"].as_int(1);
+    for (const auto& v : m.versions) {  // old snapshots: derive counter
+      m.next_version = std::max(m.next_version, v.version + 1);
+    }
+    return m;
+  }
+};
+
+// ≈ master/internal/webhooks (shipper.go): fire on experiment state change
+struct Webhook {
+  int64_t id = 0;
+  std::string url;             // http://host:port/path
+  std::string webhook_type = "default";  // default | slack
+  // triggers: experiment states that fire it (e.g. COMPLETED, ERRORED)
+  std::vector<std::string> triggers;
+
+  Json to_json() const {
+    Json ts = Json::array();
+    for (const auto& t : triggers) ts.push_back(t);
+    Json j = Json::object();
+    j.set("id", id).set("url", url).set("webhook_type", webhook_type)
+        .set("triggers", ts);
+    return j;
+  }
+  static Webhook from_json(const Json& j) {
+    Webhook w;
+    w.id = j["id"].as_int();
+    w.url = j["url"].as_string();
+    w.webhook_type = j["webhook_type"].as_string();
+    for (const auto& t : j["triggers"].elements()) {
+      w.triggers.push_back(t.as_string());
+    }
+    return w;
+  }
+};
+
+}  // namespace dct

@@ -111,6 +111,29 @@ HttpResponse Master::route(const HttpRequest& req) {
   std::lock_guard<std::mutex> lock(mu_);
   const std::string& root = parts.size() > 2 ? parts[2] : "";
 
+  // auth enforcement (when enabled): user-facing roots require a session
+  // token; the agent + allocation/trial data planes stay open (those get
+  // their own allocation-scoped auth in the reference)
+  static const std::set<std::string> kAuthRoots = {
+      "experiments", "tasks",  "users",    "workspaces",
+      "models",      "templates", "webhooks", "job-queue"};
+  if (config_.auth_required && kAuthRoots.count(root)) {
+    // reads on users and experiments stay open: in-cluster data-plane
+    // consumers (e.g. the TensorBoard task fetching metric history) have no
+    // user session, mirroring the reference's allocation-scoped tokens
+    bool readonly_open = req.method == "GET" &&
+                         (root == "users" || root == "experiments");
+    if (!current_user(req) && !readonly_open) {
+      return HttpResponse::json(
+          401, error_json("authentication required").dump());
+    }
+  }
+
+  {
+    auto platform = route_platform(req);
+    if (platform) return *platform;
+  }
+
   // ---- master info -------------------------------------------------------
   if (root == "master" && req.method == "GET") {
     Json j = Json::object();
@@ -124,8 +147,14 @@ HttpResponse Master::route(const HttpRequest& req) {
   if (root == "experiments") {
     if (parts.size() == 3 && req.method == "POST") {
       Json body = Json::parse(req.body);
-      const Json& config = body["config"];
-      if (!config.is_object()) return bad_request("missing config object");
+      if (!body["config"].is_object()) return bad_request("missing config object");
+      Json config;
+      try {
+        // template merge (≈ master/internal/templates; template is base)
+        config = resolve_template(body["config"]);
+      } catch (const std::exception& e) {
+        return bad_request(e.what());
+      }
       Experiment exp;
       exp.id = next_experiment_id_++;
       exp.name = config["name"].as_string().empty() ? "unnamed"
@@ -133,6 +162,7 @@ HttpResponse Master::route(const HttpRequest& req) {
       exp.config = config;
       exp.state = RunState::Running;
       exp.created_at = now_sec();
+      if (User* caller = current_user(req)) exp.owner = caller->username;
       if (config["workspace"].is_string() && !config["workspace"].as_string().empty())
         exp.workspace = config["workspace"].as_string();
       if (config["project"].is_string() && !config["project"].as_string().empty())
@@ -147,6 +177,10 @@ HttpResponse Master::route(const HttpRequest& req) {
         methods_.erase(id);
         return bad_request(std::string("invalid experiment config: ") + e.what());
       }
+      // register workspace/project only once the config validated — a 400
+      // must leave no side effects
+      Workspace& ws = ensure_workspace(stored.workspace, stored.owner);
+      ensure_project(stored.project, ws.id, stored.owner);
       dirty_ = true;
       Json j = Json::object();
       j.set("experiment", experiments_[id].to_json());

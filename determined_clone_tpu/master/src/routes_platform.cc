@@ -1,0 +1,666 @@
+// Platform-breadth routes: auth/users, workspaces/projects, model registry,
+// config templates, webhooks.
+//
+// ≈ the reference's master/internal/api_{user,workspace,project,model,
+// template,webhook}.go handlers over their service packages, collapsed onto
+// the Master's single state map the way routes.cc does for experiments.
+#include <cctype>
+#include <random>
+#include <thread>
+
+#include "master.h"
+
+namespace dct {
+namespace {
+
+Json perr(const std::string& msg) {
+  Json j = Json::object();
+  j.set("error", msg);
+  return j;
+}
+HttpResponse pok(const Json& j) { return HttpResponse::json(200, j.dump()); }
+HttpResponse pcreated(const Json& j) {
+  return HttpResponse::json(201, j.dump());
+}
+HttpResponse pbad(const std::string& msg) {
+  return HttpResponse::json(400, perr(msg).dump());
+}
+HttpResponse pnotfound(const std::string& msg) {
+  return HttpResponse::json(404, perr(msg).dump());
+}
+HttpResponse punauthorized(const std::string& msg) {
+  return HttpResponse::json(401, perr(msg).dump());
+}
+HttpResponse pforbidden(const std::string& msg) {
+  return HttpResponse::json(403, perr(msg).dump());
+}
+
+// dev-grade salted hash (the reference bootstraps passwordless admin/
+// determined users the same way; real deployments front with SSO)
+std::string hash_password(const std::string& username,
+                          const std::string& password) {
+  const std::string salted =
+      username + "\x1f" + password + "\x1f" + "dct-salt";
+  uint64_t h = 1469598103934665603ull;  // FNV-1a 64
+  for (unsigned char c : salted) {
+    h ^= c;
+    h *= 1099511628211ull;
+  }
+  char buf[17];
+  std::snprintf(buf, sizeof(buf), "%016llx", static_cast<unsigned long long>(h));
+  return buf;
+}
+
+std::string new_token() {
+  static thread_local std::mt19937_64 rng{std::random_device{}()};
+  char buf[33];
+  std::snprintf(buf, sizeof(buf), "%016llx%016llx",
+                static_cast<unsigned long long>(rng()),
+                static_cast<unsigned long long>(rng()));
+  return buf;
+}
+
+// deep-merge: template config is the base, experiment config overrides
+// (≈ master/internal/templates merge semantics via schemas.Merge)
+Json merge_configs(const Json& base, const Json& over) {
+  if (!base.is_object() || !over.is_object()) return over;
+  Json out = base;
+  for (const auto& [k, v] : over.items()) {
+    out.set(k, merge_configs(base[k], v));
+  }
+  return out;
+}
+
+// strips the "Bearer " scheme; empty string when no auth header is present
+std::string bearer_token(const HttpRequest& req) {
+  auto it = req.headers.find("authorization");
+  if (it == req.headers.end()) return "";
+  std::string token = it->second;
+  const std::string bearer = "Bearer ";
+  if (token.rfind(bearer, 0) == 0) token = token.substr(bearer.size());
+  return token;
+}
+
+}  // namespace
+
+User* Master::current_user(const HttpRequest& req) {
+  std::string token = bearer_token(req);
+  if (token.empty()) return nullptr;
+  auto sit = sessions_.find(token);
+  if (sit == sessions_.end()) return nullptr;
+  if (sit->second.expires_at < now_sec()) {
+    sessions_.erase(sit);
+    return nullptr;
+  }
+  auto uit = users_.find(sit->second.user_id);
+  if (uit == users_.end() || !uit->second.active) return nullptr;
+  return &uit->second;
+}
+
+void Master::bootstrap_users_locked() {
+  // ≈ the reference's bootstrap users (admin + determined, empty passwords)
+  if (!users_.empty()) return;
+  for (const char* name : {"admin", "determined"}) {
+    User u;
+    u.id = next_user_id_++;
+    u.username = name;
+    u.admin = std::string(name) == "admin";
+    u.password_hash = hash_password(name, "");
+    users_[u.id] = u;
+  }
+  ensure_workspace("Uncategorized", "admin").immutable = true;
+}
+
+Workspace& Master::ensure_workspace(const std::string& name,
+                                    const std::string& owner) {
+  for (auto& [id, w] : workspaces_) {
+    if (w.name == name) return w;
+  }
+  Workspace w;
+  w.id = next_workspace_id_++;
+  w.name = name;
+  w.owner = owner;
+  int64_t id = w.id;
+  workspaces_[id] = w;
+  ensure_project("Uncategorized", id, owner);
+  dirty_ = true;
+  return workspaces_[id];
+}
+
+void Master::ensure_project(const std::string& name, int64_t workspace_id,
+                            const std::string& owner) {
+  for (auto& [id, p] : projects_) {
+    if (p.workspace_id == workspace_id && p.name == name) return;
+  }
+  Project p;
+  p.id = next_project_id_++;
+  p.name = name;
+  p.workspace_id = workspace_id;
+  p.owner = owner;
+  projects_[p.id] = p;
+  dirty_ = true;
+}
+
+void Master::fire_webhooks(const Experiment& exp) {
+  const std::string state = to_string(exp.state);
+  for (const auto& [id, hook] : webhooks_) {
+    bool match = hook.triggers.empty();
+    for (const auto& t : hook.triggers) {
+      if (t == state) match = true;
+    }
+    if (!match) continue;
+    // parse http://host[:port][/path]
+    std::string url = hook.url;
+    const std::string scheme = "http://";
+    if (url.rfind(scheme, 0) == 0) url = url.substr(scheme.size());
+    std::string hostport = url, path = "/";
+    auto slash = url.find('/');
+    if (slash != std::string::npos) {
+      hostport = url.substr(0, slash);
+      path = url.substr(slash);
+    }
+    std::string host = hostport;
+    int port = 80;
+    auto colon = hostport.rfind(':');
+    if (colon != std::string::npos) {
+      host = hostport.substr(0, colon);
+      try {
+        port = std::stoi(hostport.substr(colon + 1));
+      } catch (const std::exception&) {
+        continue;
+      }
+    }
+    Json payload = Json::object();
+    if (hook.webhook_type == "slack") {
+      // ≈ webhooks/shipper.go slack formatting
+      payload.set("text", "experiment " + std::to_string(exp.id) + " (" +
+                              exp.name + ") is " + state);
+    } else {
+      payload.set("event", "experiment_state_change");
+      payload.set("experiment_id", exp.id);
+      payload.set("experiment_name", exp.name);
+      payload.set("state", state);
+      payload.set("workspace", exp.workspace);
+    }
+    std::string body = payload.dump();
+    // fire-and-forget off the master lock (≈ shipper's async queue)
+    std::thread([host, port, path, body] {
+      http_request(host, port, "POST", path, body, 10);
+    }).detach();
+  }
+}
+
+std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
+  const auto& parts = req.path_parts;
+  const std::string& root = parts.size() > 2 ? parts[2] : "";
+
+  // ---- auth --------------------------------------------------------------
+  if (root == "auth") {
+    if (parts.size() == 4 && parts[3] == "login" && req.method == "POST") {
+      Json body = Json::parse(req.body);
+      const std::string& username = body["username"].as_string();
+      const std::string& password = body["password"].as_string();
+      for (auto& [id, u] : users_) {
+        if (u.username == username) {
+          if (!u.active) return punauthorized("user deactivated");
+          if (u.password_hash != hash_password(username, password)) {
+            return punauthorized("invalid credentials");
+          }
+          SessionToken tok;
+          tok.token = new_token();
+          tok.user_id = id;
+          tok.expires_at = now_sec() + config_.session_ttl_sec;
+          sessions_[tok.token] = tok;
+          dirty_ = true;
+          Json j = Json::object();
+          j.set("token", tok.token).set("user", u.to_json());
+          return pok(j);
+        }
+      }
+      return punauthorized("invalid credentials");
+    }
+    if (parts.size() == 4 && parts[3] == "logout" && req.method == "POST") {
+      std::string token = bearer_token(req);
+      if (!token.empty() && sessions_.erase(token)) dirty_ = true;
+      return pok(Json::object());
+    }
+    if (parts.size() == 4 && parts[3] == "me" && req.method == "GET") {
+      User* u = current_user(req);
+      if (!u) return punauthorized("not logged in");
+      Json j = Json::object();
+      j.set("user", u->to_json());
+      return pok(j);
+    }
+    return pnotfound("unknown auth route");
+  }
+
+  // ---- users (≈ api_user.go) ---------------------------------------------
+  if (root == "users") {
+    if (parts.size() == 3 && req.method == "GET") {
+      Json arr = Json::array();
+      for (const auto& [id, u] : users_) arr.push_back(u.to_json());
+      Json j = Json::object();
+      j.set("users", arr);
+      return pok(j);
+    }
+    if (parts.size() == 3 && req.method == "POST") {
+      User* caller = current_user(req);
+      if (config_.auth_required && (!caller || !caller->admin)) {
+        return pforbidden("admin required");
+      }
+      Json body = Json::parse(req.body);
+      const std::string& username = body["username"].as_string();
+      if (username.empty()) return pbad("username required");
+      for (const auto& [id, u] : users_) {
+        if (u.username == username) return pbad("username taken");
+      }
+      User u;
+      u.id = next_user_id_++;
+      u.username = username;
+      u.admin = body["admin"].as_bool();
+      u.display_name = body["display_name"].as_string();
+      u.password_hash = hash_password(username, body["password"].as_string());
+      users_[u.id] = u;
+      dirty_ = true;
+      Json j = Json::object();
+      j.set("user", users_[u.id].to_json());
+      return pcreated(j);
+    }
+    if (parts.size() >= 4) {
+      int64_t uid = 0;
+      try {
+        uid = std::stoll(parts[3]);
+      } catch (const std::exception&) {
+        return pbad("bad user id");
+      }
+      auto it = users_.find(uid);
+      if (it == users_.end()) return pnotfound("no user " + parts[3]);
+      User& u = it->second;
+      if (parts.size() == 4 && req.method == "GET") {
+        Json j = Json::object();
+        j.set("user", u.to_json());
+        return pok(j);
+      }
+      if (parts.size() == 5 && req.method == "POST") {
+        User* caller = current_user(req);
+        bool self = caller && caller->id == uid;
+        if (config_.auth_required &&
+            (!caller || (!caller->admin && !self))) {
+          return pforbidden("admin or self required");
+        }
+        if (parts[4] == "password") {
+          Json body = Json::parse(req.body);
+          u.password_hash =
+              hash_password(u.username, body["password"].as_string());
+          dirty_ = true;
+          return pok(Json::object());
+        }
+        if (parts[4] == "activate" || parts[4] == "deactivate") {
+          if (config_.auth_required && (!caller || !caller->admin)) {
+            return pforbidden("admin required");
+          }
+          u.active = parts[4] == "activate";
+          dirty_ = true;
+          Json j = Json::object();
+          j.set("user", u.to_json());
+          return pok(j);
+        }
+      }
+    }
+    return pnotfound("unknown users route");
+  }
+
+  // ---- workspaces + projects (≈ api_workspace.go / api_project.go) -------
+  if (root == "workspaces") {
+    if (parts.size() == 3 && req.method == "GET") {
+      Json arr = Json::array();
+      for (const auto& [id, w] : workspaces_) arr.push_back(w.to_json());
+      Json j = Json::object();
+      j.set("workspaces", arr);
+      return pok(j);
+    }
+    if (parts.size() == 3 && req.method == "POST") {
+      Json body = Json::parse(req.body);
+      const std::string& name = body["name"].as_string();
+      if (name.empty()) return pbad("workspace name required");
+      for (const auto& [id, w] : workspaces_) {
+        if (w.name == name) return pbad("workspace name taken");
+      }
+      User* caller = current_user(req);
+      Workspace& w = ensure_workspace(name,
+                                      caller ? caller->username : "admin");
+      Json j = Json::object();
+      j.set("workspace", w.to_json());
+      return pcreated(j);
+    }
+    if (parts.size() >= 4) {
+      int64_t wid = 0;
+      try {
+        wid = std::stoll(parts[3]);
+      } catch (const std::exception&) {
+        return pbad("bad workspace id");
+      }
+      auto it = workspaces_.find(wid);
+      if (it == workspaces_.end()) return pnotfound("no workspace " + parts[3]);
+      Workspace& w = it->second;
+      if (parts.size() == 4 && req.method == "GET") {
+        Json projs = Json::array();
+        for (const auto& [pid, p] : projects_) {
+          if (p.workspace_id == wid) projs.push_back(p.to_json());
+        }
+        Json exps = Json::array();
+        for (const auto& [eid, e] : experiments_) {
+          if (e.workspace == w.name) exps.push_back(e.to_json());
+        }
+        Json j = Json::object();
+        j.set("workspace", w.to_json()).set("projects", projs)
+            .set("experiments", exps);
+        return pok(j);
+      }
+      if (parts.size() == 4 && req.method == "DELETE") {
+        if (w.immutable) return pbad("workspace is immutable");
+        for (const auto& [eid, e] : experiments_) {
+          if (e.workspace == w.name) {
+            return pbad("workspace has experiments");
+          }
+        }
+        for (auto pit = projects_.begin(); pit != projects_.end();) {
+          if (pit->second.workspace_id == wid) {
+            pit = projects_.erase(pit);
+          } else {
+            ++pit;
+          }
+        }
+        workspaces_.erase(it);
+        dirty_ = true;
+        return pok(Json::object());
+      }
+      if (parts.size() == 5 && req.method == "POST" &&
+          (parts[4] == "archive" || parts[4] == "unarchive")) {
+        if (w.immutable) return pbad("workspace is immutable");
+        w.archived = parts[4] == "archive";
+        dirty_ = true;
+        Json j = Json::object();
+        j.set("workspace", w.to_json());
+        return pok(j);
+      }
+      if (parts.size() == 5 && parts[4] == "projects") {
+        if (req.method == "GET") {
+          Json projs = Json::array();
+          for (const auto& [pid, p] : projects_) {
+            if (p.workspace_id == wid) projs.push_back(p.to_json());
+          }
+          Json j = Json::object();
+          j.set("projects", projs);
+          return pok(j);
+        }
+        if (req.method == "POST") {
+          Json body = Json::parse(req.body);
+          const std::string& name = body["name"].as_string();
+          if (name.empty()) return pbad("project name required");
+          for (const auto& [pid, p] : projects_) {
+            if (p.workspace_id == wid && p.name == name) {
+              return pbad("project name taken in workspace");
+            }
+          }
+          User* caller = current_user(req);
+          Project p;
+          p.id = next_project_id_++;
+          p.name = name;
+          p.workspace_id = wid;
+          p.owner = caller ? caller->username : "admin";
+          p.description = body["description"].as_string();
+          projects_[p.id] = p;
+          dirty_ = true;
+          Json j = Json::object();
+          j.set("project", projects_[p.id].to_json());
+          return pcreated(j);
+        }
+      }
+    }
+    return pnotfound("unknown workspaces route");
+  }
+
+  // ---- model registry (≈ api_model.go) -----------------------------------
+  if (root == "models") {
+    auto find_model = [&](const std::string& key) -> RegisteredModel* {
+      try {
+        size_t pos = 0;
+        int64_t mid = std::stoll(key, &pos);
+        if (pos == key.size()) {  // whole key numeric, not "2fast"
+          auto it = models_.find(mid);
+          if (it != models_.end()) return &it->second;
+        }
+      } catch (const std::exception&) {
+      }
+      for (auto& [id, m] : models_) {
+        if (m.name == key) return &m;
+      }
+      return nullptr;
+    };
+    if (parts.size() == 3 && req.method == "GET") {
+      auto name_filter = req.query.find("name");
+      Json arr = Json::array();
+      for (const auto& [id, m] : models_) {
+        if (name_filter != req.query.end() &&
+            m.name.find(name_filter->second) == std::string::npos) {
+          continue;
+        }
+        arr.push_back(m.to_json());
+      }
+      Json j = Json::object();
+      j.set("models", arr);
+      return pok(j);
+    }
+    if (parts.size() == 3 && req.method == "POST") {
+      Json body = Json::parse(req.body);
+      const std::string& name = body["name"].as_string();
+      if (name.empty()) return pbad("model name required");
+      for (const auto& [id, m] : models_) {
+        if (m.name == name) return pbad("model name taken");
+      }
+      User* caller = current_user(req);
+      RegisteredModel m;
+      m.id = next_model_id_++;
+      m.name = name;
+      m.description = body["description"].as_string();
+      m.metadata = body["metadata"];
+      m.labels = body["labels"];
+      if (!body["workspace"].as_string().empty()) {
+        m.workspace = body["workspace"].as_string();
+      }
+      m.owner = caller ? caller->username : "admin";
+      m.created_at = now_sec();
+      models_[m.id] = m;
+      dirty_ = true;
+      Json j = Json::object();
+      j.set("model", models_[m.id].to_json());
+      return pcreated(j);
+    }
+    if (parts.size() >= 4) {
+      RegisteredModel* m = find_model(parts[3]);
+      if (!m) return pnotfound("no model " + parts[3]);
+      if (parts.size() == 4 && req.method == "GET") {
+        Json j = Json::object();
+        j.set("model", m->to_json());
+        return pok(j);
+      }
+      if (parts.size() == 4 && req.method == "PATCH") {
+        Json body = Json::parse(req.body);
+        if (body.has("description")) {
+          m->description = body["description"].as_string();
+        }
+        if (body.has("metadata")) m->metadata = body["metadata"];
+        if (body.has("labels")) m->labels = body["labels"];
+        dirty_ = true;
+        Json j = Json::object();
+        j.set("model", m->to_json());
+        return pok(j);
+      }
+      if (parts.size() == 4 && req.method == "DELETE") {
+        models_.erase(m->id);
+        dirty_ = true;
+        return pok(Json::object());
+      }
+      if (parts.size() == 5 && parts[4] == "archive" && req.method == "POST") {
+        m->archived = true;
+        dirty_ = true;
+        return pok(Json::object());
+      }
+      if (parts.size() == 5 && parts[4] == "unarchive" &&
+          req.method == "POST") {
+        m->archived = false;
+        dirty_ = true;
+        return pok(Json::object());
+      }
+      if (parts.size() == 5 && parts[4] == "versions") {
+        if (req.method == "GET") {
+          Json arr = Json::array();
+          for (const auto& v : m->versions) arr.push_back(v.to_json());
+          Json j = Json::object();
+          j.set("versions", arr);
+          return pok(j);
+        }
+        if (req.method == "POST") {
+          Json body = Json::parse(req.body);
+          const std::string& uuid = body["checkpoint_uuid"].as_string();
+          if (uuid.empty()) return pbad("checkpoint_uuid required");
+          bool known = false;
+          for (const auto& c : checkpoints_) {
+            if (c.uuid == uuid && !c.deleted) known = true;
+          }
+          if (!known) return pbad("unknown checkpoint " + uuid);
+          ModelVersion v;
+          v.version = m->next_version++;
+          v.checkpoint_uuid = uuid;
+          v.name = body["name"].as_string();
+          v.comment = body["comment"].as_string();
+          v.created_at = now_sec();
+          m->versions.push_back(v);
+          dirty_ = true;
+          Json j = Json::object();
+          j.set("version", m->versions.back().to_json());
+          return pcreated(j);
+        }
+      }
+      if (parts.size() == 6 && parts[4] == "versions" &&
+          req.method == "DELETE") {
+        int64_t ver = 0;
+        try {
+          ver = std::stoll(parts[5]);
+        } catch (const std::exception&) {
+          return pbad("bad version");
+        }
+        for (auto vit = m->versions.begin(); vit != m->versions.end(); ++vit) {
+          if (vit->version == ver) {
+            m->versions.erase(vit);
+            dirty_ = true;
+            return pok(Json::object());
+          }
+        }
+        return pnotfound("no version");
+      }
+    }
+    return pnotfound("unknown models route");
+  }
+
+  // ---- templates (≈ master/internal/templates) ---------------------------
+  if (root == "templates") {
+    if (parts.size() == 3 && req.method == "GET") {
+      Json arr = Json::array();
+      for (const auto& [name, cfg] : templates_) {
+        Json t = Json::object();
+        t.set("name", name).set("config", cfg);
+        arr.push_back(t);
+      }
+      Json j = Json::object();
+      j.set("templates", arr);
+      return pok(j);
+    }
+    if (parts.size() == 3 && req.method == "POST") {
+      Json body = Json::parse(req.body);
+      const std::string& name = body["name"].as_string();
+      if (name.empty()) return pbad("template name required");
+      if (!body["config"].is_object()) return pbad("template config required");
+      templates_[name] = body["config"];
+      dirty_ = true;
+      Json t = Json::object();
+      t.set("name", name).set("config", templates_[name]);
+      return pcreated(t);
+    }
+    if (parts.size() == 4) {
+      auto it = templates_.find(parts[3]);
+      if (it == templates_.end()) return pnotfound("no template " + parts[3]);
+      if (req.method == "GET") {
+        Json t = Json::object();
+        t.set("name", it->first).set("config", it->second);
+        return pok(t);
+      }
+      if (req.method == "DELETE") {
+        templates_.erase(it);
+        dirty_ = true;
+        return pok(Json::object());
+      }
+    }
+    return pnotfound("unknown templates route");
+  }
+
+  // ---- webhooks (≈ api_webhook.go) ---------------------------------------
+  if (root == "webhooks") {
+    if (parts.size() == 3 && req.method == "GET") {
+      Json arr = Json::array();
+      for (const auto& [id, w] : webhooks_) arr.push_back(w.to_json());
+      Json j = Json::object();
+      j.set("webhooks", arr);
+      return pok(j);
+    }
+    if (parts.size() == 3 && req.method == "POST") {
+      Json body = Json::parse(req.body);
+      const std::string& url = body["url"].as_string();
+      if (url.empty()) return pbad("webhook url required");
+      Webhook w;
+      w.id = next_webhook_id_++;
+      w.url = url;
+      if (!body["webhook_type"].as_string().empty()) {
+        w.webhook_type = body["webhook_type"].as_string();
+      }
+      for (const auto& t : body["triggers"].elements()) {
+        w.triggers.push_back(t.as_string());
+      }
+      webhooks_[w.id] = w;
+      dirty_ = true;
+      Json j = Json::object();
+      j.set("webhook", webhooks_[w.id].to_json());
+      return pcreated(j);
+    }
+    if (parts.size() == 4 && req.method == "DELETE") {
+      int64_t wid = 0;
+      try {
+        wid = std::stoll(parts[3]);
+      } catch (const std::exception&) {
+        return pbad("bad webhook id");
+      }
+      if (!webhooks_.erase(wid)) return pnotfound("no webhook " + parts[3]);
+      dirty_ = true;
+      return pok(Json::object());
+    }
+    return pnotfound("unknown webhooks route");
+  }
+
+  return std::nullopt;
+}
+
+Json Master::resolve_template(const Json& config) {
+  if (!config["template"].is_string() ||
+      config["template"].as_string().empty()) {
+    return config;
+  }
+  auto it = templates_.find(config["template"].as_string());
+  if (it == templates_.end()) {
+    throw std::runtime_error("unknown template " +
+                             config["template"].as_string());
+  }
+  return merge_configs(it->second, config);
+}
+
+}  // namespace dct
