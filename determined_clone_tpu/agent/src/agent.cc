@@ -14,6 +14,7 @@
 //   3. fallback: 0 chips (cpu-only agent, zero-slot aux tasks)
 #include <dirent.h>
 #include <signal.h>
+#include <sys/stat.h>
 #include <sys/types.h>
 #include <sys/wait.h>
 #include <unistd.h>
@@ -71,6 +72,39 @@ struct RunningTask {
   bool preempt_sent = false;
 };
 
+int b64_value(char c) {
+  if (c >= 'A' && c <= 'Z') return c - 'A';
+  if (c >= 'a' && c <= 'z') return c - 'a' + 26;
+  if (c >= '0' && c <= '9') return c - '0' + 52;
+  if (c == '+') return 62;
+  if (c == '/') return 63;
+  return -1;
+}
+
+std::string b64_decode(const std::string& in) {
+  std::string out;
+  int buf = 0, bits = 0;
+  for (char c : in) {
+    int v = b64_value(c);
+    if (v < 0) continue;  // padding / whitespace
+    buf = (buf << 6) | v;
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out += static_cast<char>((buf >> bits) & 0xFF);
+    }
+  }
+  return out;
+}
+
+void mkdirs_for(const std::string& file_path) {
+  std::string cur;
+  for (size_t i = 0; i < file_path.size(); ++i) {
+    if (file_path[i] == '/' && !cur.empty()) ::mkdir(cur.c_str(), 0755);
+    cur += file_path[i];
+  }
+}
+
 class Agent {
  public:
   explicit Agent(AgentConfig config) : config_(std::move(config)) {}
@@ -83,6 +117,14 @@ class Agent {
     }
     if (config_.slots < 0) {
       config_.slots = detect_tpu_chips(&config_.topology);
+    }
+    // absolute work dir: children chdir into per-task run dirs, so every
+    // path derived from work_dir (task logs) must not be cwd-relative
+    if (!config_.work_dir.empty() && config_.work_dir[0] != '/') {
+      char cwd[4096];
+      if (::getcwd(cwd, sizeof(cwd))) {
+        config_.work_dir = std::string(cwd) + "/" + config_.work_dir;
+      }
     }
     std::cerr << "[agent] id=" << config_.id << " slots=" << config_.slots
               << " topology=" << config_.topology << std::endl;
@@ -148,12 +190,46 @@ class Agent {
     return true;
   }
 
+  // Materialize the experiment's model-def context directory for a trial
+  // (≈ prep_container.py:29 --download_context_directory). Returns the run
+  // dir to chdir into, or "" to inherit the agent's cwd.
+  std::string prepare_context(const Json& cmd, const std::string& alloc_id) {
+    if (!cmd.has("trial")) return "";
+    int64_t exp_id = cmd["trial"]["experiment_id"].as_int();
+    auto resp = http_request(
+        config_.master_host, config_.master_port, "GET",
+        "/api/v1/experiments/" + std::to_string(exp_id) + "/context", "", 30);
+    if (!resp || resp->status != 200) return "";
+    Json ctx;
+    try {
+      ctx = Json::parse(resp->body);
+    } catch (const std::exception&) {
+      return "";
+    }
+    if (!ctx["context"].is_array() || ctx["context"].size() == 0) return "";
+    std::string run_dir = config_.work_dir + "/run-" + alloc_id;
+    ::mkdir(run_dir.c_str(), 0755);
+    for (const auto& f : ctx["context"].elements()) {
+      const std::string& rel = f["path"].as_string();
+      if (rel.empty() || rel[0] == '/' ||
+          rel.find("..") != std::string::npos) {
+        continue;  // master validates too; belt-and-braces
+      }
+      std::string full = run_dir + "/" + rel;
+      mkdirs_for(full);
+      std::ofstream out(full, std::ios::binary);
+      out << b64_decode(f["content_b64"].as_string());
+    }
+    return run_dir;
+  }
+
   void start_task(const Json& cmd) {
     const std::string& alloc_id = cmd["allocation_id"].as_string();
     if (tasks_.count(alloc_id)) return;  // duplicate start
 
     std::string log_path =
         config_.work_dir + "/task-" + alloc_id + ".log";
+    std::string run_dir = prepare_context(cmd, alloc_id);
     pid_t pid = ::fork();
     if (pid == 0) {
       // child: run the harness entrypoint with the task env
@@ -183,6 +259,10 @@ class Agent {
       }
       // stdout/stderr → log file (shipped to master on exit; live shipping
       // is the harness's log-batch POST)
+      if (!run_dir.empty() && ::chdir(run_dir.c_str()) != 0) {
+        std::cerr << "chdir " << run_dir << " failed" << std::endl;
+        std::_Exit(82);
+      }
       ::setenv("DCT_TASK_TYPE", cmd["task_type"].as_string().c_str(), 1);
       if (cmd["spec"]["env"].is_object()) {
         for (const auto& [k, v] : cmd["spec"]["env"].items()) {

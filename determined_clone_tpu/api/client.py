@@ -205,6 +205,12 @@ class MasterSession:
     def list_templates(self) -> list:
         return self.get("/api/v1/templates")["templates"]
 
+    def get_template(self, name: str) -> Dict[str, Any]:
+        return self.get(f"/api/v1/templates/{_q(name)}")
+
+    def delete_template(self, name: str) -> None:
+        self.request("DELETE", f"/api/v1/templates/{_q(name)}")
+
     def create_webhook(self, url: str, triggers: Optional[list] = None,
                        webhook_type: str = "default") -> Dict[str, Any]:
         return self.post("/api/v1/webhooks", {

@@ -1,0 +1,2 @@
+"""The `det` command-line interface (≈ harness/determined/cli)."""
+from determined_clone_tpu.cli.cli import main  # noqa: F401

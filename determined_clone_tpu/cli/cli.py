@@ -1,0 +1,613 @@
+"""`det` — the CLI command tree.
+
+≈ the reference's argparse-declarative CLI (harness/determined/cli/cli.py:200
+and the per-domain modules experiment.py, trial.py, checkpoint.py, model.py,
+notebook.py, shell.py, tensorboard.py, user.py, workspace.py, template.py,
+agent.py, job.py), collapsed into one module: every subcommand is a thin
+wrapper over MasterSession/SDK calls, printing tables or JSON.
+
+Master address: -m/--master host:port, or DCT_MASTER env, default
+127.0.0.1:8080. Login tokens persist per master in ~/.dct/auth.json
+(≈ ~/.determined TokenStore, common/api/authentication.py).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence
+
+from determined_clone_tpu.api.client import MasterError, MasterSession
+
+
+# ---------------------------------------------------------------------------
+# session + auth store
+# ---------------------------------------------------------------------------
+
+def auth_store_path() -> str:
+    return os.path.join(os.path.expanduser("~"), ".dct", "auth.json")
+
+
+def load_auth_store() -> Dict[str, str]:
+    try:
+        with open(auth_store_path()) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def save_auth_store(store: Dict[str, str]) -> None:
+    path = auth_store_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(store, f)
+    os.chmod(path, 0o600)
+
+
+def make_session(args: argparse.Namespace) -> MasterSession:
+    master = args.master or os.environ.get("DCT_MASTER", "127.0.0.1:8080")
+    host, _, port = master.partition(":")
+    session = MasterSession(host or "127.0.0.1", int(port or "8080"))
+    token = load_auth_store().get(master)
+    if token:
+        session.token = token
+    return session
+
+
+# ---------------------------------------------------------------------------
+# output helpers
+# ---------------------------------------------------------------------------
+
+def print_table(rows: List[Dict[str, Any]], columns: Sequence[str]) -> None:
+    if not rows:
+        print("(none)")
+        return
+    widths = {c: len(c) for c in columns}
+    rendered = []
+    for row in rows:
+        r = {c: str(row.get(c, "")) for c in columns}
+        for c in columns:
+            widths[c] = max(widths[c], len(r[c]))
+        rendered.append(r)
+    header = " | ".join(c.ljust(widths[c]) for c in columns)
+    print(header)
+    print("-+-".join("-" * widths[c] for c in columns))
+    for r in rendered:
+        print(" | ".join(r[c].ljust(widths[c]) for c in columns))
+
+
+def print_json(obj: Any) -> None:
+    print(json.dumps(obj, indent=2, sort_keys=True))
+
+
+def load_config_file(path: str) -> Dict[str, Any]:
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    if not isinstance(cfg, dict):
+        raise SystemExit(f"config {path} must be a YAML mapping")
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# command implementations
+# ---------------------------------------------------------------------------
+
+def cmd_master_info(args) -> int:
+    print_json(make_session(args).master_info())
+    return 0
+
+
+def cmd_experiment_create(args) -> int:
+    session = make_session(args)
+    config = load_config_file(args.config)
+    if args.config_override:
+        for override in args.config_override:
+            key, _, value = override.partition("=")
+            node = config
+            parts = key.split(".")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            try:
+                node[parts[-1]] = json.loads(value)
+            except json.JSONDecodeError:
+                node[parts[-1]] = value
+    body: Dict[str, Any] = {"config": config}
+    if args.model_dir:
+        from determined_clone_tpu.sdk import read_context_dir
+
+        body["context"] = read_context_dir(args.model_dir)
+    exp = session.post("/api/v1/experiments", body)["experiment"]
+    print(f"Created experiment {exp['id']}")
+    if args.follow:
+        from determined_clone_tpu.sdk import ExperimentRef
+
+        state = ExperimentRef(session, exp["id"]).wait(timeout=args.timeout)
+        print(f"Experiment {exp['id']} finished: {state}")
+        return 0 if state == "COMPLETED" else 1
+    return 0
+
+
+def cmd_experiment_list(args) -> int:
+    exps = make_session(args).list_experiments()
+    print_table(exps, ["id", "name", "state", "owner", "workspace", "project"])
+    return 0
+
+
+def cmd_experiment_describe(args) -> int:
+    print_json(make_session(args).get_experiment(args.experiment_id))
+    return 0
+
+
+def cmd_experiment_kill(args) -> int:
+    make_session(args).kill_experiment(args.experiment_id)
+    print(f"Killed experiment {args.experiment_id}")
+    return 0
+
+
+def cmd_trial_describe(args) -> int:
+    print_json(make_session(args).get_trial(args.trial_id))
+    return 0
+
+
+def cmd_trial_metrics(args) -> int:
+    print_json(make_session(args).trial_metrics(args.trial_id, args.limit))
+    return 0
+
+
+def cmd_trial_logs(args) -> int:
+    session = make_session(args)
+    trial = session.get_trial(args.trial_id)
+    for attempt in range(int(trial.get("restarts", 0)) + 1):
+        for rec in session.task_logs(f"trial-{args.trial_id}.{attempt}"):
+            print(rec.get("log", ""))
+    return 0
+
+
+def cmd_checkpoint_list(args) -> int:
+    records = make_session(args).get(
+        f"/api/v1/experiments/{args.experiment_id}/checkpoints")["checkpoints"]
+    print_table(records, ["uuid", "trial_id", "reported_at"])
+    return 0
+
+
+def cmd_checkpoint_describe(args) -> int:
+    print_json(make_session(args).get(f"/api/v1/checkpoints/{args.uuid}"))
+    return 0
+
+
+def cmd_checkpoint_download(args) -> int:
+    from determined_clone_tpu.sdk import CheckpointRef
+
+    session = make_session(args)
+    path = CheckpointRef(session, args.uuid).download(args.output_dir)
+    print(f"Downloaded checkpoint {args.uuid} to {path}")
+    return 0
+
+
+def cmd_task_list(args) -> int:
+    tasks = make_session(args).list_tasks(args.type)
+    print_table(tasks, ["id", "task_type", "name", "state", "proxy_address"])
+    return 0
+
+
+def cmd_task_kill(args) -> int:
+    make_session(args).kill_task(args.task_id)
+    print(f"Killed task {args.task_id}")
+    return 0
+
+
+def cmd_task_logs(args) -> int:
+    for rec in make_session(args).task_logs(args.task_id):
+        print(rec.get("log", ""))
+    return 0
+
+
+def _start_ntsc(args, task_type: str, **extra: Any) -> int:
+    session = make_session(args)
+    kwargs: Dict[str, Any] = dict(extra)
+    if getattr(args, "name", None):
+        kwargs["name"] = args.name
+    if getattr(args, "idle_timeout", None):
+        kwargs["idle_timeout"] = args.idle_timeout
+    task = session.create_task(task_type, **kwargs)
+    print(f"Started {task_type} {task['id']}")
+    return 0
+
+
+def cmd_notebook_start(args) -> int:
+    return _start_ntsc(args, "notebook")
+
+
+def cmd_shell_start(args) -> int:
+    return _start_ntsc(args, "shell")
+
+
+def cmd_shell_exec(args) -> int:
+    session = make_session(args)
+    out = session.proxy(args.task_id, "/exec", "POST", {"cmd": args.cmd})
+    if out.get("stdout"):
+        sys.stdout.write(out["stdout"])
+    if out.get("stderr"):
+        sys.stderr.write(out["stderr"])
+    return int(out.get("code", 1))
+
+
+def cmd_command_run(args) -> int:
+    return _start_ntsc(args, "command", cmd=args.cmd)
+
+
+def cmd_tensorboard_start(args) -> int:
+    ids = [int(x) for x in args.experiment_ids.split(",") if x]
+    return _start_ntsc(args, "tensorboard", experiment_ids=ids)
+
+
+def cmd_agent_list(args) -> int:
+    agents = make_session(args).list_agents()
+    print_table(agents, ["id", "resource_pool", "slots", "topology",
+                         "enabled", "address"])
+    return 0
+
+
+def cmd_job_list(args) -> int:
+    queue = make_session(args).job_queue()
+    print_table(queue, ["id", "task_type", "state", "slots", "priority",
+                        "resource_pool"])
+    return 0
+
+
+def cmd_user_login(args) -> int:
+    session = make_session(args)
+    import getpass
+
+    password = args.password
+    if password is None:
+        password = getpass.getpass(f"Password for {args.username}: ")
+    session.login(args.username, password)
+    master = args.master or os.environ.get("DCT_MASTER", "127.0.0.1:8080")
+    store = load_auth_store()
+    store[master] = session.token
+    save_auth_store(store)
+    print(f"Logged in as {args.username}")
+    return 0
+
+
+def cmd_user_logout(args) -> int:
+    session = make_session(args)
+    try:
+        session.logout()
+    except MasterError:
+        pass
+    master = args.master or os.environ.get("DCT_MASTER", "127.0.0.1:8080")
+    store = load_auth_store()
+    store.pop(master, None)
+    save_auth_store(store)
+    print("Logged out")
+    return 0
+
+
+def cmd_user_whoami(args) -> int:
+    print_json(make_session(args).whoami())
+    return 0
+
+
+def cmd_user_create(args) -> int:
+    user = make_session(args).create_user(
+        args.username, args.password or "", admin=args.admin)
+    print(f"Created user {user['username']} (id {user['id']})")
+    return 0
+
+
+def cmd_user_list(args) -> int:
+    print_table(make_session(args).list_users(),
+                ["id", "username", "admin", "active"])
+    return 0
+
+
+def cmd_workspace_create(args) -> int:
+    ws = make_session(args).create_workspace(args.name)
+    print(f"Created workspace {ws['name']} (id {ws['id']})")
+    return 0
+
+
+def cmd_workspace_list(args) -> int:
+    print_table(make_session(args).list_workspaces(),
+                ["id", "name", "owner", "archived"])
+    return 0
+
+
+def cmd_workspace_describe(args) -> int:
+    print_json(make_session(args).get_workspace(args.workspace_id))
+    return 0
+
+
+def cmd_project_create(args) -> int:
+    proj = make_session(args).create_project(
+        args.workspace_id, args.name, args.description or "")
+    print(f"Created project {proj['name']} (id {proj['id']})")
+    return 0
+
+
+def cmd_model_create(args) -> int:
+    model = make_session(args).create_model(
+        args.name, description=args.description or "")
+    print(f"Created model {model['name']} (id {model['id']})")
+    return 0
+
+
+def cmd_model_list(args) -> int:
+    print_table(make_session(args).list_models(),
+                ["id", "name", "workspace", "archived"])
+    return 0
+
+
+def cmd_model_describe(args) -> int:
+    print_json(make_session(args).get_model(args.name))
+    return 0
+
+
+def cmd_model_register_version(args) -> int:
+    v = make_session(args).register_model_version(
+        args.name, args.checkpoint_uuid)
+    print(f"Registered {args.name} version {v['version']}")
+    return 0
+
+
+def cmd_template_set(args) -> int:
+    make_session(args).set_template(args.name, load_config_file(args.config))
+    print(f"Set template {args.name}")
+    return 0
+
+
+def cmd_template_list(args) -> int:
+    print_table(make_session(args).list_templates(), ["name"])
+    return 0
+
+
+def cmd_template_describe(args) -> int:
+    print_json(make_session(args).get_template(args.name))
+    return 0
+
+
+def cmd_template_delete(args) -> int:
+    make_session(args).delete_template(args.name)
+    print(f"Deleted template {args.name}")
+    return 0
+
+
+def cmd_webhook_create(args) -> int:
+    hook = make_session(args).create_webhook(
+        args.url, triggers=args.trigger or [], webhook_type=args.type)
+    print(f"Created webhook {hook['id']}")
+    return 0
+
+
+def cmd_webhook_list(args) -> int:
+    print_table(make_session(args).get("/api/v1/webhooks")["webhooks"],
+                ["id", "url", "webhook_type", "triggers"])
+    return 0
+
+
+def cmd_webhook_delete(args) -> int:
+    make_session(args).request("DELETE", f"/api/v1/webhooks/{args.webhook_id}")
+    print(f"Deleted webhook {args.webhook_id}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parser tree
+# ---------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="det", description="determined-clone-tpu CLI")
+    parser.add_argument("-m", "--master", default=None,
+                        help="master address host:port (env DCT_MASTER)")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    # master
+    p_master = sub.add_parser("master", help="master info")
+    sm = p_master.add_subparsers(dest="subcommand", required=True)
+    sm.add_parser("info").set_defaults(func=cmd_master_info)
+
+    # experiment
+    p_exp = sub.add_parser("experiment", aliases=["e"], help="experiments")
+    se = p_exp.add_subparsers(dest="subcommand", required=True)
+    c = se.add_parser("create")
+    c.add_argument("config", help="experiment config YAML")
+    c.add_argument("model_dir", nargs="?", default=None,
+                   help="model definition directory to upload")
+    c.add_argument("--config-override", action="append", default=[],
+                   metavar="KEY=VALUE", help="dotted-path config override")
+    c.add_argument("-f", "--follow", action="store_true",
+                   help="wait for completion")
+    c.add_argument("--timeout", type=float, default=3600)
+    c.set_defaults(func=cmd_experiment_create)
+    c = se.add_parser("list")
+    c.set_defaults(func=cmd_experiment_list)
+    c = se.add_parser("describe")
+    c.add_argument("experiment_id", type=int)
+    c.set_defaults(func=cmd_experiment_describe)
+    c = se.add_parser("kill")
+    c.add_argument("experiment_id", type=int)
+    c.set_defaults(func=cmd_experiment_kill)
+
+    # trial
+    p_trial = sub.add_parser("trial", aliases=["t"], help="trials")
+    st = p_trial.add_subparsers(dest="subcommand", required=True)
+    c = st.add_parser("describe")
+    c.add_argument("trial_id", type=int)
+    c.set_defaults(func=cmd_trial_describe)
+    c = st.add_parser("metrics")
+    c.add_argument("trial_id", type=int)
+    c.add_argument("--limit", type=int, default=1000)
+    c.set_defaults(func=cmd_trial_metrics)
+    c = st.add_parser("logs")
+    c.add_argument("trial_id", type=int)
+    c.set_defaults(func=cmd_trial_logs)
+
+    # checkpoint
+    p_ckpt = sub.add_parser("checkpoint", aliases=["c"], help="checkpoints")
+    sc = p_ckpt.add_subparsers(dest="subcommand", required=True)
+    c = sc.add_parser("list")
+    c.add_argument("experiment_id", type=int)
+    c.set_defaults(func=cmd_checkpoint_list)
+    c = sc.add_parser("describe")
+    c.add_argument("uuid")
+    c.set_defaults(func=cmd_checkpoint_describe)
+    c = sc.add_parser("download")
+    c.add_argument("uuid")
+    c.add_argument("-o", "--output-dir", default=".")
+    c.set_defaults(func=cmd_checkpoint_download)
+
+    # task (generic) + NTSC types
+    p_task = sub.add_parser("task", help="NTSC tasks")
+    stk = p_task.add_subparsers(dest="subcommand", required=True)
+    c = stk.add_parser("list")
+    c.add_argument("--type", default=None)
+    c.set_defaults(func=cmd_task_list)
+    c = stk.add_parser("kill")
+    c.add_argument("task_id")
+    c.set_defaults(func=cmd_task_kill)
+    c = stk.add_parser("logs")
+    c.add_argument("task_id")
+    c.set_defaults(func=cmd_task_logs)
+
+    p_nb = sub.add_parser("notebook", help="notebook tasks")
+    sn = p_nb.add_subparsers(dest="subcommand", required=True)
+    c = sn.add_parser("start")
+    c.add_argument("--name", default=None)
+    c.add_argument("--idle-timeout", type=float, default=None)
+    c.set_defaults(func=cmd_notebook_start)
+
+    p_sh = sub.add_parser("shell", help="shell tasks")
+    ss = p_sh.add_subparsers(dest="subcommand", required=True)
+    c = ss.add_parser("start")
+    c.add_argument("--name", default=None)
+    c.add_argument("--idle-timeout", type=float, default=None)
+    c.set_defaults(func=cmd_shell_start)
+    c = ss.add_parser("exec")
+    c.add_argument("task_id")
+    c.add_argument("cmd", nargs="+")
+    c.set_defaults(func=cmd_shell_exec)
+
+    p_cmd = sub.add_parser("cmd", help="command tasks")
+    scm = p_cmd.add_subparsers(dest="subcommand", required=True)
+    c = scm.add_parser("run")
+    c.add_argument("--name", default=None)
+    c.add_argument("cmd", nargs="+")
+    c.set_defaults(func=cmd_command_run)
+
+    p_tb = sub.add_parser("tensorboard", help="tensorboard tasks")
+    stb = p_tb.add_subparsers(dest="subcommand", required=True)
+    c = stb.add_parser("start")
+    c.add_argument("experiment_ids", help="comma-separated experiment ids")
+    c.add_argument("--name", default=None)
+    c.set_defaults(func=cmd_tensorboard_start)
+
+    # agent / job
+    p_agent = sub.add_parser("agent", aliases=["a"], help="agents")
+    sa = p_agent.add_subparsers(dest="subcommand", required=True)
+    sa.add_parser("list").set_defaults(func=cmd_agent_list)
+
+    p_job = sub.add_parser("job", aliases=["j"], help="job queue")
+    sj = p_job.add_subparsers(dest="subcommand", required=True)
+    sj.add_parser("list").set_defaults(func=cmd_job_list)
+
+    # user
+    p_user = sub.add_parser("user", aliases=["u"], help="users")
+    su = p_user.add_subparsers(dest="subcommand", required=True)
+    c = su.add_parser("login")
+    c.add_argument("username")
+    c.add_argument("--password", default=None)
+    c.set_defaults(func=cmd_user_login)
+    su.add_parser("logout").set_defaults(func=cmd_user_logout)
+    su.add_parser("whoami").set_defaults(func=cmd_user_whoami)
+    c = su.add_parser("create")
+    c.add_argument("username")
+    c.add_argument("--password", default=None)
+    c.add_argument("--admin", action="store_true")
+    c.set_defaults(func=cmd_user_create)
+    su.add_parser("list").set_defaults(func=cmd_user_list)
+
+    # workspace / project
+    p_ws = sub.add_parser("workspace", aliases=["w"], help="workspaces")
+    sw = p_ws.add_subparsers(dest="subcommand", required=True)
+    c = sw.add_parser("create")
+    c.add_argument("name")
+    c.set_defaults(func=cmd_workspace_create)
+    sw.add_parser("list").set_defaults(func=cmd_workspace_list)
+    c = sw.add_parser("describe")
+    c.add_argument("workspace_id", type=int)
+    c.set_defaults(func=cmd_workspace_describe)
+
+    p_proj = sub.add_parser("project", aliases=["p"], help="projects")
+    sp = p_proj.add_subparsers(dest="subcommand", required=True)
+    c = sp.add_parser("create")
+    c.add_argument("workspace_id", type=int)
+    c.add_argument("name")
+    c.add_argument("--description", default=None)
+    c.set_defaults(func=cmd_project_create)
+
+    # model registry
+    p_model = sub.add_parser("model", help="model registry")
+    smo = p_model.add_subparsers(dest="subcommand", required=True)
+    c = smo.add_parser("create")
+    c.add_argument("name")
+    c.add_argument("--description", default=None)
+    c.set_defaults(func=cmd_model_create)
+    smo.add_parser("list").set_defaults(func=cmd_model_list)
+    c = smo.add_parser("describe")
+    c.add_argument("name")
+    c.set_defaults(func=cmd_model_describe)
+    c = smo.add_parser("register-version")
+    c.add_argument("name")
+    c.add_argument("checkpoint_uuid")
+    c.set_defaults(func=cmd_model_register_version)
+
+    # template
+    p_tpl = sub.add_parser("template", help="config templates")
+    stp = p_tpl.add_subparsers(dest="subcommand", required=True)
+    c = stp.add_parser("set")
+    c.add_argument("name")
+    c.add_argument("config")
+    c.set_defaults(func=cmd_template_set)
+    stp.add_parser("list").set_defaults(func=cmd_template_list)
+    c = stp.add_parser("describe")
+    c.add_argument("name")
+    c.set_defaults(func=cmd_template_describe)
+    c = stp.add_parser("delete")
+    c.add_argument("name")
+    c.set_defaults(func=cmd_template_delete)
+
+    # webhook
+    p_wh = sub.add_parser("webhook", help="webhooks")
+    swh = p_wh.add_subparsers(dest="subcommand", required=True)
+    c = swh.add_parser("create")
+    c.add_argument("url")
+    c.add_argument("--trigger", action="append", default=None,
+                   help="experiment state that fires the hook (repeatable)")
+    c.add_argument("--type", default="default",
+                   choices=["default", "slack"])
+    c.set_defaults(func=cmd_webhook_create)
+    swh.add_parser("list").set_defaults(func=cmd_webhook_list)
+    c = swh.add_parser("delete")
+    c.add_argument("webhook_id", type=int)
+    c.set_defaults(func=cmd_webhook_delete)
+
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    try:
+        return args.func(args)
+    except MasterError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1

@@ -6,7 +6,9 @@
 // task logs, job queue, master info.
 #include <algorithm>
 #include <cctype>
+#include <fstream>
 #include <set>
+#include <sstream>
 
 #include "master.h"
 
@@ -155,6 +157,22 @@ HttpResponse Master::route(const HttpRequest& req) {
       } catch (const std::exception& e) {
         return bad_request(e.what());
       }
+      // validate the context upload BEFORE any state mutates — a 400 must
+      // truly leave no side effects (no trials, allocations, workspaces)
+      if (body["context"].is_array() && body["context"].size() > 0) {
+        size_t total = 0;
+        for (const auto& f : body["context"].elements()) {
+          const std::string& fpath = f["path"].as_string();
+          if (fpath.empty() || fpath[0] == '/' ||
+              fpath.find("..") != std::string::npos) {
+            return bad_request("context paths must be relative, no '..'");
+          }
+          total += f["content_b64"].as_string().size();
+        }
+        if (total > 8u << 20) {
+          return bad_request("context directory too large (8MB b64 cap)");
+        }
+      }
       Experiment exp;
       exp.id = next_experiment_id_++;
       exp.name = config["name"].as_string().empty() ? "unnamed"
@@ -181,6 +199,16 @@ HttpResponse Master::route(const HttpRequest& req) {
       // must leave no side effects
       Workspace& ws = ensure_workspace(stored.workspace, stored.owner);
       ensure_project(stored.project, ws.id, stored.owner);
+      // model-def context directory (≈ read_v1_context's base64 file list,
+      // cli/experiment.py:242): stored on disk, served to agents on demand
+      // (validated above, before any state mutated)
+      if (body["context"].is_array() && body["context"].size() > 0) {
+        Json ctx = Json::object();
+        ctx.set("context", body["context"]);
+        std::ofstream out(config_.data_dir + "/exp-" + std::to_string(id) +
+                          "-context.json");
+        out << ctx.dump();
+      }
       dirty_ = true;
       Json j = Json::object();
       j.set("experiment", experiments_[id].to_json());
@@ -224,6 +252,19 @@ HttpResponse Master::route(const HttpRequest& req) {
         Json j = Json::object();
         j.set("checkpoints", arr);
         return ok_json(j);
+      }
+      // context-dir download by agents (≈ prep_container.py:29)
+      if (parts.size() == 5 && parts[4] == "context" && req.method == "GET") {
+        std::ifstream in(config_.data_dir + "/exp-" + std::to_string(id) +
+                         "-context.json");
+        if (!in.good()) {
+          Json j = Json::object();
+          j.set("context", Json::array());
+          return ok_json(j);
+        }
+        std::stringstream buf;
+        buf << in.rdbuf();
+        return HttpResponse::json(200, buf.str());
       }
     }
   }

@@ -5,6 +5,7 @@
 // template,webhook}.go handlers over their service packages, collapsed onto
 // the Master's single state map the way routes.cc does for experiments.
 #include <cctype>
+#include <fstream>
 #include <random>
 #include <thread>
 
@@ -52,12 +53,28 @@ std::string hash_password(const std::string& username,
 }
 
 std::string new_token() {
-  static thread_local std::mt19937_64 rng{std::random_device{}()};
-  char buf[33];
-  std::snprintf(buf, sizeof(buf), "%016llx%016llx",
-                static_cast<unsigned long long>(rng()),
-                static_cast<unsigned long long>(rng()));
-  return buf;
+  // full-entropy 128-bit token straight from the OS — tokens are the
+  // --auth-required boundary, so no seeded PRNG (predictable from one leak)
+  unsigned char raw[16];
+  std::ifstream urandom("/dev/urandom", std::ios::binary);
+  if (urandom.good()) {
+    urandom.read(reinterpret_cast<char*>(raw), sizeof(raw));
+  }
+  if (!urandom.good()) {
+    std::random_device rd;  // fallback: one fresh word per byte-pair
+    for (size_t i = 0; i < sizeof(raw); i += 2) {
+      unsigned int v = rd();
+      raw[i] = static_cast<unsigned char>(v & 0xFF);
+      raw[i + 1] = static_cast<unsigned char>((v >> 8) & 0xFF);
+    }
+  }
+  static const char* hex = "0123456789abcdef";
+  std::string out;
+  for (unsigned char b : raw) {
+    out += hex[b >> 4];
+    out += hex[b & 0xF];
+  }
+  return out;
 }
 
 // deep-merge: template config is the base, experiment config overrides
