@@ -259,8 +259,12 @@ class Agent {
       }
       // stdout/stderr → log file (shipped to master on exit; live shipping
       // is the harness's log-batch POST)
-      if (!run_dir.empty() && ::chdir(run_dir.c_str()) != 0) {
-        std::cerr << "chdir " << run_dir << " failed" << std::endl;
+      // task cwd is the run dir (uploaded context) or the agent work dir —
+      // never the agent's own cwd (trials import model code from cwd)
+      const std::string& task_cwd =
+          run_dir.empty() ? config_.work_dir : run_dir;
+      if (::chdir(task_cwd.c_str()) != 0) {
+        std::cerr << "chdir " << task_cwd << " failed" << std::endl;
         std::_Exit(82);
       }
       ::setenv("DCT_TASK_TYPE", cmd["task_type"].as_string().c_str(), 1);

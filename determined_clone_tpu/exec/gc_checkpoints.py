@@ -1,0 +1,41 @@
+"""Checkpoint GC task — deletes doomed checkpoints from storage.
+
+≈ the reference's GC container (master/internal/checkpoint_gc.go:27 spawns
+it; harness/determined/exec/gc_checkpoints.py:97 does the deleting). The
+master marks records deleted in its registry, then schedules this zero-slot
+command task with the storage config + uuid list in env.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    from determined_clone_tpu.config.experiment import CheckpointStorageConfig
+    from determined_clone_tpu.storage import build
+
+    storage_raw = os.environ.get("DCT_GC_STORAGE")
+    uuids_raw = os.environ.get("DCT_GC_UUIDS", "")
+    if not storage_raw:
+        print("DCT_GC_STORAGE not set; nothing to do")
+        return 0
+    manager = build(CheckpointStorageConfig.from_dict(json.loads(storage_raw)))
+    uuids = [u for u in uuids_raw.split(",") if u]
+    failed = 0
+    for uuid in uuids:
+        try:
+            manager.delete(uuid)
+            print(f"deleted checkpoint {uuid}")
+        except FileNotFoundError:
+            print(f"checkpoint {uuid} already gone")
+        except Exception as exc:  # keep going; report at the end
+            print(f"failed to delete {uuid}: {exc}")
+            failed += 1
+    print(f"gc done: {len(uuids) - failed}/{len(uuids)} deleted")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,8 +3,11 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <chrono>
 #include <fstream>
+#include <regex>
+#include <set>
 #include <sstream>
 
 namespace dct {
@@ -372,12 +375,200 @@ void Master::queue_trial_leg(Trial& trial) {
   dirty_ = true;
 }
 
+void Master::apply_log_policies(const Allocation& alloc, const Json& logs) {
+  if (alloc.trial_id == 0) return;
+  auto tit = trials_.find(alloc.trial_id);
+  if (tit == trials_.end()) return;
+  Trial& trial = tit->second;
+  auto eit = experiments_.find(trial.experiment_id);
+  if (eit == experiments_.end()) return;
+  Experiment& exp = eit->second;
+  const Json& policies = exp.config["log_policies"];
+  if (!policies.is_array() || policies.size() == 0) return;
+  // compile once per experiment (validated at submission; log ingestion is
+  // on the request path, so no per-batch regex construction)
+  auto cit = log_policy_cache_.find(exp.id);
+  if (cit == log_policy_cache_.end()) {
+    std::vector<CompiledLogPolicy> compiled;
+    for (const auto& policy : policies.elements()) {
+      const std::string& pattern = policy["pattern"].as_string();
+      const std::string& action = policy["action"]["type"].as_string();
+      if (pattern.empty()) continue;
+      try {
+        compiled.push_back({std::regex(pattern), pattern, action});
+      } catch (const std::regex_error&) {
+        // unreachable for new experiments (validated at create); restored
+        // pre-validation snapshots must not take down log ingestion
+      }
+    }
+    cit = log_policy_cache_.emplace(exp.id, std::move(compiled)).first;
+  }
+  for (const auto& policy : cit->second) {
+    bool matched = false;
+    std::string matched_line;
+    for (const auto& line : logs.elements()) {
+      if (std::regex_search(line.as_string(), policy.re)) {
+        matched = true;
+        matched_line = line.as_string();
+        break;
+      }
+    }
+    if (!matched) continue;
+    const std::string& action = policy.action;
+    Json rec = Json::object();
+    rec.set("time", now_sec()).set("trial_id", trial.id)
+        .set("pattern", policy.pattern).set("action", action)
+        .set("line", matched_line);
+    append_jsonl("exp-" + std::to_string(exp.id) + "-logpattern.jsonl", rec);
+    if (action == "cancel_retries") {
+      // ≈ logpattern CancelRetries: this failure class is not transient
+      trial.no_retries = true;
+      dirty_ = true;
+    } else if (action == "exclude_node") {
+      // ≈ logpattern ExcludeNode → BlockedNodes (trial.go:381): the
+      // experiment's future legs avoid the nodes this leg ran on
+      const std::string key = "exp-" + std::to_string(exp.id);
+      for (const auto& [aid, n] : alloc.reservations) {
+        auto ait = agents_.find(aid);
+        if (ait != agents_.end()) {
+          ait->second.blocked_by.insert(key);
+          dirty_ = true;
+        }
+      }
+    }
+  }
+}
+
+void Master::gc_checkpoints_locked(Experiment& exp) {
+  const Json& storage = exp.config["checkpoint_storage"];
+  if (!storage.is_object()) return;
+  int keep_latest = static_cast<int>(storage["save_trial_latest"].as_int(1));
+  int keep_best = static_cast<int>(storage["save_trial_best"].as_int(1));
+  int keep_exp_best =
+      static_cast<int>(storage["save_experiment_best"].as_int(0));
+  bool smaller = true;
+  if (exp.config["searcher"].is_object()) {
+    smaller = exp.config["searcher"]["smaller_is_better"].as_bool(true);
+  }
+
+  std::map<int64_t, std::vector<CheckpointRecord*>> by_trial;
+  for (auto& c : checkpoints_) {
+    if (c.experiment_id == exp.id && !c.deleted) {
+      by_trial[c.trial_id].push_back(&c);  // chronological (append order)
+    }
+  }
+  if (by_trial.empty()) return;
+
+  std::set<std::string> keep;
+  // never GC a checkpoint the model registry references
+  for (const auto& [id, m] : models_) {
+    for (const auto& v : m.versions) keep.insert(v.checkpoint_uuid);
+  }
+  // per-trial metric-sorted checkpoints (stable: earlier checkpoint wins
+  // ties, so a stale-metric duplicate never displaces the measured one)
+  std::map<int64_t, std::vector<CheckpointRecord*>> best_sorted;
+  for (auto& [tid, records] : by_trial) {
+    for (int i = static_cast<int>(records.size()) - 1, n = 0;
+         i >= 0 && n < keep_latest; --i, ++n) {
+      keep.insert(records[i]->uuid);
+    }
+    std::vector<CheckpointRecord*> with_metric;
+    for (auto* c : records) {
+      if (c->metadata.has("validation_metric")) with_metric.push_back(c);
+    }
+    std::stable_sort(
+        with_metric.begin(), with_metric.end(),
+        [smaller](const CheckpointRecord* a, const CheckpointRecord* b) {
+          double ma = a->metadata["validation_metric"].as_number();
+          double mb = b->metadata["validation_metric"].as_number();
+          return smaller ? ma < mb : ma > mb;
+        });
+    for (int i = 0; i < keep_best &&
+                    i < static_cast<int>(with_metric.size()); ++i) {
+      keep.insert(with_metric[i]->uuid);
+    }
+    best_sorted[tid] = std::move(with_metric);
+  }
+  if (keep_exp_best > 0) {
+    std::vector<const Trial*> ranked;
+    for (const auto& [tid, t] : trials_) {
+      if (t.experiment_id == exp.id && t.has_metric) ranked.push_back(&t);
+    }
+    std::sort(ranked.begin(), ranked.end(),
+              [smaller](const Trial* a, const Trial* b) {
+                return smaller ? a->best_metric < b->best_metric
+                               : a->best_metric > b->best_metric;
+              });
+    for (int i = 0; i < keep_exp_best &&
+                    i < static_cast<int>(ranked.size()); ++i) {
+      // the checkpoint that ACHIEVED the trial's best metric, not whatever
+      // came last (weights drift after the best validation)
+      const auto& bs = best_sorted[ranked[i]->id];
+      if (!bs.empty()) {
+        keep.insert(bs.front()->uuid);
+      } else if (!ranked[i]->latest_checkpoint.empty()) {
+        keep.insert(ranked[i]->latest_checkpoint);
+      }
+    }
+  }
+
+  std::vector<std::string> doomed;
+  for (auto& c : checkpoints_) {
+    if (c.experiment_id == exp.id && !c.deleted && !keep.count(c.uuid)) {
+      c.deleted = true;
+      doomed.push_back(c.uuid);
+    }
+  }
+  if (doomed.empty()) return;
+  dirty_ = true;
+
+  // zero-slot GC task deletes the files from storage in-container
+  // (≈ runCheckpointGCTask → exec/gc_checkpoints.py:97)
+  Allocation gc;
+  gc.id = "task-gc-" + std::to_string(next_task_id_++);
+  gc.task_type = "command";
+  gc.trial_id = 0;
+  gc.name = "checkpoint-gc-exp-" + std::to_string(exp.id);
+  gc.state = RunState::Queued;
+  gc.slots = 0;
+  gc.priority = 99;  // background
+  // run in the experiment's pool — a "default"-pool task can never
+  // schedule on a cluster whose agents all sit in another pool
+  if (exp.config["resources"].is_object() &&
+      !exp.config["resources"]["resource_pool"].as_string().empty()) {
+    gc.resource_pool = exp.config["resources"]["resource_pool"].as_string();
+  }
+  gc.queued_at = now_sec();
+  gc.last_activity = gc.queued_at;
+  Json argv = Json::array();
+  argv.push_back("python");
+  argv.push_back("-m");
+  argv.push_back("determined_clone_tpu.exec.gc_checkpoints");
+  gc.spec.set("argv", argv);
+  Json env = Json::object();
+  env.set("DCT_GC_STORAGE", storage.dump());
+  std::string csv;
+  for (const auto& u : doomed) {
+    if (!csv.empty()) csv += ",";
+    csv += u;
+  }
+  env.set("DCT_GC_UUIDS", csv);
+  gc.spec.set("env", env);
+  allocations_[gc.id] = std::move(gc);
+}
+
 void Master::finish_experiment(Experiment& exp, RunState state,
                                const std::string& error) {
   exp.state = state;
   exp.ended_at = now_sec();
   exp.error = error;
   fire_webhooks(exp);  // async, detached (≈ webhooks/shipper.go)
+  gc_checkpoints_locked(exp);  // storage-policy GC (≈ checkpoint_gc.go:27)
+  // a finished experiment's node blocklist is dead state — drop it so
+  // agents don't accumulate exp-N keys (and snapshots don't grow) forever
+  const std::string block_key = "exp-" + std::to_string(exp.id);
+  for (auto& [aid, agent] : agents_) agent.blocked_by.erase(block_key);
+  log_policy_cache_.erase(exp.id);
   // cancel queued allocations of this experiment's trials
   for (auto& [id, alloc] : allocations_) {
     if (alloc.trial_id == 0) continue;
@@ -412,11 +603,13 @@ void Master::on_task_done(const std::string& alloc_id, int exit_code,
     return;
   }
   if (failed) {
-    // trial restart logic (≈ trial.go:531 handleAllocationExit)
+    // trial restart logic (≈ trial.go:531 handleAllocationExit);
+    // no_retries set by a cancel_retries log policy makes the failure
+    // non-retryable (≈ trial.go:184 classification)
     const Json& cfg = exp.config;
     int max_restarts = static_cast<int>(cfg["max_restarts"].as_int(5));
     trial.restarts += 1;
-    if (trial.restarts <= max_restarts &&
+    if (!trial.no_retries && trial.restarts <= max_restarts &&
         exp.state == RunState::Running) {
       queue_trial_leg(trial);  // resumes from latest_checkpoint
     } else {

@@ -11,6 +11,7 @@
 #include <map>
 #include <memory>
 #include <mutex>
+#include <regex>
 #include <string>
 #include <thread>
 #include <vector>
@@ -91,6 +92,12 @@ class Master {
   void fire_webhooks(const Experiment& exp);
   // merges a named template under the config (throws on unknown template)
   Json resolve_template(const Json& config);
+  // log-pattern policies on a shipped log batch (routes.cc):
+  // cancel_retries / exclude_node (≈ master/internal/logpattern)
+  void apply_log_policies(const Allocation& alloc, const Json& logs);
+  // checkpoint GC per storage policy at experiment end; marks records
+  // deleted and spawns a zero-slot GC task (≈ checkpoint_gc.go:27)
+  void gc_checkpoints_locked(Experiment& exp);
 
   MasterConfig config_;
   std::unique_ptr<HttpServer> server_;
@@ -123,6 +130,13 @@ class Master {
   std::map<int64_t, RegisteredModel> models_;
   std::map<std::string, Json> templates_;
   std::map<int64_t, Webhook> webhooks_;
+  // compiled log-pattern policies per experiment (lazy; not persisted)
+  struct CompiledLogPolicy {
+    std::regex re;
+    std::string pattern;
+    std::string action;
+  };
+  std::map<int64_t, std::vector<CompiledLogPolicy>> log_policy_cache_;
   bool dirty_ = false;
 };
 

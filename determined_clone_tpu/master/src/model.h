@@ -99,6 +99,9 @@ struct Trial {
   int64_t target_units = 0;   // current cumulative searcher target
   int64_t units_done = 0;
   int restarts = 0;
+  // log-pattern policy tripped: no more restart legs for this trial
+  // (≈ logpattern CancelRetries, master/internal/logpattern/logpattern.go)
+  bool no_retries = false;
   std::string latest_checkpoint;
   double best_metric = 0;
   bool has_metric = false;
@@ -112,7 +115,7 @@ struct Trial {
         .set("request_id", request_id).set("hparams", hparams)
         .set("state", to_string(state))
         .set("target_units", target_units).set("units_done", units_done)
-        .set("restarts", restarts)
+        .set("restarts", restarts).set("no_retries", no_retries)
         .set("latest_checkpoint", latest_checkpoint)
         .set("best_metric", best_metric).set("has_metric", has_metric)
         .set("created_at", created_at).set("ended_at", ended_at)
@@ -129,6 +132,7 @@ struct Trial {
     t.target_units = j["target_units"].as_int();
     t.units_done = j["units_done"].as_int();
     t.restarts = static_cast<int>(j["restarts"].as_int());
+    t.no_retries = j["no_retries"].as_bool();
     t.latest_checkpoint = j["latest_checkpoint"].as_string();
     t.best_metric = j["best_metric"].as_number();
     t.has_metric = j["has_metric"].as_bool();

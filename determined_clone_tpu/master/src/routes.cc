@@ -7,6 +7,7 @@
 #include <algorithm>
 #include <cctype>
 #include <fstream>
+#include <regex>
 #include <set>
 #include <sstream>
 
@@ -156,6 +157,22 @@ HttpResponse Master::route(const HttpRequest& req) {
         config = resolve_template(body["config"]);
       } catch (const std::exception& e) {
         return bad_request(e.what());
+      }
+      // validate log-pattern regexes up front — a typo'd pattern must be a
+      // 400 at submission, not a silent no-op policy at runtime
+      if (config["log_policies"].is_array()) {
+        for (const auto& policy : config["log_policies"].elements()) {
+          const std::string& pattern = policy["pattern"].as_string();
+          if (pattern.empty()) {
+            return bad_request("log policy requires a non-empty pattern");
+          }
+          try {
+            std::regex re(pattern);
+          } catch (const std::regex_error& e) {
+            return bad_request("invalid log policy pattern '" + pattern +
+                               "': " + e.what());
+          }
+        }
       }
       // validate the context upload BEFORE any state mutates — a 400 must
       // truly leave no side effects (no trials, allocations, workspaces)
@@ -666,6 +683,8 @@ HttpResponse Master::route(const HttpRequest& req) {
               .set("log", line);
           append_jsonl("task-" + alloc_id + "-logs.jsonl", rec);
         }
+        // log-pattern policies (≈ logpattern.go → trial.go:381)
+        apply_log_policies(alloc, body["logs"]);
         return ok_json(Json::object());
       }
       if (req.method == "GET") {

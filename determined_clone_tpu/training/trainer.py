@@ -56,19 +56,23 @@ class Trainer:
     # -- checkpoint save/restore -------------------------------------------
 
     def _save(self, state: TrainState, batches_trained: int,
-              reason: str) -> str:
+              reason: str, metric=None) -> str:
         """Every host writes its addressable shard files; sharded upload
         merges the manifests (multi-host pjit state is never fully
-        addressable on one host)."""
+        addressable on one host). ``metric`` (the searcher metric at save
+        time) feeds the master's save_trial_best GC policy."""
         dist = self.core.distributed
         ck = self.core.checkpoint
         sharded = dist.size > 1
+        metadata = {
+            "steps_completed": batches_trained,
+            "reason": reason,
+            "global_batch_size": self.trial.global_batch_size,
+        }
+        if metric is not None:
+            metadata["validation_metric"] = float(metric)
         with ck.store_path(
-            metadata={
-                "steps_completed": batches_trained,
-                "reason": reason,
-                "global_batch_size": self.trial.global_batch_size,
-            },
+            metadata=metadata,
             shard=sharded,
         ) as (path, holder):
             save_pytree(f"{path}/{CKPT_STATE_DIR}", state, host_id=dist.rank)
@@ -211,19 +215,30 @@ class Trainer:
                         if is_best:
                             best_val = v
                             if policy == "best":
-                                self._save(state, batches_trained, "best")
+                                self._save(state, batches_trained, "best",
+                                           metric=v)
                                 last_ckpt_at = batches_trained
+
+                # a metric only describes the saved weights when validation
+                # ran at THIS batch count — a stale value would misattribute
+                # quality to drifted weights (and mislead best-checkpoint GC)
+                def fresh_metric():
+                    if last_val_at == batches_trained:
+                        return last_val.get(searcher_metric)
+                    return None
 
                 if ckpt_period and batches_trained - last_ckpt_at >= ckpt_period:
                     if policy != "none":
-                        self._save(state, batches_trained, "periodic")
+                        self._save(state, batches_trained, "periodic",
+                                   metric=fresh_metric())
                     last_ckpt_at = batches_trained
 
                 if self.core.preempt.should_preempt():
                     preempted = True
 
             if preempted:
-                self._save(state, batches_trained, "preemption")
+                self._save(state, batches_trained, "preemption",
+                           metric=fresh_metric())
                 self.core.train.report_early_exit("preempted")
                 break
 
@@ -231,6 +246,7 @@ class Trainer:
             final_val = validate()
             if final_val:
                 last_val = final_val
+                last_val_at = batches_trained
                 if searcher_metric in final_val:
                     v = final_val[searcher_metric]
                     if best_val is None or (v < best_val if smaller else v > best_val):
@@ -238,7 +254,9 @@ class Trainer:
             op.complete(last_val.get(searcher_metric, float("nan")))
 
         if not preempted and policy != "none" and batches_trained > last_ckpt_at:
-            self._save(state, batches_trained, "final")
+            metric = (last_val.get(searcher_metric)
+                      if last_val_at == batches_trained else None)
+            self._save(state, batches_trained, "final", metric=metric)
 
         result.update(
             batches_trained=batches_trained,
