@@ -102,6 +102,10 @@ class MasterSession:
         return self.get(f"/api/v1/trials/{trial_id}/metrics?limit={limit}")[
             "metrics"]
 
+    def trial_profiler_samples(self, trial_id: int, limit: int = 1000) -> list:
+        return self.get(
+            f"/api/v1/trials/{trial_id}/profiler?limit={limit}")["samples"]
+
     def list_agents(self) -> list:
         return self.get("/api/v1/agents")["agents"]
 

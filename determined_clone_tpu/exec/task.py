@@ -62,8 +62,9 @@ def register_proxy(addr: str, port: int) -> None:
     )
 
 
-def fetch_tb_data(experiment_ids: List[int]) -> Dict[str, Any]:
-    """Metric history per trial for each experiment, from the master."""
+def _per_experiment(experiment_ids: List[int], fn) -> Dict[str, Any]:
+    """Shared scaffolding: fetch each experiment's detail from the master,
+    map trials through ``fn(session, detail, trial) -> value``."""
     session = _master()
     out: Dict[str, Any] = {}
     for eid in experiment_ids:
@@ -74,12 +75,55 @@ def fetch_tb_data(experiment_ids: List[int]) -> Dict[str, Any]:
             continue
         trials = {}
         for trial in detail.get("trials", []):
-            tid = trial["id"]
-            metrics = session.request(
-                "GET", f"/api/v1/trials/{tid}/metrics?limit=10000")
-            trials[str(tid)] = metrics.get("metrics", [])
+            try:
+                trials[str(trial["id"])] = fn(session, detail, trial)
+            except Exception as exc:  # noqa: BLE001 - per-trial isolation
+                trials[str(trial["id"])] = {"error": str(exc)}
         out[str(eid)] = {"trials": trials}
     return out
+
+
+def fetch_tb_data(experiment_ids: List[int]) -> Dict[str, Any]:
+    """Metric history per trial for each experiment, from the master."""
+    def metrics_of(session, detail, trial):
+        return session.request(
+            "GET", f"/api/v1/trials/{trial['id']}/metrics?limit=10000"
+        ).get("metrics", [])
+
+    return _per_experiment(experiment_ids, metrics_of)
+
+
+def fetch_tb_scalars(experiment_ids: List[int]) -> Dict[str, Any]:
+    """Download each trial's tfevents from the experiment's checkpoint
+    storage and parse the scalar series (the `det tensorboard` data path)."""
+    import tempfile
+
+    from determined_clone_tpu.tensorboard import (
+        fetch_trial_events,
+        read_tfevents,
+    )
+
+    def scalars_of(session, detail, trial):
+        exp = detail["experiment"]
+        storage_raw = exp["config"].get("checkpoint_storage")
+        if not storage_raw:
+            return {"error": "experiment has no checkpoint storage"}
+        with tempfile.TemporaryDirectory() as dst:
+            files = fetch_trial_events(storage_raw, exp["id"], trial["id"],
+                                       dst)
+            series: Dict[str, list] = {}
+            for path in files:
+                try:
+                    for event in read_tfevents(path):
+                        for tag, value in event["scalars"].items():
+                            series.setdefault(tag, []).append(
+                                [event.get("step", 0), value])
+                except (ValueError, OSError):
+                    continue
+            return {"scalars": series,
+                    "files": [os.path.basename(f) for f in files]}
+
+    return _per_experiment(experiment_ids, scalars_of)
 
 
 class TaskHandler(BaseHTTPRequestHandler):
@@ -107,6 +151,12 @@ class TaskHandler(BaseHTTPRequestHandler):
             return
         if self.path.startswith("/data") and self.mode == "tensorboard":
             self._send(200, {"experiments": fetch_tb_data(self.experiment_ids)})
+            return
+        if self.path.startswith("/scalars") and self.mode == "tensorboard":
+            # tfevents fetched from checkpoint storage via the per-backend
+            # fetcher path (≈ tensorboard/fetchers/), then parsed locally
+            self._send(200, {"experiments":
+                             fetch_tb_scalars(self.experiment_ids)})
             return
         self._send(404, {"error": f"no route {self.path}"})
 

@@ -183,15 +183,46 @@ def main(argv=None) -> int:
         checkpoint_registry=MasterCheckpointRegistry(session, info.trial_id),
         trial_id=info.trial_id,
     ) as cctx:
-        tctx = TrialContext(config=config, hparams=info.hparams, core=cctx)
-        trial = trial_cls(tctx)
-        trainer = Trainer(trial)
+        # observability: profiler (opt-in via `profiling` config) +
+        # tensorboard event shipping (chief only, needs a storage backend)
+        from determined_clone_tpu import profiler as profiler_mod
+
+        prof = profiler_mod.from_config(session, info.trial_id,
+                                        info.experiment_config)
+        cctx.profiler = prof if prof.enabled else None
+        prof.start()
+
+        tbm = None
+        storage_raw = info.experiment_config.get("checkpoint_storage")
+        if dist.is_chief and storage_raw:
+            from determined_clone_tpu.tensorboard import TensorboardManager
+
+            try:
+                tbm = TensorboardManager.from_config(
+                    storage_raw, info.experiment_id, info.trial_id,
+                    os.path.abspath(f"tb-events-trial-{info.trial_id}"),
+                    rank=info.rank,
+                ).start()
+            except Exception as e:  # noqa: BLE001 - observability is best-effort
+                print(f"[trial] tensorboard disabled: {e}", flush=True)
+        cctx.tensorboard = tbm
+
+        # trial construction INSIDE the try: a raising user __init__ must
+        # still stop the profiler/tb threads and report the failure cleanly
         try:
+            tctx = TrialContext(config=config, hparams=info.hparams,
+                                core=cctx)
+            trial = trial_cls(tctx)
+            trainer = Trainer(trial)
             result = trainer.fit(latest_checkpoint=info.latest_checkpoint)
             print(f"[trial] leg finished: {result}", flush=True)
         except Exception as e:  # noqa: BLE001 - report, then fail the task
             print(f"[trial] FAILED: {type(e).__name__}: {e}", flush=True)
             exit_code = 1
+        finally:
+            prof.stop()
+            if tbm is not None:
+                tbm.close()
     return exit_code
 
 

@@ -5,6 +5,7 @@
 
 #include <algorithm>
 #include <chrono>
+#include <deque>
 #include <fstream>
 #include <regex>
 #include <set>
@@ -242,6 +243,33 @@ void Master::load_snapshot() {
 void Master::append_jsonl(const std::string& file, const Json& record) {
   std::ofstream out(config_.data_dir + "/" + file, std::ios::app);
   out << record.dump() << "\n";
+}
+
+void Master::append_jsonl_many(const std::string& file,
+                               const std::vector<const Json*>& records) {
+  if (records.empty()) return;
+  std::ofstream out(config_.data_dir + "/" + file, std::ios::app);
+  for (const Json* rec : records) out << rec->dump() << "\n";
+}
+
+std::vector<Json> Master::read_jsonl_tail(const std::string& file,
+                                          size_t limit) {
+  std::ifstream in(config_.data_dir + "/" + file);
+  std::deque<std::string> tail;
+  std::string line;
+  while (std::getline(in, line)) {
+    if (line.empty()) continue;
+    tail.push_back(std::move(line));
+    if (tail.size() > limit) tail.pop_front();
+  }
+  std::vector<Json> out;
+  for (const auto& l : tail) {
+    try {
+      out.push_back(Json::parse(l));
+    } catch (const std::exception&) {
+    }
+  }
+  return out;
 }
 
 std::vector<Json> Master::read_jsonl(const std::string& file, size_t limit,

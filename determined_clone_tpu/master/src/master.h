@@ -67,8 +67,13 @@ class Master {
   void save_snapshot_locked();
   void load_snapshot();
   void append_jsonl(const std::string& file, const Json& record);
+  // one stream open for the whole batch (profiler flushes are 100 samples)
+  void append_jsonl_many(const std::string& file,
+                         const std::vector<const Json*>& records);
   std::vector<Json> read_jsonl(const std::string& file, size_t limit,
                                size_t offset = 0);
+  // last `limit` records — live-monitoring reads want the newest data
+  std::vector<Json> read_jsonl_tail(const std::string& file, size_t limit);
 
   // -- routes --
   HttpResponse route(const HttpRequest& req);

@@ -329,6 +329,34 @@ HttpResponse Master::route(const HttpRequest& req) {
         return ok_json(j);
       }
     }
+    // profiler samples (≈ master profiler API, common/api/profiler.py)
+    if (parts.size() == 5 && parts[4] == "profiler") {
+      if (req.method == "POST") {
+        Json body = Json::parse(req.body);
+        std::vector<const Json*> batch;
+        for (const auto& sample : body["samples"].elements()) {
+          batch.push_back(&sample);
+        }
+        append_jsonl_many("trial-" + std::to_string(id) + "-profiler.jsonl",
+                          batch);
+        return ok_json(Json::object());
+      }
+      if (req.method == "GET") {
+        size_t limit = 1000;
+        auto lim = req.query.find("limit");
+        if (lim != req.query.end()) limit = std::stoul(lim->second);
+        Json arr = Json::array();
+        // tail: live monitoring wants the NEWEST samples, and without it
+        // anything past the first `limit` records would be unreachable
+        for (auto& rec : read_jsonl_tail(
+                 "trial-" + std::to_string(id) + "-profiler.jsonl", limit)) {
+          arr.push_back(rec);
+        }
+        Json j = Json::object();
+        j.set("samples", arr);
+        return ok_json(j);
+      }
+    }
     // searcher operation poll + completion (≈ SearcherContext +
     // CompleteTrialSearcherValidation api_trials.go:1248)
     if (parts.size() == 6 && parts[4] == "searcher") {

@@ -1,0 +1,238 @@
+"""Trial profiler — system metrics + per-batch timings shipped to the master.
+
+≈ the reference's ProfilerAgent (harness/determined/profiler.py:238):
+a sampling thread collects system metrics (CPU, memory, disk, network —
+pynvml GPU sampling becomes device-memory stats from JAX on TPU), a batcher
+thread flushes batched measurements to the master's profiler endpoints
+(common/api/profiler.py), and the trainer feeds per-batch timings
+(dataloading / to-device / compute, _pytorch_trial.py:34 dataloader_next).
+
+Opt-in per experiment via the ``profiling: {enabled: true}`` config block
+(expconf RawProfiling, master/pkg/schemas/expconf/profiling.go).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+SYSTEM_SAMPLE_PERIOD_SEC = 1.0
+FLUSH_PERIOD_SEC = 5.0
+MAX_BATCHED = 100
+
+
+def _read_proc_stat() -> Optional[List[int]]:
+    try:
+        with open("/proc/stat") as f:
+            line = f.readline()
+        return [int(x) for x in line.split()[1:]]
+    except (OSError, ValueError):
+        return None
+
+
+def _read_meminfo() -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                key, _, rest = line.partition(":")
+                out[key.strip()] = int(rest.split()[0])  # kB
+    except (OSError, ValueError, IndexError):
+        pass
+    return out
+
+
+def _read_net_bytes() -> Dict[str, int]:
+    rx = tx = 0
+    try:
+        with open("/proc/net/dev") as f:
+            for line in f.readlines()[2:]:
+                name, _, rest = line.partition(":")
+                if name.strip() == "lo":
+                    continue
+                fields = rest.split()
+                rx += int(fields[0])
+                tx += int(fields[8])
+    except (OSError, ValueError, IndexError):
+        pass
+    return {"rx": rx, "tx": tx}
+
+
+def _device_memory_stats() -> Dict[str, float]:
+    """TPU/accelerator memory via JAX (the pynvml analogue on TPU)."""
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats() or {}
+        return {
+            "device_bytes_in_use": float(stats.get("bytes_in_use", 0)),
+            "device_bytes_limit": float(stats.get("bytes_limit", 0)),
+        }
+    except Exception:
+        return {}
+
+
+class SystemMetricsThread(threading.Thread):
+    """≈ SysMetricCollectorThread (profiler.py:602)."""
+
+    def __init__(self, sink: "ProfilerAgent") -> None:
+        super().__init__(daemon=True, name="profiler-sysmetrics")
+        self._sink = sink
+        # NOT named _stop: threading.Thread has an internal _stop() method
+        # that an attribute by that name would shadow (join() calls it)
+        self._stop_event = threading.Event()
+        self._prev_cpu: Optional[List[int]] = None
+        self._prev_net = _read_net_bytes()
+        self._prev_t = time.time()
+
+    def stop(self) -> None:
+        self._stop_event.set()
+
+    def run(self) -> None:
+        while not self._stop_event.wait(SYSTEM_SAMPLE_PERIOD_SEC):
+            self.sample_once()
+
+    def sample_once(self) -> None:
+        now = time.time()
+        sample: Dict[str, Any] = {"time": now, "group": "system"}
+
+        cpu = _read_proc_stat()
+        if cpu and self._prev_cpu:
+            deltas = [a - b for a, b in zip(cpu, self._prev_cpu)]
+            total = sum(deltas)
+            idle = deltas[3] + (deltas[4] if len(deltas) > 4 else 0)
+            if total > 0:
+                sample["cpu_util_pct"] = round(100.0 * (total - idle) / total, 2)
+        self._prev_cpu = cpu
+
+        mem = _read_meminfo()
+        if mem.get("MemTotal"):
+            used = mem["MemTotal"] - mem.get("MemAvailable", 0)
+            sample["memory_used_gb"] = round(used / 1048576, 3)
+            sample["memory_util_pct"] = round(100.0 * used / mem["MemTotal"], 2)
+
+        net = _read_net_bytes()
+        dt = max(now - self._prev_t, 1e-6)
+        sample["net_rx_bps"] = round((net["rx"] - self._prev_net["rx"]) / dt, 1)
+        sample["net_tx_bps"] = round((net["tx"] - self._prev_net["tx"]) / dt, 1)
+        self._prev_net = net
+        self._prev_t = now
+
+        sample.update(_device_memory_stats())
+        self._sink.record(sample)
+
+
+class ProfilerAgent:
+    """Collects measurements and flushes batches to the master
+    (≈ profiler.py:238 ProfilerAgent + :732 MetricsBatcherThread)."""
+
+    def __init__(self, session: Any, trial_id: int, *,
+                 enabled: bool = True,
+                 sample_system: bool = True) -> None:
+        self._session = session
+        self._trial_id = trial_id
+        self.enabled = enabled
+        self._buffer: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._sys_thread: Optional[SystemMetricsThread] = None
+        self._flush_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._flush_now = threading.Event()
+        self._sample_system = sample_system
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ProfilerAgent":
+        if not self.enabled:
+            return self
+        if self._sample_system:
+            self._sys_thread = SystemMetricsThread(self)
+            self._sys_thread.start()
+        self._flush_thread = threading.Thread(
+            target=self._flush_loop, daemon=True, name="profiler-flush")
+        self._flush_thread.start()
+        return self
+
+    def stop(self) -> None:
+        if not self.enabled:
+            return
+        self._stop.set()
+        self._flush_now.set()  # wake the flush loop so join() is prompt
+        if self._sys_thread:
+            self._sys_thread.stop()
+            self._sys_thread.join(timeout=5)
+        if self._flush_thread:
+            self._flush_thread.join(timeout=10)
+        self.flush()
+
+    def __enter__(self) -> "ProfilerAgent":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+    # -- recording ---------------------------------------------------------
+
+    def record(self, sample: Dict[str, Any]) -> None:
+        """Never blocks on the network: a full buffer only signals the flush
+        thread (posting inline here would stall the trainer's hot loop when
+        the master is slow — profiling must never take down training)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._buffer.append(sample)
+            if len(self._buffer) >= 10 * MAX_BATCHED:
+                # master unreachable for a long stretch: shed oldest samples
+                del self._buffer[:MAX_BATCHED]
+            full = len(self._buffer) >= MAX_BATCHED
+        if full:
+            self._flush_now.set()
+
+    def record_batch_timing(self, batches_trained: int, *,
+                            dataloading_s: float, compute_s: float) -> None:
+        """Per-batch (or per-chunk) timings from the trainer's hot loop —
+        the dataloader_next/compute split (profiler.py timings)."""
+        self.record({
+            "time": time.time(),
+            "group": "timing",
+            "batches_trained": batches_trained,
+            "dataloading_s": round(dataloading_s, 6),
+            "compute_s": round(compute_s, 6),
+        })
+
+    # -- flushing ----------------------------------------------------------
+
+    def _flush_loop(self) -> None:
+        while not self._stop.is_set():
+            self._flush_now.wait(FLUSH_PERIOD_SEC)
+            self._flush_now.clear()
+            if self._stop.is_set():
+                break
+            self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            batch = self._buffer
+            self._buffer = []
+        if batch:
+            self._post(batch)
+
+    def _post(self, batch: List[Dict[str, Any]]) -> None:
+        try:
+            self._session.post(
+                f"/api/v1/trials/{self._trial_id}/profiler",
+                {"samples": batch}, retryable=True)
+        except Exception:
+            pass  # profiling must never take down training
+
+
+def from_config(session: Any, trial_id: int,
+                experiment_config: Dict[str, Any]) -> ProfilerAgent:
+    """Build from the experiment's ``profiling`` block; disabled by default
+    like the reference (expconf profiling.go)."""
+    profiling = experiment_config.get("profiling") or {}
+    enabled = bool(profiling.get("enabled", False))
+    if os.environ.get("DCT_PROFILING") == "1":
+        enabled = True
+    return ProfilerAgent(session, trial_id, enabled=enabled)

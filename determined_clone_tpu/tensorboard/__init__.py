@@ -1,0 +1,19 @@
+"""TensorBoard subsystem (≈ harness/determined/tensorboard): tfevents
+writers with no TF dependency, background upload manager, storage fetchers."""
+from determined_clone_tpu.tensorboard._tfevents import (
+    EventFileWriter,
+    read_tfevents,
+)
+from determined_clone_tpu.tensorboard.manager import (
+    TensorboardManager,
+    fetch_trial_events,
+    tb_storage_id,
+)
+
+__all__ = [
+    "EventFileWriter",
+    "TensorboardManager",
+    "fetch_trial_events",
+    "read_tfevents",
+    "tb_storage_id",
+]

@@ -1,0 +1,192 @@
+"""Minimal tfevents (TensorBoard event file) writer/reader — no TF needed.
+
+≈ the reference's metric writers (harness/determined/tensorboard/
+metric_writers/) which delegate to torch/TF summary writers; here the
+TFRecord framing (length + masked crc32c) and the Event/Summary protobuf
+wire format are emitted directly, so TPU images need no tensorflow install.
+
+Format notes (TensorBoard's record_writer.cc + event.proto):
+- record: u64le(len) | u32le(masked_crc32c(len_bytes)) | data |
+  u32le(masked_crc32c(data))
+- Event: 1=wall_time(double) 2=step(int64) 3=file_version(string)
+  5=summary(Summary); Summary: repeated 1=Value; Value: 1=tag(string)
+  2=simple_value(float)
+"""
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+from typing import Any, Dict, Iterator, List, Tuple
+
+# -- crc32c (Castagnoli, reflected poly 0x82F63B78) -------------------------
+
+_CRC_TABLE: List[int] = []
+
+
+def _build_table() -> None:
+    poly = 0x82F63B78
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        _CRC_TABLE.append(crc)
+
+
+_build_table()
+
+
+def crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def masked_crc32c(data: bytes) -> int:
+    crc = crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# -- protobuf wire helpers ---------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        bits = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(bits | 0x80)
+        else:
+            out.append(bits)
+            return bytes(out)
+
+
+def _key(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _len_delim(field: int, payload: bytes) -> bytes:
+    return _key(field, 2) + _varint(len(payload)) + payload
+
+
+def encode_scalar_event(wall_time: float, step: int, tag: str,
+                        value: float) -> bytes:
+    tag_b = tag.encode()
+    value_msg = (_len_delim(1, tag_b) +
+                 _key(2, 5) + struct.pack("<f", float(value)))
+    summary = _len_delim(1, value_msg)
+    return (_key(1, 1) + struct.pack("<d", wall_time) +
+            _key(2, 0) + _varint(step) +
+            _len_delim(5, summary))
+
+
+def encode_file_version(wall_time: float) -> bytes:
+    return (_key(1, 1) + struct.pack("<d", wall_time) +
+            _len_delim(3, b"brain.Event:2"))
+
+
+def frame_record(data: bytes) -> bytes:
+    header = struct.pack("<Q", len(data))
+    return (header + struct.pack("<I", masked_crc32c(header)) +
+            data + struct.pack("<I", masked_crc32c(data)))
+
+
+# -- writer ------------------------------------------------------------------
+
+class EventFileWriter:
+    """One tfevents file; append scalar summaries, flush on demand."""
+
+    def __init__(self, logdir: str, suffix: str = "") -> None:
+        os.makedirs(logdir, exist_ok=True)
+        name = (f"events.out.tfevents.{int(time.time())}."
+                f"{socket.gethostname()}{suffix}")
+        self.path = os.path.join(logdir, name)
+        self._f = open(self.path, "ab")
+        self._f.write(frame_record(encode_file_version(time.time())))
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        self._f.write(frame_record(
+            encode_scalar_event(time.time(), step, tag, value)))
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.flush()
+            self._f.close()
+
+
+# -- reader (for tests and the TB task's JSON view) --------------------------
+
+def _read_varint(data: bytes, pos: int) -> Tuple[int, int]:
+    out = shift = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _parse_fields(data: bytes) -> Dict[int, List[Any]]:
+    fields: Dict[int, List[Any]] = {}
+    pos = 0
+    while pos < len(data):
+        key, pos = _read_varint(data, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _read_varint(data, pos)
+        elif wire == 1:
+            val = data[pos:pos + 8]
+            pos += 8
+        elif wire == 2:
+            length, pos = _read_varint(data, pos)
+            val = data[pos:pos + length]
+            pos += length
+        elif wire == 5:
+            val = data[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        fields.setdefault(field, []).append(val)
+    return fields
+
+
+def read_tfevents(path: str) -> Iterator[Dict[str, Any]]:
+    """Yield {wall_time, step, scalars: {tag: value}} per event record,
+    verifying record CRCs."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    pos = 0
+    while pos + 12 <= len(blob):
+        header = blob[pos:pos + 8]
+        (length,) = struct.unpack("<Q", header)
+        (hcrc,) = struct.unpack("<I", blob[pos + 8:pos + 12])
+        if masked_crc32c(header) != hcrc:
+            raise ValueError(f"bad length crc at offset {pos}")
+        if pos + 16 + length > len(blob):
+            break  # truncated tail: file was synced mid-append — normal
+        data = blob[pos + 12:pos + 12 + length]
+        (dcrc,) = struct.unpack("<I",
+                                blob[pos + 12 + length:pos + 16 + length])
+        if masked_crc32c(data) != dcrc:
+            raise ValueError(f"bad data crc at offset {pos}")
+        pos += 16 + length
+
+        fields = _parse_fields(data)
+        event: Dict[str, Any] = {"scalars": {}}
+        if 1 in fields:
+            event["wall_time"] = struct.unpack("<d", fields[1][0])[0]
+        if 2 in fields:
+            event["step"] = fields[2][0]
+        for summary in fields.get(5, []):
+            for value_msg in _parse_fields(summary).get(1, []):
+                vf = _parse_fields(value_msg)
+                if 1 in vf and 2 in vf:
+                    tag = vf[1][0].decode()
+                    event["scalars"][tag] = struct.unpack("<f", vf[2][0])[0]
+        yield event

@@ -1,0 +1,120 @@
+"""TensorboardManager — background upload of tfevents to checkpoint storage.
+
+≈ harness/determined/tensorboard/base.py:22 (TensorboardManager: watches a
+local logdir, ships event files to the experiment's checkpoint storage) and
+the per-backend fetchers (tensorboard/fetchers/) that the `det tensorboard`
+task uses to pull them back down. Both directions ride the StorageManager
+abstraction, so every backend (shared_fs/gcs/s3/directory) works unchanged.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+from determined_clone_tpu.config.experiment import CheckpointStorageConfig
+from determined_clone_tpu.storage import StorageManager, build
+from determined_clone_tpu.tensorboard._tfevents import EventFileWriter
+
+SYNC_PERIOD_SEC = 10.0
+
+
+def tb_storage_id(experiment_id: int, trial_id: int) -> str:
+    """Storage location for one trial's event files (≈ the reference's
+    tensorboard path layout under checkpoint storage). Flat id: storage
+    managers reject separators (path-traversal guard, storage/base.py)."""
+    return f"tensorboard-exp{experiment_id}-trial{trial_id}"
+
+
+class TensorboardManager:
+    """Owns a local logdir + writer; syncs changed files to storage."""
+
+    def __init__(self, storage: StorageManager, storage_id: str,
+                 logdir: str, *, rank: int = 0) -> None:
+        self._storage = storage
+        self._storage_id = storage_id
+        self.logdir = logdir
+        self.writer = EventFileWriter(logdir, suffix=f".rank{rank}")
+        self._synced_sizes: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def from_config(storage_raw: Dict[str, Any], experiment_id: int,
+                    trial_id: int, logdir: str, *,
+                    rank: int = 0) -> "TensorboardManager":
+        storage = build(CheckpointStorageConfig.from_dict(storage_raw))
+        return TensorboardManager(
+            storage, tb_storage_id(experiment_id, trial_id), logdir,
+            rank=rank)
+
+    # -- metric writing (chief) --------------------------------------------
+
+    def add_scalars(self, prefix: str, metrics: Dict[str, Any],
+                    step: int) -> None:
+        for name, value in metrics.items():
+            try:
+                self.writer.add_scalar(f"{prefix}/{name}", float(value), step)
+            except (TypeError, ValueError):
+                continue  # non-scalar metric values are skipped
+        self.writer.flush()
+
+    # -- sync --------------------------------------------------------------
+
+    def start(self) -> "TensorboardManager":
+        self._thread = threading.Thread(
+            target=self._sync_loop, daemon=True, name="tb-sync")
+        self._thread.start()
+        return self
+
+    def _sync_loop(self) -> None:
+        while not self._stop.wait(SYNC_PERIOD_SEC):
+            self.sync()
+
+    def sync(self) -> None:
+        """Upload files that grew since the last sync (tfevents are
+        append-only, so re-uploading the whole file is always correct)."""
+        with self._lock:
+            self.writer.flush()
+            changed: List[str] = []
+            for name in os.listdir(self.logdir):
+                full = os.path.join(self.logdir, name)
+                if not os.path.isfile(full):
+                    continue
+                size = os.path.getsize(full)
+                if self._synced_sizes.get(name) != size:
+                    changed.append(name)
+                    self._synced_sizes[name] = size
+            if changed:
+                try:
+                    self._storage.upload(self.logdir, self._storage_id,
+                                         paths=changed)
+                except Exception:
+                    # storage hiccups must not kill training; next sync
+                    # retries (sizes were recorded, so force a full pass)
+                    self._synced_sizes.clear()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+        self.sync()
+        self.writer.close()
+
+
+def fetch_trial_events(storage_raw: Dict[str, Any], experiment_id: int,
+                       trial_id: int, dst_dir: str) -> List[str]:
+    """Download one trial's event files (the fetcher side,
+    tensorboard/fetchers/). Returns the fetched file paths."""
+    storage = build(CheckpointStorageConfig.from_dict(storage_raw))
+    sid = tb_storage_id(experiment_id, trial_id)
+    try:
+        files = storage.list_files(sid)
+    except FileNotFoundError:
+        return []
+    if not files:
+        return []
+    os.makedirs(dst_dir, exist_ok=True)
+    storage.download(sid, dst_dir)
+    return [os.path.join(dst_dir, name) for name in files]

@@ -159,6 +159,11 @@ class Trainer:
         preempted = False
         result: Dict[str, Any] = {}
 
+        # optional observability attached by the exec layer (absent in
+        # local/unmanaged runs): profiler (≈ ProfilerAgent) + tensorboard
+        profiler = getattr(self.core, "profiler", None)
+        tb = getattr(self.core, "tensorboard", None)
+
         def validate() -> Dict[str, float]:
             vdata = trial.validation_data()
             if vdata is None:
@@ -170,6 +175,8 @@ class Trainer:
             metrics = vacc.result() if len(vacc) else {}
             if metrics:
                 self.core.train.report_validation_metrics(batches_trained, metrics)
+                if tb is not None:
+                    tb.add_scalars("validation", metrics, batches_trained)
             return metrics
 
         for op in self.core.searcher.operations():
@@ -188,8 +195,11 @@ class Trainer:
                 )
                 t0 = time.perf_counter()
                 n0 = batches_trained
+                t_data = 0.0  # host-side input time vs XLA dispatch+compute
                 while batches_trained < chunk_end:
+                    td0 = time.perf_counter()
                     batch = jax.device_put(next(batch_gen), batch_sharding)
+                    t_data += time.perf_counter() - td0
                     state, metrics = train_step(state, batch)
                     acc.add(metrics)
                     batches_trained += 1
@@ -202,6 +212,14 @@ class Trainer:
                 )
                 self.core.train.report_training_metrics(batches_trained,
                                                         train_metrics)
+                if profiler is not None:
+                    # chunk-level split of the hot loop: dataloading vs the
+                    # rest (dispatch + device compute up to the acc sync)
+                    profiler.record_batch_timing(
+                        batches_trained, dataloading_s=t_data,
+                        compute_s=max(dt - t_data, 0.0))
+                if tb is not None:
+                    tb.add_scalars("training", train_metrics, batches_trained)
                 op.report_progress(batches_trained)
 
                 if val_period and batches_trained - last_val_at >= val_period:
