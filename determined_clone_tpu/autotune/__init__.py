@@ -1,0 +1,22 @@
+"""Autotune — parallelism-config search (the DeepSpeed-Autotune analogue).
+
+≈ the reference's dsat (harness/determined/pytorch/dsat/
+_dsat_search_method.py:24-1386, _run_dsat.py:99): HP-search over the
+engine's parallelism knobs driven by measured throughput. TPU-native, the
+knobs are the device-mesh factorization (dp/fsdp/tp/sp), rematerialization,
+and per-device batch — searched either locally (measure a few steps per
+candidate in-process) or as a cluster experiment (grid searcher over
+generated candidates, metric = samples_per_second maximized)."""
+from determined_clone_tpu.autotune.core import (
+    AutotuneResult,
+    autotune,
+    make_autotune_experiment_config,
+    mesh_candidates,
+)
+
+__all__ = [
+    "AutotuneResult",
+    "autotune",
+    "make_autotune_experiment_config",
+    "mesh_candidates",
+]

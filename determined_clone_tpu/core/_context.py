@@ -52,6 +52,10 @@ class Context:
         self.preempt = preempt
         self.searcher = searcher
         self.info = info
+        # observability, wired by the exec layer on managed runs (None in
+        # local/unmanaged mode): ProfilerAgent / TensorboardManager
+        self.profiler: Optional[Any] = None
+        self.tensorboard: Optional[Any] = None
 
     def close(self) -> None:
         self.preempt.close()

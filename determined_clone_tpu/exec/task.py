@@ -93,14 +93,21 @@ def fetch_tb_data(experiment_ids: List[int]) -> Dict[str, Any]:
     return _per_experiment(experiment_ids, metrics_of)
 
 
+# per-(experiment, trial) incremental-fetch state for the TB task: cached
+# event files + their last-seen storage sizes, so polling /scalars doesn't
+# re-download full (append-only) files every few seconds
+_TB_CACHE_DIR: Dict[Any, str] = {}
+_TB_CACHE_SIZES: Dict[Any, Dict[str, int]] = {}
+
+
 def fetch_tb_scalars(experiment_ids: List[int]) -> Dict[str, Any]:
     """Download each trial's tfevents from the experiment's checkpoint
     storage and parse the scalar series (the `det tensorboard` data path)."""
     import tempfile
 
     from determined_clone_tpu.tensorboard import (
-        fetch_trial_events,
         read_tfevents,
+        sync_trial_events,
     )
 
     def scalars_of(session, detail, trial):
@@ -108,20 +115,24 @@ def fetch_tb_scalars(experiment_ids: List[int]) -> Dict[str, Any]:
         storage_raw = exp["config"].get("checkpoint_storage")
         if not storage_raw:
             return {"error": "experiment has no checkpoint storage"}
-        with tempfile.TemporaryDirectory() as dst:
-            files = fetch_trial_events(storage_raw, exp["id"], trial["id"],
-                                       dst)
-            series: Dict[str, list] = {}
-            for path in files:
-                try:
-                    for event in read_tfevents(path):
-                        for tag, value in event["scalars"].items():
-                            series.setdefault(tag, []).append(
-                                [event.get("step", 0), value])
-                except (ValueError, OSError):
-                    continue
-            return {"scalars": series,
-                    "files": [os.path.basename(f) for f in files]}
+        key = (exp["id"], trial["id"])
+        if key not in _TB_CACHE_DIR:
+            _TB_CACHE_DIR[key] = tempfile.mkdtemp(prefix="dct-tb-")
+        files, sizes = sync_trial_events(
+            storage_raw, exp["id"], trial["id"], _TB_CACHE_DIR[key],
+            prev_sizes=_TB_CACHE_SIZES.get(key))
+        _TB_CACHE_SIZES[key] = sizes
+        series: Dict[str, list] = {}
+        for path in files:
+            try:
+                for event in read_tfevents(path):
+                    for tag, value in event["scalars"].items():
+                        series.setdefault(tag, []).append(
+                            [event.get("step", 0), value])
+            except (ValueError, OSError):
+                continue
+        return {"scalars": series,
+                "files": [os.path.basename(f) for f in files]}
 
     return _per_experiment(experiment_ids, scalars_of)
 

@@ -220,9 +220,11 @@ class ProfilerAgent:
 
     def _post(self, batch: List[Dict[str, Any]]) -> None:
         try:
+            # NOT retryable: the master append has no dedup key, so a lost
+            # response + retry would duplicate samples; telemetry is lossy
             self._session.post(
                 f"/api/v1/trials/{self._trial_id}/profiler",
-                {"samples": batch}, retryable=True)
+                {"samples": batch}, retryable=False)
         except Exception:
             pass  # profiling must never take down training
 

@@ -7,6 +7,7 @@ from determined_clone_tpu.tensorboard._tfevents import (
 from determined_clone_tpu.tensorboard.manager import (
     TensorboardManager,
     fetch_trial_events,
+    sync_trial_events,
     tb_storage_id,
 )
 
@@ -15,5 +16,6 @@ __all__ = [
     "TensorboardManager",
     "fetch_trial_events",
     "read_tfevents",
+    "sync_trial_events",
     "tb_storage_id",
 ]

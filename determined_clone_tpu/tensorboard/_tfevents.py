@@ -52,6 +52,11 @@ def masked_crc32c(data: bytes) -> int:
 # -- protobuf wire helpers ---------------------------------------------------
 
 def _varint(n: int) -> bytes:
+    if n < 0:
+        # proto int64 negatives need 10-byte two's-complement varints; no
+        # caller here has a negative (steps are batch counts), so reject
+        # loudly instead of looping forever on `n >>= 7`
+        raise ValueError(f"negative varint {n} not supported")
     out = bytearray()
     while True:
         bits = n & 0x7F
@@ -107,6 +112,8 @@ class EventFileWriter:
         self._f.write(frame_record(encode_file_version(time.time())))
 
     def add_scalar(self, tag: str, value: float, step: int) -> None:
+        if step < 0:
+            raise ValueError(f"step must be >= 0, got {step}")
         self._f.write(frame_record(
             encode_scalar_event(time.time(), step, tag, value)))
 

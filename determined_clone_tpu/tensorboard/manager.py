@@ -107,14 +107,30 @@ def fetch_trial_events(storage_raw: Dict[str, Any], experiment_id: int,
                        trial_id: int, dst_dir: str) -> List[str]:
     """Download one trial's event files (the fetcher side,
     tensorboard/fetchers/). Returns the fetched file paths."""
+    paths, _ = sync_trial_events(storage_raw, experiment_id, trial_id,
+                                 dst_dir, prev_sizes=None)
+    return paths
+
+
+def sync_trial_events(storage_raw: Dict[str, Any], experiment_id: int,
+                      trial_id: int, dst_dir: str, *,
+                      prev_sizes: Optional[Dict[str, int]] = None
+                      ) -> tuple:
+    """Incremental fetch: only files whose size changed since ``prev_sizes``
+    are re-downloaded (the size-delta scheme sync() uses on the upload side
+    — tfevents are append-only). Returns (paths, sizes) where ``sizes``
+    feeds the next call; pass prev_sizes=None for a full fetch."""
     storage = build(CheckpointStorageConfig.from_dict(storage_raw))
     sid = tb_storage_id(experiment_id, trial_id)
     try:
-        files = storage.list_files(sid)
+        sizes = storage.list_files(sid)
     except FileNotFoundError:
-        return []
-    if not files:
-        return []
+        return [], {}
+    if not sizes:
+        return [], {}
     os.makedirs(dst_dir, exist_ok=True)
-    storage.download(sid, dst_dir)
-    return [os.path.join(dst_dir, name) for name in files]
+    changed = [name for name, size in sizes.items()
+               if prev_sizes is None or prev_sizes.get(name) != size]
+    if changed:
+        storage.download(sid, dst_dir, paths=changed)
+    return [os.path.join(dst_dir, name) for name in sizes], dict(sizes)

@@ -159,10 +159,10 @@ class Trainer:
         preempted = False
         result: Dict[str, Any] = {}
 
-        # optional observability attached by the exec layer (absent in
+        # optional observability wired by the exec layer (None in
         # local/unmanaged runs): profiler (≈ ProfilerAgent) + tensorboard
-        profiler = getattr(self.core, "profiler", None)
-        tb = getattr(self.core, "tensorboard", None)
+        profiler = self.core.profiler
+        tb = self.core.tensorboard
 
         def validate() -> Dict[str, float]:
             vdata = trial.validation_data()

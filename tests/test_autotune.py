@@ -1,0 +1,117 @@
+"""Autotune (dsat analogue): mesh-candidate search over measured throughput.
+
+≈ the reference's dsat tests (search over DS configs driven by profile
+metrics, _dsat_search_method.py) re-keyed to mesh factorizations.
+"""
+import json
+
+import jax
+import pytest
+
+
+def test_mesh_candidates_enumeration():
+    from determined_clone_tpu.autotune import mesh_candidates
+
+    cands = mesh_candidates(8, ("dp", "fsdp", "tp"))
+    # every candidate multiplies out to 8
+    for c in cands:
+        prod = 1
+        for v in c.values():
+            prod *= v
+        assert prod == 8
+    # dp-heavy first
+    assert cands[0] == {"dp": 8, "fsdp": 1, "tp": 1}
+    # all distinct
+    assert len({tuple(sorted(c.items())) for c in cands}) == len(cands)
+    # cap respected
+    assert len(mesh_candidates(8, ("dp", "tp"), max_candidates=2)) == 2
+
+
+def test_autotune_ranks_and_prunes():
+    from determined_clone_tpu.autotune import autotune
+
+    calls = []
+
+    def measure(mesh, remat, batch):
+        calls.append((tuple(sorted(mesh.items())), remat, batch))
+        if mesh.get("tp", 1) == 4:
+            raise RuntimeError("OOM: tp=4 infeasible")
+        # pretend pure dp is fastest, fsdp slower, remat slower
+        score = 100.0 * mesh.get("dp", 1) / (1 + mesh.get("fsdp", 1))
+        return score * (0.9 if remat else 1.0)
+
+    results = autotune(measure, 4, axes=("dp", "fsdp", "tp"),
+                       remat_options=(False,), max_trials=32,
+                       early_stop_after=32)
+    assert results[0].feasible
+    assert results[0].mesh == {"dp": 4, "fsdp": 1, "tp": 1}
+    infeasible = [r for r in results if not r.feasible]
+    assert infeasible and all("OOM" in r.error for r in infeasible)
+    # ranked descending among feasible
+    feas = [r.samples_per_sec for r in results if r.feasible]
+    assert feas == sorted(feas, reverse=True)
+
+
+def test_autotune_early_stop():
+    from determined_clone_tpu.autotune import autotune
+
+    n_calls = [0]
+
+    def measure(mesh, remat, batch):
+        n_calls[0] += 1
+        return 1.0  # never improves after the first
+
+    autotune(measure, 8, axes=("dp", "fsdp", "tp"), remat_options=(False,),
+             max_trials=100, early_stop_after=3)
+    # 1 best + 3 non-improving = stop
+    assert n_calls[0] == 4
+
+
+def test_autotune_real_gpt_on_cpu_mesh():
+    """End-to-end local autotune over the virtual 8-device CPU mesh: real
+    jitted sharded train steps per candidate."""
+    from determined_clone_tpu.autotune import autotune
+    from determined_clone_tpu.autotune.gpt_bench import make_gpt_measure
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the 8-device CPU mesh")
+
+    measure = make_gpt_measure(seq_len=32, warmup=1, steps=2)
+    results = autotune(measure, 4, axes=("dp", "tp"),
+                       remat_options=(True,), batch_options=(2,),
+                       max_trials=3, early_stop_after=3)
+    feasible = [r for r in results if r.feasible]
+    assert feasible, [r.error for r in results]
+    assert all(r.samples_per_sec > 0 for r in feasible)
+
+
+def test_make_autotune_experiment_config():
+    from determined_clone_tpu.autotune import make_autotune_experiment_config
+    from determined_clone_tpu.config.experiment import ExperimentConfig
+
+    base = {
+        "name": "gpt-run",
+        "entrypoint": "model_def:Trial",
+        "searcher": {"name": "single", "metric": "loss",
+                     "max_length": {"batches": 100}},
+        "hyperparameters": {"lr": 0.001},
+    }
+    cfg = make_autotune_experiment_config(base, 8, axes=("dp", "fsdp", "tp"),
+                                          max_candidates=6)
+    assert cfg["name"] == "gpt-run-autotune"
+    assert cfg["searcher"]["name"] == "grid"
+    assert cfg["searcher"]["metric"] == "samples_per_second"
+    assert cfg["searcher"]["smaller_is_better"] is False
+    assert cfg["resources"]["slots_per_trial"] == 8
+    meshes = [json.loads(v) for v in cfg["hyperparameters"]["mesh_json"]["vals"]]
+    assert len(meshes) == 6
+    for m in meshes:
+        prod = 1
+        for v in m.values():
+            prod *= v
+        assert prod == 8
+    # base config untouched
+    assert base["searcher"]["name"] == "single"
+    # and the generated config validates + grid-expands client-side
+    parsed = ExperimentConfig.from_dict(cfg)
+    assert parsed.searcher.name == "grid"
