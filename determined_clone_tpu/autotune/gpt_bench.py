@@ -36,13 +36,13 @@ def make_gpt_measure(cfg=None, *, seq_len: int = 64, warmup: int = 1,
         import time
 
         run_cfg = dataclasses.replace(cfg, remat=remat)
-        spec_kwargs = {k: v for k, v in mesh_axes.items() if v > 1}
+        # dp is always re-derived (MeshSpec dp=-1 absorbs the remainder)
+        spec_kwargs = {k: v for k, v in mesh_axes.items()
+                       if k != "dp" and v > 1}
         n_devices = 1
         for v in mesh_axes.values():
             n_devices *= v
-        mesh = make_mesh(MeshSpec(dp=-1, **{k: v for k, v in
-                                            spec_kwargs.items()
-                                            if k != "dp"}),
+        mesh = make_mesh(MeshSpec(dp=-1, **spec_kwargs),
                          jax.devices()[:n_devices])
 
         params = gpt.init(jax.random.PRNGKey(0), run_cfg)
@@ -64,7 +64,9 @@ def make_gpt_measure(cfg=None, *, seq_len: int = 64, warmup: int = 1,
         step = make_train_step(loss_fn, tx, mesh=mesh,
                                state_sharding=sharding,
                                batch_sharding=batch_sharding)
-        for _ in range(warmup):
+        # at least one warmup step: compilation must not land in the timed
+        # region (and `metrics` must exist for the sync below)
+        for _ in range(max(1, warmup)):
             state, metrics = step(state, tokens)
         jax.block_until_ready(metrics["loss"])
         t0 = time.perf_counter()

@@ -1,0 +1,141 @@
+"""Sharded offline batch processing with checkpointed progress.
+
+≈ the reference's batch inference API (harness/determined/pytorch/
+experimental/_torch_batch_process.py: `TorchBatchProcessor` :194 +
+`torch_batch_process` :366): split a dataset across the gang, run a
+user-defined processor over batches, checkpoint progress so a preempted or
+restarted job resumes where it left off, cooperate with preemption.
+
+TPU-native shape: the processor gets the whole Core API context (so it can
+jit/shard its model over the mesh); rank r owns batches r, r+size, ...
+
+    class Embedder(BatchProcessor):
+        def __init__(self, context):
+            self.fn = jax.jit(model.apply)
+        def process_batch(self, batch, batch_idx):
+            out = self.fn(params, batch)
+            ...write out...
+
+    jax_batch_process(Embedder, dataset, batch_size=32,
+                      checkpoint_interval=10)
+"""
+from __future__ import annotations
+
+import contextlib
+import math
+from typing import Any, Dict, Optional, Sequence, Type
+
+from determined_clone_tpu import core
+
+
+class BatchProcessor:
+    """User subclass (≈ TorchBatchProcessor :194). Override process_batch;
+    the hooks are optional."""
+
+    def __init__(self, context: "core.Context") -> None:
+        self.context = context
+
+    def process_batch(self, batch: Any, batch_idx: int) -> None:
+        raise NotImplementedError
+
+    def on_checkpoint_start(self) -> None:
+        """Called before each progress checkpoint (flush outputs here)."""
+
+    def on_finish(self) -> None:
+        """Called once after this rank's final batch."""
+
+
+def _progress_key(rank: int) -> str:
+    return f"rank_{rank}_batches_completed"
+
+
+def jax_batch_process(
+    processor_cls: Type[BatchProcessor],
+    dataset: Sequence[Any],
+    *,
+    batch_size: int = 1,
+    checkpoint_interval: int = 10,
+    core_context: Optional["core.Context"] = None,
+    latest_checkpoint: Optional[str] = None,
+    max_batches: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Run the processor over the dataset; returns a summary dict.
+
+    ``dataset`` needs ``len()`` + slicing. Progress is checkpointed every
+    ``checkpoint_interval`` processed batches per rank (sharded metadata
+    merge: each rank reports its own high-water mark); pass the returned
+    ``storage_id`` back as ``latest_checkpoint`` to resume (the reference's
+    skip-completed-batches semantics, _torch_batch_process.py:366).
+    """
+    with contextlib.ExitStack() as stack:
+        ctx = core_context
+        if ctx is None:
+            ctx = stack.enter_context(core.init())
+        dist = ctx.distributed
+        rank, size = dist.rank, dist.size
+
+        n_batches = math.ceil(len(dataset) / batch_size)
+        if max_batches is not None:
+            n_batches = min(n_batches, max_batches)
+
+        # resume: skip this rank's already-completed batches
+        completed = 0
+        if latest_checkpoint:
+            meta = ctx.checkpoint.get_metadata(latest_checkpoint)
+            completed = int(meta.get(_progress_key(rank), 0))
+
+        processor = processor_cls(ctx)
+        processed = completed
+        storage_id: Optional[str] = latest_checkpoint
+        preempted = False
+        since_ckpt = 0
+
+        def save_progress() -> Optional[str]:
+            # COLLECTIVE: metadata.json is chief-written, so per-rank
+            # progress is allgathered and the chief persists the merge
+            # (≈ _upload_sharded + merge_resources, core/_checkpoint.py:280)
+            processor.on_checkpoint_start()
+            merged: Dict[str, Any] = {"batch_size": batch_size}
+            for d in dist.allgather({_progress_key(rank): processed}):
+                merged.update(d)
+            with ctx.checkpoint.store_path(
+                metadata=merged, shard=size > 1,
+            ) as (path, holder):
+                # progress lives in the metadata; the dir carries a marker
+                # file so single-rank saves are never empty
+                with open(f"{path}/progress-rank-{rank}.txt", "w") as f:
+                    f.write(str(processed))
+            return holder.get("storage_id")
+
+        # Every rank runs the SAME trip count even when n_batches % size != 0
+        # — save_progress and should_preempt are collectives, so trip counts
+        # (and break decisions) must be identical on every rank.
+        steps = math.ceil(n_batches / size)
+        for local_pos in range(steps):
+            idx = rank + local_pos * size
+            if local_pos >= completed and idx < n_batches:
+                lo = idx * batch_size
+                batch = dataset[lo:min(lo + batch_size, len(dataset))]
+                processor.process_batch(batch, idx)
+                processed += 1
+            since_ckpt += 1
+
+            if since_ckpt >= checkpoint_interval:
+                storage_id = save_progress() or storage_id
+                since_ckpt = 0
+            if ctx.preempt.should_preempt():  # chief-coordinated: same
+                preempted = True              # answer on every rank
+                break
+
+        if since_ckpt > 0 or preempted:
+            storage_id = save_progress() or storage_id
+        if not preempted:
+            processor.on_finish()
+
+        return {
+            "rank": rank,
+            "batches_processed": processed,
+            "total_batches": n_batches,
+            "preempted": preempted,
+            "storage_id": storage_id,
+        }

@@ -20,6 +20,7 @@ Usage (by the agent, argv built master-side in routes.cc "tasks"):
 """
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import signal
@@ -95,9 +96,22 @@ def fetch_tb_data(experiment_ids: List[int]) -> Dict[str, Any]:
 
 # per-(experiment, trial) incremental-fetch state for the TB task: cached
 # event files + their last-seen storage sizes, so polling /scalars doesn't
-# re-download full (append-only) files every few seconds
+# re-download full (append-only) files every few seconds. One lock
+# serializes overlapping polls — ThreadingHTTPServer runs a thread per
+# request and shutil.copy2 downloads are not atomic reads for a peer.
 _TB_CACHE_DIR: Dict[Any, str] = {}
 _TB_CACHE_SIZES: Dict[Any, Dict[str, int]] = {}
+_TB_CACHE_LOCK = threading.Lock()
+
+
+def _tb_cache_cleanup() -> None:
+    import shutil
+
+    for d in _TB_CACHE_DIR.values():
+        shutil.rmtree(d, ignore_errors=True)
+
+
+atexit.register(_tb_cache_cleanup)
 
 
 def fetch_tb_scalars(experiment_ids: List[int]) -> Dict[str, Any]:
@@ -116,21 +130,22 @@ def fetch_tb_scalars(experiment_ids: List[int]) -> Dict[str, Any]:
         if not storage_raw:
             return {"error": "experiment has no checkpoint storage"}
         key = (exp["id"], trial["id"])
-        if key not in _TB_CACHE_DIR:
-            _TB_CACHE_DIR[key] = tempfile.mkdtemp(prefix="dct-tb-")
-        files, sizes = sync_trial_events(
-            storage_raw, exp["id"], trial["id"], _TB_CACHE_DIR[key],
-            prev_sizes=_TB_CACHE_SIZES.get(key))
-        _TB_CACHE_SIZES[key] = sizes
-        series: Dict[str, list] = {}
-        for path in files:
-            try:
-                for event in read_tfevents(path):
-                    for tag, value in event["scalars"].items():
-                        series.setdefault(tag, []).append(
-                            [event.get("step", 0), value])
-            except (ValueError, OSError):
-                continue
+        with _TB_CACHE_LOCK:
+            if key not in _TB_CACHE_DIR:
+                _TB_CACHE_DIR[key] = tempfile.mkdtemp(prefix="dct-tb-")
+            files, sizes = sync_trial_events(
+                storage_raw, exp["id"], trial["id"], _TB_CACHE_DIR[key],
+                prev_sizes=_TB_CACHE_SIZES.get(key))
+            _TB_CACHE_SIZES[key] = sizes
+            series: Dict[str, list] = {}
+            for path in files:
+                try:
+                    for event in read_tfevents(path):
+                        for tag, value in event["scalars"].items():
+                            series.setdefault(tag, []).append(
+                                [event.get("step", 0), value])
+                except (ValueError, OSError):
+                    continue
         return {"scalars": series,
                 "files": [os.path.basename(f) for f in files]}
 
