@@ -78,10 +78,23 @@ def jax_batch_process(
         if max_batches is not None:
             n_batches = min(n_batches, max_batches)
 
-        # resume: skip this rank's already-completed batches
+        # resume: skip this rank's already-completed batches. The sharding
+        # arithmetic (idx = rank + pos*size, slice = idx*batch_size) only
+        # lines up if batch_size and gang size match the original run —
+        # silently shifted boundaries would drop/duplicate data.
         completed = 0
         if latest_checkpoint:
             meta = ctx.checkpoint.get_metadata(latest_checkpoint)
+            old_bs = meta.get("batch_size")
+            if old_bs is not None and int(old_bs) != batch_size:
+                raise ValueError(
+                    f"resume batch_size {batch_size} != checkpointed "
+                    f"{old_bs}; progress indices would not line up")
+            old_size = meta.get("world_size")
+            if old_size is not None and int(old_size) != size:
+                raise ValueError(
+                    f"resume world size {size} != checkpointed {old_size}; "
+                    f"per-rank progress would map to different data")
             completed = int(meta.get(_progress_key(rank), 0))
 
         processor = processor_cls(ctx)
@@ -95,7 +108,8 @@ def jax_batch_process(
             # progress is allgathered and the chief persists the merge
             # (≈ _upload_sharded + merge_resources, core/_checkpoint.py:280)
             processor.on_checkpoint_start()
-            merged: Dict[str, Any] = {"batch_size": batch_size}
+            merged: Dict[str, Any] = {"batch_size": batch_size,
+                                      "world_size": size}
             for d in dist.allgather({_progress_key(rank): processed}):
                 merged.update(d)
             with ctx.checkpoint.store_path(

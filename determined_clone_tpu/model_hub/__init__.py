@@ -1,0 +1,12 @@
+"""Model hub — ready-made trials for external model families.
+
+≈ the reference's model_hub package (model_hub/model_hub/huggingface/:
+HF-transformers fine-tuning trials; mmdetection has no JAX ecosystem
+equivalent, its role — a second adapted family — is filled by the
+built-in model zoo in determined_clone_tpu.models)."""
+from determined_clone_tpu.model_hub.huggingface import (
+    HFCausalLMTrial,
+    lm_batches,
+)
+
+__all__ = ["HFCausalLMTrial", "lm_batches"]

@@ -1,0 +1,140 @@
+"""HuggingFace transformers adapter — Flax causal-LM fine-tuning trials.
+
+≈ the reference's model_hub/model_hub/huggingface (BaseTransformerTrial:
+wraps an HF model + optimizer + LR schedule behind the Trial API). Here the
+model is a Flax transformer traced into the jitted train step; weights come
+from ``from_pretrained`` when a checkout/network is available or
+``from_config`` (random init) otherwise — the config path is fully offline.
+
+Usage::
+
+    from transformers import GPT2Config
+
+    class MyTrial(HFCausalLMTrial):
+        def model_config(self):
+            return GPT2Config(n_layer=4, n_embd=256, n_head=8)
+
+        def training_data(self):
+            yield from lm_batches(token_array, self.global_batch_size,
+                                  seq_len=128)
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from determined_clone_tpu.training.trial import JaxTrial
+
+
+def lm_batches(tokens: np.ndarray, batch_size: int,
+               seq_len: int) -> Iterator[np.ndarray]:
+    """Chop a flat token array into (batch, seq_len+1) LM batches (the +1
+    feeds the shifted-label loss). A ragged tail that can't fill a whole
+    batch is dropped (static shapes keep XLA from recompiling)."""
+    window = seq_len + 1
+    n = (len(tokens) - 1) // (batch_size * seq_len)
+    for i in range(n):
+        rows = []
+        for b in range(batch_size):
+            lo = (i * batch_size + b) * seq_len
+            chunk = tokens[lo:lo + window]
+            if len(chunk) < window:
+                break
+            rows.append(chunk)
+        if len(rows) == batch_size:
+            yield np.stack(rows).astype(np.int32)
+
+
+class HFCausalLMTrial(JaxTrial):
+    """Fine-tune (or train) an HF Flax causal-LM.
+
+    Subclasses override ``model_config()`` (offline) or
+    ``pretrained_name()`` (downloads weights). hparams understood:
+    learning_rate, weight_decay, warmup_steps, adam_beta1/2.
+    """
+
+    # -- model construction -------------------------------------------------
+
+    def model_config(self) -> Any:
+        """Return a transformers PretrainedConfig (offline path)."""
+        raise NotImplementedError(
+            "override model_config() or pretrained_name()")
+
+    def pretrained_name(self) -> Optional[str]:
+        """Model id/path for from_pretrained; None = random init from
+        model_config()."""
+        return None
+
+    def build_model(self) -> Any:
+        from transformers import FlaxAutoModelForCausalLM
+
+        name = self.pretrained_name()
+        if name:
+            return FlaxAutoModelForCausalLM.from_pretrained(name)
+        return FlaxAutoModelForCausalLM.from_config(self.model_config())
+
+    @property
+    def model(self) -> Any:
+        """The Flax model wrapper (built once; its .params are NOT used as
+        training state — initial_params owns that)."""
+        if not hasattr(self, "_model"):
+            self._model = self.build_model()
+        return self._model
+
+    # -- JaxTrial surface ---------------------------------------------------
+
+    def initial_params(self, rng: jax.Array) -> Any:
+        params = self.model.params
+        # the train state owns the weights from here on; keeping the
+        # wrapper's copy too would pin ~2x params for the trial's lifetime
+        try:
+            self._model._params = None  # loss() always passes params=
+        except AttributeError:
+            pass
+        return params
+
+    def optimizer(self) -> optax.GradientTransformation:
+        get = self.context.get_hparam
+        lr = float(get("learning_rate", 5e-5))
+        warmup = int(get("warmup_steps", 0))
+        schedule: Any = lr
+        if warmup > 0:
+            schedule = optax.linear_schedule(0.0, lr, warmup)
+        return optax.adamw(
+            schedule,
+            b1=float(get("adam_beta1", 0.9)),
+            b2=float(get("adam_beta2", 0.999)),
+            weight_decay=float(get("weight_decay", 0.01)),
+        )
+
+    def _lm_loss(self, params: Any, batch: Any, *, train: bool,
+                 rng: Optional[jax.Array] = None
+                 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        from determined_clone_tpu.ops.layers import softmax_cross_entropy
+
+        inputs, labels = batch[:, :-1], batch[:, 1:]
+        kwargs: Dict[str, Any] = {}
+        if train and rng is not None:
+            kwargs["dropout_rng"] = rng
+        logits = self.model(inputs, params=params, train=train,
+                            **kwargs).logits
+        loss = softmax_cross_entropy(logits, labels).mean()
+        return loss, {"perplexity": jnp.exp(loss)}
+
+    def loss(self, params: Any, batch: Any, rng: jax.Array
+             ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        """Next-token cross entropy over a (batch, seq+1) int32 array;
+        dropout active (train mode), driven by the step rng."""
+        return self._lm_loss(params, batch, train=True, rng=rng)
+
+    def eval_metrics(self, params: Any, batch: Any) -> Dict[str, jax.Array]:
+        """Validation in eval mode — dropout off."""
+        loss, metrics = self._lm_loss(params, batch, train=False)
+        return {"loss": loss, **metrics}
+
+    def training_data(self) -> Iterable[Any]:
+        raise NotImplementedError("provide training_data()")
