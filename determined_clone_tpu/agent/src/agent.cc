@@ -14,6 +14,7 @@
 //   3. fallback: 0 chips (cpu-only agent, zero-slot aux tasks)
 #include <dirent.h>
 #include <signal.h>
+#include <sys/prctl.h>
 #include <sys/stat.h>
 #include <sys/types.h>
 #include <sys/wait.h>
@@ -172,10 +173,20 @@ class Agent {
     for (const auto& [aid, task] : tasks_) running.push_back(aid);
     Json body = Json::object();
     body.set("running", running);
+    // at-least-once exit reporting: a lost task_event POST must not leave
+    // the master thinking the task still runs (it would re-issue a start);
+    // exits ride every heartbeat until one succeeds, master side is
+    // idempotent
+    size_t exits_sent = pending_exits_.size();
+    Json exited = Json::array();
+    for (const auto& e : pending_exits_) exited.push_back(e);
+    body.set("exited", exited);
     auto resp = http_request(
         config_.master_host, config_.master_port, "POST",
         "/api/v1/agents/" + config_.id + "/heartbeat", body.dump(), 10);
     if (!resp || resp->status != 200) return false;
+    pending_exits_.erase(pending_exits_.begin(),
+                         pending_exits_.begin() + exits_sent);
     Json j = Json::parse(resp->body);
     for (const auto& cmd : j["commands"].elements()) {
       const std::string& type = cmd["type"].as_string();
@@ -234,6 +245,10 @@ class Agent {
     if (pid == 0) {
       // child: run the harness entrypoint with the task env
       // (≈ container Entrypoint + DET_* env, tasks/task.go:236)
+      // fate-sharing: if the agent dies (even SIGKILL), its tasks must not
+      // become orphans (≈ pid_server/pid_client, harness ipc.py:264-553)
+      ::prctl(PR_SET_PDEATHSIG, SIGKILL);
+      if (::getppid() == 1) std::_Exit(83);  // agent died before prctl
       ::setenv("DCT_MASTER_HOST", config_.master_host.c_str(), 1);
       ::setenv("DCT_MASTER_PORT",
                std::to_string(config_.master_port).c_str(), 1);
@@ -330,8 +345,13 @@ class Agent {
         int exit_code = WIFEXITED(status) ? WEXITSTATUS(status)
                                           : 128 + WTERMSIG(status);
         ship_logs(it->second);
+        // fast path now; the heartbeat carries it again until acked
         send_event(it->first, "exited", exit_code,
                    exit_code ? "task failed" : "");
+        Json rec = Json::object();
+        rec.set("allocation_id", it->first).set("exit_code", exit_code)
+            .set("error", exit_code ? "task failed" : "");
+        pending_exits_.push_back(std::move(rec));
         std::cerr << "[agent] task " << it->first << " exited "
                   << exit_code << std::endl;
         it = tasks_.erase(it);
@@ -370,6 +390,7 @@ class Agent {
 
   AgentConfig config_;
   std::map<std::string, RunningTask> tasks_;
+  std::vector<Json> pending_exits_;  // unacked exit reports
 };
 
 }  // namespace

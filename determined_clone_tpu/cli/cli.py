@@ -396,6 +396,35 @@ def cmd_webhook_delete(args) -> int:
     return 0
 
 
+def cmd_deploy_up(args) -> int:
+    from determined_clone_tpu.deploy import cluster_up
+
+    state = cluster_up(
+        n_agents=args.agents, slots_per_agent=args.slots_per_agent,
+        port=args.port, topology=args.topology or "",
+        scheduler=args.scheduler, auth_required=args.auth_required,
+    )
+    print(f"Local cluster up: master 127.0.0.1:{state['port']} "
+          f"({args.agents} agent(s) x {args.slots_per_agent} slot(s))")
+    print(f"  export DCT_MASTER=127.0.0.1:{state['port']}")
+    return 0
+
+
+def cmd_deploy_down(args) -> int:
+    from determined_clone_tpu.deploy import cluster_down
+
+    out = cluster_down()
+    print(f"Stopped {out['stopped']} process(es)")
+    return 0
+
+
+def cmd_deploy_status(args) -> int:
+    from determined_clone_tpu.deploy import cluster_status
+
+    print_json(cluster_status())
+    return 0
+
+
 # ---------------------------------------------------------------------------
 # parser tree
 # ---------------------------------------------------------------------------
@@ -597,6 +626,23 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("webhook_id", type=int)
     c.set_defaults(func=cmd_webhook_delete)
 
+    # deploy
+    p_dep = sub.add_parser("deploy", help="cluster deployment")
+    sd = p_dep.add_subparsers(dest="subcommand", required=True)
+    p_local = sd.add_parser("local", help="local process cluster")
+    sdl = p_local.add_subparsers(dest="action", required=True)
+    c = sdl.add_parser("cluster-up")
+    c.add_argument("--agents", type=int, default=1)
+    c.add_argument("--slots-per-agent", type=int, default=1)
+    c.add_argument("--port", type=int, default=None)
+    c.add_argument("--topology", default=None)
+    c.add_argument("--scheduler", default="fifo",
+                   choices=["fifo", "priority", "fair_share"])
+    c.add_argument("--auth-required", action="store_true")
+    c.set_defaults(func=cmd_deploy_up)
+    sdl.add_parser("cluster-down").set_defaults(func=cmd_deploy_down)
+    sdl.add_parser("status").set_defaults(func=cmd_deploy_status)
+
     return parser
 
 
@@ -605,9 +651,6 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     args = parser.parse_args(argv)
     try:
         return args.func(args)
-    except MasterError as e:
-        print(f"error: {e}", file=sys.stderr)
-        return 1
-    except FileNotFoundError as e:
+    except (MasterError, RuntimeError, FileNotFoundError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1

@@ -1,0 +1,10 @@
+"""Deploy tooling (≈ harness/determined/deploy): local process cluster
+(the devcluster analogue); cloud TPU-VM provisioning is config-generation
+only in this environment (zero egress)."""
+from determined_clone_tpu.deploy.local import (
+    cluster_down,
+    cluster_status,
+    cluster_up,
+)
+
+__all__ = ["cluster_down", "cluster_status", "cluster_up"]

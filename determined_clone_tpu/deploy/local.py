@@ -1,0 +1,181 @@
+"""`det deploy local` — boot a master + N agents on this machine.
+
+≈ the reference's devcluster (tools/devcluster.yaml: db+master+agent from
+source) + `det deploy local` (harness/determined/deploy/local): one command
+brings up a working cluster, state is tracked in a JSON file so
+`cluster-down` can tear it down later. Multiple agent processes on one host
+is also how the reference fakes multi-node (managed_cluster.py:16).
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+REPO = Path(__file__).resolve().parent.parent
+MASTER_DIR = REPO / "master"
+MASTER_BIN = MASTER_DIR / "build" / "dct-master"
+AGENT_BIN = MASTER_DIR / "build" / "dct-agent"
+
+
+def default_state_path() -> str:
+    return os.path.join(os.path.expanduser("~"), ".dct", "local-cluster.json")
+
+
+def ensure_binaries() -> None:
+    if MASTER_BIN.exists() and AGENT_BIN.exists():
+        return
+    proc = subprocess.run(["make", "-C", str(MASTER_DIR)],
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"building master/agent failed:\n{proc.stderr}")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def cluster_up(*, n_agents: int = 1, slots_per_agent: int = 1,
+               port: Optional[int] = None, base_dir: Optional[str] = None,
+               topology: str = "", scheduler: str = "fifo",
+               auth_required: bool = False,
+               state_path: Optional[str] = None,
+               wait_sec: float = 30.0) -> Dict[str, Any]:
+    """Start dct-master + agents; returns the cluster state dict."""
+    state_path = state_path or default_state_path()
+    if os.path.exists(state_path):
+        state = cluster_status(state_path=state_path)
+        if state.get("alive"):
+            raise RuntimeError(
+                f"a local cluster is already up (master pid "
+                f"{state['master_pid']}); run cluster-down first")
+        # stale state (dead master, possibly surviving agents): tear the
+        # remnants down so their pids aren't leaked by the overwrite below
+        cluster_down(state_path=state_path)
+    ensure_binaries()
+    port = port or _free_port()
+    base = Path(base_dir or os.path.join(
+        os.path.expanduser("~"), ".dct", "local-cluster"))
+    base.mkdir(parents=True, exist_ok=True)
+    (base / "logs").mkdir(exist_ok=True)
+
+    master_args = [str(MASTER_BIN), "--port", str(port),
+                   "--data-dir", str(base / "master-data"),
+                   "--scheduler", scheduler]
+    if auth_required:
+        master_args.append("--auth-required")
+    master_log = open(base / "logs" / "master.log", "ab")
+    master = subprocess.Popen(master_args, stdout=master_log,
+                              stderr=subprocess.STDOUT,
+                              start_new_session=True)
+
+    env = {
+        **os.environ,
+        "PYTHONPATH": str(REPO.parent) + os.pathsep +
+                      os.environ.get("PYTHONPATH", ""),
+        "DCT_AGENT_SLOTS": str(slots_per_agent),
+    }
+    if topology:
+        env["DCT_AGENT_TOPOLOGY"] = topology
+    agents: List[Dict[str, Any]] = []
+    for i in range(n_agents):
+        workdir = base / f"agent-{i}"
+        workdir.mkdir(exist_ok=True)
+        log = open(base / "logs" / f"agent-{i}.log", "ab")
+        proc = subprocess.Popen(
+            [str(AGENT_BIN), "--master-port", str(port),
+             "--id", f"local-agent-{i}", "--work-dir", str(workdir)],
+            cwd=str(workdir), env=env, stdout=log,
+            stderr=subprocess.STDOUT, start_new_session=True)
+        agents.append({"pid": proc.pid, "id": f"local-agent-{i}",
+                       "workdir": str(workdir)})
+
+    # wait for the cluster to report all agents
+    from determined_clone_tpu.api.client import MasterSession
+
+    session = MasterSession("127.0.0.1", port, timeout=5, retries=2)
+    deadline = time.time() + wait_sec
+    up = False
+    while time.time() < deadline:
+        try:
+            if len(session.list_agents()) >= n_agents:
+                up = True
+                break
+        except Exception:
+            pass
+        time.sleep(0.3)
+
+    state = {
+        "port": port,
+        "master_pid": master.pid,
+        "agents": agents,
+        "base_dir": str(base),
+        "started_at": time.time(),
+        "came_up": up,
+    }
+    os.makedirs(os.path.dirname(state_path), exist_ok=True)
+    with open(state_path, "w") as f:
+        json.dump(state, f, indent=2)
+    if not up:
+        cluster_down(state_path=state_path)
+        raise RuntimeError(
+            f"cluster did not come up within {wait_sec}s; see "
+            f"{base}/logs/")
+    return state
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+def cluster_status(*, state_path: Optional[str] = None) -> Dict[str, Any]:
+    state_path = state_path or default_state_path()
+    if not os.path.exists(state_path):
+        return {"alive": False, "error": "no local cluster state"}
+    with open(state_path) as f:
+        state = json.load(f)
+    state["alive"] = _alive(state.get("master_pid", -1))
+    state["agents_alive"] = sum(
+        1 for a in state.get("agents", []) if _alive(a["pid"]))
+    return state
+
+
+def cluster_down(*, state_path: Optional[str] = None) -> Dict[str, Any]:
+    state_path = state_path or default_state_path()
+    if not os.path.exists(state_path):
+        return {"stopped": 0}
+    with open(state_path) as f:
+        state = json.load(f)
+    stopped = 0
+    pids = [a["pid"] for a in state.get("agents", [])]
+    pids.append(state.get("master_pid", -1))
+    for pid in pids:
+        if pid > 0 and _alive(pid):
+            try:
+                os.kill(pid, signal.SIGTERM)
+                stopped += 1
+            except OSError:
+                pass
+    # grace period, then hard-kill stragglers
+    deadline = time.time() + 10
+    while time.time() < deadline and any(_alive(p) for p in pids if p > 0):
+        time.sleep(0.2)
+    for pid in pids:
+        if pid > 0 and _alive(pid):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+    os.unlink(state_path)
+    return {"stopped": stopped}

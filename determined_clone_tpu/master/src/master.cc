@@ -613,13 +613,30 @@ void Master::on_task_done(const std::string& alloc_id, int exit_code,
   auto ait = allocations_.find(alloc_id);
   if (ait == allocations_.end()) return;
   Allocation& alloc = ait->second;
+  if (alloc.state == RunState::Completed || alloc.state == RunState::Errored) {
+    return;  // idempotent: exits may arrive twice (task_event + heartbeat)
+  }
+  if (alloc.state == RunState::Canceled) {
+    // killed/idle-reaped: record the exit, close out the trial as CANCELED
+    // (not an error), and never run restart logic — idempotently
+    if (alloc.exit_code == 0 && exit_code != 0) {
+      alloc.exit_code = exit_code;
+      dirty_ = true;
+    }
+    if (alloc.trial_id && trials_.count(alloc.trial_id)) {
+      Trial& t = trials_[alloc.trial_id];
+      if (t.state != RunState::Completed && t.state != RunState::Errored &&
+          t.state != RunState::Canceled) {
+        t.state = RunState::Canceled;
+        t.ended_at = now_sec();
+        dirty_ = true;
+      }
+    }
+    return;
+  }
   bool failed = exit_code != 0;
   alloc.exit_code = exit_code;
-  if (alloc.state != RunState::Canceled) {
-    // a killed/idle-reaped task stays CANCELED; its SIGKILL exit is not an
-    // error (≈ the reference's aborted-allocation classification)
-    alloc.state = failed ? RunState::Errored : RunState::Completed;
-  }
+  alloc.state = failed ? RunState::Errored : RunState::Completed;
   dirty_ = true;
   if (alloc.trial_id == 0) return;
   auto tit = trials_.find(alloc.trial_id);

@@ -81,6 +81,8 @@ class Master {
   // address (≈ master/internal/proxy/proxy.go). Forwards OUTSIDE the
   // master lock; only the address lookup locks.
   HttpResponse proxy_route(const HttpRequest& req);
+  // GET /metrics — Prometheus text exposition of cluster state gauges
+  HttpResponse metrics_route();
   // platform-breadth routes: auth/users, workspaces/projects, model
   // registry, templates, webhooks (routes_platform.cc). Returns nullopt when
   // the path is not one of its roots.

@@ -52,10 +52,67 @@ HttpResponse Master::handle(const HttpRequest& req) {
     if (req.path_parts.size() >= 2 && req.path_parts[0] == "proxy") {
       return proxy_route(req);
     }
+    if (req.path_parts.size() == 1 && req.path_parts[0] == "metrics" &&
+        req.method == "GET") {
+      return metrics_route();
+    }
     return route(req);
   } catch (const std::exception& e) {
     return HttpResponse::json(500, error_json(e.what()).dump());
   }
+}
+
+// Prometheus text exposition (≈ the reference's /prom/det-state-metrics
+// endpoints, master/internal/core.go:1203 + internal/prom/)
+HttpResponse Master::metrics_route() {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::map<std::string, int> exp_states, trial_states, alloc_states;
+  for (const auto& [id, e] : experiments_) exp_states[to_string(e.state)]++;
+  for (const auto& [id, t] : trials_) trial_states[to_string(t.state)]++;
+  int queue_depth = 0, slots_total = 0, slots_used = 0, agents_alive = 0;
+  for (const auto& [id, a] : allocations_) {
+    alloc_states[to_string(a.state)]++;
+    if (a.state == RunState::Queued) queue_depth++;
+    if (a.state == RunState::Running || a.state == RunState::Pulling) {
+      for (const auto& [aid, n] : a.reservations) slots_used += n;
+    }
+  }
+  for (const auto& [id, a] : agents_) {
+    if (a.enabled) {
+      agents_alive++;
+      slots_total += a.slots;
+    }
+  }
+  std::ostringstream out;
+  auto gauge = [&](const std::string& name, const std::string& help) {
+    out << "# HELP " << name << " " << help << "\n"
+        << "# TYPE " << name << " gauge\n";
+  };
+  gauge("dct_experiments", "experiments by state");
+  for (const auto& [state, n] : exp_states) {
+    out << "dct_experiments{state=\"" << state << "\"} " << n << "\n";
+  }
+  gauge("dct_trials", "trials by state");
+  for (const auto& [state, n] : trial_states) {
+    out << "dct_trials{state=\"" << state << "\"} " << n << "\n";
+  }
+  gauge("dct_allocations", "allocations by state");
+  for (const auto& [state, n] : alloc_states) {
+    out << "dct_allocations{state=\"" << state << "\"} " << n << "\n";
+  }
+  gauge("dct_agents_alive", "enabled agents");
+  out << "dct_agents_alive " << agents_alive << "\n";
+  gauge("dct_slots_total", "slots on enabled agents");
+  out << "dct_slots_total " << slots_total << "\n";
+  gauge("dct_slots_used", "slots reserved by live allocations");
+  out << "dct_slots_used " << slots_used << "\n";
+  gauge("dct_queue_depth", "queued allocations");
+  out << "dct_queue_depth " << queue_depth << "\n";
+  HttpResponse resp;
+  resp.status = 200;
+  resp.content_type = "text/plain; version=0.0.4";
+  resp.body = out.str();
+  return resp;
 }
 
 HttpResponse Master::proxy_route(const HttpRequest& req) {
@@ -564,6 +621,15 @@ HttpResponse Master::route(const HttpRequest& req) {
       it->second.last_heartbeat = now_sec();
       it->second.enabled = true;
       Json body = req.body.empty() ? Json::object() : Json::parse(req.body);
+      // exit reports ride the heartbeat at-least-once (agent retries until
+      // a heartbeat succeeds); on_task_done is terminal-state idempotent.
+      // Processed BEFORE command derivation so a just-exited task can't be
+      // re-issued a start below.
+      for (const auto& e : body["exited"].elements()) {
+        on_task_done(e["allocation_id"].as_string(),
+                     static_cast<int>(e["exit_code"].as_int()),
+                     e["error"].as_string());
+      }
       std::set<std::string> reported;
       for (const auto& r : body["running"].elements()) {
         reported.insert(r.as_string());
@@ -577,7 +643,13 @@ HttpResponse Master::route(const HttpRequest& req) {
         bool terminal = alloc.state == RunState::Completed ||
                         alloc.state == RunState::Errored ||
                         alloc.state == RunState::Canceled;
-        if (mine && alloc.state == RunState::Pulling &&
+        bool live = alloc.state == RunState::Pulling ||
+                    alloc.state == RunState::Running;
+        // start derives from "reserved and not yet running here" — NOT from
+        // the Pulling state alone: in a gang, the first member's `running`
+        // event flips the allocation to Running before slower members'
+        // heartbeats, which must still receive their start command
+        if (mine && live && !alloc.preempt_requested &&
             !reported.count(alloc_id)) {
           Json cmd = allocation_start_command(alloc, aid);
           int rank = 0;

@@ -56,7 +56,11 @@ class GPTConfig:
     compute_dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True
-    blockwise_attention: bool = False  # streaming attention for long seqs
+    # attention implementation: "mha" (plain XLA), "blockwise" (streaming
+    # scan for long seqs), "flash" (fused Pallas TPU kernel). The legacy
+    # blockwise_attention flag still selects "blockwise".
+    attention_impl: str = "mha"
+    blockwise_attention: bool = False
     attention_block_size: int = 512
     tie_embeddings: bool = True
     # MoE (expert parallel over the ep mesh axis; 0 = dense FFN).
@@ -167,8 +171,19 @@ def _block(cfg: GPTConfig, block_params: Params, x: jax.Array,
     q = rotary_embedding(q.reshape(B, T, H, hd), positions)
     k = rotary_embedding(k.reshape(B, T, H, hd), positions)
     v = v.reshape(B, T, H, hd)
-    if cfg.blockwise_attention:
+    impl = "blockwise" if cfg.blockwise_attention else cfg.attention_impl
+    if impl not in ("mha", "blockwise", "flash"):
+        raise ValueError(
+            f"unknown attention_impl {impl!r}; expected mha|blockwise|flash")
+    if impl == "blockwise":
         attn = causal_blockwise_attention(q, k, v, block_size=cfg.attention_block_size)
+    elif impl == "flash":
+        from determined_clone_tpu.ops.flash_attention import flash_attention
+
+        attn = flash_attention(
+            q, k, v, causal=True,
+            block_q=min(cfg.attention_block_size, 128),
+            block_k=min(cfg.attention_block_size, 128))
     else:
         attn = mha(q, k, v, causal=True)
     attn = dense(block_params["attn_out"], attn.reshape(B, T, D),

@@ -68,9 +68,11 @@ def _online_softmax_block(carry, qkv_block, *, scale):
 
 
 def causal_blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                               block_size: int = 512) -> jax.Array:
+                               block_size: int = 512,
+                               causal: bool = True) -> jax.Array:
     """Streaming attention over K/V blocks via lax.scan; O(T·block) memory
-    instead of O(T²). Matches ``mha(causal=True)`` numerically (fp32 softmax)."""
+    instead of O(T²). Matches ``mha`` numerically (fp32 softmax); pass
+    causal=False for the unmasked variant (same streaming memory)."""
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     block_size = min(block_size, Tk)
@@ -86,7 +88,11 @@ def causal_blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
     def step(carry, inputs):
         idx, k_blk, v_blk = inputs
-        bmask = _causal_mask(Tq, block_size, q_offset=0, k_offset=idx * block_size)
+        if causal:
+            bmask = _causal_mask(Tq, block_size, q_offset=0,
+                                 k_offset=idx * block_size)
+        else:
+            bmask = jnp.ones((Tq, block_size), bool)
         carry = _online_softmax_block(
             carry, (q, k_blk, v_blk, bmask[None, None]), scale=scale
         )
