@@ -1,29 +1,66 @@
-"""Headline benchmark: GPT training throughput (samples/sec/chip).
+"""Headline benchmark: GPT training throughput + MFU on the flagship path.
 
 North-star metric from BASELINE.md: trial throughput in samples/sec/chip with
-loss parity for the mnist + GPT baseline configs. The reference publishes no
+loss parity for the GPT + mnist baseline configs. The reference publishes no
 absolute numbers (BASELINE.json ``published: {}``), so ``vs_baseline`` is
-reported against 1.0 until a reference measurement exists.
+reported against 1.0 until a reference measurement exists; ``detail.mfu``
+gives the absolute utilization story (6·N·tokens/sec over v5e bf16 peak).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Runs on whatever jax.devices() provides (the real TPU chip under axon; CPU
-falls back to a tiny config so the harness still completes).
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
+
+Never hangs and never exits non-zero: the measurement runs in a child process
+under a wall-clock budget — the axon TPU tunnel's backend init failed outright
+in round 1 (BENCH_r01: UNAVAILABLE) and blocked past the driver timeout in
+round 2 (BENCH_r02: rc 124) — and on child timeout/failure the parent reruns
+on a steered CPU backend. As a last resort it emits the JSON line with the
+errors recorded.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
+# Per-chip bf16 peak FLOP/s by TPU generation (axon exposes the grant's
+# generation via PALLAS_AXON_TPU_GEN; default v5e).
+TPU_PEAK_BF16_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
 
 
-def main() -> None:
+def _budget(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+TPU_BUDGET_S = _budget("DCT_BENCH_TPU_BUDGET_S", 300.0)
+CPU_BUDGET_S = _budget("DCT_BENCH_CPU_BUDGET_S", 180.0)
+
+
+# --------------------------------------------------------------------------
+# Child: the actual measurement (runs under the parent's wall-clock budget).
+# --------------------------------------------------------------------------
+
+def _run_child() -> None:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # The axon sitecustomize registers its TPU plugin at interpreter
+        # start; env alone does not steer it (see tests/conftest.py).
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
     import optax
 
-    from determined_clone_tpu.models import gpt
-    from determined_clone_tpu.parallel import single_device_mesh
+    from determined_clone_tpu.models import gpt, mnist_cnn
     from determined_clone_tpu.training.train_step import (
         create_train_state,
         make_train_step,
@@ -32,66 +69,189 @@ def main() -> None:
     device = jax.devices()[0]
     on_tpu = device.platform != "cpu"
 
-    if on_tpu:
-        # GPT-2-small-ish: saturates a v5e chip's MXU at bf16.
-        cfg = gpt.GPTConfig(
-            vocab_size=50304, n_layers=12, d_model=768, n_heads=12,
-            d_ff=3072, max_seq_len=1024, remat=True,
-        )
-        batch, seq, timed_steps = 8, 1024, 10
-    else:
-        cfg = gpt.GPTConfig(
-            vocab_size=1024, n_layers=2, d_model=128, n_heads=4,
-            d_ff=512, max_seq_len=128, remat=False,
-        )
-        batch, seq, timed_steps = 4, 128, 3
+    def time_gpt(attention_impl: str, timed_steps: int) -> dict:
+        if on_tpu:
+            # GPT-2-small-ish: saturates a v5e chip's MXU at bf16.
+            cfg = gpt.GPTConfig(
+                vocab_size=50304, n_layers=12, d_model=768, n_heads=12,
+                d_ff=3072, max_seq_len=1024, remat=True,
+                attention_impl=attention_impl,
+            )
+            batch, seq = 8, 1024
+        else:
+            cfg = gpt.GPTConfig(
+                vocab_size=512, n_layers=2, d_model=128, n_heads=4,
+                d_ff=512, max_seq_len=128, remat=False,
+                attention_impl=attention_impl,
+            )
+            batch, seq = 4, 128
+        params = gpt.init(jax.random.PRNGKey(0), cfg)
+        tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+        state = create_train_state(params, tx, jax.random.PRNGKey(1))
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(2), (batch, seq + 1), 0, cfg.vocab_size)
 
-    params = gpt.init(jax.random.PRNGKey(0), cfg)
-    tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
-    state = create_train_state(params, tx, jax.random.PRNGKey(1))
-    state = jax.device_put(state, device)
+        def loss(p, b, rng):
+            return gpt.loss_fn(p, cfg, b[:, :-1], b[:, 1:]), {}
 
-    tokens = jax.random.randint(jax.random.PRNGKey(2), (batch, seq + 1), 0,
-                                cfg.vocab_size)
-    tokens = jax.device_put(tokens, device)
+        step = make_train_step(loss, tx)
+        for _ in range(2):  # compile + one executed step
+            state, metrics = step(state, tokens)
+        jax.block_until_ready(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(timed_steps):
+            state, metrics = step(state, tokens)
+        jax.block_until_ready(metrics["loss"])
+        dt = time.perf_counter() - t0
+        return {
+            "samples_per_sec": batch * timed_steps / dt,
+            "tokens_per_sec": batch * seq * timed_steps / dt,
+            "final_loss": round(float(metrics["loss"]), 4),
+            "model_params": gpt.param_count(params),
+            "batch": batch,
+            "seq_len": seq,
+        }
 
-    def loss_fn(p, b, rng):
-        return gpt.loss_fn(p, cfg, b[:, :-1], b[:, 1:]), {}
+    def time_mnist(timed_steps: int) -> dict:
+        cfg = mnist_cnn.MnistCNNConfig(
+            compute_dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+        params = mnist_cnn.init(jax.random.PRNGKey(3), cfg)
+        tx = optax.adamw(1e-3)
+        state = create_train_state(params, tx, jax.random.PRNGKey(4))
+        batch = 512 if on_tpu else 64
+        data = {
+            "x": jax.random.normal(jax.random.PRNGKey(5), (batch, 28, 28, 1)),
+            "y": jax.random.randint(jax.random.PRNGKey(6), (batch,), 0, 10),
+        }
 
-    step = make_train_step(loss_fn, tx)
+        def loss(p, b, rng):
+            return mnist_cnn.loss_fn(p, cfg, b["x"], b["y"]), {}
 
-    # Warmup: compile + one executed step.
-    state, metrics = step(state, tokens)
-    jax.block_until_ready(metrics["loss"])
-    state, metrics = step(state, tokens)
-    jax.block_until_ready(metrics["loss"])
+        step = make_train_step(loss, tx)
+        for _ in range(2):
+            state, metrics = step(state, data)
+        jax.block_until_ready(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(timed_steps):
+            state, metrics = step(state, data)
+        jax.block_until_ready(metrics["loss"])
+        dt = time.perf_counter() - t0
+        return {"samples_per_sec": round(batch * timed_steps / dt, 1),
+                "batch": batch}
 
-    t0 = time.perf_counter()
-    for _ in range(timed_steps):
-        state, metrics = step(state, tokens)
-    jax.block_until_ready(metrics["loss"])
-    dt = time.perf_counter() - t0
+    gpt_steps = 10 if on_tpu else 2
+    flash = time_gpt("flash", gpt_steps)   # flagship path: Pallas kernel
+    mha = time_gpt("mha", gpt_steps)       # plain-XLA attention for the delta
+    mnist = time_mnist(20 if on_tpu else 3)
 
-    samples_per_sec = batch * timed_steps / dt
-    n_params = gpt.param_count(params)
-    loss = float(metrics["loss"])
+    n_params = flash["model_params"]
+    tpu_gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    peak = TPU_PEAK_BF16_FLOPS.get(tpu_gen, TPU_PEAK_BF16_FLOPS["v5e"])
+    mfu = (6.0 * n_params * flash["tokens_per_sec"] / peak
+           if on_tpu else None)
 
     print(json.dumps({
         "metric": "gpt_train_throughput",
-        "value": round(samples_per_sec, 3),
+        "value": round(flash["samples_per_sec"], 3),
         "unit": "samples/sec/chip",
         "vs_baseline": 1.0,
         "detail": {
-            "model_params": n_params,
-            "batch": batch,
-            "seq_len": seq,
             "platform": device.platform,
-            "final_loss": round(loss, 4),
-            "tokens_per_sec": round(samples_per_sec * seq, 1),
+            "attention_impl": "flash",
+            "model_params": n_params,
+            "batch": flash["batch"],
+            "seq_len": flash["seq_len"],
+            "tokens_per_sec": round(flash["tokens_per_sec"], 1),
+            "mfu": round(mfu, 4) if mfu is not None else None,
+            "mfu_peak_assumed": f"{tpu_gen}:{peak:.0f}" if on_tpu else None,
+            "final_loss": flash["final_loss"],
+            "mha_samples_per_sec": round(mha["samples_per_sec"], 3),
+            "flash_over_mha": round(
+                flash["samples_per_sec"] / mha["samples_per_sec"], 3),
+            "mnist_cnn": mnist,
         },
     }))
 
 
+# --------------------------------------------------------------------------
+# Parent: bounded attempts, guaranteed single JSON line, exit 0.
+# --------------------------------------------------------------------------
+
+def _attempt(env: dict, budget: float) -> tuple:
+    """Run the child under ``budget`` seconds; return (json_obj, error).
+
+    Runs the child in its own session and kills the whole process group on
+    timeout: the axon sitecustomize can spawn tunnel helper processes that
+    inherit the stdout/stderr pipes, and ``subprocess.run``'s post-kill
+    ``communicate()`` has no timeout — it would block on those orphaned pipe
+    holders forever, defeating the never-hangs contract.
+    """
+    import signal
+
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, start_new_session=True,
+        )
+    except Exception as exc:  # noqa: BLE001 - must never crash the parent
+        return None, f"spawn failed: {exc!r}"
+    try:
+        stdout, stderr = proc.communicate(timeout=budget)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except Exception:  # noqa: BLE001
+            proc.kill()
+        try:  # bounded drain; abandon pipes still held by orphans
+            proc.communicate(timeout=10)
+        except Exception:  # noqa: BLE001
+            pass
+        return None, f"timeout after {budget:.0f}s"
+    if proc.returncode != 0:
+        return None, f"rc={proc.returncode}: {stderr.strip()[-400:]}"
+    for line in reversed(stdout.strip().splitlines()):
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            return obj, None
+    return None, "child produced no JSON line"
+
+
+def main() -> None:
+    errors = {}
+    env = dict(os.environ)
+    if env.get("JAX_PLATFORMS", "") != "cpu":
+        obj, err = _attempt(env, TPU_BUDGET_S)
+        if obj is not None:
+            print(json.dumps(obj))
+            return
+        errors["tpu"] = err
+
+    cpu_env = dict(os.environ)
+    cpu_env.pop("PALLAS_AXON_POOL_IPS", None)
+    cpu_env["JAX_PLATFORMS"] = "cpu"
+    obj, err = _attempt(cpu_env, CPU_BUDGET_S)
+    if obj is not None:
+        if errors:
+            obj.setdefault("detail", {})["tpu_error"] = errors.get("tpu")
+        print(json.dumps(obj))
+        return
+    errors["cpu"] = err
+
+    print(json.dumps({
+        "metric": "gpt_train_throughput",
+        "value": 0.0,
+        "unit": "samples/sec/chip",
+        "vs_baseline": 0.0,
+        "detail": {"errors": errors},
+    }))
+
+
 if __name__ == "__main__":
-    sys.path.insert(0, ".")
-    main()
+    if "--child" in sys.argv:
+        _run_child()
+    else:
+        main()

@@ -4,19 +4,15 @@ Mirrors the reference's "artificial slots" trick (agent/internal/detect/detect.g
 — an 8-"chip" gang runs on one box — but via XLA's host-platform device count so that
 jax.sharding.Mesh code paths are exercised exactly as they would be on a v5e-8.
 
-The axon sitecustomize (TPU tunnel) may have already imported jax and
-registered a TPU PJRT plugin at interpreter startup — before this conftest
-runs — so plain env mutation is not enough: we also steer the platform via
-``jax.config``, which takes effect as long as no backend has been
-initialized yet (no ``jax.devices()`` call has happened).
+The steering itself (env + jax.config, because the axon sitecustomize may have
+pre-registered a TPU PJRT plugin at interpreter start) lives in
+determined_clone_tpu.utils.host_steering, shared with __graft_entry__ and bench.py.
 """
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from determined_clone_tpu.utils.host_steering import steer_to_host_cpu  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+steer_to_host_cpu(8)
