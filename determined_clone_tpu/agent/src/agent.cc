@@ -207,9 +207,16 @@ class Agent {
   std::string prepare_context(const Json& cmd, const std::string& alloc_id) {
     if (!cmd.has("trial")) return "";
     int64_t exp_id = cmd["trial"]["experiment_id"].as_int();
+    // authenticate with the allocation token: under --auth-required the
+    // experiments root only opens reads to holders of a live alloc token
+    std::map<std::string, std::string> headers;
+    if (!cmd["alloc_token"].as_string().empty()) {
+      headers["Authorization"] = "Bearer " + cmd["alloc_token"].as_string();
+    }
     auto resp = http_request(
         config_.master_host, config_.master_port, "GET",
-        "/api/v1/experiments/" + std::to_string(exp_id) + "/context", "", 30);
+        "/api/v1/experiments/" + std::to_string(exp_id) + "/context", "", 30,
+        headers);
     if (!resp || resp->status != 200) return "";
     Json ctx;
     try {
@@ -253,6 +260,9 @@ class Agent {
       ::setenv("DCT_MASTER_PORT",
                std::to_string(config_.master_port).c_str(), 1);
       ::setenv("DCT_ALLOCATION_ID", alloc_id.c_str(), 1);
+      // allocation-scoped credential: the task server requires it on every
+      // request, and harness→master calls authenticate with it
+      ::setenv("DCT_ALLOC_TOKEN", cmd["alloc_token"].as_string().c_str(), 1);
       ::setenv("DCT_AGENT_ID", config_.id.c_str(), 1);
       ::setenv("DCT_SLOTS", std::to_string(cmd["slots"].as_int()).c_str(), 1);
       ::setenv("DCT_RANK", std::to_string(cmd["rank"].as_int()).c_str(), 1);

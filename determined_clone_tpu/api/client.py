@@ -4,6 +4,7 @@ of swagger codegen)."""
 from __future__ import annotations
 
 import json
+import os
 import time
 import urllib.error
 import urllib.parse
@@ -29,7 +30,10 @@ class MasterSession:
         self.port = port
         self.timeout = timeout
         self.retries = retries
-        self.token: Optional[str] = None  # set by login()
+        # set by login(); inside an allocation the task's data-plane
+        # credential (DCT_ALLOC_TOKEN, injected by the agent) authenticates
+        # harness→master calls under --auth-required
+        self.token: Optional[str] = os.environ.get("DCT_ALLOC_TOKEN") or None
 
     @property
     def base_url(self) -> str:

@@ -7,9 +7,13 @@ runner covers the built-in types with a small HTTP app served behind the
 master's reverse proxy (/proxy/<task_id>/...):
 
 - ``shell``:       POST /exec {"cmd": [...]} → {stdout, stderr, code}
-                   (the det-shell remote-exec capability without sshd)
+                   (the det-shell remote-exec capability without sshd;
+                   shell-mode only — other modes 403 it)
 - ``notebook``:    execs jupyter if installed (DCT_NOTEBOOK_REAL=1), else
-                   serves a landing page + the same /exec surface
+                   serves a landing page
+
+Every request must carry the allocation token (x-alloc-token, injected by
+the master's reverse proxy) when DCT_ALLOC_TOKEN is set.
 - ``tensorboard``: GET /data → metric history for the requested
                    experiments, fetched live from the master (the reference
                    TB task fetches tfevents from checkpoint storage;
@@ -167,12 +171,35 @@ class TaskHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt: str, *args: Any) -> None:
         print("[task]", fmt % args, flush=True)
 
+    def _authorized(self) -> bool:
+        """Require the allocation token on every request: the only legitimate
+        caller is the master's reverse proxy, which injects x-alloc-token.
+        Interface binding is NOT the access boundary — on multi-host networks
+        the port is reachable by any peer (ADVICE r1)."""
+        expected = os.environ.get("DCT_ALLOC_TOKEN", "")
+        if not expected:
+            return True  # tokenless dev mode (run outside an agent)
+        import hmac
+
+        got = self.headers.get("X-Alloc-Token", "")
+        if not got:
+            auth = self.headers.get("Authorization", "")
+            if auth.startswith("Bearer "):
+                got = auth[len("Bearer "):]
+        return hmac.compare_digest(got, expected)
+
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        if not self._authorized():
+            self._send(401, {"error": "allocation token required"})
+            return
         if self.path.rstrip("/") in ("", "/"):
+            endpoints = ["/data (GET, tensorboard)"]
+            if self.mode == "shell":
+                endpoints.insert(0, "/exec (POST)")
             self._send(200, {
                 "task": os.environ.get("DCT_ALLOCATION_ID", ""),
                 "mode": self.mode,
-                "endpoints": ["/exec (POST)", "/data (GET, tensorboard)"],
+                "endpoints": endpoints,
             })
             return
         if self.path.startswith("/data") and self.mode == "tensorboard":
@@ -187,6 +214,9 @@ class TaskHandler(BaseHTTPRequestHandler):
         self._send(404, {"error": f"no route {self.path}"})
 
     def do_POST(self) -> None:  # noqa: N802
+        if not self._authorized():
+            self._send(401, {"error": "allocation token required"})
+            return
         length = int(self.headers.get("Content-Length", "0"))
         try:
             body = json.loads(self.rfile.read(length) or b"{}")
@@ -194,6 +224,11 @@ class TaskHandler(BaseHTTPRequestHandler):
             self._send(400, {"error": "invalid json"})
             return
         if self.path.startswith("/exec"):
+            if self.mode != "shell":
+                # remote argv execution is the det-shell capability only;
+                # notebooks/tensorboards/commands must not expose it
+                self._send(403, {"error": "/exec is shell-mode only"})
+                return
             cmd = body.get("cmd")
             if not isinstance(cmd, list) or not cmd:
                 self._send(400, {"error": "cmd must be a non-empty argv list"})

@@ -1,0 +1,247 @@
+#include "crypto.h"
+
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <random>
+#include <vector>
+
+namespace dct {
+namespace crypto {
+namespace {
+
+// ---- SHA-256 (FIPS 180-4) --------------------------------------------------
+
+constexpr uint32_t kK[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+inline uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+struct Sha256Ctx {
+  uint32_t h[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                   0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+  uint8_t buf[64];
+  size_t buf_len = 0;
+  uint64_t total = 0;
+
+  void block(const uint8_t* p) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; ++i) {
+      w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+             (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+    }
+    for (int i = 16; i < 64; ++i) {
+      uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; ++i) {
+      uint32_t s1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + s1 + ch + kK[i] + w[i];
+      uint32_t s0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = s0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void update(const uint8_t* data, size_t len) {
+    total += len;
+    while (len > 0) {
+      size_t take = std::min(len, sizeof(buf) - buf_len);
+      std::memcpy(buf + buf_len, data, take);
+      buf_len += take;
+      data += take;
+      len -= take;
+      if (buf_len == 64) {
+        block(buf);
+        buf_len = 0;
+      }
+    }
+  }
+
+  void final(uint8_t out[32]) {
+    uint64_t bits = total * 8;
+    uint8_t pad = 0x80;
+    update(&pad, 1);
+    uint8_t zero = 0;
+    while (buf_len != 56) update(&zero, 1);
+    uint8_t len_be[8];
+    for (int i = 0; i < 8; ++i) {
+      len_be[i] = static_cast<uint8_t>(bits >> (56 - 8 * i));
+    }
+    update(len_be, 8);
+    for (int i = 0; i < 8; ++i) {
+      out[4 * i] = static_cast<uint8_t>(h[i] >> 24);
+      out[4 * i + 1] = static_cast<uint8_t>(h[i] >> 16);
+      out[4 * i + 2] = static_cast<uint8_t>(h[i] >> 8);
+      out[4 * i + 3] = static_cast<uint8_t>(h[i]);
+    }
+  }
+};
+
+constexpr int kIterations = 10000;
+constexpr const char* kScheme = "pbkdf2_sha256";
+
+// legacy FNV-1a 64 hash (pre-KDF snapshots persisted these; verify-only)
+std::string legacy_fnv_hash(const std::string& username,
+                            const std::string& password) {
+  const std::string salted = username + "\x1f" + password + "\x1f" + "dct-salt";
+  uint64_t h = 1469598103934665603ull;
+  for (unsigned char c : salted) {
+    h ^= c;
+    h *= 1099511628211ull;
+  }
+  char buf[17];
+  std::snprintf(buf, sizeof(buf), "%016llx",
+                static_cast<unsigned long long>(h));
+  return buf;
+}
+
+}  // namespace
+
+void sha256(const uint8_t* data, size_t len, uint8_t out[32]) {
+  Sha256Ctx ctx;
+  ctx.update(data, len);
+  ctx.final(out);
+}
+
+void hmac_sha256(const uint8_t* key, size_t key_len, const uint8_t* msg,
+                 size_t msg_len, uint8_t out[32]) {
+  uint8_t k[64] = {0};
+  if (key_len > 64) {
+    sha256(key, key_len, k);  // leaves bytes 32..63 zero
+  } else {
+    std::memcpy(k, key, key_len);
+  }
+  uint8_t ipad[64], opad[64];
+  for (int i = 0; i < 64; ++i) {
+    ipad[i] = k[i] ^ 0x36;
+    opad[i] = k[i] ^ 0x5c;
+  }
+  Sha256Ctx inner;
+  inner.update(ipad, 64);
+  inner.update(msg, msg_len);
+  uint8_t inner_digest[32];
+  inner.final(inner_digest);
+  Sha256Ctx outer;
+  outer.update(opad, 64);
+  outer.update(inner_digest, 32);
+  outer.final(out);
+}
+
+void pbkdf2_sha256(const std::string& password, const std::string& salt,
+                   int iterations, uint8_t out[32]) {
+  // dkLen = hLen = 32 → exactly one block (INT(i) = 1)
+  std::vector<uint8_t> msg(salt.begin(), salt.end());
+  msg.push_back(0);
+  msg.push_back(0);
+  msg.push_back(0);
+  msg.push_back(1);
+  uint8_t u[32];
+  hmac_sha256(reinterpret_cast<const uint8_t*>(password.data()),
+              password.size(), msg.data(), msg.size(), u);
+  uint8_t t[32];
+  std::memcpy(t, u, 32);
+  for (int i = 1; i < iterations; ++i) {
+    hmac_sha256(reinterpret_cast<const uint8_t*>(password.data()),
+                password.size(), u, 32, u);
+    for (int j = 0; j < 32; ++j) t[j] ^= u[j];
+  }
+  std::memcpy(out, t, 32);
+}
+
+std::string to_hex(const uint8_t* data, size_t len) {
+  static const char* hex = "0123456789abcdef";
+  std::string out;
+  out.reserve(len * 2);
+  for (size_t i = 0; i < len; ++i) {
+    out += hex[data[i] >> 4];
+    out += hex[data[i] & 0xF];
+  }
+  return out;
+}
+
+bool constant_time_eq(const std::string& a, const std::string& b) {
+  // length leak is fine (formats are public); content must not leak
+  unsigned char diff = a.size() == b.size() ? 0 : 1;
+  const size_t n = std::min(a.size(), b.size());
+  for (size_t i = 0; i < n; ++i) {
+    diff |= static_cast<unsigned char>(a[i]) ^ static_cast<unsigned char>(b[i]);
+  }
+  return diff == 0;
+}
+
+std::string random_token() {
+  unsigned char raw[16];
+  std::ifstream urandom("/dev/urandom", std::ios::binary);
+  if (urandom.good()) {
+    urandom.read(reinterpret_cast<char*>(raw), sizeof(raw));
+  }
+  if (!urandom.good()) {
+    std::random_device rd;  // fallback: one fresh word per byte-pair
+    for (size_t i = 0; i < sizeof(raw); i += 2) {
+      unsigned int v = rd();
+      raw[i] = static_cast<unsigned char>(v & 0xFF);
+      raw[i + 1] = static_cast<unsigned char>((v >> 8) & 0xFF);
+    }
+  }
+  return to_hex(raw, sizeof(raw));
+}
+
+std::string hash_password(const std::string& username,
+                          const std::string& password) {
+  std::string salt_hex = random_token();  // 128-bit per-user random salt
+  uint8_t dk[32];
+  pbkdf2_sha256(username + "\x1f" + password, salt_hex, kIterations, dk);
+  return std::string(kScheme) + "$" + std::to_string(kIterations) + "$" +
+         salt_hex + "$" + to_hex(dk, 32);
+}
+
+bool password_needs_rehash(const std::string& stored) {
+  return stored.rfind(std::string(kScheme) + "$", 0) != 0;
+}
+
+bool verify_password(const std::string& stored, const std::string& username,
+                     const std::string& password) {
+  if (password_needs_rehash(stored)) {
+    // legacy FNV-1a entries from pre-KDF snapshots
+    return constant_time_eq(stored, legacy_fnv_hash(username, password));
+  }
+  // pbkdf2_sha256$<iterations>$<salt_hex>$<dk_hex>
+  size_t p1 = stored.find('$');
+  size_t p2 = stored.find('$', p1 + 1);
+  size_t p3 = stored.find('$', p2 + 1);
+  if (p2 == std::string::npos || p3 == std::string::npos) return false;
+  int iterations = 0;
+  try {
+    iterations = std::stoi(stored.substr(p1 + 1, p2 - p1 - 1));
+  } catch (const std::exception&) {
+    return false;
+  }
+  if (iterations <= 0 || iterations > 10000000) return false;
+  const std::string salt_hex = stored.substr(p2 + 1, p3 - p2 - 1);
+  const std::string dk_hex = stored.substr(p3 + 1);
+  uint8_t dk[32];
+  pbkdf2_sha256(username + "\x1f" + password, salt_hex, iterations, dk);
+  return constant_time_eq(dk_hex, to_hex(dk, 32));
+}
+
+}  // namespace crypto
+}  // namespace dct

@@ -1,0 +1,50 @@
+// Credential primitives for the master's auth boundary.
+//
+// The reference delegates password hashing to bcrypt
+// (master/internal/user/postgres_users.go UserByUsername → bcrypt compare)
+// and session/allocation tokens to crypto/rand. This master has no external
+// deps, so the KDF is PBKDF2-HMAC-SHA256 (FIPS 198/180-4, implemented here)
+// with per-user random salt, plus constant-time comparison.
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+namespace dct {
+namespace crypto {
+
+// FIPS 180-4 SHA-256 of `data`; returns 32 raw bytes in `out`.
+void sha256(const uint8_t* data, size_t len, uint8_t out[32]);
+
+// FIPS 198 HMAC-SHA256.
+void hmac_sha256(const uint8_t* key, size_t key_len, const uint8_t* msg,
+                 size_t msg_len, uint8_t out[32]);
+
+// PBKDF2-HMAC-SHA256, single 32-byte block (dkLen = 32).
+void pbkdf2_sha256(const std::string& password, const std::string& salt,
+                   int iterations, uint8_t out[32]);
+
+std::string to_hex(const uint8_t* data, size_t len);
+
+// Timing-safe equality (compares full length regardless of mismatches).
+bool constant_time_eq(const std::string& a, const std::string& b);
+
+// 128-bit token from /dev/urandom, hex-encoded. Tokens are the
+// --auth-required boundary, so no seeded PRNG.
+std::string random_token();
+
+// Password hashing: "pbkdf2_sha256$<iterations>$<salt_hex>$<dk_hex>".
+std::string hash_password(const std::string& username,
+                          const std::string& password);
+
+// Verifies against the current format AND the legacy 16-hex-char FNV-1a
+// format (pre-KDF snapshots); callers should re-hash on successful legacy
+// verification. Constant-time on the digest comparison.
+bool verify_password(const std::string& stored, const std::string& username,
+                     const std::string& password);
+
+// True when `stored` is not in the current KDF format (needs upgrade).
+bool password_needs_rehash(const std::string& stored);
+
+}  // namespace crypto
+}  // namespace dct

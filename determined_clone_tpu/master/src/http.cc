@@ -239,7 +239,8 @@ void HttpServer::serve_connection(int fd) {
 
 std::optional<HttpClientResponse> http_request(
     const std::string& host, int port, const std::string& method,
-    const std::string& path, const std::string& body, int timeout_sec) {
+    const std::string& path, const std::string& body, int timeout_sec,
+    const std::map<std::string, std::string>& extra_headers) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return std::nullopt;
   timeval tv{timeout_sec, 0};
@@ -259,7 +260,9 @@ std::optional<HttpClientResponse> http_request(
   std::ostringstream out;
   out << method << ' ' << path << " HTTP/1.1\r\nHost: " << host
       << "\r\nContent-Type: application/json\r\nContent-Length: "
-      << body.size() << "\r\nConnection: close\r\n\r\n" << body;
+      << body.size() << "\r\nConnection: close";
+  for (const auto& [k, v] : extra_headers) out << "\r\n" << k << ": " << v;
+  out << "\r\n\r\n" << body;
   if (!send_all(fd, out.str())) { ::close(fd); return std::nullopt; }
 
   std::string data;
@@ -278,6 +281,25 @@ std::optional<HttpClientResponse> http_request(
     std::istringstream rl(data.substr(0, data.find("\r\n")));
     std::string version;
     rl >> version >> resp.status;
+  }
+  // response headers: only content-type matters to callers (proxy pass-thru)
+  {
+    std::istringstream headers(data.substr(0, header_end));
+    std::string line;
+    std::getline(headers, line);  // status line
+    while (std::getline(headers, line)) {
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      auto colon = line.find(':');
+      if (colon == std::string::npos) continue;
+      std::string key = line.substr(0, colon);
+      for (auto& c : key) {
+        c = static_cast<char>(std::tolower(static_cast<unsigned char>(c)));
+      }
+      if (key == "content-type") {
+        auto start = line.find_first_not_of(" \t", colon + 1);
+        if (start != std::string::npos) resp.content_type = line.substr(start);
+      }
+    }
   }
   resp.body = data.substr(header_end + 4);
   return resp;

@@ -73,12 +73,15 @@ class HttpServer {
 struct HttpClientResponse {
   int status = 0;
   std::string body;
+  std::string content_type = "application/json";  // from the response headers
 };
 
-// Returns nullopt on connect/transport error.
+// Returns nullopt on connect/transport error. `extra_headers` are appended
+// to the request (e.g. the proxy path's x-alloc-token injection).
 std::optional<HttpClientResponse> http_request(
     const std::string& host, int port, const std::string& method,
     const std::string& path, const std::string& body = "",
-    int timeout_sec = 70);
+    int timeout_sec = 70,
+    const std::map<std::string, std::string>& extra_headers = {});
 
 }  // namespace dct

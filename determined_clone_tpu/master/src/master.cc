@@ -1,5 +1,7 @@
 #include "master.h"
 
+#include "crypto.h"
+
 #include <sys/stat.h>
 #include <unistd.h>
 
@@ -106,7 +108,7 @@ void Master::save_snapshot_locked() {
   Json trials = Json::array();
   for (const auto& [id, t] : trials_) trials.push_back(t.to_json());
   Json allocs = Json::array();
-  for (const auto& [id, a] : allocations_) allocs.push_back(a.to_json());
+  for (const auto& [id, a] : allocations_) allocs.push_back(a.to_json(true));
   Json agents = Json::array();
   for (const auto& [id, a] : agents_) agents.push_back(a.to_json());
   Json ckpts = Json::array();
@@ -184,6 +186,12 @@ void Master::load_snapshot() {
   }
   for (const auto& a : snap["allocations"].elements()) {
     Allocation alloc = Allocation::from_json(a);
+    if (alloc.token.empty()) {
+      // pre-token snapshot: mint one so the proxy/data-plane gates work
+      // (the already-running task holds no token, so its own server stays
+      // in tokenless mode until the allocation is restarted)
+      alloc.token = crypto::random_token();
+    }
     allocations_[alloc.id] = std::move(alloc);
   }
   for (const auto& a : snap["agents"].elements()) {
@@ -395,6 +403,7 @@ void Master::queue_trial_leg(Trial& trial) {
                             : resources["resource_pool"].as_string();
   alloc.topology = resources["topology"].as_string();
   alloc.queued_at = now_sec();
+  alloc.token = crypto::random_token();
   alloc.spec.set("entrypoint", exp.config["entrypoint"]);
   alloc.spec.set("experiment_id", trial.experiment_id);
   alloc.spec.set("trial_id", trial.id);
@@ -568,6 +577,7 @@ void Master::gc_checkpoints_locked(Experiment& exp) {
   }
   gc.queued_at = now_sec();
   gc.last_activity = gc.queued_at;
+  gc.token = crypto::random_token();
   Json argv = Json::array();
   argv.push_back("python");
   argv.push_back("-m");
@@ -796,6 +806,7 @@ Json Master::allocation_start_command(const Allocation& alloc,
   cmd.set("slots", alloc.reservations.count(agent_id)
                        ? alloc.reservations.at(agent_id) : 0);
   cmd.set("world_size", alloc.world_size);
+  cmd.set("alloc_token", alloc.token);
   cmd.set("spec", alloc.spec);
   if (alloc.trial_id) {
     auto tit = trials_.find(alloc.trial_id);

@@ -90,6 +90,10 @@ class Master {
 
   // -- platform helpers (routes_platform.cc) --
   User* current_user(const HttpRequest& req);   // nullptr if no valid token
+  // true when the request bears a live allocation's token (the data-plane
+  // analogue of a user session; ≈ the reference's allocation session tokens,
+  // master/internal/task/allocation_service.go)
+  bool alloc_authed(const HttpRequest& req);
   void bootstrap_users_locked();
   Workspace& ensure_workspace(const std::string& name,
                               const std::string& owner);
@@ -148,5 +152,9 @@ class Master {
 };
 
 double now_sec();
+
+// strips the "Bearer " scheme from the Authorization header; empty string
+// when absent (routes_platform.cc)
+std::string bearer_token(const HttpRequest& req);
 
 }  // namespace dct

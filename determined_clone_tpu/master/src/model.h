@@ -210,10 +210,15 @@ struct Allocation {
   double idle_timeout_sec = 0; // kill idle NTSC tasks (task/idle/watcher.go)
   double last_activity = 0;    // updated on proxy hits
   int exit_code = 0;
+  // per-allocation secret: the data-plane credential handed to the task via
+  // env and required by the task server / proxy path (≈ the reference's
+  // allocation session tokens). Only serialized into the snapshot
+  // (with_secrets) — never into API responses.
+  std::string token;
 
   bool scheduled() const { return !reservations.empty(); }
 
-  Json to_json() const {
+  Json to_json(bool with_secrets = false) const {
     Json res = Json::object();
     for (const auto& [aid, n] : reservations) res.set(aid, n);
     Json rdv = Json::object();
@@ -232,6 +237,7 @@ struct Allocation {
         .set("proxy_address", proxy_address)
         .set("idle_timeout_sec", idle_timeout_sec)
         .set("last_activity", last_activity).set("exit_code", exit_code);
+    if (with_secrets) j.set("token", token);
     return j;
   }
   static Allocation from_json(const Json& j) {
@@ -260,6 +266,7 @@ struct Allocation {
     a.idle_timeout_sec = j["idle_timeout_sec"].as_number();
     a.last_activity = j["last_activity"].as_number();
     a.exit_code = static_cast<int>(j["exit_code"].as_int());
+    a.token = j["token"].as_string();
     return a;
   }
 };

@@ -20,7 +20,7 @@ namespace dct {
 struct User {
   int64_t id = 0;
   std::string username;
-  std::string password_hash;  // salted FNV-1a (dev-grade, like det's default
+  std::string password_hash;  // pbkdf2_sha256$... (crypto.cc; like det's default
                               // empty-password bootstrap users)
   bool admin = false;
   bool active = true;

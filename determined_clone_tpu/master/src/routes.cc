@@ -11,6 +11,7 @@
 #include <set>
 #include <sstream>
 
+#include "crypto.h"
 #include "master.h"
 
 namespace dct {
@@ -118,15 +119,30 @@ HttpResponse Master::metrics_route() {
 HttpResponse Master::proxy_route(const HttpRequest& req) {
   const std::string& alloc_id = req.path_parts[1];
   std::string address;
+  std::string alloc_token;
   {
     std::lock_guard<std::mutex> lock(mu_);
     auto it = allocations_.find(alloc_id);
     if (it == allocations_.end()) return not_found("no allocation " + alloc_id);
+    // the proxy fronts task servers whose /exec runs arbitrary argv — it is
+    // part of the user-facing surface and must sit behind the same auth gate
+    // as the API (a user session, or the allocation's own token for
+    // task-to-task traffic)
+    // empty tokens never match — a restored pre-token allocation must not
+    // turn the empty Authorization header into a grant
+    bool alloc_token_ok =
+        !it->second.token.empty() &&
+        crypto::constant_time_eq(bearer_token(req), it->second.token);
+    if (config_.auth_required && !current_user(req) && !alloc_token_ok) {
+      return HttpResponse::json(
+          401, error_json("authentication required").dump());
+    }
     if (it->second.proxy_address.empty()) {
       return HttpResponse::json(
           502, error_json("task has not registered a proxy address").dump());
     }
     address = it->second.proxy_address;
+    alloc_token = it->second.token;
     it->second.last_activity = now_sec();
     dirty_ = true;  // persists activity across master restarts (idle watcher)
   }
@@ -155,12 +171,21 @@ HttpResponse Master::proxy_route(const HttpRequest& req) {
     }
     path += qs;
   }
-  auto resp = http_request(host, port, req.method, path, req.body, 30);
+  // inject the allocation token so the task server can reject traffic that
+  // did not come through the master's authenticated proxy
+  auto resp = http_request(host, port, req.method, path, req.body, 30,
+                           {{"x-alloc-token", alloc_token}});
   if (!resp) {
     return HttpResponse::json(
         502, error_json("task at " + address + " unreachable").dump());
   }
-  return HttpResponse::json(resp->status, resp->body);
+  // pass the upstream response through untouched: content-type matters for
+  // proxied HTML/JS (real jupyter under DCT_NOTEBOOK_REAL=1)
+  HttpResponse out;
+  out.status = resp->status;
+  out.content_type = resp->content_type;
+  out.body = resp->body;
+  return out;
 }
 
 HttpResponse Master::route(const HttpRequest& req) {
@@ -172,18 +197,21 @@ HttpResponse Master::route(const HttpRequest& req) {
   const std::string& root = parts.size() > 2 ? parts[2] : "";
 
   // auth enforcement (when enabled): user-facing roots require a session
-  // token; the agent + allocation/trial data planes stay open (those get
-  // their own allocation-scoped auth in the reference)
+  // token. A live allocation token (the data-plane credential handed to
+  // every task via DCT_ALLOC_TOKEN) grants READ-ONLY access to experiments
+  // and users — the in-cluster needs (TensorBoard metric fetch, agent
+  // context download) — and nothing else: task containers run untrusted
+  // user code, so the token must not reach mutating routes (≈ the
+  // reference's allocation-scoped session tokens, which are similarly
+  // limited). (/api/v1/auth/login mints sessions and stays open.)
   static const std::set<std::string> kAuthRoots = {
       "experiments", "tasks",  "users",    "workspaces",
       "models",      "templates", "webhooks", "job-queue"};
   if (config_.auth_required && kAuthRoots.count(root)) {
-    // reads on users and experiments stay open: in-cluster data-plane
-    // consumers (e.g. the TensorBoard task fetching metric history) have no
-    // user session, mirroring the reference's allocation-scoped tokens
-    bool readonly_open = req.method == "GET" &&
-                         (root == "users" || root == "experiments");
-    if (!current_user(req) && !readonly_open) {
+    bool alloc_readonly = req.method == "GET" &&
+                          (root == "experiments" || root == "users") &&
+                          alloc_authed(req);
+    if (!current_user(req) && !alloc_readonly) {
       return HttpResponse::json(
           401, error_json("authentication required").dump());
     }
@@ -508,6 +536,7 @@ HttpResponse Master::route(const HttpRequest& req) {
       alloc.idle_timeout_sec = body["idle_timeout"].as_number(0);
       alloc.queued_at = now_sec();
       alloc.last_activity = alloc.queued_at;
+      alloc.token = crypto::random_token();
       // the agent execs spec.argv directly; built-in task types run the
       // generic harness task server (determined_clone_tpu/exec/task.py)
       Json argv = Json::array();

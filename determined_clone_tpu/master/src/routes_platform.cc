@@ -9,6 +9,7 @@
 #include <random>
 #include <thread>
 
+#include "crypto.h"
 #include "master.h"
 
 namespace dct {
@@ -36,46 +37,11 @@ HttpResponse pforbidden(const std::string& msg) {
   return HttpResponse::json(403, perr(msg).dump());
 }
 
-// dev-grade salted hash (the reference bootstraps passwordless admin/
-// determined users the same way; real deployments front with SSO)
-std::string hash_password(const std::string& username,
-                          const std::string& password) {
-  const std::string salted =
-      username + "\x1f" + password + "\x1f" + "dct-salt";
-  uint64_t h = 1469598103934665603ull;  // FNV-1a 64
-  for (unsigned char c : salted) {
-    h ^= c;
-    h *= 1099511628211ull;
-  }
-  char buf[17];
-  std::snprintf(buf, sizeof(buf), "%016llx", static_cast<unsigned long long>(h));
-  return buf;
-}
+// PBKDF2-HMAC-SHA256 with per-user random salt (crypto.cc); verification
+// accepts legacy FNV entries from pre-KDF snapshots and login re-hashes them
+using crypto::hash_password;
 
-std::string new_token() {
-  // full-entropy 128-bit token straight from the OS — tokens are the
-  // --auth-required boundary, so no seeded PRNG (predictable from one leak)
-  unsigned char raw[16];
-  std::ifstream urandom("/dev/urandom", std::ios::binary);
-  if (urandom.good()) {
-    urandom.read(reinterpret_cast<char*>(raw), sizeof(raw));
-  }
-  if (!urandom.good()) {
-    std::random_device rd;  // fallback: one fresh word per byte-pair
-    for (size_t i = 0; i < sizeof(raw); i += 2) {
-      unsigned int v = rd();
-      raw[i] = static_cast<unsigned char>(v & 0xFF);
-      raw[i + 1] = static_cast<unsigned char>((v >> 8) & 0xFF);
-    }
-  }
-  static const char* hex = "0123456789abcdef";
-  std::string out;
-  for (unsigned char b : raw) {
-    out += hex[b >> 4];
-    out += hex[b & 0xF];
-  }
-  return out;
-}
+std::string new_token() { return crypto::random_token(); }
 
 // deep-merge: template config is the base, experiment config overrides
 // (≈ master/internal/templates merge semantics via schemas.Merge)
@@ -88,6 +54,8 @@ Json merge_configs(const Json& base, const Json& over) {
   return out;
 }
 
+}  // namespace
+
 // strips the "Bearer " scheme; empty string when no auth header is present
 std::string bearer_token(const HttpRequest& req) {
   auto it = req.headers.find("authorization");
@@ -97,8 +65,6 @@ std::string bearer_token(const HttpRequest& req) {
   if (token.rfind(bearer, 0) == 0) token = token.substr(bearer.size());
   return token;
 }
-
-}  // namespace
 
 User* Master::current_user(const HttpRequest& req) {
   std::string token = bearer_token(req);
@@ -112,6 +78,21 @@ User* Master::current_user(const HttpRequest& req) {
   auto uit = users_.find(sit->second.user_id);
   if (uit == users_.end() || !uit->second.active) return nullptr;
   return &uit->second;
+}
+
+bool Master::alloc_authed(const HttpRequest& req) {
+  const std::string token = bearer_token(req);
+  if (token.empty()) return false;
+  // scan is O(allocations); constant-time per compare. Tokens of terminal
+  // allocations stay valid until GC'd — matches the reference's allocation
+  // sessions living as long as the allocation row.
+  for (const auto& [id, alloc] : allocations_) {
+    if (!alloc.token.empty() &&
+        crypto::constant_time_eq(token, alloc.token)) {
+      return true;
+    }
+  }
+  return false;
 }
 
 void Master::bootstrap_users_locked() {
@@ -220,8 +201,12 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
       for (auto& [id, u] : users_) {
         if (u.username == username) {
           if (!u.active) return punauthorized("user deactivated");
-          if (u.password_hash != hash_password(username, password)) {
+          if (!crypto::verify_password(u.password_hash, username, password)) {
             return punauthorized("invalid credentials");
+          }
+          if (crypto::password_needs_rehash(u.password_hash)) {
+            // transparent upgrade of legacy FNV entries from old snapshots
+            u.password_hash = hash_password(username, password);
           }
           SessionToken tok;
           tok.token = new_token();

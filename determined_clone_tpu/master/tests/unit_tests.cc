@@ -7,6 +7,7 @@
 #include <iostream>
 #include <set>
 
+#include "../src/crypto.h"
 #include "../src/json.h"
 #include "../src/master.h"
 #include "../src/scheduler.h"
@@ -471,7 +472,56 @@ void test_master_snapshot_restore() {
   }
 }
 
+void test_crypto() {
+  // SHA-256 FIPS 180-4 test vectors
+  uint8_t d[32];
+  crypto::sha256(reinterpret_cast<const uint8_t*>(""), 0, d);
+  CHECK(crypto::to_hex(d, 32) ==
+        "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855");
+  crypto::sha256(reinterpret_cast<const uint8_t*>("abc"), 3, d);
+  CHECK(crypto::to_hex(d, 32) ==
+        "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad");
+  // multi-block message (exercises buffering across the 64-byte boundary)
+  const std::string two_block =
+      "abcdbcdecdefdefgefghfghighijhijkijkljklmklmnlmnomnopnopq";
+  crypto::sha256(reinterpret_cast<const uint8_t*>(two_block.data()),
+                 two_block.size(), d);
+  CHECK(crypto::to_hex(d, 32) ==
+        "248d6a61d20638b8e5c026930c3e6039a33ce45964ff2167f6ecedd419db06c1");
+  // HMAC-SHA256 (RFC 4231 test case 2)
+  crypto::hmac_sha256(reinterpret_cast<const uint8_t*>("Jefe"), 4,
+                      reinterpret_cast<const uint8_t*>(
+                          "what do ya want for nothing?"), 28, d);
+  CHECK(crypto::to_hex(d, 32) ==
+        "5bdcc146bf60754e6a042426089575c75a003f089d2739839dec58b964ec3843");
+  // PBKDF2-HMAC-SHA256 (RFC 7914 §11 test vector, 1 iter + iterated)
+  crypto::pbkdf2_sha256("passwd", "salt", 1, d);
+  CHECK(crypto::to_hex(d, 32) ==
+        "55ac046e56e3089fec1691c22544b605f94185216dde0465e68b9d57c20dacbc");
+  crypto::pbkdf2_sha256("passwd", "salt", 10000, d);
+  CHECK(crypto::to_hex(d, 32) ==
+        "891ba7f6f871dbadd932fa3b35a3a07054eadd85b47aa470399b3521aaa5b686");
+  // constant-time compare
+  CHECK(crypto::constant_time_eq("abc", "abc"));
+  CHECK(!crypto::constant_time_eq("abc", "abd"));
+  CHECK(!crypto::constant_time_eq("abc", "ab"));
+  // KDF round-trip + legacy verify + rehash detection
+  std::string h = crypto::hash_password("admin", "hunter2");
+  CHECK(h.rfind("pbkdf2_sha256$", 0) == 0);
+  CHECK(crypto::verify_password(h, "admin", "hunter2"));
+  CHECK(!crypto::verify_password(h, "admin", "hunter3"));
+  CHECK(!crypto::password_needs_rehash(h));
+  // two hashes of the same password differ (random salt)
+  CHECK(h != crypto::hash_password("admin", "hunter2"));
+  // legacy FNV-1a entry (pre-KDF snapshot format)
+  CHECK(crypto::password_needs_rehash("0123456789abcdef"));
+  // random tokens are 32 hex chars and distinct
+  std::string t1 = crypto::random_token(), t2 = crypto::random_token();
+  CHECK(t1.size() == 32 && t2.size() == 32 && t1 != t2);
+}
+
 int run_all() {
+  test_crypto();
   test_json();
   test_hparam_sampling();
   test_search_methods();
