@@ -87,8 +87,12 @@ class MasterSession:
     def master_info(self) -> Dict[str, Any]:
         return self.get("/api/v1/master")
 
-    def create_experiment(self, config: Dict[str, Any]) -> Dict[str, Any]:
-        return self.post("/api/v1/experiments", {"config": config})["experiment"]
+    def create_experiment(self, config: Dict[str, Any],
+                          context: Optional[list] = None) -> Dict[str, Any]:
+        body: Dict[str, Any] = {"config": config}
+        if context is not None:
+            body["context"] = context
+        return self.post("/api/v1/experiments", body)["experiment"]
 
     def list_experiments(self) -> list:
         return self.get("/api/v1/experiments")["experiments"]

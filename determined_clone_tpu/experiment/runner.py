@@ -62,13 +62,16 @@ class LocalExperimentRunner:
                  trial_cls: Type[JaxTrial], *,
                  storage_path: str,
                  mesh: Optional[Any] = None,
-                 max_events: int = 10_000) -> None:
+                 max_events: int = 10_000,
+                 method: Optional[Any] = None) -> None:
         self.config = config
         self.trial_cls = trial_cls
         self.storage_path = storage_path
         self.mesh = mesh
         self.max_events = max_events
-        self.engine = Searcher(build_method(
+        # method override: a user-provided SearchMethod (custom search via
+        # searcher.LocalSearchRunner) instead of the built-in factory
+        self.engine = Searcher(method if method is not None else build_method(
             config.searcher, config.hyperparameters, seed=config.experiment_seed
         ))
         self.trials: Dict[int, TrialRecord] = {}

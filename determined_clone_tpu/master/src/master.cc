@@ -334,6 +334,11 @@ void Master::apply_search_ops(Experiment& exp, std::vector<SearchOp> ops) {
       case SearchOp::Kind::Create: {
         int64_t rid = op.request_id >= 0 ? op.request_id
                                          : exp.next_request_id;
+        if (request_to_trial_[exp.id].count(rid)) {
+          // duplicate create (a restarted custom-search runner replaying the
+          // event log): the trial exists — idempotent no-op
+          break;
+        }
         exp.next_request_id = std::max(exp.next_request_id, rid + 1);
         Trial trial;
         trial.id = next_trial_id_++;
@@ -364,14 +369,20 @@ void Master::apply_search_ops(Experiment& exp, std::vector<SearchOp> ops) {
         if (tit == request_to_trial_[exp.id].end()) break;
         Trial& trial = trials_[tit->second];
         if (trial.state != RunState::Errored) {
+          bool was_terminal = trial.state == RunState::Completed;
           trial.state = RunState::Completed;
           trial.ended_at = now_sec();
+          if (!was_terminal) {
+            auto more = method->on_trial_closed(op.request_id);
+            queue.insert(queue.end(), more.begin(), more.end());
+          }
         }
         break;
       }
       case SearchOp::Kind::Shutdown: {
-        finish_experiment(exp,
-                          op.failure ? RunState::Errored : RunState::Completed);
+        finish_experiment(exp, op.failure ? RunState::Errored
+                               : op.cancel ? RunState::Canceled
+                                           : RunState::Completed);
         break;
       }
     }

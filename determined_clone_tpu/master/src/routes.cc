@@ -355,6 +355,68 @@ HttpResponse Master::route(const HttpRequest& req) {
         j.set("checkpoints", arr);
         return ok_json(j);
       }
+      // custom-search event queue (≈ master/pkg/searcher/custom_search.go
+      // events + api_experiment.go GetSearcherEvents/PostSearcherOperations)
+      if (parts.size() == 6 && parts[4] == "searcher") {
+        auto* custom = dynamic_cast<CustomSearchCpp*>(method_for(exp));
+        if (parts[5] == "events" && req.method == "GET") {
+          if (!custom) {
+            return bad_request("experiment searcher is not custom");
+          }
+          int64_t since = 0;
+          auto sit = req.query.find("since");
+          if (sit != req.query.end()) since = std::stoll(sit->second);
+          Json j = Json::object();
+          j.set("events", custom->events_after(since));
+          j.set("state", to_string(exp.state));
+          j.set("progress", custom->progress());
+          return ok_json(j);
+        }
+        if (parts[5] == "operations" && req.method == "POST") {
+          if (!custom) {
+            return bad_request("experiment searcher is not custom");
+          }
+          Json body = Json::parse(req.body);
+          // parse/validate ALL ops before mutating anything — a 400 must
+          // truly leave no side effects (progress included)
+          std::vector<SearchOp> ops;
+          for (const auto& o : body["ops"].elements()) {
+            const std::string& type = o["type"].as_string();
+            if (type == "create") {
+              SearchOp op = SearchOp::create(o["hparams"]);
+              if (o.has("request_id")) op.request_id = o["request_id"].as_int();
+              ops.push_back(std::move(op));
+            } else if (type == "validate_after") {
+              ops.push_back(SearchOp::validate_after(
+                  o["request_id"].as_int(), o["units"].as_int()));
+            } else if (type == "close") {
+              ops.push_back(SearchOp::close(o["request_id"].as_int()));
+            } else if (type == "shutdown") {
+              ops.push_back(SearchOp::shutdown(o["failure"].as_bool(),
+                                               o["cancel"].as_bool()));
+            } else {
+              return bad_request("unknown searcher op type '" + type + "'");
+            }
+          }
+          if (body["progress"].is_number()) {
+            custom->set_progress(body["progress"].as_number());
+          }
+          if (body["ack_through"].is_number()) {
+            // opt-in log trim: the runner persists its own state and no
+            // longer needs events <= ack_through for replay
+            custom->trim_events(body["ack_through"].as_int());
+          }
+          if (exp.state == RunState::Running && !ops.empty()) {
+            apply_search_ops(exp, std::move(ops));
+          } else {
+            exp.searcher_snapshot = method_for(exp)->snapshot();
+            dirty_ = true;  // persist progress updates even with no ops
+          }
+          Json j = Json::object();
+          j.set("state", to_string(exp.state));
+          return ok_json(j);
+        }
+      }
       // context-dir download by agents (≈ prep_container.py:29)
       if (parts.size() == 5 && parts[4] == "context" && req.method == "GET") {
         std::ifstream in(config_.data_dir + "/exp-" + std::to_string(id) +

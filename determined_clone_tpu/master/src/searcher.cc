@@ -663,6 +663,81 @@ class AdaptiveAshaCpp : public SearchMethodCpp {
 
 }  // namespace
 
+// ---------------------------------------------------------------------------
+// custom search: event queue for an external search method
+// ---------------------------------------------------------------------------
+
+void CustomSearchCpp::record(const std::string& type, Json data) {
+  data.set("id", next_event_id_++);
+  data.set("type", type);
+  events_.push_back(std::move(data));
+}
+
+std::vector<SearchOp> CustomSearchCpp::initial_operations() {
+  record("initial_operations", Json::object());
+  return {};
+}
+
+std::vector<SearchOp> CustomSearchCpp::on_trial_created(int64_t rid) {
+  Json d = Json::object();
+  d.set("request_id", rid);
+  record("trial_created", std::move(d));
+  return {};
+}
+
+std::vector<SearchOp> CustomSearchCpp::on_validation_completed(
+    int64_t rid, double metric, int64_t units) {
+  Json d = Json::object();
+  d.set("request_id", rid).set("metric", metric).set("units", units);
+  record("validation_completed", std::move(d));
+  return {};
+}
+
+std::vector<SearchOp> CustomSearchCpp::on_trial_exited_early(int64_t rid) {
+  Json d = Json::object();
+  d.set("request_id", rid);
+  record("trial_exited_early", std::move(d));
+  return {};
+}
+
+std::vector<SearchOp> CustomSearchCpp::on_trial_closed(int64_t rid) {
+  Json d = Json::object();
+  d.set("request_id", rid);
+  record("trial_closed", std::move(d));
+  return {};
+}
+
+void CustomSearchCpp::trim_events(int64_t up_to) {
+  events_.erase(
+      std::remove_if(events_.begin(), events_.end(),
+                     [&](const Json& e) { return e["id"].as_int() <= up_to; }),
+      events_.end());
+}
+
+Json CustomSearchCpp::events_after(int64_t since) const {
+  Json out = Json::array();
+  for (const auto& e : events_) {
+    if (e["id"].as_int() > since) out.push_back(e);
+  }
+  return out;
+}
+
+Json CustomSearchCpp::snapshot() const {
+  Json j = Json::object();
+  Json evs = Json::array();
+  for (const auto& e : events_) evs.push_back(e);
+  j.set("events", evs).set("next_event_id", next_event_id_)
+      .set("progress", progress_);
+  return j;
+}
+
+void CustomSearchCpp::restore(const Json& snap) {
+  events_.clear();
+  for (const auto& e : snap["events"].elements()) events_.push_back(e);
+  next_event_id_ = snap["next_event_id"].as_int(1);
+  progress_ = snap["progress"].as_number(0.0);
+}
+
 std::unique_ptr<SearchMethodCpp> build_search_method(
     const Json& cfg, const Json& space, uint64_t seed) {
   const std::string& name =
@@ -681,6 +756,9 @@ std::unique_ptr<SearchMethodCpp> build_search_method(
   }
   if (name == "adaptive_asha") {
     return std::make_unique<AdaptiveAshaCpp>(cfg, space, seed);
+  }
+  if (name == "custom") {
+    return std::make_unique<CustomSearchCpp>();
   }
   throw std::runtime_error("unknown searcher name '" + name + "'");
 }

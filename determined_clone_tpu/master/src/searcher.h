@@ -23,19 +23,20 @@ struct SearchOp {
   int64_t request_id = -1;   // Create: -1 = engine assigns
   Json hparams;              // Create
   int64_t units = 0;         // ValidateAfter: cumulative target
-  bool failure = false;      // Shutdown
+  bool failure = false;      // Shutdown → Errored
+  bool cancel = false;       // Shutdown → Canceled (failure wins)
 
   static SearchOp create(Json hparams) {
-    return {Kind::Create, -1, std::move(hparams), 0, false};
+    return {Kind::Create, -1, std::move(hparams), 0, false, false};
   }
   static SearchOp validate_after(int64_t rid, int64_t units) {
-    return {Kind::ValidateAfter, rid, Json(), units, false};
+    return {Kind::ValidateAfter, rid, Json(), units, false, false};
   }
   static SearchOp close(int64_t rid) {
-    return {Kind::Close, rid, Json(), 0, false};
+    return {Kind::Close, rid, Json(), 0, false, false};
   }
-  static SearchOp shutdown(bool failure = false) {
-    return {Kind::Shutdown, -1, Json(), 0, failure};
+  static SearchOp shutdown(bool failure = false, bool cancel = false) {
+    return {Kind::Shutdown, -1, Json(), 0, failure, cancel};
   }
 };
 
@@ -55,9 +56,45 @@ class SearchMethodCpp {
   virtual std::vector<SearchOp> on_validation_completed(
       int64_t rid, double metric, int64_t units) = 0;
   virtual std::vector<SearchOp> on_trial_exited_early(int64_t rid) = 0;
+  // a trial reached Completed via a Close op (custom search records it;
+  // built-ins drive closes themselves, so the default is a no-op)
+  virtual std::vector<SearchOp> on_trial_closed(int64_t) { return {}; }
   virtual double progress() const = 0;
   virtual Json snapshot() const = 0;
   virtual void restore(const Json& snap) = 0;
+};
+
+// Custom search (≈ master/pkg/searcher/custom_search.go:15-23): the method
+// lives OUTSIDE the master — a user process running a Python SearchMethod —
+// and talks to the experiment through an event queue. Each lifecycle
+// callback appends an event (and returns no operations); the remote runner
+// polls GET /api/v1/experiments/<id>/searcher/events and posts operations
+// back via POST .../searcher/operations, which the orchestrator applies
+// exactly like built-in method output.
+class CustomSearchCpp : public SearchMethodCpp {
+ public:
+  std::vector<SearchOp> initial_operations() override;
+  std::vector<SearchOp> on_trial_created(int64_t rid) override;
+  std::vector<SearchOp> on_validation_completed(int64_t rid, double metric,
+                                                int64_t units) override;
+  std::vector<SearchOp> on_trial_exited_early(int64_t rid) override;
+  std::vector<SearchOp> on_trial_closed(int64_t rid) override;
+  double progress() const override { return progress_; }
+  Json snapshot() const override;
+  void restore(const Json& snap) override;
+
+  // events with id > since, oldest first (the runner's poll cursor)
+  Json events_after(int64_t since) const;
+  void set_progress(double p) { progress_ = p; }
+  // drop events with id <= up_to. Opt-in (the runner must persist its own
+  // state to still resume): bounds the log/snapshot for long searches.
+  void trim_events(int64_t up_to);
+
+ private:
+  void record(const std::string& type, Json data);
+  std::vector<Json> events_;   // each: {"id", "type", ...payload}
+  int64_t next_event_id_ = 1;
+  double progress_ = 0.0;
 };
 
 // Factory from the searcher config JSON (name/metric/max_trials/max_length/

@@ -520,8 +520,55 @@ void test_crypto() {
   CHECK(t1.size() == 32 && t2.size() == 32 && t1 != t2);
 }
 
+void test_custom_search() {
+  // the event queue: callbacks record events and emit no ops
+  auto method = build_search_method(
+      Json::parse(R"({"name": "custom"})"), Json::object(), 1);
+  auto* custom = dynamic_cast<CustomSearchCpp*>(method.get());
+  CHECK(custom != nullptr);
+  CHECK(custom->initial_operations().empty());
+  CHECK(custom->on_trial_created(0).empty());
+  CHECK(custom->on_validation_completed(0, 0.5, 4).empty());
+  CHECK(custom->on_trial_exited_early(1).empty());
+  Json evs = custom->events_after(0);
+  CHECK(evs.elements().size() == 4);
+  CHECK(evs.elements()[0]["type"].as_string() == "initial_operations");
+  CHECK(evs.elements()[2]["type"].as_string() == "validation_completed");
+  CHECK(std::abs(evs.elements()[2]["metric"].as_number() - 0.5) < 1e-12);
+  // cursor semantics: only events past `since`
+  int64_t second = evs.elements()[1]["id"].as_int();
+  CHECK(custom->events_after(second).elements().size() == 2);
+  // progress + snapshot/restore round-trip
+  custom->set_progress(0.25);
+  Json snap = custom->snapshot();
+  auto method2 = build_search_method(
+      Json::parse(R"({"name": "custom"})"), Json::object(), 1);
+  auto* custom2 = dynamic_cast<CustomSearchCpp*>(method2.get());
+  custom2->restore(snap);
+  CHECK(custom2->events_after(0).elements().size() == 4);
+  CHECK(std::abs(custom2->progress() - 0.25) < 1e-12);
+  custom2->on_trial_created(7);  // ids keep increasing after restore
+  Json evs2 = custom2->events_after(0);
+  CHECK(evs2.elements().back()["id"].as_int() ==
+        evs.elements().back()["id"].as_int() + 1);
+  // trial_closed records an event too (remote runners rely on it)
+  CHECK(custom2->on_trial_closed(7).empty());
+  CHECK(custom2->events_after(0).elements().back()["type"].as_string() ==
+        "trial_closed");
+  // opt-in trim: acked events drop; later ones stay
+  int64_t cut = evs2.elements().back()["id"].as_int();
+  custom2->trim_events(cut);
+  Json left = custom2->events_after(0);
+  CHECK(left.elements().size() == 1);
+  CHECK(left.elements()[0]["type"].as_string() == "trial_closed");
+  // shutdown op carries cancel distinct from failure
+  SearchOp sd = SearchOp::shutdown(false, true);
+  CHECK(sd.cancel && !sd.failure);
+}
+
 int run_all() {
   test_crypto();
+  test_custom_search();
   test_json();
   test_hparam_sampling();
   test_search_methods();

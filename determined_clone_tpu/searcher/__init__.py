@@ -12,6 +12,10 @@ from determined_clone_tpu.searcher.base import (
     Shutdown,
     ValidateAfter,
 )
+from determined_clone_tpu.searcher.custom import (
+    LocalSearchRunner,
+    RemoteSearchRunner,
+)
 from determined_clone_tpu.searcher.methods import (
     GridSearch,
     RandomSearch,
@@ -34,8 +38,9 @@ def build_method(config: SearcherConfig, space: HyperparameterSpace,
     if config.name == "adaptive_asha":
         return AdaptiveASHASearch(config, space, seed)
     raise ValueError(
-        f"searcher {config.name!r} has no built-in method "
-        f"(custom searchers attach via the custom-search event queue)"
+        f"searcher {config.name!r} has no built-in method — custom searchers "
+        f"pass their SearchMethod to searcher.RemoteSearchRunner (cluster, "
+        f"via the master's event queue) or searcher.LocalSearchRunner"
     )
 
 
@@ -45,6 +50,8 @@ __all__ = [
     "Close",
     "Create",
     "GridSearch",
+    "LocalSearchRunner",
+    "RemoteSearchRunner",
     "Operation",
     "RandomSearch",
     "Searcher",

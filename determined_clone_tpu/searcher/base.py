@@ -63,9 +63,15 @@ class Shutdown(Operation):
 # ---------------------------------------------------------------------------
 
 class SearchMethod(abc.ABC):
-    """Implementations are pure state machines over events."""
+    """Implementations are pure state machines over events.
 
-    def __init__(self, config: SearcherConfig, space: HyperparameterSpace,
+    Built-in methods take (config, space, seed); user-defined custom methods
+    (searcher/custom.py runners) may define any constructor — the base
+    snapshot/restore only covers ``self.rng`` when present.
+    """
+
+    def __init__(self, config: Optional[SearcherConfig] = None,
+                 space: Optional[HyperparameterSpace] = None,
                  seed: int = 0) -> None:
         self.config = config
         self.space = space
@@ -96,11 +102,12 @@ class SearchMethod(abc.ABC):
 
     # crash-consistency (reference: searcher state snapshots)
     def snapshot(self) -> Dict[str, Any]:
-        return {"rng": self.rng.getstate()}
+        rng = getattr(self, "rng", None)
+        return {"rng": rng.getstate()} if rng is not None else {}
 
     def restore(self, snap: Dict[str, Any]) -> None:
         state = snap.get("rng")
-        if state is not None:
+        if state is not None and getattr(self, "rng", None) is not None:
             # JSON roundtrips tuples to lists; normalize back
             a, b, c = state
             self.rng.setstate((a, tuple(b), c))
