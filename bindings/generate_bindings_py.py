@@ -1,0 +1,320 @@
+#!/usr/bin/env python3
+"""Generate the typed Python client from the proto definitions.
+
+≈ the reference's bindings/generate_bindings_py.py (swagger →
+harness/determined/common/api/bindings.py), re-done proto-first: protoc
+compiles proto/dct/api/v1 into a FileDescriptorSet, this script walks it
+with the protobuf runtime and emits determined_clone_tpu/api/bindings.py —
+dataclass messages with snake_case JSON (de)serialization plus one request
+function per RPC, bound to the REST gateway via the http.proto options.
+
+Usage: python bindings/generate_bindings_py.py [--check]
+  --check  regenerate to a buffer and fail if the checked-in file differs
+           (the CI drift gate; ≈ the reference's bindings "make check").
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROTO_DIR = os.path.join(REPO, "proto")
+OUT_PATH = os.path.join(REPO, "determined_clone_tpu", "api", "bindings.py")
+
+# field numbers of the custom MethodOptions in dct/api/v1/http.proto
+HTTP_METHOD_FIELD = 50001
+HTTP_PATH_FIELD = 50002
+HTTP_POLL_STREAM_FIELD = 50003
+
+SCALAR_TYPES = {
+    1: ("float", "0.0"),   # double
+    2: ("float", "0.0"),   # float
+    3: ("int", "0"),       # int64
+    4: ("int", "0"),       # uint64
+    5: ("int", "0"),       # int32
+    8: ("bool", "False"),  # bool
+    9: ("str", '""'),      # string
+    13: ("int", "0"),      # uint32
+}
+TYPE_MESSAGE = 11
+LABEL_REPEATED = 3
+
+WELL_KNOWN_ANY = {
+    ".google.protobuf.Struct": "dict",
+    ".google.protobuf.Value": "object",
+}
+
+
+def compile_descriptors() -> bytes:
+    with tempfile.NamedTemporaryFile(suffix=".pb") as tmp:
+        subprocess.run(
+            ["protoc", f"-I{PROTO_DIR}",
+             f"--descriptor_set_out={tmp.name}", "--include_imports",
+             os.path.join(PROTO_DIR, "dct", "api", "v1", "api.proto")],
+            check=True,
+        )
+        tmp.seek(0)
+        return tmp.read()
+
+
+def snake(name: str) -> str:
+    out = []
+    for i, c in enumerate(name):
+        if c.isupper() and i > 0 and (not name[i - 1].isupper() or
+                                      (i + 1 < len(name) and
+                                       name[i + 1].islower())):
+            out.append("_")
+        out.append(c.lower())
+    return "".join(out)
+
+
+def parse_method_options(options) -> dict:
+    """Read the raw custom options (unknown to the runtime's descriptor pool)
+    out of the serialized MethodOptions."""
+    raw = options.SerializeToString()
+    out = {}
+    i = 0
+    while i < len(raw):
+        tag, i = _read_varint(raw, i)
+        field, wire = tag >> 3, tag & 7
+        if wire == 2:  # length-delimited
+            length, i = _read_varint(raw, i)
+            val = raw[i:i + length]
+            i += length
+            if field == HTTP_METHOD_FIELD:
+                out["method"] = val.decode()
+            elif field == HTTP_PATH_FIELD:
+                out["path"] = val.decode()
+        elif wire == 0:
+            val, i = _read_varint(raw, i)
+            if field == HTTP_POLL_STREAM_FIELD:
+                out["stream"] = bool(val)
+        else:  # pragma: no cover - no other wire types in MethodOptions
+            raise ValueError(f"unexpected wire type {wire}")
+    return out
+
+
+def _read_varint(buf: bytes, i: int):
+    shift = 0
+    val = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return val, i
+        shift += 7
+
+
+def py_type(field) -> tuple:
+    """(annotation, default_expr, from_json_expr(v), to_json_expr(x)).
+
+    Scalars use a None sentinel (proto3 "explicit presence"): unset fields
+    serialize to nothing, while an explicit zero/empty value round-trips —
+    so e.g. priority=0 is expressible and distinct from "use the server
+    default"."""
+    if field.type == TYPE_MESSAGE:
+        if field.type_name in WELL_KNOWN_ANY:
+            base = WELL_KNOWN_ANY[field.type_name]
+            conv_in = "v"
+            conv_out = "x"
+        else:
+            base = "V1" + field.type_name.split(".")[-1]
+            conv_in = f"{base}.from_json(v)"
+            conv_out = "x.to_json()"
+        if field.label == LABEL_REPEATED:
+            return (f"List[{base}]", "None",
+                    f"[{conv_in} for v in (v or [])]",
+                    f"[{conv_out} for x in x]")
+        return (f"Optional[{base}]", "None",
+                f"({conv_in} if v is not None else None)",
+                f"({conv_out} if x is not None else None)")
+    ann, _ = SCALAR_TYPES[field.type]
+    if field.label == LABEL_REPEATED:
+        return (f"List[{ann}]", "None", f"[{ann}(v) for v in (v or [])]",
+                "list(x)")
+    return (f"Optional[{ann}]", "None",
+            f"{ann}(v)" if ann != "bool" else "bool(v)", "x")
+
+
+def gen_message(msg) -> str:
+    name = "V1" + msg.name
+    lines = [f"@dataclasses.dataclass", f"class {name}:"]
+    if not msg.field:
+        lines.append("    pass")
+    inits = []
+    froms = []
+    tos = []
+    for field in msg.field:
+        ann, default, from_expr, to_expr = py_type(field)
+        if ann.startswith("List["):
+            inits.append(
+                f"    {field.name}: {ann} = dataclasses.field("
+                f"default_factory=list)")
+        else:
+            inits.append(f"    {field.name}: {ann} = {default}")
+        froms.append(
+            f"            {field.name}=(lambda v: {from_expr})"
+            f"(obj.get({field.name!r}))"
+            f" if obj.get({field.name!r}) is not None else "
+            + ("[]" if ann.startswith("List[") else "None") + ",")
+        guard = (f"self.{field.name}" if ann.startswith("List[")
+                 else f"self.{field.name} is not None")
+        tos.append(
+            f"        if {guard}:\n"
+            f"            out[{field.name!r}] = "
+            f"(lambda x: {to_expr})(self.{field.name})")
+    lines.extend(inits)
+    lines.append("")
+    lines.append("    @classmethod")
+    lines.append(f"    def from_json(cls, obj: dict) -> \"{name}\":")
+    lines.append("        obj = obj or {}")
+    lines.append("        return cls(")
+    lines.extend(froms)
+    lines.append("        )")
+    lines.append("")
+    lines.append("    def to_json(self) -> dict:")
+    lines.append("        # None = unset (proto3 explicit presence): omitted")
+    lines.append("        out: dict = {}")
+    lines.extend(tos if tos else ["        pass"])
+    lines.append("        return out")
+    return "\n".join(lines)
+
+
+def gen_rpc(method) -> str:
+    opts = parse_method_options(method.options)
+    http_method = opts.get("method", "POST")
+    path = opts.get("path")
+    if not path:
+        raise ValueError(f"rpc {method.name} missing http_path option")
+    req_type = "V1" + method.input_type.split(".")[-1]
+    resp_type = "V1" + method.output_type.split(".")[-1]
+    fname = snake(method.name)
+    path_fields = [seg[1:-1] for seg in
+                   [p for p in path.split("/") if p.startswith("{")]]
+    body_lines = [
+        f"def {fname}(session: Any, req: {req_type}) -> "
+        + (f"Iterator[{resp_type}]" if opts.get("stream") else resp_type)
+        + ":",
+        f'    """{http_method} {path}"""',
+        "    body = req.to_json()",
+    ]
+    fmt_path = path
+    for pf in path_fields:
+        fmt_path = fmt_path.replace(
+            "{" + pf + "}",
+            "{" + f"_path_param(body, {pf!r}, {method.name!r})" + "}")
+    body_lines.append(f'    path = f"{fmt_path}"')
+    if opts.get("stream"):
+        # poll-stream: page with offset/limit until a short page arrives
+        body_lines.extend([
+            "    offset = int(body.pop('offset', 0) or 0)",
+            "    limit = int(body.pop('limit', 0) or 0) or 1000",
+            "    while True:",
+            "        out = session.request(",
+            "            'GET', path + f'?limit={limit}&offset={offset}')",
+            f"        page = {resp_type}.from_json(out)",
+            "        yield page",
+            "        n = sum(len(v) for v in out.values()"
+            " if isinstance(v, list))",
+            "        if n < limit:",
+            "            return",
+            "        offset += n",
+        ])
+        return "\n".join(body_lines)
+    if http_method == "GET":
+        body_lines.extend([
+            "    query = '&'.join(f'{k}={_q(v)}' for k, v in body.items()",
+            "                     if not isinstance(v, (dict, list)) and"
+            " v not in (None, ''))",
+            "    if query:",
+            "        path += '?' + query",
+            f"    out = session.request('GET', path)",
+        ])
+    else:
+        body_lines.append(
+            f"    out = session.request({http_method!r}, path, body)")
+    body_lines.append(f"    return {resp_type}.from_json(out)")
+    return "\n".join(body_lines)
+
+
+HEADER = '''"""GENERATED by bindings/generate_bindings_py.py — DO NOT EDIT.
+
+Typed client over the DCT master's REST gateway, generated from
+proto/dct/api/v1/api.proto (the schema source of truth; ≈ the reference's
+generated harness/determined/common/api/bindings.py). Transport is any
+object with ``request(method, path, body=None)`` — normally
+determined_clone_tpu.api.client.MasterSession.
+"""
+# flake8: noqa
+from __future__ import annotations
+
+import dataclasses
+import urllib.parse
+from typing import Any, Iterator, List, Optional
+
+
+def _q(segment: Any) -> str:
+    return urllib.parse.quote(str(segment), safe="")
+
+
+def _path_param(body: dict, name: str, rpc: str) -> str:
+    """Pop a path parameter; an unset path param is a caller bug and must
+    not silently route to a different endpoint."""
+    val = body.pop(name, None)
+    if val is None or val == "":
+        raise ValueError(f"{rpc}: request field {name!r} is required "
+                         "(it fills the URL path)")
+    return _q(val)
+
+'''
+
+
+def generate() -> str:
+    from google.protobuf import descriptor_pb2
+
+    fds = descriptor_pb2.FileDescriptorSet.FromString(compile_descriptors())
+    chunks = [HEADER]
+    api_files = [f for f in fds.file if f.package == "dct.api.v1"
+                 and f.name.endswith("api.proto")]
+    for f in api_files:
+        for msg in f.message_type:
+            chunks.append(gen_message(msg))
+            chunks.append("")
+        for svc in f.service:
+            chunks.append(f"# ---- service {svc.name} "
+                          f"({len(svc.method)} RPCs) ----")
+            chunks.append("")
+            for method in svc.method:
+                chunks.append(gen_rpc(method))
+                chunks.append("")
+    return "\n".join(chunks).rstrip() + "\n"
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--check", action="store_true",
+                        help="fail if the checked-in bindings are stale")
+    args = parser.parse_args()
+    code = generate()
+    compile(code, OUT_PATH, "exec")  # syntax-check before writing
+    if args.check:
+        with open(OUT_PATH) as f:
+            if f.read() != code:
+                print("bindings.py is stale — run "
+                      "python bindings/generate_bindings_py.py",
+                      file=sys.stderr)
+                return 1
+        print("bindings.py up to date")
+        return 0
+    with open(OUT_PATH, "w") as f:
+        f.write(code)
+    print(f"wrote {OUT_PATH} ({len(code.splitlines())} lines)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

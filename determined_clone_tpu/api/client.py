@@ -17,6 +17,14 @@ def _q(segment: Any) -> str:
     return urllib.parse.quote(str(segment), safe="")
 
 
+def _b():
+    """The generated bindings module (lazy: keeps client import-light for
+    the in-task data plane)."""
+    from determined_clone_tpu.api import bindings
+
+    return bindings
+
+
 class MasterError(RuntimeError):
     def __init__(self, status: int, message: str) -> None:
         super().__init__(f"master returned {status}: {message}")
@@ -83,30 +91,43 @@ class MasterSession:
         return self.request("POST", path, body or {}, retryable=retryable)
 
     # -- convenience wrappers ----------------------------------------------
+    # These run on the GENERATED bindings (api/bindings.py, from
+    # proto/dct/api/v1/api.proto) and convert back to plain dicts so
+    # callers keep the wire shapes. New code can call bindings directly.
 
     def master_info(self) -> Dict[str, Any]:
-        return self.get("/api/v1/master")
+        return _b().get_master(self, _b().V1GetMasterRequest()).to_json()
 
     def create_experiment(self, config: Dict[str, Any],
                           context: Optional[list] = None) -> Dict[str, Any]:
-        body: Dict[str, Any] = {"config": config}
-        if context is not None:
-            body["context"] = context
-        return self.post("/api/v1/experiments", body)["experiment"]
+        b = _b()
+        resp = b.create_experiment(self, b.V1CreateExperimentRequest(
+            config=config, context=context or []))
+        return resp.experiment.to_json()
 
     def list_experiments(self) -> list:
-        return self.get("/api/v1/experiments")["experiments"]
+        b = _b()
+        resp = b.list_experiments(self, b.V1ListExperimentsRequest())
+        return [e.to_json() for e in resp.experiments]
 
     def get_experiment(self, exp_id: int) -> Dict[str, Any]:
-        return self.get(f"/api/v1/experiments/{exp_id}")
+        b = _b()
+        return b.get_experiment(
+            self, b.V1GetExperimentRequest(id=exp_id)).to_json()
 
     def kill_experiment(self, exp_id: int) -> Dict[str, Any]:
-        return self.post(f"/api/v1/experiments/{exp_id}/kill")
+        b = _b()
+        return b.kill_experiment(
+            self, b.V1KillExperimentRequest(id=exp_id)).to_json()
 
     def get_trial(self, trial_id: int) -> Dict[str, Any]:
-        return self.get(f"/api/v1/trials/{trial_id}")["trial"]
+        b = _b()
+        return b.get_trial(self, b.V1GetTrialRequest(id=trial_id)
+                           ).trial.to_json()
 
     def trial_metrics(self, trial_id: int, limit: int = 1000) -> list:
+        # raw dicts, not V1MetricsRecord: metric records carry arbitrary
+        # harness-defined keys the typed message would drop
         return self.get(f"/api/v1/trials/{trial_id}/metrics?limit={limit}")[
             "metrics"]
 
@@ -115,34 +136,51 @@ class MasterSession:
             f"/api/v1/trials/{trial_id}/profiler?limit={limit}")["samples"]
 
     def list_agents(self) -> list:
-        return self.get("/api/v1/agents")["agents"]
+        b = _b()
+        resp = b.list_agents(self, b.V1ListAgentsRequest())
+        return [a.to_json() for a in resp.agents]
 
     def job_queue(self) -> list:
-        return self.get("/api/v1/job-queue")["queue"]
+        b = _b()
+        resp = b.get_job_queue(self, b.V1GetJobQueueRequest())
+        return [t.to_json() for t in resp.queue]
 
     def task_logs(self, allocation_id: str, limit: int = 1000) -> list:
         return self.get(
             f"/api/v1/allocations/{allocation_id}/logs?limit={limit}")["logs"]
+
+    def stream_task_logs(self, allocation_id: str, page_size: int = 1000):
+        """Yield log records, paging until the stream is dry (the REST
+        analogue of the reference's streaming TrialLogs, api.proto:781)."""
+        b = _b()
+        for page in b.get_task_logs(self, b.V1GetTaskLogsRequest(
+                id=allocation_id, limit=page_size)):
+            for rec in page.logs:
+                yield rec.to_json()
 
     # -- NTSC tasks (notebooks/shells/commands/tensorboards) ---------------
 
     def create_task(self, task_type: str, **kwargs: Any) -> Dict[str, Any]:
         """kwargs: name, cmd (argv, command type), slots, resource_pool,
         priority, idle_timeout, env, experiment_ids (tensorboard)."""
-        body = {"type": task_type, **kwargs}
-        return self.post("/api/v1/tasks", body)["task"]
+        b = _b()
+        resp = b.create_task(self, b.V1CreateTaskRequest(
+            type=task_type, **kwargs))
+        return resp.task.to_json()
 
     def list_tasks(self, task_type: Optional[str] = None) -> list:
-        path = "/api/v1/tasks"
-        if task_type:
-            path += f"?type={_q(task_type)}"
-        return self.get(path)["tasks"]
+        b = _b()
+        resp = b.list_tasks(self, b.V1ListTasksRequest(type=task_type or ""))
+        return [t.to_json() for t in resp.tasks]
 
     def get_task(self, task_id: str) -> Dict[str, Any]:
-        return self.get(f"/api/v1/tasks/{task_id}")["task"]
+        b = _b()
+        return b.get_task(self, b.V1GetTaskRequest(id=task_id)).task.to_json()
 
     def kill_task(self, task_id: str) -> Dict[str, Any]:
-        return self.post(f"/api/v1/tasks/{task_id}/kill")["task"]
+        b = _b()
+        return b.kill_task(self, b.V1KillTaskRequest(id=task_id)
+                           ).task.to_json()
 
     def proxy(self, task_id: str, path: str, method: str = "GET",
               body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
@@ -152,34 +190,45 @@ class MasterSession:
     # -- auth / users ------------------------------------------------------
 
     def login(self, username: str, password: str = "") -> Dict[str, Any]:
-        out = self.post("/api/v1/auth/login",
-                        {"username": username, "password": password})
-        self.token = out["token"]
-        return out["user"]
+        b = _b()
+        resp = b.login(self, b.V1LoginRequest(username=username,
+                                              password=password))
+        self.token = resp.token
+        return resp.user.to_json()
 
     def logout(self) -> None:
-        self.post("/api/v1/auth/logout")
+        b = _b()
+        b.logout(self, b.V1LogoutRequest())
         self.token = None
 
     def whoami(self) -> Dict[str, Any]:
-        return self.get("/api/v1/auth/me")["user"]
+        b = _b()
+        return b.get_me(self, b.V1GetMeRequest()).user.to_json()
 
     def create_user(self, username: str, password: str = "", *,
                     admin: bool = False) -> Dict[str, Any]:
-        return self.post("/api/v1/users", {
-            "username": username, "password": password, "admin": admin,
-        })["user"]
+        b = _b()
+        resp = b.create_user(self, b.V1CreateUserRequest(
+            username=username, password=password, admin=admin))
+        return resp.user.to_json()
 
     def list_users(self) -> list:
-        return self.get("/api/v1/users")["users"]
+        b = _b()
+        return [u.to_json() for u in
+                b.list_users(self, b.V1ListUsersRequest()).users]
 
     # -- workspaces / projects ---------------------------------------------
 
     def create_workspace(self, name: str) -> Dict[str, Any]:
-        return self.post("/api/v1/workspaces", {"name": name})["workspace"]
+        b = _b()
+        return b.create_workspace(self, b.V1CreateWorkspaceRequest(
+            name=name)).workspace.to_json()
 
     def list_workspaces(self) -> list:
-        return self.get("/api/v1/workspaces")["workspaces"]
+        b = _b()
+        return [w.to_json() for w in
+                b.list_workspaces(self, b.V1ListWorkspacesRequest()
+                                  ).workspaces]
 
     def get_workspace(self, workspace_id: int) -> Dict[str, Any]:
         return self.get(f"/api/v1/workspaces/{workspace_id}")

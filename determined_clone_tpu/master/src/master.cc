@@ -288,11 +288,17 @@ std::vector<Json> Master::read_jsonl(const std::string& file, size_t limit,
   size_t index = 0;
   while (std::getline(in, line)) {
     if (line.empty()) continue;
-    if (index++ < offset) continue;
+    // the offset cursor counts PARSED records — clients page with
+    // offset += records_received, so a torn/corrupt line must not shift
+    // the cursor (it would duplicate or drop records across pages)
+    Json rec;
     try {
-      out.push_back(Json::parse(line));
+      rec = Json::parse(line);
     } catch (const std::exception&) {
+      continue;
     }
+    if (index++ < offset) continue;
+    out.push_back(std::move(rec));
     if (out.size() >= limit) break;
   }
   return out;

@@ -31,6 +31,21 @@ HttpResponse not_found(const std::string& msg) {
   return HttpResponse::json(404, error_json(msg).dump());
 }
 
+// parses a non-negative integer query param; false = malformed (caller 400s)
+bool parse_size(const std::map<std::string, std::string>& query,
+                const char* key, size_t* out) {
+  auto it = query.find(key);
+  if (it == query.end()) return true;  // absent: keep caller default
+  try {
+    long long v = std::stoll(it->second);
+    if (v < 0) return false;
+    *out = static_cast<size_t>(v);
+  } catch (const std::exception&) {
+    return false;
+  }
+  return true;
+}
+
 std::string url_encode(const std::string& s) {
   static const char* hex = "0123456789ABCDEF";
   std::string out;
@@ -344,7 +359,9 @@ HttpResponse Master::route(const HttpRequest& req) {
         if (exp.state == RunState::Running || exp.state == RunState::Queued) {
           finish_experiment(exp, RunState::Canceled);
         }
-        return ok_json(exp.to_json());
+        Json j = Json::object();
+        j.set("experiment", exp.to_json());
+        return ok_json(j);
       }
       if (parts.size() == 5 && parts[4] == "checkpoints" && req.method == "GET") {
         Json arr = Json::array();
@@ -363,9 +380,11 @@ HttpResponse Master::route(const HttpRequest& req) {
           if (!custom) {
             return bad_request("experiment searcher is not custom");
           }
-          int64_t since = 0;
-          auto sit = req.query.find("since");
-          if (sit != req.query.end()) since = std::stoll(sit->second);
+          size_t since_sz = 0;
+          if (!parse_size(req.query, "since", &since_sz)) {
+            return bad_request("since must be a non-negative integer");
+          }
+          int64_t since = static_cast<int64_t>(since_sz);
           Json j = Json::object();
           j.set("events", custom->events_after(since));
           j.set("state", to_string(exp.state));
@@ -464,8 +483,9 @@ HttpResponse Master::route(const HttpRequest& req) {
       }
       if (req.method == "GET") {
         size_t limit = 1000;
-        auto lim = req.query.find("limit");
-        if (lim != req.query.end()) limit = std::stoul(lim->second);
+        if (!parse_size(req.query, "limit", &limit)) {
+          return bad_request("limit must be a non-negative integer");
+        }
         Json arr = Json::array();
         for (auto& rec : read_jsonl(
                  "trial-" + std::to_string(id) + "-metrics.jsonl", limit)) {
@@ -490,8 +510,9 @@ HttpResponse Master::route(const HttpRequest& req) {
       }
       if (req.method == "GET") {
         size_t limit = 1000;
-        auto lim = req.query.find("limit");
-        if (lim != req.query.end()) limit = std::stoul(lim->second);
+        if (!parse_size(req.query, "limit", &limit)) {
+          return bad_request("limit must be a non-negative integer");
+        }
         Json arr = Json::array();
         // tail: live monitoring wants the NEWEST samples, and without it
         // anything past the first `limit` records would be unreachable
@@ -880,10 +901,14 @@ HttpResponse Master::route(const HttpRequest& req) {
       }
       if (req.method == "GET") {
         size_t limit = 1000;
-        auto lim = req.query.find("limit");
-        if (lim != req.query.end()) limit = std::stoul(lim->second);
+        size_t offset = 0;  // stream cursor (generated bindings page with it)
+        if (!parse_size(req.query, "limit", &limit) ||
+            !parse_size(req.query, "offset", &offset)) {
+          return bad_request("limit/offset must be non-negative integers");
+        }
         Json arr = Json::array();
-        for (auto& rec : read_jsonl("task-" + alloc_id + "-logs.jsonl", limit)) {
+        for (auto& rec : read_jsonl("task-" + alloc_id + "-logs.jsonl", limit,
+                                    offset)) {
           arr.push_back(rec);
         }
         Json j = Json::object();
