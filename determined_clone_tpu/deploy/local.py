@@ -68,7 +68,11 @@ def cluster_up(*, n_agents: int = 1, slots_per_agent: int = 1,
 
     master_args = [str(MASTER_BIN), "--port", str(port),
                    "--data-dir", str(base / "master-data"),
-                   "--scheduler", scheduler]
+                   "--scheduler", scheduler,
+                   # absolute: the default "webui" is cwd-relative and the
+                   # deployed master's cwd is wherever the user launched from
+                   "--webui-dir",
+                   str(MASTER_DIR.parent.parent / "webui")]
     if auth_required:
         master_args.append("--auth-required")
     master_log = open(base / "logs" / "master.log", "ab")

@@ -28,10 +28,13 @@ int main(int argc, char** argv) {
       config.agent_timeout_sec = std::atof(argv[++i]);
     } else if (!std::strcmp(argv[i], "--auth-required")) {
       config.auth_required = true;
+    } else if (!std::strcmp(argv[i], "--webui-dir") && i + 1 < argc) {
+      config.webui_dir = argv[++i];
     } else if (!std::strcmp(argv[i], "--help")) {
       std::cout << "usage: dct-master [--port N] [--data-dir DIR] "
                    "[--scheduler fifo|priority|fair_share] "
-                   "[--agent-timeout SEC] [--auth-required]\n";
+                   "[--agent-timeout SEC] [--auth-required] "
+                   "[--webui-dir DIR]\n";
       return 0;
     }
   }

@@ -36,6 +36,8 @@ struct MasterConfig {
   // (the reference gives those their own allocation tokens)
   bool auth_required = false;
   double session_ttl_sec = 7 * 24 * 3600;
+  // static WebUI assets directory ("" disables); served at / and /ui/*
+  std::string webui_dir = "webui";
 };
 
 class Master {
@@ -83,6 +85,9 @@ class Master {
   HttpResponse proxy_route(const HttpRequest& req);
   // GET /metrics — Prometheus text exposition of cluster state gauges
   HttpResponse metrics_route();
+  // GET / and /ui/* — WebUI static assets (webui/, served by the master the
+  // way the reference master serves the built React bundle)
+  HttpResponse static_route(const HttpRequest& req);
   // platform-breadth routes: auth/users, workspaces/projects, model
   // registry, templates, webhooks (routes_platform.cc). Returns nullopt when
   // the path is not one of its roots.

@@ -4,6 +4,8 @@
 // (proto/src/determined/api/v1/api.proto:79): experiments, trials, metrics,
 // searcher ops, checkpoints, agents, allocations (rendezvous/preemption),
 // task logs, job queue, master info.
+#include <sys/stat.h>
+
 #include <algorithm>
 #include <cctype>
 #include <fstream>
@@ -72,6 +74,11 @@ HttpResponse Master::handle(const HttpRequest& req) {
         req.method == "GET") {
       return metrics_route();
     }
+    if (req.method == "GET" && !config_.webui_dir.empty() &&
+        (req.path == "/" ||
+         (!req.path_parts.empty() && req.path_parts[0] == "ui"))) {
+      return static_route(req);
+    }
     return route(req);
   } catch (const std::exception& e) {
     return HttpResponse::json(500, error_json(e.what()).dump());
@@ -128,6 +135,47 @@ HttpResponse Master::metrics_route() {
   resp.status = 200;
   resp.content_type = "text/plain; version=0.0.4";
   resp.body = out.str();
+  return resp;
+}
+
+// WebUI static assets. The reference master embeds and serves the built
+// React bundle (master/internal/core.go webui routes); here the master
+// serves the dependency-free vanilla bundle from webui/ on disk.
+HttpResponse Master::static_route(const HttpRequest& req) {
+  std::string rel = "index.html";
+  if (req.path != "/") {
+    // "/ui/<file...>" — rebuild from decoded parts, skipping the "ui" root
+    rel.clear();
+    for (size_t i = 1; i < req.path_parts.size(); ++i) {
+      if (!rel.empty()) rel += "/";
+      rel += req.path_parts[i];
+    }
+  }
+  // traversal guard: no "..", no absolute, no empty
+  if (rel.empty() || rel[0] == '/' || rel.find("..") != std::string::npos) {
+    return not_found("no asset " + req.path);
+  }
+  const std::string full = config_.webui_dir + "/" + rel;
+  struct stat st {};
+  if (::stat(full.c_str(), &st) != 0 || !S_ISREG(st.st_mode)) {
+    return not_found("no asset " + req.path);  // directories are not assets
+  }
+  std::ifstream in(full, std::ios::binary);
+  if (!in.good()) return not_found("no asset " + req.path);
+  std::stringstream buf;
+  buf << in.rdbuf();
+  HttpResponse resp;
+  resp.status = 200;
+  resp.body = buf.str();
+  auto dot = rel.rfind('.');
+  const std::string ext = dot == std::string::npos ? "" : rel.substr(dot);
+  if (ext == ".html") resp.content_type = "text/html; charset=utf-8";
+  else if (ext == ".js") resp.content_type = "text/javascript";
+  else if (ext == ".css") resp.content_type = "text/css";
+  else if (ext == ".svg") resp.content_type = "image/svg+xml";
+  else if (ext == ".json") resp.content_type = "application/json";
+  else if (ext == ".png") resp.content_type = "image/png";
+  else resp.content_type = "application/octet-stream";
   return resp;
 }
 

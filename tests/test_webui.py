@@ -1,0 +1,115 @@
+"""WebUI: the master serves the static bundle and the app's API surface.
+
+≈ the reference's webui smoke coverage: assets load from the master, content
+types are right, path traversal is blocked, and the pages' API calls return
+the shapes the views render.
+"""
+import json
+import subprocess
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+MASTER_DIR = REPO / "determined_clone_tpu" / "master"
+MASTER_BIN = MASTER_DIR / "build" / "dct-master"
+WEBUI_DIR = REPO / "webui"
+
+
+@pytest.fixture(scope="module")
+def master(tmp_path_factory):
+    if not MASTER_BIN.exists():
+        r = subprocess.run(["make", "-C", str(MASTER_DIR)],
+                           capture_output=True)
+        if r.returncode != 0:
+            pytest.skip("C++ master build unavailable")
+    tmp = tmp_path_factory.mktemp("webui")
+
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [str(MASTER_BIN), "--port", str(port), "--data-dir", str(tmp / "data"),
+         "--webui-dir", str(WEBUI_DIR)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/v1/master", timeout=2)
+            break
+        except Exception:
+            time.sleep(0.2)
+    else:
+        proc.kill()
+        pytest.fail("master did not come up")
+    yield port
+    proc.kill()
+    proc.wait(timeout=10)
+
+
+def fetch(port, path):
+    resp = urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5)
+    return resp.status, resp.headers.get("Content-Type", ""), resp.read()
+
+
+def test_index_served_at_root(master):
+    status, ctype, body = fetch(master, "/")
+    assert status == 200 and ctype.startswith("text/html")
+    assert b"DCT" in body and b"/ui/app.js" in body
+
+
+def test_assets_with_content_types(master):
+    status, ctype, body = fetch(master, "/ui/app.js")
+    assert status == 200 and ctype == "text/javascript"
+    assert b"lineChart" in body
+    status, ctype, body = fetch(master, "/ui/style.css")
+    assert status == 200 and ctype == "text/css"
+    assert b"--series-1" in body
+    status, ctype, body = fetch(master, "/ui/index.html")
+    assert status == 200 and ctype.startswith("text/html")
+
+
+def test_traversal_blocked(master):
+    # encoded and raw traversal must 404, never escape webui/
+    for path in ("/ui/..%2F..%2Fbench.py", "/ui/%2e%2e/secrets",
+                 "/ui/x/%2e%2e/%2e%2e/bench.py"):
+        try:
+            status, _, body = fetch(master, path)
+        except urllib.error.HTTPError as e:
+            status, body = e.code, e.read()
+        assert status == 404, (path, body[:100])
+        assert b"import" not in body
+
+
+def test_unknown_asset_404(master):
+    with pytest.raises(urllib.error.HTTPError) as err:
+        fetch(master, "/ui/nope.js")
+    assert err.value.code == 404
+
+
+def test_directory_is_not_an_asset(master):
+    # "." resolves to the webui dir itself: must 404, not 200-empty
+    with pytest.raises(urllib.error.HTTPError) as err:
+        fetch(master, "/ui/%2e")
+    assert err.value.code == 404
+
+
+def test_view_api_shapes(master):
+    """Each view's fetches return the keys the JS renders."""
+    _, _, body = fetch(master, "/api/v1/master")
+    info = json.loads(body)
+    assert {"version", "cluster_name", "agents"} <= set(info)
+    _, _, body = fetch(master, "/api/v1/experiments")
+    assert "experiments" in json.loads(body)
+    _, _, body = fetch(master, "/api/v1/agents")
+    assert "agents" in json.loads(body)
+    _, _, body = fetch(master, "/api/v1/job-queue")
+    assert "queue" in json.loads(body)
+    _, _, body = fetch(master, "/api/v1/tasks")
+    assert "tasks" in json.loads(body)

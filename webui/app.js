@@ -1,0 +1,441 @@
+/* DCT WebUI application — hash-routed views over /api/v1.
+   Views: dashboard, experiments list, experiment detail (live metrics
+   chart), tasks + task logs, cluster. Auth: bearer token in localStorage,
+   login modal on 401. */
+"use strict";
+
+const $view = document.getElementById("view");
+const SERIES = ["--series-1", "--series-2", "--series-3", "--series-4",
+                "--series-5", "--series-6", "--series-7", "--series-8"];
+const REFRESH_MS = 3000;
+let refreshTimer = null;
+// render generation: navigating bumps it; a view checks it after every await
+// so a stale in-flight render can't clobber the current view or steal the
+// refresh timer
+let renderGen = 0;
+
+// ---------------------------------------------------------------------------
+// api client
+// ---------------------------------------------------------------------------
+
+async function api(method, path, body) {
+  const headers = { "Content-Type": "application/json" };
+  const token = localStorage.getItem("dct-token");
+  if (token) headers["Authorization"] = "Bearer " + token;
+  const resp = await fetch(path, {
+    method, headers, body: body ? JSON.stringify(body) : undefined,
+  });
+  if (resp.status === 401) {
+    showLogin();
+    throw new Error("authentication required");
+  }
+  const out = await resp.json();
+  if (!resp.ok) throw new Error(out.error || resp.statusText);
+  return out;
+}
+
+function showLogin() {
+  document.getElementById("login").classList.remove("hidden");
+}
+
+document.getElementById("login-form").addEventListener("submit", async (e) => {
+  e.preventDefault();
+  const form = new FormData(e.target);
+  try {
+    const out = await api("POST", "/api/v1/auth/login", {
+      username: form.get("username"), password: form.get("password"),
+    });
+    localStorage.setItem("dct-token", out.token);
+    document.getElementById("whoami").textContent = out.user.username;
+    document.getElementById("login").classList.add("hidden");
+    route();
+  } catch (err) {
+    document.getElementById("login-error").textContent = String(err.message);
+  }
+});
+
+// ---------------------------------------------------------------------------
+// svg line chart (dependency-free; tokens from style.css)
+// ---------------------------------------------------------------------------
+
+function colorOf(i) {
+  return getComputedStyle(document.documentElement)
+      .getPropertyValue(SERIES[i % SERIES.length]).trim();
+}
+
+// series: [{name, points: [[x, y], ...]}]; renders into `el`
+function lineChart(el, title, series) {
+  // the live views re-render every few seconds: drop stale tooltip nodes
+  document.querySelectorAll(".chart-tooltip").forEach((t) => t.remove());
+  el.innerHTML = "";
+  el.className = "chart-box";
+  const titleEl = document.createElement("div");
+  titleEl.className = "chart-title";
+  titleEl.textContent = title;
+  el.appendChild(titleEl);
+
+  const drawn = series.filter((s) => s.points.length > 0).slice(0, 8);
+  if (!drawn.length) {
+    const empty = document.createElement("div");
+    empty.className = "muted";
+    empty.textContent = "no data yet";
+    el.appendChild(empty);
+    return;
+  }
+  if (drawn.length > 1) {  // single series: the title names it, no legend box
+    const legend = document.createElement("div");
+    legend.className = "legend";
+    drawn.forEach((s, i) => {
+      const item = document.createElement("span");
+      const sw = document.createElement("span");
+      sw.className = "swatch";
+      sw.style.background = colorOf(i);
+      item.appendChild(sw);
+      item.appendChild(document.createTextNode(s.name));
+      legend.appendChild(item);
+    });
+    if (series.length > 8) {
+      const more = document.createElement("span");
+      more.className = "muted";
+      more.textContent = `+${series.length - 8} more`;
+      legend.appendChild(more);
+    }
+    el.appendChild(legend);
+  }
+
+  const W = 820, H = 260, PAD = { l: 56, r: 16, t: 10, b: 28 };
+  const xs = drawn.flatMap((s) => s.points.map((p) => p[0]));
+  const ys = drawn.flatMap((s) => s.points.map((p) => p[1]));
+  const xmin = Math.min(...xs), xmax = Math.max(...xs);
+  const ymin = Math.min(...ys), ymax = Math.max(...ys);
+  const xpad = xmax === xmin ? 1 : 0;
+  const ypad = (ymax - ymin || Math.abs(ymax) || 1) * 0.08;
+  const X = (v) => PAD.l + ((v - xmin) / (xmax - xmin + xpad)) * (W - PAD.l - PAD.r);
+  const Y = (v) => H - PAD.b - ((v - (ymin - ypad)) / ((ymax + ypad) - (ymin - ypad))) * (H - PAD.t - PAD.b);
+
+  const svg = document.createElementNS("http://www.w3.org/2000/svg", "svg");
+  svg.setAttribute("viewBox", `0 0 ${W} ${H}`);
+  svg.style.width = "100%";
+  const mk = (tag, attrs, text) => {
+    const node = document.createElementNS("http://www.w3.org/2000/svg", tag);
+    for (const [k, v] of Object.entries(attrs)) node.setAttribute(k, v);
+    if (text !== undefined) node.textContent = text;
+    svg.appendChild(node);
+    return node;
+  };
+
+  // recessive horizontal grid + y labels
+  const ticks = 4;
+  for (let i = 0; i <= ticks; i++) {
+    const v = (ymin - ypad) + (i / ticks) * ((ymax + ypad) - (ymin - ypad));
+    const y = Y(v);
+    mk("line", { x1: PAD.l, x2: W - PAD.r, y1: y, y2: y, class: "grid-line" });
+    mk("text", { x: PAD.l - 8, y: y + 4, "text-anchor": "end" },
+       Math.abs(v) >= 1000 ? v.toExponential(1) : v.toPrecision(3));
+  }
+  mk("line", { x1: PAD.l, x2: W - PAD.r, y1: H - PAD.b, y2: H - PAD.b,
+               class: "axis-line" });
+  // x labels (min / mid / max)
+  [xmin, (xmin + xmax) / 2, xmax].forEach((v) => {
+    mk("text", { x: X(v), y: H - 8, "text-anchor": "middle" }, Math.round(v));
+  });
+
+  // 2px series lines (thin marks; color carries identity, text stays ink)
+  drawn.forEach((s, i) => {
+    const d = s.points.map((p) => `${X(p[0]).toFixed(1)},${Y(p[1]).toFixed(1)}`)
+        .join(" ");
+    mk("polyline", { points: d, fill: "none", stroke: colorOf(i),
+                     "stroke-width": 2, "stroke-linejoin": "round" });
+    // selective direct label at the line end (≤4 series)
+    if (drawn.length <= 4) {
+      const last = s.points[s.points.length - 1];
+      mk("text", { x: Math.min(X(last[0]) + 5, W - 4), y: Y(last[1]) + 4 },
+         s.name);
+    }
+  });
+
+  // hover layer: crosshair + tooltip at nearest x
+  const crosshair = mk("line", { y1: PAD.t, y2: H - PAD.b, class: "crosshair",
+                                 visibility: "hidden" });
+  const tooltip = document.createElement("div");
+  tooltip.className = "chart-tooltip";
+  tooltip.style.display = "none";
+  document.body.appendChild(tooltip);
+  svg.addEventListener("mousemove", (e) => {
+    const rect = svg.getBoundingClientRect();
+    const px = ((e.clientX - rect.left) / rect.width) * W;
+    const xv = xmin + ((px - PAD.l) / (W - PAD.l - PAD.r)) * (xmax - xmin + xpad);
+    let best = null;
+    for (const s of drawn) {
+      for (const p of s.points) {
+        if (best === null || Math.abs(p[0] - xv) < Math.abs(best - xv)) best = p[0];
+      }
+    }
+    if (best === null) return;
+    crosshair.setAttribute("x1", X(best));
+    crosshair.setAttribute("x2", X(best));
+    crosshair.setAttribute("visibility", "visible");
+    const rows = drawn
+        .map((s, i) => ({ s, i, p: s.points.find((p) => p[0] === best) }))
+        .filter((r) => r.p);
+    tooltip.innerHTML = "";
+    const step = document.createElement("div");
+    step.className = "t-step";
+    step.textContent = `step ${best}`;
+    tooltip.appendChild(step);
+    rows.forEach(({ s, i, p }) => {
+      const row = document.createElement("div");
+      const sw = document.createElement("span");
+      sw.className = "swatch";
+      sw.style.background = colorOf(i);
+      row.appendChild(sw);
+      row.appendChild(document.createTextNode(
+          ` ${s.name}: ${Number(p[1]).toPrecision(5)}`));
+      tooltip.appendChild(row);
+    });
+    tooltip.style.display = "block";
+    tooltip.style.left = Math.min(e.clientX + 14, window.innerWidth - 180) + "px";
+    tooltip.style.top = (e.clientY + 10) + "px";
+  });
+  svg.addEventListener("mouseleave", () => {
+    crosshair.setAttribute("visibility", "hidden");
+    tooltip.style.display = "none";
+  });
+
+  el.appendChild(svg);
+}
+
+// ---------------------------------------------------------------------------
+// views
+// ---------------------------------------------------------------------------
+
+function stateBadge(state) {
+  return `<span class="state state-${state}">${state}</span>`;
+}
+
+function card(num, label) {
+  return `<div class="card"><div class="num">${num}</div>` +
+         `<div class="label">${label}</div></div>`;
+}
+
+function esc(s) {
+  return String(s).replace(/[&<>"]/g,
+      (c) => ({ "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;" }[c]));
+}
+
+async function viewDashboard() {
+  const gen = renderGen;
+  const [info, exps, agents] = await Promise.all([
+    api("GET", "/api/v1/master"),
+    api("GET", "/api/v1/experiments"),
+    api("GET", "/api/v1/agents"),
+  ]);
+  if (gen !== renderGen) return;
+  const active = exps.experiments.filter((e) => e.state === "RUNNING").length;
+  const slots = agents.agents.reduce((n, a) => n + (a.enabled ? a.slots : 0), 0);
+  const recent = exps.experiments.slice(-8).reverse();
+  $view.innerHTML = `
+    <h1>Dashboard <span class="muted">· cluster ${esc(info.cluster_name)}
+      v${esc(info.version)}</span></h1>
+    <div class="cards">
+      ${card(exps.experiments.length, "experiments")}
+      ${card(active, "running")}
+      ${card(agents.agents.length, "agents")}
+      ${card(slots, "slots")}
+    </div>
+    <h2>Recent experiments</h2>
+    ${experimentTable(recent)}`;
+  bindRowLinks();
+}
+
+function experimentTable(exps) {
+  if (!exps.length) return `<p class="muted">no experiments</p>`;
+  return `<table><tr><th>ID</th><th>Name</th><th>State</th><th>Owner</th>
+    <th>Workspace</th></tr>
+    ${exps.map((e) => `<tr class="rowlink" data-href="#/experiments/${e.id}">
+      <td>${e.id}</td><td>${esc(e.name)}</td><td>${stateBadge(e.state)}</td>
+      <td>${esc(e.owner)}</td><td>${esc(e.workspace)}</td></tr>`).join("")}
+  </table>`;
+}
+
+async function viewExperiments() {
+  const gen = renderGen;
+  const out = await api("GET", "/api/v1/experiments");
+  if (gen !== renderGen) return;
+  $view.innerHTML = `<h1>Experiments</h1>
+    ${experimentTable(out.experiments.slice().reverse())}`;
+  bindRowLinks();
+}
+
+async function viewExperimentDetail(id) {
+  const gen = renderGen;
+  const detail = await api("GET", `/api/v1/experiments/${id}`);
+  if (gen !== renderGen) return;
+  const exp = detail.experiment;
+  const trials = detail.trials || [];
+  const metric = (exp.config.searcher || {}).metric || "loss";
+  $view.innerHTML = `
+    <a class="backlink" href="#/experiments">← experiments</a>
+    <h1>${esc(exp.name)} <span class="muted">#${exp.id}</span>
+      ${stateBadge(exp.state)}</h1>
+    <div class="cards">
+      ${card(trials.length, "trials")}
+      ${card(detail.progress !== undefined
+             ? Math.round(detail.progress * 100) + "%" : "—", "progress")}
+      ${card(esc((exp.config.searcher || {}).name || "single"), "searcher")}
+    </div>
+    <div id="chart"></div>
+    <h2>Trials</h2>
+    <table><tr><th>ID</th><th>State</th><th>Units</th>
+      <th>Best ${esc(metric)}</th><th>Restarts</th><th>Hparams</th></tr>
+      ${trials.map((t) => `<tr>
+        <td>${t.id}</td><td>${stateBadge(t.state)}</td>
+        <td>${t.units_done}/${t.target_units}</td>
+        <td>${t.has_metric ? Number(t.best_metric).toPrecision(5) : "—"}</td>
+        <td>${t.restarts}</td>
+        <td class="muted">${esc(JSON.stringify(t.hparams))}</td></tr>`).join("")}
+    </table>`;
+
+  // live metrics: searcher-metric series per trial (validation group),
+  // fetched concurrently and reused for the training-loss fallback
+  const shown = trials.slice(0, 8);
+  const fetched = await Promise.all(shown.map((t) =>
+      api("GET", `/api/v1/trials/${t.id}/metrics?limit=5000`)));
+  if (gen !== renderGen) return;
+  let chartMetric = `${metric} (validation)`;
+  let series = shown.map((t, i) => ({
+    name: `trial ${t.id}`,
+    points: fetched[i].metrics
+        .filter((r) => r.group === "validation" && metric in (r.metrics || {}))
+        .map((r, j) => [r.steps_completed || j, r.metrics[metric]]),
+  }));
+  if (series.every((s) => !s.points.length)) {
+    // no validation series yet — fall back to training loss (same payloads)
+    chartMetric = "loss (training)";
+    series = shown.map((t, i) => ({
+      name: `trial ${t.id}`,
+      points: fetched[i].metrics
+          .filter((r) => r.group === "training" &&
+                         (r.metrics || {}).loss !== undefined)
+          .map((r, j) => [r.steps_completed || j, r.metrics.loss]),
+    }));
+  }
+  lineChart(document.getElementById("chart"),
+            `${chartMetric} by step`, series);
+  scheduleRefresh(() => viewExperimentDetail(id),
+                  ["RUNNING", "QUEUED"].includes(exp.state));
+}
+
+async function viewTasks() {
+  const gen = renderGen;
+  const out = await api("GET", "/api/v1/tasks");
+  if (gen !== renderGen) return;
+  const tasks = out.tasks.slice().reverse();
+  $view.innerHTML = `<h1>Tasks</h1>
+    ${tasks.length ? `<table><tr><th>ID</th><th>Type</th><th>Name</th>
+      <th>State</th><th>Owner</th></tr>
+      ${tasks.map((t) => `<tr class="rowlink" data-href="#/tasks/${t.id}">
+        <td>${esc(t.id)}</td><td>${esc(t.task_type)}</td><td>${esc(t.name)}</td>
+        <td>${stateBadge(t.state)}</td><td>${esc(t.owner)}</td></tr>`).join("")}
+      </table>` : `<p class="muted">no tasks</p>`}`;
+  bindRowLinks();
+}
+
+async function viewTaskLogs(id) {
+  const gen = renderGen;
+  const [task, logs] = await Promise.all([
+    api("GET", `/api/v1/tasks/${id}`),
+    api("GET", `/api/v1/allocations/${id}/logs?limit=2000`),
+  ]);
+  if (gen !== renderGen) return;
+  const lines = logs.logs.map((r) =>
+      typeof r.log === "string" ? r.log : JSON.stringify(r.log));
+  $view.innerHTML = `
+    <a class="backlink" href="#/tasks">← tasks</a>
+    <h1>${esc(task.task.name)} <span class="muted">${esc(id)}</span>
+      ${stateBadge(task.task.state)}</h1>
+    <h2>Logs</h2>
+    <pre class="logs">${esc(lines.join("\n")) || "no logs yet"}</pre>`;
+  scheduleRefresh(() => viewTaskLogs(id),
+                  ["RUNNING", "PULLING", "QUEUED"].includes(task.task.state));
+}
+
+async function viewCluster() {
+  const gen = renderGen;
+  const [agents, queue] = await Promise.all([
+    api("GET", "/api/v1/agents"),
+    api("GET", "/api/v1/job-queue"),
+  ]);
+  if (gen !== renderGen) return;
+  $view.innerHTML = `<h1>Cluster</h1>
+    <h2>Agents</h2>
+    ${agents.agents.length ? `<table><tr><th>ID</th><th>Pool</th><th>Slots</th>
+      <th>Topology</th><th>Enabled</th><th>Last heartbeat</th></tr>
+      ${agents.agents.map((a) => `<tr><td>${esc(a.id)}</td>
+        <td>${esc(a.resource_pool)}</td><td>${a.slots}</td>
+        <td>${esc(a.topology)}</td><td>${a.enabled ? "yes" : "no"}</td>
+        <td class="muted">${new Date(a.last_heartbeat * 1000)
+            .toLocaleTimeString()}</td></tr>`).join("")}
+      </table>` : `<p class="muted">no agents registered</p>`}
+    <h2>Job queue</h2>
+    ${queue.queue.length ? `<table><tr><th>ID</th><th>Type</th><th>State</th>
+      <th>Slots</th><th>Priority</th><th>Pool</th></tr>
+      ${queue.queue.map((j) => `<tr><td>${esc(j.id)}</td>
+        <td>${esc(j.task_type)}</td><td>${stateBadge(j.state)}</td>
+        <td>${j.slots}</td><td>${j.priority}</td>
+        <td>${esc(j.resource_pool)}</td></tr>`).join("")}
+      </table>` : `<p class="muted">queue is empty</p>`}`;
+  scheduleRefresh(viewCluster, true);
+}
+
+// ---------------------------------------------------------------------------
+// router + refresh
+// ---------------------------------------------------------------------------
+
+function bindRowLinks() {
+  $view.querySelectorAll("tr.rowlink").forEach((tr) => {
+    tr.addEventListener("click", () => { location.hash = tr.dataset.href.slice(1); });
+  });
+}
+
+function scheduleRefresh(fn, active) {
+  if (refreshTimer) clearTimeout(refreshTimer);
+  if (active) refreshTimer = setTimeout(fn, REFRESH_MS);
+}
+
+async function route() {
+  renderGen++;
+  if (refreshTimer) clearTimeout(refreshTimer);
+  const hash = location.hash || "#/dashboard";
+  const parts = hash.slice(2).split("/");
+  document.querySelectorAll("nav a").forEach((a) => {
+    a.classList.toggle("active", a.dataset.nav === parts[0]);
+  });
+  try {
+    if (parts[0] === "experiments" && parts[1]) {
+      await viewExperimentDetail(parts[1]);
+    } else if (parts[0] === "experiments") {
+      await viewExperiments();
+    } else if (parts[0] === "tasks" && parts[1]) {
+      await viewTaskLogs(parts.slice(1).join("/"));
+    } else if (parts[0] === "tasks") {
+      await viewTasks();
+    } else if (parts[0] === "cluster") {
+      await viewCluster();
+    } else {
+      await viewDashboard();
+    }
+  } catch (err) {
+    if (String(err.message) !== "authentication required") {
+      $view.innerHTML = `<p class="error">${esc(err.message)}</p>`;
+    }
+  }
+}
+
+window.addEventListener("hashchange", route);
+api("GET", "/api/v1/auth/me")
+    .then((out) => {
+      document.getElementById("whoami").textContent = out.user.username;
+    })
+    .catch(() => {})  // anonymous is fine when auth is off
+    .finally(route);
