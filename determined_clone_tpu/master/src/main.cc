@@ -1,4 +1,5 @@
 // determined-clone-tpu master binary (≈ master/cmd/determined-master/main.go:9).
+#include <algorithm>
 #include <chrono>
 #include <csignal>
 #include <cstdlib>
@@ -30,11 +31,37 @@ int main(int argc, char** argv) {
       config.auth_required = true;
     } else if (!std::strcmp(argv[i], "--webui-dir") && i + 1 < argc) {
       config.webui_dir = argv[++i];
+    } else if (!std::strcmp(argv[i], "--provision-accelerator") &&
+               i + 1 < argc) {
+      config.provisioner.enabled = true;
+      config.provisioner.accelerator_type = argv[++i];
+    } else if (!std::strcmp(argv[i], "--provision-zone") && i + 1 < argc) {
+      config.provisioner.zone = argv[++i];
+    } else if (!std::strcmp(argv[i], "--provision-project") && i + 1 < argc) {
+      config.provisioner.project = argv[++i];
+    } else if (!std::strcmp(argv[i], "--provision-slots") && i + 1 < argc) {
+      config.provisioner.slots_per_instance = std::max(1, std::atoi(argv[++i]));
+    } else if (!std::strcmp(argv[i], "--provision-min") && i + 1 < argc) {
+      config.provisioner.min_instances = std::atoi(argv[++i]);
+    } else if (!std::strcmp(argv[i], "--provision-max") && i + 1 < argc) {
+      config.provisioner.max_instances = std::atoi(argv[++i]);
+    } else if (!std::strcmp(argv[i], "--provision-idle-timeout") &&
+               i + 1 < argc) {
+      config.provisioner.idle_timeout_sec = std::atof(argv[++i]);
+    } else if (!std::strcmp(argv[i], "--provision-cooldown") && i + 1 < argc) {
+      config.provisioner.cooldown_sec = std::atof(argv[++i]);
+    } else if (!std::strcmp(argv[i], "--provision-live")) {
+      config.provisioner.dry_run = false;  // actually exec gcloud
     } else if (!std::strcmp(argv[i], "--help")) {
       std::cout << "usage: dct-master [--port N] [--data-dir DIR] "
                    "[--scheduler fifo|priority|fair_share] "
                    "[--agent-timeout SEC] [--auth-required] "
-                   "[--webui-dir DIR]\n";
+                   "[--webui-dir DIR] "
+                   "[--provision-accelerator TYPE [--provision-zone Z] "
+                   "[--provision-project P] [--provision-slots N] "
+                   "[--provision-min N] [--provision-max N] "
+                   "[--provision-idle-timeout SEC] "
+                   "[--provision-cooldown SEC] [--provision-live]]\n";
       return 0;
     }
   }

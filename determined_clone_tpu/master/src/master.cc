@@ -40,6 +40,16 @@ void mkdirs(const std::string& path) {
 Master::Master(MasterConfig config) : config_(std::move(config)) {
   server_ = std::make_unique<HttpServer>(
       [this](const HttpRequest& req) { return handle(req); });
+  if (config_.provisioner.enabled) {
+    std::unique_ptr<CloudClient> client;
+    if (config_.provisioner.dry_run) {
+      client = std::make_unique<RecordingClient>();
+    } else {
+      client = std::make_unique<GcloudTpuVmClient>();
+    }
+    provisioner_ = std::make_unique<Provisioner>(config_.provisioner,
+                                                 std::move(client));
+  }
 }
 
 Master::~Master() { stop(); }
@@ -808,6 +818,41 @@ void Master::tick_locked() {
       Allocation& alloc = allocations_[victim];
       if (!alloc.preempt_requested) {
         alloc.preempt_requested = true;
+        dirty_ = true;
+      }
+    }
+  }
+
+  // TPU-VM autoscaling: feed the provisioner the post-scheduling view of
+  // its pool — still-queued slots, free chips, idle agents — and disable
+  // agents it terminates so the scheduler stops placing on dying slices
+  // (≈ provisioner.go Schedule → scaleDecider.calculate)
+  if (provisioner_) {
+    const std::string& pool = provisioner_->config().resource_pool;
+    ClusterView view;
+    view.now = now;
+    for (const auto& alloc : pool_pending[pool]) {
+      if (alloc.reservations.empty() &&
+          allocations_[alloc.id].state == RunState::Queued) {
+        view.pending_slots += std::max(alloc.slots, 1);
+      }
+    }
+    std::set<std::string> busy;
+    for (const auto& [id, alloc] : allocations_) {
+      if (alloc.state == RunState::Running || alloc.state == RunState::Pulling) {
+        for (const auto& [aid, n] : alloc.reservations) busy.insert(aid);
+      }
+    }
+    for (const auto& agent : pool_agents[pool]) {
+      view.agent_ids.insert(agent.id);
+      view.free_slots += std::max(0, pool_free[pool][agent.id]);
+      if (!busy.count(agent.id)) view.idle_agent_ids.insert(agent.id);
+    }
+    ScaleDecision scale = provisioner_->step(view);
+    for (const auto& name : scale.terminate) {
+      auto it = agents_.find(name);
+      if (it != agents_.end()) {
+        it->second.enabled = false;
         dirty_ = true;
       }
     }

@@ -20,6 +20,7 @@
 #include "json.h"
 #include "model.h"
 #include "platform.h"
+#include "provisioner.h"
 #include "scheduler.h"
 #include "searcher.h"
 
@@ -38,6 +39,8 @@ struct MasterConfig {
   double session_ttl_sec = 7 * 24 * 3600;
   // static WebUI assets directory ("" disables); served at / and /ui/*
   std::string webui_dir = "webui";
+  // TPU-VM autoscaling (provisioner.h); disabled unless enabled=true
+  ProvisionerConfig provisioner;
 };
 
 class Master {
@@ -119,6 +122,7 @@ class Master {
   std::unique_ptr<HttpServer> server_;
   std::thread tick_thread_;
   std::atomic<bool> running_{false};
+  std::unique_ptr<Provisioner> provisioner_;  // null unless enabled
 
   std::mutex mu_;
   int64_t next_experiment_id_ = 1;

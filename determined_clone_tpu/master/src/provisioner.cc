@@ -1,0 +1,241 @@
+#include "provisioner.h"
+
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <iostream>
+#include <thread>
+
+#include "crypto.h"
+
+namespace dct {
+namespace {
+
+std::vector<std::string> gcloud_argv(const std::string& verb,
+                                     const std::string& name,
+                                     const ProvisionerConfig& cfg) {
+  std::vector<std::string> argv = {
+      "gcloud", "compute", "tpus", "tpu-vm", verb, name,
+      "--zone", cfg.zone, "--quiet",
+  };
+  if (verb == "create") {
+    argv.push_back("--accelerator-type");
+    argv.push_back(cfg.accelerator_type);
+    argv.push_back("--version");
+    argv.push_back(cfg.runtime_version);
+  }
+  if (!cfg.project.empty()) {
+    argv.push_back("--project");
+    argv.push_back(cfg.project);
+  }
+  return argv;
+}
+
+std::string join(const std::vector<std::string>& parts) {
+  std::string out;
+  for (const auto& p : parts) {
+    if (!out.empty()) out += " ";
+    out += p;
+  }
+  return out;
+}
+
+void exec_detached(std::vector<std::string> argv) {
+  // fork/exec on a detached thread: `gcloud tpus tpu-vm create` blocks for
+  // minutes and the caller is the master tick
+  std::thread([argv = std::move(argv)]() {
+    pid_t pid = ::fork();
+    if (pid == 0) {
+      std::vector<char*> cargv;
+      for (const auto& a : argv) cargv.push_back(const_cast<char*>(a.c_str()));
+      cargv.push_back(nullptr);
+      ::execvp(cargv[0], cargv.data());
+      std::_Exit(127);
+    }
+    if (pid < 0) {
+      std::cerr << "[provisioner] fork failed for: " << join(argv)
+                << std::endl;
+      return;
+    }
+    int status = 0;
+    ::waitpid(pid, &status, 0);
+    if (WIFEXITED(status) && WEXITSTATUS(status) != 0) {
+      std::cerr << "[provisioner] command exited " << WEXITSTATUS(status)
+                << ": " << join(argv) << std::endl;
+    } else if (WIFSIGNALED(status)) {
+      std::cerr << "[provisioner] command killed by signal "
+                << WTERMSIG(status) << ": " << join(argv) << std::endl;
+    }
+  }).detach();
+}
+
+}  // namespace
+
+void GcloudTpuVmClient::launch(const std::string& name,
+                               const ProvisionerConfig& cfg) {
+  exec_detached(gcloud_argv("create", name, cfg));
+}
+
+void GcloudTpuVmClient::terminate(const std::string& name,
+                                  const ProvisionerConfig& cfg) {
+  exec_detached(gcloud_argv("delete", name, cfg));
+}
+
+void RecordingClient::launch(const std::string& name,
+                             const ProvisionerConfig& cfg) {
+  commands.push_back(join(gcloud_argv("create", name, cfg)));
+  if (commands.size() > 200) commands.erase(commands.begin());
+}
+
+void RecordingClient::terminate(const std::string& name,
+                                const ProvisionerConfig& cfg) {
+  commands.push_back(join(gcloud_argv("delete", name, cfg)));
+  if (commands.size() > 200) commands.erase(commands.begin());
+}
+
+Provisioner::Provisioner(ProvisionerConfig cfg,
+                         std::unique_ptr<CloudClient> client)
+    : cfg_(std::move(cfg)), client_(std::move(client)) {
+  // a zero/negative slice size would divide by zero in decide() — clamp
+  // (reachable via an unvalidated --provision-slots flag)
+  if (cfg_.slots_per_instance < 1) cfg_.slots_per_instance = 1;
+}
+
+void Provisioner::act(const std::string& entry) {
+  actions_.push_back(entry);
+  if (actions_.size() > 100) actions_.erase(actions_.begin());
+}
+
+ScaleDecision Provisioner::decide(
+    const ProvisionerConfig& cfg, const ClusterView& view, int starting,
+    const std::vector<std::string>& idle_candidates) {
+  ScaleDecision out;
+  const int instances = static_cast<int>(view.agent_ids.size()) + starting;
+
+  // scale up: slots the queue needs beyond current + in-flight capacity
+  // (≈ scaledecider calculateInstanceStates: desired from pending slots)
+  const int deficit =
+      view.pending_slots - view.free_slots - starting * cfg.slots_per_instance;
+  if (deficit > 0) {
+    int want = (deficit + cfg.slots_per_instance - 1) / cfg.slots_per_instance;
+    want = std::min(want, cfg.max_instances - instances);
+    for (int i = 0; i < want; ++i) out.launch.push_back("");  // named by step()
+    return out;  // never terminate while the queue is starved
+  }
+
+  // floor: keep min_instances warm even with an empty queue
+  int removable = instances - cfg.min_instances;
+  for (const auto& name : idle_candidates) {
+    if (removable <= 0) break;
+    out.terminate.push_back(name);
+    --removable;
+  }
+  // below the floor (e.g. after manual deletes): top back up
+  if (instances < cfg.min_instances) {
+    for (int i = instances; i < cfg.min_instances; ++i) out.launch.push_back("");
+  }
+  return out;
+}
+
+ScaleDecision Provisioner::step(const ClusterView& view) {
+  // startup tracking: an instance stops being "starting" when its agent
+  // registers; a grace-budget expiry is a presumed-failed launch — issue a
+  // best-effort delete so a slow create that eventually succeeds cannot
+  // leak a slice that nothing tracks
+  for (auto it = starting_.begin(); it != starting_.end();) {
+    if (view.agent_ids.count(it->first)) {
+      registered_.insert(it->first);
+      it = starting_.erase(it);
+    } else if (view.now - it->second > cfg_.startup_grace_sec) {
+      client_->terminate(it->first, cfg_);
+      act("cleanup " + it->first + " (startup grace expired)");
+      it = starting_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  // reconciliation: an instance we launched whose agent has vanished
+  // (heartbeat timeout disabled it, or the VM died) must be deleted, or
+  // the slice bills forever with no owner
+  for (auto it = registered_.begin(); it != registered_.end();) {
+    if (!view.agent_ids.count(*it)) {
+      client_->terminate(*it, cfg_);
+      act("reclaim " + *it + " (agent gone)");
+      it = registered_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  // idle tracking: first-seen-idle timestamps; busy agents reset
+  for (auto it = idle_since_.begin(); it != idle_since_.end();) {
+    if (!view.idle_agent_ids.count(it->first)) {
+      it = idle_since_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  for (const auto& id : view.idle_agent_ids) {
+    idle_since_.emplace(id, view.now);
+  }
+
+  if (view.now - last_action_ < cfg_.cooldown_sec) return {};
+
+  std::vector<std::string> idle_candidates;
+  for (const auto& [id, since] : idle_since_) {
+    if (view.now - since >= cfg_.idle_timeout_sec) {
+      idle_candidates.push_back(id);
+    }
+  }
+  std::sort(idle_candidates.begin(), idle_candidates.end());
+
+  ScaleDecision decision =
+      decide(cfg_, view, static_cast<int>(starting_.size()), idle_candidates);
+  for (auto& name : decision.launch) {
+    // random suffix: names must not collide with instances from a previous
+    // master incarnation that still exist in the cloud
+    name = "dct-tpu-" + cfg_.accelerator_type + "-" +
+           crypto::random_token().substr(0, 8);
+    starting_[name] = view.now;
+    client_->launch(name, cfg_);
+    act("launch " + name);
+  }
+  for (const auto& name : decision.terminate) {
+    idle_since_.erase(name);
+    registered_.erase(name);
+    client_->terminate(name, cfg_);
+    act("terminate " + name);
+  }
+  if (!decision.launch.empty() || !decision.terminate.empty()) {
+    last_action_ = view.now;
+  }
+  return decision;
+}
+
+Json Provisioner::status() const {
+  Json starting = Json::array();
+  for (const auto& [name, t] : starting_) {
+    Json j = Json::object();
+    j.set("name", name).set("launched_at", t);
+    starting.push_back(j);
+  }
+  Json actions = Json::array();
+  for (const auto& a : actions_) actions.push_back(a);
+  Json j = Json::object();
+  j.set("enabled", cfg_.enabled).set("dry_run", cfg_.dry_run)
+      .set("accelerator_type", cfg_.accelerator_type)
+      .set("zone", cfg_.zone)
+      .set("slots_per_instance", cfg_.slots_per_instance)
+      .set("min_instances", cfg_.min_instances)
+      .set("max_instances", cfg_.max_instances)
+      .set("starting", starting)
+      .set("recent_actions", actions);
+  if (auto* rec = dynamic_cast<RecordingClient*>(client_.get())) {
+    Json cmds = Json::array();
+    for (const auto& c : rec->commands) cmds.push_back(c);
+    j.set("commands", cmds);  // dry-run: the gcloud lines that would run
+  }
+  return j;
+}
+
+}  // namespace dct

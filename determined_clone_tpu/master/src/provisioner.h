@@ -1,0 +1,111 @@
+// GCP TPU-VM provisioner: autoscaling the agent fleet from queue depth.
+//
+// ≈ the reference's agentrm provisioner (master/internal/rm/agentrm/
+// provisioner/provisioner.go:44 + scaledecider/), re-targeted from GCE GPU
+// instances to TPU-VM slices: one instance = one ICI slice (e.g. v5litepod-8
+// = 8 chips in a 2x4 torus), so the scale unit is a whole slice, launched
+// and deleted via `gcloud compute tpus tpu-vm create|delete`. A dry-run
+// client records the commands instead of shelling out (the test seam and
+// the no-credentials default).
+#pragma once
+
+#include <map>
+#include <memory>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "json.h"
+
+namespace dct {
+
+struct ProvisionerConfig {
+  bool enabled = false;
+  std::string zone = "us-central2-b";
+  std::string project;                 // "" = gcloud's configured default
+  std::string accelerator_type = "v5litepod-8";
+  std::string runtime_version = "tpu-ubuntu2204-base";
+  std::string resource_pool = "default";
+  int slots_per_instance = 8;          // chips per slice
+  int min_instances = 0;
+  int max_instances = 4;
+  double startup_grace_sec = 600;      // launch → agent-registered budget
+  double idle_timeout_sec = 300;       // idle agent age before terminate
+  double cooldown_sec = 15;            // min seconds between scale actions
+  bool dry_run = true;                 // record commands, don't exec gcloud
+};
+
+// What the master sees this tick for the provisioner's pool.
+struct ClusterView {
+  int pending_slots = 0;               // slots of queued, unplaced allocations
+  int free_slots = 0;                  // free chips on enabled agents
+  std::set<std::string> agent_ids;     // enabled agents in the pool
+  std::set<std::string> idle_agent_ids;  // subset with zero reservations
+  double now = 0;
+};
+
+struct ScaleDecision {
+  std::vector<std::string> launch;     // new instance names
+  std::vector<std::string> terminate;  // agent/instance names to delete
+};
+
+// Cloud seam: real gcloud or a recorder.
+class CloudClient {
+ public:
+  virtual ~CloudClient() = default;
+  virtual void launch(const std::string& name,
+                      const ProvisionerConfig& cfg) = 0;
+  virtual void terminate(const std::string& name,
+                         const ProvisionerConfig& cfg) = 0;
+};
+
+// Shells out to gcloud on a detached thread (launch takes minutes; the
+// master tick must not block on it).
+class GcloudTpuVmClient : public CloudClient {
+ public:
+  void launch(const std::string& name, const ProvisionerConfig& cfg) override;
+  void terminate(const std::string& name,
+                 const ProvisionerConfig& cfg) override;
+};
+
+// Dry-run / test client: records the equivalent command lines.
+class RecordingClient : public CloudClient {
+ public:
+  void launch(const std::string& name, const ProvisionerConfig& cfg) override;
+  void terminate(const std::string& name,
+                 const ProvisionerConfig& cfg) override;
+  std::vector<std::string> commands;
+};
+
+class Provisioner {
+ public:
+  Provisioner(ProvisionerConfig cfg, std::unique_ptr<CloudClient> client);
+
+  // One scale pass: track idleness/startup, decide, execute. Called from
+  // the master tick under its lock (execution is non-blocking).
+  ScaleDecision step(const ClusterView& view);
+
+  // Pure decision logic (unit-testable without a client):
+  // `starting` = instances launched but not yet registered as agents;
+  // `idle_candidates` = agents idle longer than idle_timeout_sec.
+  static ScaleDecision decide(const ProvisionerConfig& cfg,
+                              const ClusterView& view, int starting,
+                              const std::vector<std::string>& idle_candidates);
+
+  Json status() const;  // instances starting, idle ages, recent actions
+
+  const ProvisionerConfig& config() const { return cfg_; }
+
+ private:
+  void act(const std::string& entry);
+
+  ProvisionerConfig cfg_;
+  std::unique_ptr<CloudClient> client_;
+  std::map<std::string, double> starting_;    // instance -> launch time
+  std::set<std::string> registered_;          // launched AND seen as an agent
+  std::map<std::string, double> idle_since_;  // agent -> first idle sighting
+  double last_action_ = 0;
+  std::vector<std::string> actions_;          // bounded recent-action log
+};
+
+}  // namespace dct

@@ -268,8 +268,8 @@ HttpResponse Master::route(const HttpRequest& req) {
   // reference's allocation-scoped session tokens, which are similarly
   // limited). (/api/v1/auth/login mints sessions and stays open.)
   static const std::set<std::string> kAuthRoots = {
-      "experiments", "tasks",  "users",    "workspaces",
-      "models",      "templates", "webhooks", "job-queue"};
+      "experiments", "tasks",  "users",    "workspaces", "models",
+      "templates",   "webhooks", "job-queue", "provisioner"};
   if (config_.auth_required && kAuthRoots.count(root)) {
     bool alloc_readonly = req.method == "GET" &&
                           (root == "experiments" || root == "users") &&
@@ -964,6 +964,16 @@ HttpResponse Master::route(const HttpRequest& req) {
         return ok_json(j);
       }
     }
+  }
+
+  // ---- provisioner (≈ GET provisioner state for ops visibility) ----------
+  if (root == "provisioner" && req.method == "GET") {
+    if (!provisioner_) {
+      Json j = Json::object();
+      j.set("enabled", false);
+      return ok_json(j);
+    }
+    return ok_json(provisioner_->status());
   }
 
   // ---- job queue (≈ jobservice) ------------------------------------------

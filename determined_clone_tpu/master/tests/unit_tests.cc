@@ -10,6 +10,7 @@
 #include "../src/crypto.h"
 #include "../src/json.h"
 #include "../src/master.h"
+#include "../src/provisioner.h"
 #include "../src/scheduler.h"
 #include "../src/searcher.h"
 
@@ -566,9 +567,121 @@ void test_custom_search() {
   CHECK(sd.cancel && !sd.failure);
 }
 
+void test_provisioner() {
+  ProvisionerConfig cfg;
+  cfg.enabled = true;
+  cfg.slots_per_instance = 8;
+  cfg.max_instances = 3;
+  cfg.min_instances = 0;
+  cfg.idle_timeout_sec = 10;
+  cfg.cooldown_sec = 0;
+  cfg.startup_grace_sec = 100;
+
+  // --- pure decisions ---
+  ClusterView view;
+  view.pending_slots = 12;  // needs ceil(12/8) = 2 slices
+  auto d = Provisioner::decide(cfg, view, 0, {});
+  CHECK(d.launch.size() == 2 && d.terminate.empty());
+  // in-flight capacity counts: 1 starting slice covers 8 of the 12
+  d = Provisioner::decide(cfg, view, 1, {});
+  CHECK(d.launch.size() == 1);
+  // max_instances caps the fleet
+  view.pending_slots = 100;
+  d = Provisioner::decide(cfg, view, 1, {});
+  CHECK(d.launch.size() == 2);  // 1 starting + 2 new = max 3
+  // free capacity suppresses launches; idle agents are NOT terminated
+  // while the queue is starved
+  view.pending_slots = 4;
+  view.free_slots = 8;
+  d = Provisioner::decide(cfg, view, 0, {"a1"});
+  CHECK(d.launch.empty() && d.terminate.empty());
+  // empty queue: idle candidates terminate down to min_instances
+  view.pending_slots = 0;
+  view.free_slots = 16;
+  view.agent_ids = {"a1", "a2"};
+  cfg.min_instances = 1;
+  d = Provisioner::decide(cfg, view, 0, {"a1", "a2"});
+  CHECK(d.terminate.size() == 1);
+  // below the floor: top back up
+  ClusterView empty_view;
+  d = Provisioner::decide(cfg, empty_view, 0, {});
+  CHECK(d.launch.size() == 1);
+  cfg.min_instances = 0;
+
+  // --- stateful lifecycle over a recording client ---
+  auto client = std::make_unique<RecordingClient>();
+  auto* rec = client.get();
+  Provisioner prov(cfg, std::move(client));
+  ClusterView v;
+  v.now = 1000;
+  v.pending_slots = 8;
+  auto s = prov.step(v);
+  CHECK(s.launch.size() == 1);
+  CHECK(rec->commands.size() == 1);
+  CHECK(rec->commands[0].find("gcloud compute tpus tpu-vm create") == 0);
+  CHECK(rec->commands[0].find("--accelerator-type v5litepod-8") !=
+        std::string::npos);
+  const std::string instance = s.launch[0];
+  // same view next tick: the starting instance covers the demand
+  v.now = 1001;
+  s = prov.step(v);
+  CHECK(s.launch.empty());
+  // the instance's agent registers: demand satisfied, nothing to do
+  v.now = 1002;
+  v.pending_slots = 0;
+  v.free_slots = 8;
+  v.agent_ids = {instance};
+  v.idle_agent_ids = {instance};
+  s = prov.step(v);
+  CHECK(s.launch.empty() && s.terminate.empty());
+  // idle past the timeout: terminated
+  v.now = 1013;
+  s = prov.step(v);
+  CHECK(s.terminate.size() == 1 && s.terminate[0] == instance);
+  CHECK(rec->commands.back().find("tpu-vm delete " + instance) !=
+        std::string::npos);
+  // startup-grace expiry: a launch whose agent never shows stops counting
+  ClusterView v2;
+  v2.now = 2000;
+  v2.pending_slots = 8;
+  Provisioner prov2(cfg, std::make_unique<RecordingClient>());
+  auto s2 = prov2.step(v2);
+  CHECK(s2.launch.size() == 1);
+  v2.now = 2050;  // within grace: no relaunch
+  CHECK(prov2.step(v2).launch.empty());
+  v2.now = 2101;  // grace (100s) expired: presumed failed, relaunch
+  CHECK(prov2.step(v2).launch.size() == 1);
+
+  // reconciliation: a registered instance whose agent vanishes (heartbeat
+  // timeout) is deleted — slices must never leak without an owner
+  auto client3 = std::make_unique<RecordingClient>();
+  auto* rec3 = client3.get();
+  Provisioner prov3(cfg, std::move(client3));
+  ClusterView v3;
+  v3.now = 3000;
+  v3.pending_slots = 8;
+  auto s3 = prov3.step(v3);
+  CHECK(s3.launch.size() == 1);
+  const std::string inst3 = s3.launch[0];
+  CHECK(inst3.rfind("dct-tpu-v5litepod-8-", 0) == 0);
+  v3.now = 3001;
+  v3.pending_slots = 0;
+  v3.free_slots = 8;
+  v3.agent_ids = {inst3};
+  v3.idle_agent_ids = {};
+  prov3.step(v3);  // registers
+  v3.now = 3002;
+  v3.agent_ids.clear();
+  v3.free_slots = 0;
+  prov3.step(v3);  // agent gone -> reclaim
+  CHECK(rec3->commands.back().find("tpu-vm delete " + inst3) !=
+        std::string::npos);
+}
+
 int run_all() {
   test_crypto();
   test_custom_search();
+  test_provisioner();
   test_json();
   test_hparam_sampling();
   test_search_methods();

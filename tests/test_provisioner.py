@@ -1,0 +1,147 @@
+"""TPU-VM provisioner e2e: queue depth drives dry-run gcloud scale actions.
+
+≈ the reference's provisioner flow (agentrm/provisioner/provisioner.go:44):
+pending workload → scale decider → instance launch; agent registers →
+startup tracking clears; idle fleet → terminate. Dry-run records the exact
+gcloud tpu-vm command lines.
+"""
+import subprocess
+import time
+import urllib.request
+import json as jsonlib
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+MASTER_DIR = REPO / "determined_clone_tpu" / "master"
+MASTER_BIN = MASTER_DIR / "build" / "dct-master"
+
+
+@pytest.fixture()
+def master(tmp_path):
+    if not MASTER_BIN.exists():
+        r = subprocess.run(["make", "-C", str(MASTER_DIR)],
+                           capture_output=True)
+        if r.returncode != 0:
+            pytest.skip("C++ master build unavailable")
+
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [str(MASTER_BIN), "--port", str(port), "--data-dir",
+         str(tmp_path / "data"),
+         "--provision-accelerator", "v5litepod-8",
+         "--provision-zone", "us-central2-b",
+         "--provision-slots", "8", "--provision-max", "2",
+         "--provision-cooldown", "0", "--provision-idle-timeout", "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/v1/master", timeout=2)
+            break
+        except Exception:
+            time.sleep(0.2)
+    else:
+        proc.kill()
+        pytest.fail("master did not come up")
+    yield port
+    proc.kill()
+    proc.wait(timeout=10)
+
+
+def req(port, method, path, body=None):
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=jsonlib.dumps(body).encode() if body is not None else None,
+        method=method, headers={"Content-Type": "application/json"})
+    return jsonlib.loads(urllib.request.urlopen(r, timeout=5).read() or "{}")
+
+
+def wait_for(fn, timeout=20, desc="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        out = fn()
+        if out:
+            return out
+        time.sleep(0.2)
+    raise AssertionError(f"timed out waiting for {desc}")
+
+
+def test_queue_depth_launches_and_idle_terminates(master):
+    port = master
+    status = req(port, "GET", "/api/v1/provisioner")
+    assert status["enabled"] and status["dry_run"]
+    assert status["commands"] == []
+
+    # queue a 12-slot gang with no agents: decider wants 2 slices (capped
+    # at provision-max 2)
+    req(port, "POST", "/api/v1/tasks",
+        {"type": "command", "cmd": ["sleep", "1"], "slots": 12})
+    status = wait_for(
+        lambda: (lambda s: s if len(s.get("commands", [])) >= 2 else None)(
+            req(port, "GET", "/api/v1/provisioner")),
+        desc="launch commands recorded")
+    creates = [c for c in status["commands"] if " create " in c]
+    assert len(creates) == 2
+    assert all("gcloud compute tpus tpu-vm create" in c for c in creates)
+    assert all("--accelerator-type v5litepod-8" in c for c in creates)
+    assert all("--zone us-central2-b" in c for c in creates)
+    assert len(status["starting"]) == 2
+
+    # the instances' agents register → startup tracking clears
+    names = [s["name"] for s in status["starting"]]
+    for name in names:
+        req(port, "POST", "/api/v1/agents/register",
+            {"id": name, "slots": 8, "topology": "v5e-8",
+             "address": "127.0.0.1:0"})
+    wait_for(
+        lambda: not req(port, "GET", "/api/v1/provisioner")["starting"],
+        desc="starting cleared after registration")
+
+    # kill the queued task → fleet idle → terminated after idle-timeout
+    task_id = req(port, "GET", "/api/v1/tasks")["tasks"][0]["id"]
+    req(port, "POST", f"/api/v1/tasks/{task_id}/kill")
+    status = wait_for(
+        lambda: (lambda s: s if sum(" delete " in c for c in
+                                    s.get("commands", [])) >= 2 else None)(
+            req(port, "GET", "/api/v1/provisioner")),
+        timeout=30, desc="idle fleet terminated")
+    deletes = [c for c in status["commands"] if " delete " in c]
+    assert all(any(n in c for n in names) for c in deletes)
+    # terminated agents are disabled so the scheduler stops using them
+    agents = req(port, "GET", "/api/v1/agents")["agents"]
+    assert all(not a["enabled"] for a in agents)
+
+
+def test_provisioner_disabled_by_default(tmp_path):
+    if not MASTER_BIN.exists():
+        pytest.skip("C++ master build unavailable")
+
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [str(MASTER_BIN), "--port", str(port), "--data-dir",
+         str(tmp_path / "data")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/api/v1/master", timeout=2)
+                break
+            except Exception:
+                time.sleep(0.2)
+        assert req(port, "GET", "/api/v1/provisioner") == {"enabled": False}
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)

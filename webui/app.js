@@ -307,7 +307,7 @@ async function viewExperimentDetail(id) {
     name: `trial ${t.id}`,
     points: fetched[i].metrics
         .filter((r) => r.group === "validation" && metric in (r.metrics || {}))
-        .map((r, j) => [r.steps_completed || j, r.metrics[metric]]),
+        .map((r, j) => [r.steps_completed ?? j, r.metrics[metric]]),
   }));
   if (series.every((s) => !s.points.length)) {
     // no validation series yet — fall back to training loss (same payloads)
@@ -317,7 +317,7 @@ async function viewExperimentDetail(id) {
       points: fetched[i].metrics
           .filter((r) => r.group === "training" &&
                          (r.metrics || {}).loss !== undefined)
-          .map((r, j) => [r.steps_completed || j, r.metrics.loss]),
+          .map((r, j) => [r.steps_completed ?? j, r.metrics.loss]),
     }));
   }
   lineChart(document.getElementById("chart"),
@@ -400,7 +400,12 @@ function bindRowLinks() {
 
 function scheduleRefresh(fn, active) {
   if (refreshTimer) clearTimeout(refreshTimer);
-  if (active) refreshTimer = setTimeout(fn, REFRESH_MS);
+  if (!active) return;
+  refreshTimer = setTimeout(() => {
+    // a transient fetch failure must not kill the refresh loop — retry on
+    // the next interval
+    Promise.resolve(fn()).catch(() => scheduleRefresh(fn, true));
+  }, REFRESH_MS);
 }
 
 async function route() {
