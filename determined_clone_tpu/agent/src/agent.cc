@@ -24,6 +24,7 @@
 #include <cstring>
 #include <fstream>
 #include <iostream>
+#include <iterator>
 #include <map>
 #include <sstream>
 #include <string>
@@ -32,6 +33,7 @@
 
 #include "../../master/src/http.h"
 #include "../../master/src/json.h"
+#include "docker.h"
 
 namespace dct {
 namespace {
@@ -45,20 +47,34 @@ struct AgentConfig {
   std::string topology;
   double heartbeat_sec = 1.0;
   std::string work_dir = ".";
+  // task runtime (≈ agent/internal/containers + pkg/docker):
+  //   process   — fate-shared child (PDEATHSIG; dies with the agent)
+  //   container — detached supervisor+task; survives agent restarts and is
+  //               reattached from the state file (manager.go:76 semantics)
+  //   docker    — container semantics with the task inside `docker run`
+  std::string runtime = "process";
+  std::string docker_image = "python:3.11-slim";
 };
+
+std::vector<std::string> list_accel_devices() {
+  std::vector<std::string> out;
+  if (DIR* dev = ::opendir("/dev")) {
+    while (dirent* entry = ::readdir(dev)) {
+      if (std::strncmp(entry->d_name, "accel", 5) == 0) {
+        out.push_back("/dev/" + std::string(entry->d_name));
+      }
+    }
+    ::closedir(dev);
+  }
+  return out;
+}
 
 int detect_tpu_chips(std::string* topology) {
   if (const char* env = std::getenv("DCT_AGENT_SLOTS")) {
     if (const char* topo = std::getenv("DCT_AGENT_TOPOLOGY")) *topology = topo;
     return std::atoi(env);
   }
-  int count = 0;
-  if (DIR* dev = ::opendir("/dev")) {
-    while (dirent* entry = ::readdir(dev)) {
-      if (std::strncmp(entry->d_name, "accel", 5) == 0) ++count;
-    }
-    ::closedir(dev);
-  }
+  int count = static_cast<int>(list_accel_devices().size());
   if (count > 0 && topology->empty()) {
     const char* gen = std::getenv("PALLAS_AXON_TPU_GEN");
     *topology = std::string(gen ? gen : "tpu") + "-" + std::to_string(count);
@@ -67,11 +83,57 @@ int detect_tpu_chips(std::string* topology) {
 }
 
 struct RunningTask {
-  pid_t pid = 0;
+  pid_t pid = 0;          // direct child (process) or supervisor (container)
+  pid_t task_pid = 0;     // the actual task process (container runtimes)
   std::string allocation_id;
   std::string log_path;
   bool preempt_sent = false;
+  bool adopted = false;   // reattached after an agent restart: `pid` is not
+                          // our child, so liveness is polled and the exit
+                          // code comes from the supervisor's exit file
+  int dead_polls = 0;     // adopted: polls since the task vanished (grace
+                          // for the supervisor's exit-file write)
 };
+
+bool pid_alive(pid_t pid) {
+  return pid > 0 && (::kill(pid, 0) == 0 || errno == EPERM);
+}
+
+std::string read_proc_file(pid_t pid, const char* name) {
+  std::ifstream in("/proc/" + std::to_string(pid) + "/" + name,
+                   std::ios::binary);
+  if (!in.good()) return "";
+  return std::string((std::istreambuf_iterator<char>(in)),
+                     std::istreambuf_iterator<char>());
+}
+
+bool has_nul_delimited(const std::string& data, const std::string& needle) {
+  size_t pos = 0;
+  while ((pos = data.find(needle, pos)) != std::string::npos) {
+    // whole entry: preceded by NUL/start, followed by NUL/end
+    bool start_ok = pos == 0 || data[pos - 1] == '\0';
+    size_t end = pos + needle.size();
+    bool end_ok = end == data.size() || data[end] == '\0';
+    if (start_ok && end_ok) return true;
+    ++pos;
+  }
+  return false;
+}
+
+// pid-reuse-proof identity for a task process. The exec'd task carries
+// DCT_ALLOCATION_ID in /proc/<pid>/environ (environ reflects the exec-time
+// environment, which setenv-before-exec populates — NOT post-fork setenv,
+// so a never-exec'd supervisor cannot carry it). The docker runtime's task
+// pid is the docker CLI, whose env has no task vars but whose cmdline
+// names the container: --name dct-task-<alloc>.
+bool proc_matches_task(pid_t pid, const std::string& alloc_id) {
+  if (has_nul_delimited(read_proc_file(pid, "environ"),
+                        "DCT_ALLOCATION_ID=" + alloc_id)) {
+    return true;
+  }
+  return has_nul_delimited(read_proc_file(pid, "cmdline"),
+                           "dct-task-" + alloc_id);
+}
 
 int b64_value(char c) {
   if (c >= 'A' && c <= 'Z') return c - 'A';
@@ -128,7 +190,12 @@ class Agent {
       }
     }
     std::cerr << "[agent] id=" << config_.id << " slots=" << config_.slots
-              << " topology=" << config_.topology << std::endl;
+              << " topology=" << config_.topology
+              << " runtime=" << config_.runtime << std::endl;
+
+    // reattach-after-restart (container/docker runtimes): adopt surviving
+    // tasks BEFORE the first heartbeat so the master never sees them absent
+    if (config_.runtime != "process") reattach_tasks();
 
     // register with reconnect+backoff (≈ agent.go:246,330)
     int backoff_ms = 500;
@@ -241,6 +308,168 @@ class Agent {
     return run_dir;
   }
 
+  // The DCT_* environment one task sees (≈ container Entrypoint + DET_*
+  // env, tasks/task.go:236). Shared by all runtimes: process/container
+  // apply it via setenv before exec; docker turns it into -e flags.
+  std::map<std::string, std::string> task_env(const Json& cmd,
+                                              const std::string& alloc_id) {
+    std::map<std::string, std::string> env;
+    env["DCT_MASTER_HOST"] = config_.master_host;
+    env["DCT_MASTER_PORT"] = std::to_string(config_.master_port);
+    env["DCT_ALLOCATION_ID"] = alloc_id;
+    // allocation-scoped credential: the task server requires it on every
+    // request, and harness→master calls authenticate with it
+    env["DCT_ALLOC_TOKEN"] = cmd["alloc_token"].as_string();
+    env["DCT_AGENT_ID"] = config_.id;
+    env["DCT_SLOTS"] = std::to_string(cmd["slots"].as_int());
+    env["DCT_RANK"] = std::to_string(cmd["rank"].as_int());
+    env["DCT_WORLD_SIZE"] = std::to_string(cmd["world_size"].as_int());
+    env["DCT_TASK_TYPE"] = cmd["task_type"].as_string();
+    if (cmd.has("trial")) {
+      env["DCT_TRIAL_ID"] = std::to_string(cmd["trial"]["id"].as_int());
+      env["DCT_EXPERIMENT_ID"] =
+          std::to_string(cmd["trial"]["experiment_id"].as_int());
+      env["DCT_HPARAMS"] = cmd["trial"]["hparams"].dump();
+      env["DCT_TARGET_UNITS"] =
+          std::to_string(cmd["trial"]["target_units"].as_int());
+      env["DCT_LATEST_CHECKPOINT"] =
+          cmd["trial"]["latest_checkpoint"].as_string();
+      env["DCT_EXPERIMENT_CONFIG"] = cmd["config"].dump();
+    }
+    if (cmd["spec"]["env"].is_object()) {
+      for (const auto& [k, v] : cmd["spec"]["env"].items()) {
+        env[k] = v.as_string();
+      }
+    }
+    return env;
+  }
+
+  // The in-container / in-process command for one task: NTSC argv, or the
+  // trial-harness invocation.
+  std::vector<std::string> task_argv(const Json& cmd) {
+    const Json& argv = cmd["spec"]["argv"];
+    std::vector<std::string> out;
+    if (argv.is_array() && argv.size() > 0) {
+      for (const auto& e : argv.elements()) out.push_back(e.as_string());
+      return out;
+    }
+    const std::string entrypoint = cmd["spec"]["entrypoint"].as_string();
+    if (!entrypoint.empty()) {
+      out = {"python", "-m", "determined_clone_tpu.exec.trial", entrypoint};
+    }
+    return out;
+  }
+
+  // Child-side: apply env, chdir, redirect stdout/stderr to the log, exec.
+  // Never returns.
+  [[noreturn]] void exec_task_child(const Json& cmd,
+                                    const std::string& alloc_id,
+                                    const std::string& log_path,
+                                    const std::string& run_dir) {
+    for (const auto& [k, v] : task_env(cmd, alloc_id)) {
+      ::setenv(k.c_str(), v.c_str(), 1);
+    }
+    // task cwd is the run dir (uploaded context) or the agent work dir —
+    // never the agent's own cwd (trials import model code from cwd)
+    const std::string& task_cwd = run_dir.empty() ? config_.work_dir : run_dir;
+    if (::chdir(task_cwd.c_str()) != 0) {
+      std::cerr << "chdir " << task_cwd << " failed" << std::endl;
+      std::_Exit(82);
+    }
+    // stdout/stderr → log file (shipped to master on exit; live shipping
+    // is the harness's log-batch POST)
+    FILE* log = ::freopen(log_path.c_str(), "a", stdout);
+    (void)log;
+    ::dup2(::fileno(stdout), ::fileno(stderr));
+
+    std::vector<std::string> args = task_argv(cmd);
+    if (args.empty()) {
+      std::cerr << "no argv/entrypoint for " << alloc_id << std::endl;
+      std::_Exit(80);
+    }
+    std::vector<char*> cargs;
+    for (auto& a : args) cargs.push_back(a.data());
+    cargs.push_back(nullptr);
+    ::execvp(cargs[0], cargs.data());
+    std::cerr << "execvp failed: " << std::strerror(errno) << std::endl;
+    std::_Exit(81);
+  }
+
+  std::string exit_file(const std::string& alloc_id) const {
+    return config_.work_dir + "/task-" + alloc_id + ".exit";
+  }
+  std::string state_file() const {
+    return config_.work_dir + "/agent-state.json";
+  }
+
+  // Detached supervisor+task pair: the supervisor (a new session, so it
+  // survives the agent dying by any signal) waits for the task, records the
+  // exit code to a file — readable after a reattach, when waitpid is
+  // impossible — and exits with the same code for the normal path.
+  void start_detached(const Json& cmd, const std::string& alloc_id,
+                      const std::string& log_path, const std::string& run_dir,
+                      bool docker) {
+    int pipefd[2];
+    if (::pipe(pipefd) != 0) return;
+    ::unlink(exit_file(alloc_id).c_str());
+    pid_t sup = ::fork();
+    if (sup == 0) {
+      ::setsid();  // detach: agent death must not take the task down
+      ::close(pipefd[0]);
+      pid_t task = ::fork();
+      if (task == 0) {
+        ::close(pipefd[1]);
+        if (docker) {
+          auto env = task_env(cmd, alloc_id);
+          const std::string cwd = run_dir.empty() ? config_.work_dir : run_dir;
+          auto argv = docker_run_argv(alloc_id, config_.docker_image,
+                                      config_.work_dir, cwd, env,
+                                      list_accel_devices(), task_argv(cmd));
+          FILE* log = ::freopen(log_path.c_str(), "a", stdout);
+          (void)log;
+          ::dup2(::fileno(stdout), ::fileno(stderr));
+          std::vector<char*> cargs;
+          for (auto& a : argv) cargs.push_back(a.data());
+          cargs.push_back(nullptr);
+          ::execvp(cargs[0], cargs.data());
+          std::_Exit(81);
+        }
+        exec_task_child(cmd, alloc_id, log_path, run_dir);
+      }
+      // supervisor: report the task pid, wait, persist the exit code
+      ::write(pipefd[1], &task, sizeof(task));
+      ::close(pipefd[1]);
+      int code = 80;  // fork failure: the task never ran
+      if (task > 0) {
+        int status = 0;
+        ::waitpid(task, &status, 0);
+        code = WIFEXITED(status) ? WEXITSTATUS(status)
+                                 : 128 + WTERMSIG(status);
+      }
+      {
+        std::ofstream out(exit_file(alloc_id) + ".tmp");
+        out << code;
+      }
+      ::rename((exit_file(alloc_id) + ".tmp").c_str(),
+               exit_file(alloc_id).c_str());
+      std::_Exit(code & 0xFF);
+    }
+    ::close(pipefd[1]);
+    pid_t task_pid = 0;
+    ssize_t n = ::read(pipefd[0], &task_pid, sizeof(task_pid));
+    (void)n;
+    ::close(pipefd[0]);
+    if (sup > 0) {
+      tasks_[alloc_id] = RunningTask{sup, task_pid, alloc_id, log_path,
+                                     false, false};
+      persist_state();
+      send_event(alloc_id, "running", 0, "");
+      std::cerr << "[agent] started " << alloc_id << " supervisor=" << sup
+                << " task=" << task_pid
+                << (docker ? " (docker)" : " (container)") << std::endl;
+    }
+  }
+
   void start_task(const Json& cmd) {
     const std::string& alloc_id = cmd["allocation_id"].as_string();
     if (tasks_.count(alloc_id)) return;  // duplicate start
@@ -248,85 +477,21 @@ class Agent {
     std::string log_path =
         config_.work_dir + "/task-" + alloc_id + ".log";
     std::string run_dir = prepare_context(cmd, alloc_id);
+    if (config_.runtime == "container" || config_.runtime == "docker") {
+      start_detached(cmd, alloc_id, log_path, run_dir,
+                     config_.runtime == "docker");
+      return;
+    }
     pid_t pid = ::fork();
     if (pid == 0) {
-      // child: run the harness entrypoint with the task env
-      // (≈ container Entrypoint + DET_* env, tasks/task.go:236)
       // fate-sharing: if the agent dies (even SIGKILL), its tasks must not
       // become orphans (≈ pid_server/pid_client, harness ipc.py:264-553)
       ::prctl(PR_SET_PDEATHSIG, SIGKILL);
       if (::getppid() == 1) std::_Exit(83);  // agent died before prctl
-      ::setenv("DCT_MASTER_HOST", config_.master_host.c_str(), 1);
-      ::setenv("DCT_MASTER_PORT",
-               std::to_string(config_.master_port).c_str(), 1);
-      ::setenv("DCT_ALLOCATION_ID", alloc_id.c_str(), 1);
-      // allocation-scoped credential: the task server requires it on every
-      // request, and harness→master calls authenticate with it
-      ::setenv("DCT_ALLOC_TOKEN", cmd["alloc_token"].as_string().c_str(), 1);
-      ::setenv("DCT_AGENT_ID", config_.id.c_str(), 1);
-      ::setenv("DCT_SLOTS", std::to_string(cmd["slots"].as_int()).c_str(), 1);
-      ::setenv("DCT_RANK", std::to_string(cmd["rank"].as_int()).c_str(), 1);
-      ::setenv("DCT_WORLD_SIZE",
-               std::to_string(cmd["world_size"].as_int()).c_str(), 1);
-      if (cmd.has("trial")) {
-        ::setenv("DCT_TRIAL_ID",
-                 std::to_string(cmd["trial"]["id"].as_int()).c_str(), 1);
-        ::setenv("DCT_EXPERIMENT_ID",
-                 std::to_string(cmd["trial"]["experiment_id"].as_int()).c_str(),
-                 1);
-        ::setenv("DCT_HPARAMS", cmd["trial"]["hparams"].dump().c_str(), 1);
-        ::setenv("DCT_TARGET_UNITS",
-                 std::to_string(cmd["trial"]["target_units"].as_int()).c_str(),
-                 1);
-        ::setenv("DCT_LATEST_CHECKPOINT",
-                 cmd["trial"]["latest_checkpoint"].as_string().c_str(), 1);
-        ::setenv("DCT_EXPERIMENT_CONFIG", cmd["config"].dump().c_str(), 1);
-      }
-      // stdout/stderr → log file (shipped to master on exit; live shipping
-      // is the harness's log-batch POST)
-      // task cwd is the run dir (uploaded context) or the agent work dir —
-      // never the agent's own cwd (trials import model code from cwd)
-      const std::string& task_cwd =
-          run_dir.empty() ? config_.work_dir : run_dir;
-      if (::chdir(task_cwd.c_str()) != 0) {
-        std::cerr << "chdir " << task_cwd << " failed" << std::endl;
-        std::_Exit(82);
-      }
-      ::setenv("DCT_TASK_TYPE", cmd["task_type"].as_string().c_str(), 1);
-      if (cmd["spec"]["env"].is_object()) {
-        for (const auto& [k, v] : cmd["spec"]["env"].items()) {
-          ::setenv(k.c_str(), v.as_string().c_str(), 1);
-        }
-      }
-      FILE* log = ::freopen(log_path.c_str(), "a", stdout);
-      (void)log;
-      ::dup2(::fileno(stdout), ::fileno(stderr));
-
-      // NTSC tasks carry an explicit argv (≈ the reference's generic task
-      // container spec, tasks/task_command.go); trials exec the harness.
-      const Json& argv = cmd["spec"]["argv"];
-      if (argv.is_array() && argv.size() > 0) {
-        std::vector<std::string> args;
-        for (const auto& e : argv.elements()) args.push_back(e.as_string());
-        std::vector<char*> cargs;
-        for (auto& a : args) cargs.push_back(a.data());
-        cargs.push_back(nullptr);
-        ::execvp(cargs[0], cargs.data());
-        std::cerr << "execvp failed: " << std::strerror(errno) << std::endl;
-        std::_Exit(81);
-      }
-      std::string entrypoint = cmd["spec"]["entrypoint"].as_string();
-      if (entrypoint.empty()) {
-        std::cerr << "no entrypoint for " << alloc_id << std::endl;
-        std::_Exit(80);
-      }
-      ::execlp("python", "python", "-m", "determined_clone_tpu.exec.trial",
-               entrypoint.c_str(), nullptr);
-      std::cerr << "execlp failed: " << std::strerror(errno) << std::endl;
-      std::_Exit(81);
+      exec_task_child(cmd, alloc_id, log_path, run_dir);
     }
     if (pid > 0) {
-      tasks_[alloc_id] = RunningTask{pid, alloc_id, log_path, false};
+      tasks_[alloc_id] = RunningTask{pid, 0, alloc_id, log_path, false, false};
       send_event(alloc_id, "running", 0, "");
       std::cerr << "[agent] started " << alloc_id << " pid=" << pid << std::endl;
     }
@@ -336,39 +501,164 @@ class Agent {
     auto it = tasks_.find(alloc_id);
     if (it == tasks_.end() || it->second.preempt_sent) return;
     // cooperative: harness polls the preempt endpoint; SIGTERM is the
-    // belt-and-braces (exec/launch.py:18's SLURM SIGTERM semantics)
-    ::kill(it->second.pid, SIGTERM);
+    // belt-and-braces (exec/launch.py:18's SLURM SIGTERM semantics).
+    // Signal the task, not the supervisor (which must survive to record
+    // the exit code). task_pid <= 0 (supervisor fork failure) must never
+    // reach kill() — kill(-1, sig) signals everything we can.
+    pid_t target = it->second.task_pid > 0 ? it->second.task_pid
+                                           : it->second.pid;
+    if (target > 0) ::kill(target, SIGTERM);
     it->second.preempt_sent = true;
   }
 
   void kill_task(const std::string& alloc_id) {
     auto it = tasks_.find(alloc_id);
     if (it == tasks_.end()) return;
-    ::kill(it->second.pid, SIGKILL);
+    if (config_.runtime == "docker") {
+      // the docker CLI process does not forward SIGKILL to the container;
+      // double-fork so the helper can't accumulate as a zombie
+      std::string name = "dct-task-" + alloc_id;
+      pid_t helper = ::fork();
+      if (helper == 0) {
+        if (::fork() == 0) {
+          ::execlp("docker", "docker", "kill", name.c_str(), nullptr);
+          std::_Exit(127);
+        }
+        std::_Exit(0);
+      }
+      if (helper > 0) ::waitpid(helper, nullptr, 0);
+    }
+    pid_t target = it->second.task_pid > 0 ? it->second.task_pid
+                                           : it->second.pid;
+    if (target > 0) ::kill(target, SIGKILL);
+  }
+
+  // Reattach after an agent restart (≈ containers/manager.go:76): re-adopt
+  // tasks from the state file whose processes still run; report exits for
+  // those that finished while the agent was down.
+  void reattach_tasks() {
+    std::ifstream in(state_file());
+    if (!in.good()) return;
+    Json state;
+    try {
+      std::stringstream buf;
+      buf << in.rdbuf();
+      state = Json::parse(buf.str());
+    } catch (const std::exception&) {
+      return;
+    }
+    for (const auto& t : state["tasks"].elements()) {
+      const std::string alloc_id = t["allocation_id"].as_string();
+      pid_t sup = static_cast<pid_t>(t["supervisor_pid"].as_int());
+      pid_t task = static_cast<pid_t>(t["task_pid"].as_int());
+      // identity check beats pid reuse (env for exec'd tasks, container
+      // name in cmdline for the docker CLI)
+      bool alive = pid_alive(task) && proc_matches_task(task, alloc_id);
+      if (alive) {
+        tasks_[alloc_id] = RunningTask{sup, task, alloc_id,
+                                       t["log_path"].as_string(), false,
+                                       true};
+        std::cerr << "[agent] reattached " << alloc_id << " task=" << task
+                  << std::endl;
+        continue;
+      }
+      // finished (or lost) while we were down: the supervisor's exit file
+      // has the code; without it the outcome is unknown -> error
+      int exit_code = 1;
+      std::string error = "task lost across agent restart";
+      std::ifstream ef(exit_file(alloc_id));
+      if (ef.good()) {
+        ef >> exit_code;
+        error = exit_code ? "task failed" : "";
+      }
+      ship_logs(RunningTask{0, 0, alloc_id, t["log_path"].as_string(),
+                            false, false});
+      Json rec = Json::object();
+      rec.set("allocation_id", alloc_id).set("exit_code", exit_code)
+          .set("error", error);
+      pending_exits_.push_back(std::move(rec));
+      std::cerr << "[agent] task " << alloc_id
+                << " finished while agent was down: exit " << exit_code
+                << std::endl;
+    }
+    persist_state();
+  }
+
+  void persist_state() {
+    if (config_.runtime == "process") return;  // fate-shared: nothing survives
+    Json tasks = Json::array();
+    for (const auto& [aid, t] : tasks_) {
+      Json j = Json::object();
+      j.set("allocation_id", aid)
+          .set("supervisor_pid", static_cast<int64_t>(t.pid))
+          .set("task_pid", static_cast<int64_t>(t.task_pid))
+          .set("log_path", t.log_path);
+      tasks.push_back(j);
+    }
+    Json state = Json::object();
+    state.set("tasks", tasks);
+    std::ofstream out(state_file() + ".tmp");
+    out << state.dump();
+    out.close();
+    ::rename((state_file() + ".tmp").c_str(), state_file().c_str());
+  }
+
+  void finish_task(const std::string& alloc_id, const RunningTask& task,
+                   int exit_code) {
+    ship_logs(task);
+    // fast path now; the heartbeat carries it again until acked
+    send_event(alloc_id, "exited", exit_code,
+               exit_code ? "task failed" : "");
+    Json rec = Json::object();
+    rec.set("allocation_id", alloc_id).set("exit_code", exit_code)
+        .set("error", exit_code ? "task failed" : "");
+    pending_exits_.push_back(std::move(rec));
+    std::cerr << "[agent] task " << alloc_id << " exited " << exit_code
+              << std::endl;
   }
 
   void reap_tasks() {
+    bool changed = false;
     for (auto it = tasks_.begin(); it != tasks_.end();) {
+      const RunningTask& task = it->second;
+      if (task.adopted) {
+        // not our child: poll the TASK's liveness with the identity check
+        // (a bare kill(pid, 0) would follow a reused pid forever)
+        if (pid_alive(task.task_pid) &&
+            proc_matches_task(task.task_pid, it->first)) {
+          it->second.dead_polls = 0;
+          ++it;
+          continue;
+        }
+        // task gone: the supervisor writes the exit file just before it
+        // exits — give it a grace window before assuming a crash
+        std::ifstream ef(exit_file(it->first));
+        if (!ef.good() && ++it->second.dead_polls < 20) {
+          ++it;
+          continue;
+        }
+        int exit_code = 1;
+        if (ef.good()) ef >> exit_code;
+        finish_task(it->first, task, exit_code);
+        it = tasks_.erase(it);
+        changed = true;
+        continue;
+      }
       int status = 0;
-      pid_t done = ::waitpid(it->second.pid, &status, WNOHANG);
-      if (done == it->second.pid) {
+      pid_t done = ::waitpid(task.pid, &status, WNOHANG);
+      if (done == task.pid) {
+        // process runtime: the child's status; container/docker: the
+        // supervisor exits with the task's code
         int exit_code = WIFEXITED(status) ? WEXITSTATUS(status)
                                           : 128 + WTERMSIG(status);
-        ship_logs(it->second);
-        // fast path now; the heartbeat carries it again until acked
-        send_event(it->first, "exited", exit_code,
-                   exit_code ? "task failed" : "");
-        Json rec = Json::object();
-        rec.set("allocation_id", it->first).set("exit_code", exit_code)
-            .set("error", exit_code ? "task failed" : "");
-        pending_exits_.push_back(std::move(rec));
-        std::cerr << "[agent] task " << it->first << " exited "
-                  << exit_code << std::endl;
+        finish_task(it->first, task, exit_code);
         it = tasks_.erase(it);
+        changed = true;
       } else {
         ++it;
       }
     }
+    if (changed) persist_state();
   }
 
   void ship_logs(const RunningTask& task) {
@@ -423,10 +713,22 @@ int main(int argc, char** argv) {
       config.topology = argv[++i];
     } else if (!std::strcmp(argv[i], "--work-dir") && i + 1 < argc) {
       config.work_dir = argv[++i];
+    } else if (!std::strcmp(argv[i], "--runtime") && i + 1 < argc) {
+      config.runtime = argv[++i];
+      if (config.runtime != "process" && config.runtime != "container" &&
+          config.runtime != "docker") {
+        std::cerr << "unknown runtime '" << config.runtime
+                  << "' (process|container|docker)\n";
+        return 2;
+      }
+    } else if (!std::strcmp(argv[i], "--docker-image") && i + 1 < argc) {
+      config.docker_image = argv[++i];
     } else if (!std::strcmp(argv[i], "--help")) {
       std::cout << "usage: dct-agent [--master-host H] [--master-port P] "
                    "[--id ID] [--resource-pool POOL] [--slots N] "
-                   "[--topology T] [--work-dir DIR]\n";
+                   "[--topology T] [--work-dir DIR] "
+                   "[--runtime process|container|docker] "
+                   "[--docker-image IMG]\n";
       return 0;
     }
   }

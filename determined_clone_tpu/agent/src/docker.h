@@ -1,0 +1,44 @@
+// Docker runtime command construction (pure; unit-tested).
+//
+// ≈ the reference agent's docker runner (agent/pkg/docker/docker.go:87-244):
+// tasks run as containers instead of host processes. On TPU-VMs the
+// container needs the accelerator device files, host networking (the
+// harness rendezvous announces host addresses) and the agent work dir
+// mounted (task logs + model-def run dirs live there).
+#pragma once
+
+#include <map>
+#include <string>
+#include <vector>
+
+namespace dct {
+
+// argv for `docker run` of one task. `env` is the DCT_* task environment;
+// `argv` the in-container command (the task argv or the trial harness
+// invocation); `devices` e.g. {"/dev/accel0", ...}.
+inline std::vector<std::string> docker_run_argv(
+    const std::string& alloc_id, const std::string& image,
+    const std::string& work_dir, const std::string& task_cwd,
+    const std::map<std::string, std::string>& env,
+    const std::vector<std::string>& devices,
+    const std::vector<std::string>& argv) {
+  std::vector<std::string> out = {
+      "docker", "run", "--rm", "--name", "dct-task-" + alloc_id,
+      "--network", "host",           // rendezvous addresses are host addresses
+      "-v", work_dir + ":" + work_dir,  // logs + run dirs
+      "-w", task_cwd,
+  };
+  for (const auto& d : devices) {
+    out.push_back("--device");
+    out.push_back(d);
+  }
+  for (const auto& [k, v] : env) {
+    out.push_back("-e");
+    out.push_back(k + "=" + v);
+  }
+  out.push_back(image);
+  out.insert(out.end(), argv.begin(), argv.end());
+  return out;
+}
+
+}  // namespace dct

@@ -853,6 +853,7 @@ void Master::tick_locked() {
       auto it = agents_.find(name);
       if (it != agents_.end()) {
         it->second.enabled = false;
+        it->second.draining = true;  // heartbeats must not re-enable it
         dirty_ = true;
       }
     }

@@ -155,6 +155,9 @@ struct Agent {
   std::string address;       // host:port the harness can reach
   double last_heartbeat = 0;
   bool enabled = true;
+  // terminated by the provisioner: the VM is being deleted, so heartbeats
+  // must NOT re-enable it (a fresh registration clears it)
+  bool draining = false;
   std::set<std::string> blocked_by;  // experiment ids that blocklisted this node
 
   Json to_json() const {
@@ -164,7 +167,7 @@ struct Agent {
     j.set("id", id).set("resource_pool", resource_pool).set("slots", slots)
         .set("topology", topology).set("address", address)
         .set("last_heartbeat", last_heartbeat).set("enabled", enabled)
-        .set("blocked_by", blocked);
+        .set("draining", draining).set("blocked_by", blocked);
     return j;
   }
   static Agent from_json(const Json& j) {
@@ -176,6 +179,7 @@ struct Agent {
     a.address = j["address"].as_string();
     a.last_heartbeat = j["last_heartbeat"].as_number();
     a.enabled = j["enabled"].as_bool(true);
+    a.draining = j["draining"].as_bool(false);
     for (const auto& b : j["blocked_by"].elements()) {
       a.blocked_by.insert(b.as_string());
     }

@@ -183,7 +183,10 @@ ScaleDecision Provisioner::step(const ClusterView& view) {
 
   std::vector<std::string> idle_candidates;
   for (const auto& [id, since] : idle_since_) {
-    if (view.now - since >= cfg_.idle_timeout_sec) {
+    // only instances this provisioner launched are ours to delete —
+    // statically provisioned agents in the same pool are the operator's
+    if (registered_.count(id) &&
+        view.now - since >= cfg_.idle_timeout_sec) {
       idle_candidates.push_back(id);
     }
   }

@@ -767,6 +767,7 @@ HttpResponse Master::route(const HttpRequest& req) {
         agent.resource_pool = body["resource_pool"].as_string();
       }
       agent.enabled = true;
+      agent.draining = false;  // a fresh registration is a live node again
       agent.last_heartbeat = now_sec();
       dirty_ = true;
       Json j = Json::object();
@@ -779,7 +780,9 @@ HttpResponse Master::route(const HttpRequest& req) {
       auto it = agents_.find(aid);
       if (it == agents_.end()) return not_found("unregistered agent " + aid);
       it->second.last_heartbeat = now_sec();
-      it->second.enabled = true;
+      // a draining agent (provisioner-terminated, VM deletion in flight)
+      // must not flip back to schedulable on its dying heartbeats
+      if (!it->second.draining) it->second.enabled = true;
       Json body = req.body.empty() ? Json::object() : Json::parse(req.body);
       // exit reports ride the heartbeat at-least-once (agent retries until
       // a heartbeat succeeds); on_task_done is terminal-state idempotent.

@@ -7,6 +7,7 @@
 #include <iostream>
 #include <set>
 
+#include "../../agent/src/docker.h"
 #include "../src/crypto.h"
 #include "../src/json.h"
 #include "../src/master.h"
@@ -678,10 +679,32 @@ void test_provisioner() {
         std::string::npos);
 }
 
+void test_docker_argv() {
+  auto argv = docker_run_argv(
+      "trial-7.0", "dct-harness:latest", "/work", "/work/run-trial-7.0",
+      {{"DCT_ALLOCATION_ID", "trial-7.0"}, {"DCT_RANK", "0"}},
+      {"/dev/accel0", "/dev/accel1"},
+      {"python", "-m", "determined_clone_tpu.exec.trial", "m:T"});
+  std::string joined;
+  for (const auto& a : argv) joined += a + " ";
+  CHECK(joined.find("docker run --rm --name dct-task-trial-7.0") == 0);
+  CHECK(joined.find("--network host") != std::string::npos);
+  CHECK(joined.find("-v /work:/work") != std::string::npos);
+  CHECK(joined.find("-w /work/run-trial-7.0") != std::string::npos);
+  CHECK(joined.find("--device /dev/accel0") != std::string::npos);
+  CHECK(joined.find("--device /dev/accel1") != std::string::npos);
+  CHECK(joined.find("-e DCT_ALLOCATION_ID=trial-7.0") != std::string::npos);
+  // image comes after all flags, then the in-container argv verbatim
+  CHECK(joined.find("dct-harness:latest python -m "
+                    "determined_clone_tpu.exec.trial m:T") !=
+        std::string::npos);
+}
+
 int run_all() {
   test_crypto();
   test_custom_search();
   test_provisioner();
+  test_docker_argv();
   test_json();
   test_hparam_sampling();
   test_search_methods();
