@@ -31,6 +31,14 @@ int main(int argc, char** argv) {
       config.auth_required = true;
     } else if (!std::strcmp(argv[i], "--webui-dir") && i + 1 < argc) {
       config.webui_dir = argv[++i];
+    } else if (!std::strcmp(argv[i], "--db") && i + 1 < argc) {
+      config.db = argv[++i];
+      if (config.db != "auto" && config.db != "sqlite" &&
+          config.db != "files") {
+        std::cerr << "unknown --db '" << config.db
+                  << "' (auto|sqlite|files)\n";
+        return 2;
+      }
     } else if (!std::strcmp(argv[i], "--provision-accelerator") &&
                i + 1 < argc) {
       config.provisioner.enabled = true;
@@ -69,20 +77,21 @@ int main(int argc, char** argv) {
   if (const char* p = std::getenv("DCT_MASTER_PORT")) config.port = std::atoi(p);
   if (const char* d = std::getenv("DCT_MASTER_DATA_DIR")) config.data_dir = d;
 
-  dct::Master master(config);
-  std::signal(SIGINT, handle_signal);
-  std::signal(SIGTERM, handle_signal);
   try {
+    // construction can throw too (--db sqlite without libsqlite3)
+    dct::Master master(config);
+    std::signal(SIGINT, handle_signal);
+    std::signal(SIGTERM, handle_signal);
     master.start();
+    std::cout << "dct-master listening on port " << master.port()
+              << " (data dir: " << config.data_dir << ")" << std::endl;
+    while (!g_stop) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(200));
+    }
+    master.stop();  // final snapshot save
   } catch (const std::exception& e) {
     std::cerr << "dct-master failed to start: " << e.what() << std::endl;
     return 1;
   }
-  std::cout << "dct-master listening on port " << master.port()
-            << " (data dir: " << config.data_dir << ")" << std::endl;
-  while (!g_stop) {
-    std::this_thread::sleep_for(std::chrono::milliseconds(200));
-  }
-  master.stop();  // final snapshot save
   return 0;
 }

@@ -23,6 +23,7 @@
 #include "provisioner.h"
 #include "scheduler.h"
 #include "searcher.h"
+#include "store.h"
 
 namespace dct {
 
@@ -41,6 +42,9 @@ struct MasterConfig {
   std::string webui_dir = "webui";
   // TPU-VM autoscaling (provisioner.h); disabled unless enabled=true
   ProvisionerConfig provisioner;
+  // persistence backend: "auto" (sqlite when libsqlite3 loads, else files),
+  // "sqlite", or "files" (store.h)
+  std::string db = "auto";
 };
 
 class Master {
@@ -123,6 +127,8 @@ class Master {
   std::thread tick_thread_;
   std::atomic<bool> running_{false};
   std::unique_ptr<Provisioner> provisioner_;  // null unless enabled
+  std::unique_ptr<Store> store_;  // created in the ctor (routes need it
+                                  // even when start() is never called)
 
   std::mutex mu_;
   int64_t next_experiment_id_ = 1;

@@ -1,0 +1,370 @@
+#include "store.h"
+
+#include <dirent.h>
+#include <dlfcn.h>
+
+#include <cstdio>
+#include <deque>
+#include <fstream>
+#include <iostream>
+#include <sstream>
+
+namespace dct {
+namespace {
+
+// ---------------------------------------------------------------------------
+// files backend (the original persistence mode)
+// ---------------------------------------------------------------------------
+
+class FileStore : public Store {
+ public:
+  explicit FileStore(std::string data_dir) : data_dir_(std::move(data_dir)) {}
+
+  void save_snapshot(const std::string& json) override {
+    const std::string path = data_dir_ + "/snapshot.json";
+    const std::string tmp = path + ".tmp";
+    {
+      std::ofstream out(tmp);
+      out << json;
+    }
+    ::rename(tmp.c_str(), path.c_str());
+  }
+
+  std::string load_snapshot() override {
+    std::ifstream in(data_dir_ + "/snapshot.json");
+    if (!in.good()) return "";
+    std::stringstream buf;
+    buf << in.rdbuf();
+    return buf.str();
+  }
+
+  void append(const std::string& stream, const Json& rec) override {
+    std::ofstream out(data_dir_ + "/" + stream, std::ios::app);
+    out << rec.dump() << "\n";
+  }
+
+  void append_many(const std::string& stream,
+                   const std::vector<const Json*>& recs) override {
+    if (recs.empty()) return;
+    std::ofstream out(data_dir_ + "/" + stream, std::ios::app);
+    for (const Json* rec : recs) out << rec->dump() << "\n";
+  }
+
+  std::vector<Json> read(const std::string& stream, size_t limit,
+                         size_t offset) override {
+    std::ifstream in(data_dir_ + "/" + stream);
+    std::vector<Json> out;
+    std::string line;
+    size_t index = 0;
+    while (std::getline(in, line)) {
+      if (line.empty()) continue;
+      // the offset cursor counts PARSED records — clients page with
+      // offset += records_received, so a torn line must not shift it
+      Json rec;
+      try {
+        rec = Json::parse(line);
+      } catch (const std::exception&) {
+        continue;
+      }
+      if (index++ < offset) continue;
+      out.push_back(std::move(rec));
+      if (out.size() >= limit) break;
+    }
+    return out;
+  }
+
+  std::vector<Json> read_tail(const std::string& stream,
+                              size_t limit) override {
+    std::ifstream in(data_dir_ + "/" + stream);
+    std::deque<std::string> tail;
+    std::string line;
+    while (std::getline(in, line)) {
+      if (line.empty()) continue;
+      tail.push_back(std::move(line));
+      if (tail.size() > limit) tail.pop_front();
+    }
+    std::vector<Json> out;
+    for (const auto& l : tail) {
+      try {
+        out.push_back(Json::parse(l));
+      } catch (const std::exception&) {
+      }
+    }
+    return out;
+  }
+
+  const char* kind() const override { return "files"; }
+
+ private:
+  std::string data_dir_;
+};
+
+// ---------------------------------------------------------------------------
+// sqlite backend (libsqlite3 via dlopen — the image ships the runtime .so
+// but no -dev header, so the stable C API subset is declared here)
+// ---------------------------------------------------------------------------
+
+struct sqlite3;
+struct sqlite3_stmt;
+constexpr int kSqliteOk = 0;
+constexpr int kSqliteRow = 100;
+constexpr int kSqliteDone = 101;
+// SQLITE_TRANSIENT: sqlite copies the bound text immediately
+const auto kTransient = reinterpret_cast<void (*)(void*)>(-1);
+
+struct SqliteApi {
+  int (*open)(const char*, sqlite3**);
+  int (*close)(sqlite3*);
+  int (*exec)(sqlite3*, const char*, int (*)(void*, int, char**, char**),
+              void*, char**);
+  int (*prepare)(sqlite3*, const char*, int, sqlite3_stmt**, const char**);
+  int (*step)(sqlite3_stmt*);
+  int (*reset)(sqlite3_stmt*);
+  int (*finalize)(sqlite3_stmt*);
+  int (*bind_text)(sqlite3_stmt*, int, const char*, int, void (*)(void*));
+  int (*bind_int64)(sqlite3_stmt*, int, long long);
+  const unsigned char* (*column_text)(sqlite3_stmt*, int);
+  const char* (*errmsg)(sqlite3*);
+
+  bool load() {
+    void* lib = ::dlopen("libsqlite3.so.0", RTLD_NOW | RTLD_GLOBAL);
+    if (!lib) lib = ::dlopen("libsqlite3.so", RTLD_NOW | RTLD_GLOBAL);
+    if (!lib) return false;
+    auto sym = [&](const char* name) { return ::dlsym(lib, name); };
+    open = reinterpret_cast<decltype(open)>(sym("sqlite3_open"));
+    close = reinterpret_cast<decltype(close)>(sym("sqlite3_close"));
+    exec = reinterpret_cast<decltype(exec)>(sym("sqlite3_exec"));
+    prepare = reinterpret_cast<decltype(prepare)>(sym("sqlite3_prepare_v2"));
+    step = reinterpret_cast<decltype(step)>(sym("sqlite3_step"));
+    reset = reinterpret_cast<decltype(reset)>(sym("sqlite3_reset"));
+    finalize = reinterpret_cast<decltype(finalize)>(sym("sqlite3_finalize"));
+    bind_text =
+        reinterpret_cast<decltype(bind_text)>(sym("sqlite3_bind_text"));
+    bind_int64 =
+        reinterpret_cast<decltype(bind_int64)>(sym("sqlite3_bind_int64"));
+    column_text =
+        reinterpret_cast<decltype(column_text)>(sym("sqlite3_column_text"));
+    errmsg = reinterpret_cast<decltype(errmsg)>(sym("sqlite3_errmsg"));
+    return open && close && exec && prepare && step && reset && finalize &&
+           bind_text && bind_int64 && column_text && errmsg;
+  }
+};
+
+class SqliteStore : public Store {
+ public:
+  SqliteStore(SqliteApi api, sqlite3* db, std::string data_dir)
+      : api_(api), db_(db), data_dir_(std::move(data_dir)) {}
+
+  ~SqliteStore() override {
+    if (insert_stmt_) api_.finalize(insert_stmt_);
+    if (db_) api_.close(db_);
+  }
+
+  void save_snapshot(const std::string& json) override {
+    exec_bound("INSERT OR REPLACE INTO kv (key, value) VALUES "
+               "('snapshot', ?1)",
+               {json});
+  }
+
+  std::string load_snapshot() override {
+    std::string out;
+    sqlite3_stmt* stmt = nullptr;
+    if (api_.prepare(db_, "SELECT value FROM kv WHERE key = 'snapshot'", -1,
+                     &stmt, nullptr) == kSqliteOk) {
+      if (api_.step(stmt) == kSqliteRow) {
+        const unsigned char* text = api_.column_text(stmt, 0);
+        if (text) out = reinterpret_cast<const char*>(text);
+      }
+      api_.finalize(stmt);
+    }
+    if (!out.empty()) return out;
+    // migration: adopt a files-backend snapshot on first boot
+    std::ifstream in(data_dir_ + "/snapshot.json");
+    if (!in.good()) return "";
+    std::stringstream buf;
+    buf << in.rdbuf();
+    return buf.str();
+  }
+
+  void append(const std::string& stream, const Json& rec) override {
+    append_raw(stream, rec.dump());
+  }
+
+  void append_many(const std::string& stream,
+                   const std::vector<const Json*>& recs) override {
+    if (recs.empty()) return;
+    exec_sql("BEGIN");
+    for (const Json* rec : recs) append(stream, *rec);
+    exec_sql("COMMIT");
+  }
+
+  std::vector<Json> read(const std::string& stream, size_t limit,
+                         size_t offset) override {
+    return query("SELECT body FROM records WHERE stream = ?1 "
+                 "ORDER BY seq LIMIT ?2 OFFSET ?3",
+                 stream, limit, offset);
+  }
+
+  std::vector<Json> read_tail(const std::string& stream,
+                              size_t limit) override {
+    // newest `limit`, returned oldest-first
+    return query("SELECT body FROM (SELECT seq, body FROM records "
+                 "WHERE stream = ?1 ORDER BY seq DESC LIMIT ?2 OFFSET ?3) "
+                 "ORDER BY seq ASC",
+                 stream, limit, 0);
+  }
+
+  const char* kind() const override { return "sqlite"; }
+
+  bool init_schema() {
+    return exec_sql("PRAGMA journal_mode=WAL") &&
+           exec_sql("PRAGMA synchronous=NORMAL") &&
+           exec_sql("CREATE TABLE IF NOT EXISTS kv ("
+                    "key TEXT PRIMARY KEY, value TEXT NOT NULL)") &&
+           exec_sql("CREATE TABLE IF NOT EXISTS records ("
+                    "stream TEXT NOT NULL, seq INTEGER NOT NULL, "
+                    "body TEXT NOT NULL, PRIMARY KEY (stream, seq))");
+  }
+
+  // files→sqlite migration: on an empty records table, import legacy
+  // .jsonl streams so existing metric/log history stays visible through
+  // the API after the backend switch.
+  void migrate_legacy_streams() {
+    sqlite3_stmt* stmt = nullptr;
+    bool empty = true;
+    if (api_.prepare(db_, "SELECT 1 FROM records LIMIT 1", -1, &stmt,
+                     nullptr) == kSqliteOk) {
+      empty = api_.step(stmt) != kSqliteRow;
+      api_.finalize(stmt);
+    }
+    if (!empty) return;
+    DIR* dir = ::opendir(data_dir_.c_str());
+    if (!dir) return;
+    std::vector<std::string> streams;
+    while (dirent* entry = ::readdir(dir)) {
+      const std::string name = entry->d_name;
+      if (name.size() > 6 && name.rfind(".jsonl") == name.size() - 6) {
+        streams.push_back(name);
+      }
+    }
+    ::closedir(dir);
+    for (const auto& stream : streams) {
+      std::ifstream in(data_dir_ + "/" + stream);
+      std::string line;
+      size_t imported = 0;
+      exec_sql("BEGIN");
+      while (std::getline(in, line)) {
+        if (line.empty()) continue;
+        append_raw(stream, line);
+        ++imported;
+      }
+      exec_sql("COMMIT");
+      if (imported) {
+        std::cerr << "[store] migrated " << imported << " records from "
+                  << stream << std::endl;
+      }
+    }
+  }
+
+ private:
+  void append_raw(const std::string& stream, const std::string& body) {
+    // one prepared statement for the hot write path (log batches of 100+)
+    if (!insert_stmt_) {
+      if (api_.prepare(db_,
+                       "INSERT INTO records (stream, seq, body) VALUES (?1, "
+                       "(SELECT COALESCE(MAX(seq), 0) + 1 FROM records "
+                       " WHERE stream = ?1), ?2)",
+                       -1, &insert_stmt_, nullptr) != kSqliteOk) {
+        std::cerr << "[store] sqlite prepare failed: " << api_.errmsg(db_)
+                  << std::endl;
+        return;
+      }
+    }
+    api_.reset(insert_stmt_);
+    api_.bind_text(insert_stmt_, 1, stream.c_str(),
+                   static_cast<int>(stream.size()), kTransient);
+    api_.bind_text(insert_stmt_, 2, body.c_str(),
+                   static_cast<int>(body.size()), kTransient);
+    if (api_.step(insert_stmt_) != kSqliteDone) {
+      std::cerr << "[store] sqlite write failed: " << api_.errmsg(db_)
+                << std::endl;
+    }
+  }
+  bool exec_sql(const char* sql) {
+    char* err = nullptr;
+    if (api_.exec(db_, sql, nullptr, nullptr, &err) != kSqliteOk) {
+      std::cerr << "[store] sqlite: " << (err ? err : "error") << " in "
+                << sql << std::endl;
+      return false;
+    }
+    return true;
+  }
+
+  void exec_bound(const char* sql, const std::vector<std::string>& binds) {
+    sqlite3_stmt* stmt = nullptr;
+    if (api_.prepare(db_, sql, -1, &stmt, nullptr) != kSqliteOk) {
+      std::cerr << "[store] sqlite prepare failed: " << api_.errmsg(db_)
+                << std::endl;
+      return;
+    }
+    for (size_t i = 0; i < binds.size(); ++i) {
+      api_.bind_text(stmt, static_cast<int>(i + 1), binds[i].c_str(),
+                     static_cast<int>(binds[i].size()), kTransient);
+    }
+    if (api_.step(stmt) != kSqliteDone) {
+      std::cerr << "[store] sqlite write failed: " << api_.errmsg(db_)
+                << std::endl;
+    }
+    api_.finalize(stmt);
+  }
+
+  std::vector<Json> query(const char* sql, const std::string& stream,
+                          size_t limit, size_t offset) {
+    std::vector<Json> out;
+    sqlite3_stmt* stmt = nullptr;
+    if (api_.prepare(db_, sql, -1, &stmt, nullptr) != kSqliteOk) {
+      return out;
+    }
+    api_.bind_text(stmt, 1, stream.c_str(), static_cast<int>(stream.size()),
+                   kTransient);
+    api_.bind_int64(stmt, 2, static_cast<long long>(limit));
+    api_.bind_int64(stmt, 3, static_cast<long long>(offset));
+    while (api_.step(stmt) == kSqliteRow) {
+      const unsigned char* text = api_.column_text(stmt, 0);
+      if (!text) continue;
+      try {
+        out.push_back(Json::parse(reinterpret_cast<const char*>(text)));
+      } catch (const std::exception&) {
+      }
+    }
+    api_.finalize(stmt);
+    return out;
+  }
+
+  SqliteApi api_;
+  sqlite3* db_;
+  std::string data_dir_;
+  sqlite3_stmt* insert_stmt_ = nullptr;
+};
+
+}  // namespace
+
+std::unique_ptr<Store> make_file_store(const std::string& data_dir) {
+  return std::make_unique<FileStore>(data_dir);
+}
+
+std::unique_ptr<Store> make_sqlite_store(const std::string& data_dir) {
+  SqliteApi api{};
+  if (!api.load()) return nullptr;
+  sqlite3* db = nullptr;
+  if (api.open((data_dir + "/master.db").c_str(), &db) != kSqliteOk || !db) {
+    if (db) api.close(db);
+    return nullptr;
+  }
+  auto store = std::make_unique<SqliteStore>(api, db, data_dir);
+  if (!store->init_schema()) return nullptr;
+  store->migrate_legacy_streams();
+  return store;
+}
+
+}  // namespace dct

@@ -1,0 +1,49 @@
+// Persistence seam: snapshot document + append-only record streams.
+//
+// ≈ the reference's master/internal/db (Postgres + 144 migrations) scaled
+// to this master's needs: one whole-state snapshot (crash recovery) and
+// per-entity append streams (metrics, task logs, profiler samples) with
+// indexed reads. Two backends:
+//   files  — snapshot.json + per-stream .jsonl appends (the original mode;
+//            reads rescan the file)
+//   sqlite — libsqlite3 loaded at runtime via dlopen (no -dev package in
+//            the image): WAL journal, (stream, seq) primary key, O(log n)
+//            offset/tail reads. The BASELINE.md p95 < 1 s API gate at 25
+//            concurrent readers needs this once history grows.
+#pragma once
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "json.h"
+
+namespace dct {
+
+class Store {
+ public:
+  virtual ~Store() = default;
+
+  virtual void save_snapshot(const std::string& json) = 0;
+  virtual std::string load_snapshot() = 0;  // "" = no snapshot yet
+
+  virtual void append(const std::string& stream, const Json& rec) = 0;
+  virtual void append_many(const std::string& stream,
+                           const std::vector<const Json*>& recs) = 0;
+  // offset/limit page, oldest first (the poll-stream cursor counts
+  // returned records)
+  virtual std::vector<Json> read(const std::string& stream, size_t limit,
+                                 size_t offset) = 0;
+  // newest `limit` records, oldest first
+  virtual std::vector<Json> read_tail(const std::string& stream,
+                                      size_t limit) = 0;
+
+  virtual const char* kind() const = 0;
+};
+
+std::unique_ptr<Store> make_file_store(const std::string& data_dir);
+// nullptr when libsqlite3 cannot be loaded. Falls back to a legacy
+// snapshot.json for the initial load (migration from the files backend).
+std::unique_ptr<Store> make_sqlite_store(const std::string& data_dir);
+
+}  // namespace dct

@@ -1,0 +1,178 @@
+"""API load smoke: the BASELINE.md perf gate, in-process k6 analogue.
+
+≈ the reference's k6 API performance tests
+(performance/src/api_performance_tests.ts:336-374): N concurrent virtual
+users hammer the read endpoints of a master with realistic history and the
+p95 latency must stay under 1 s. Runs against the sqlite store (the
+default) with thousands of metric records so indexed reads are actually
+exercised.
+"""
+import json
+import statistics
+import subprocess
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+MASTER_DIR = REPO / "determined_clone_tpu" / "master"
+MASTER_BIN = MASTER_DIR / "build" / "dct-master"
+
+VUS = 25             # concurrent virtual users (the reference gate's 25)
+REQS_PER_VU = 40
+P95_BUDGET_S = 1.0   # BASELINE.md: p95 < 1 s
+
+
+@pytest.fixture(scope="module")
+def loaded_master(tmp_path_factory):
+    if not MASTER_BIN.exists():
+        r = subprocess.run(["make", "-C", str(MASTER_DIR)],
+                           capture_output=True)
+        if r.returncode != 0:
+            pytest.skip("C++ master build unavailable")
+    tmp = tmp_path_factory.mktemp("load")
+
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [str(MASTER_BIN), "--port", str(port), "--data-dir",
+         str(tmp / "data"), "--db", "sqlite"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/v1/master", timeout=2)
+            break
+        except Exception:
+            time.sleep(0.2)
+    else:
+        proc.kill()
+        pytest.fail("master did not come up")
+
+    def req(method, path, body=None):
+        r = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(body).encode() if body is not None else None,
+            method=method, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            return json.loads(resp.read() or "{}")
+
+    # seed realistic history: an experiment with trials and a deep metric
+    # stream (the read path that used to rescan whole files per request)
+    exp = req("POST", "/api/v1/experiments", {"config": {
+        "name": "load", "entrypoint": "m:T",
+        "searcher": {"name": "custom", "metric": "loss"},
+        "hyperparameters": {"lr": 0.1}}})["experiment"]
+    req("POST", f"/api/v1/experiments/{exp['id']}/searcher/operations",
+        {"ops": [{"type": "create", "request_id": 0, "hparams": {"lr": 0.1}},
+                 {"type": "create", "request_id": 1, "hparams": {"lr": 0.2}},
+                 {"type": "validate_after", "request_id": 0, "units": 100},
+                 {"type": "validate_after", "request_id": 1, "units": 100}]})
+    trials = req("GET", f"/api/v1/experiments/{exp['id']}")["trials"]
+    t0 = time.time()
+    for t in trials:
+        for step in range(0, 2000, 50):
+            req("POST", f"/api/v1/trials/{t['id']}/metrics",
+                {"group": "training", "steps_completed": step,
+                 "metrics": {"loss": 1.0 / (step + 1)}})
+    alloc = f"trial-{trials[0]['id']}.0"
+    for i in range(0, 2000, 100):
+        req("POST", f"/api/v1/allocations/{alloc}/logs",
+            {"logs": [f"line-{i + j}" for j in range(100)]})
+    seed_s = time.time() - t0
+
+    yield {"port": port, "exp_id": exp["id"],
+           "trial_ids": [t["id"] for t in trials], "alloc": alloc,
+           "seed_s": seed_s}
+    proc.kill()
+    proc.wait(timeout=10)
+
+
+def test_p95_under_budget_at_25_vus(loaded_master):
+    port = loaded_master["port"]
+    exp_id = loaded_master["exp_id"]
+    trial_ids = loaded_master["trial_ids"]
+    alloc = loaded_master["alloc"]
+
+    paths = [
+        "/api/v1/experiments",
+        f"/api/v1/experiments/{exp_id}",
+        f"/api/v1/trials/{trial_ids[0]}/metrics?limit=1000",
+        f"/api/v1/trials/{trial_ids[-1]}/metrics?limit=200",
+        f"/api/v1/allocations/{alloc}/logs?limit=500",
+        "/api/v1/agents",
+        "/api/v1/job-queue",
+        "/api/v1/master",
+    ]
+    latencies = []
+    errors = []
+    lock = threading.Lock()
+
+    def vu(vu_idx):
+        for i in range(REQS_PER_VU):
+            path = paths[(vu_idx + i) % len(paths)]
+            t0 = time.perf_counter()
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+                    r.read()
+                dt = time.perf_counter() - t0
+                with lock:
+                    latencies.append(dt)
+            except Exception as exc:  # noqa: BLE001
+                with lock:
+                    errors.append(f"{path}: {exc!r}")
+
+    threads = [threading.Thread(target=vu, args=(i,)) for i in range(VUS)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    assert not errors, errors[:5]
+    assert len(latencies) == VUS * REQS_PER_VU
+    latencies.sort()
+    p50 = latencies[len(latencies) // 2]
+    p95 = latencies[int(len(latencies) * 0.95)]
+    rps = len(latencies) / wall
+    print(f"\n[load] {VUS} VUs x {REQS_PER_VU} reqs: "
+          f"p50={p50 * 1000:.1f}ms p95={p95 * 1000:.1f}ms "
+          f"({rps:.0f} req/s, seed took {loaded_master['seed_s']:.1f}s)")
+    assert p95 < P95_BUDGET_S, f"p95 {p95:.3f}s over the {P95_BUDGET_S}s gate"
+
+
+def test_indexed_offset_reads_do_not_degrade(loaded_master):
+    """Paged reads deep into the stream must not rescan from the start:
+    the last page must cost about the same as the first."""
+    port = loaded_master["port"]
+    trial_id = loaded_master["trial_ids"][0]
+
+    def timed(path, n=30):
+        out = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+                r.read()
+            out.append(time.perf_counter() - t0)
+        return statistics.median(out)
+
+    base = f"/api/v1/trials/{trial_id}/metrics?limit=10"
+    first = timed(base)
+    # the metric route has no offset param; use the logs stream which pages
+    alloc = loaded_master["alloc"]
+    early = timed(f"/api/v1/allocations/{alloc}/logs?limit=10&offset=0")
+    late = timed(f"/api/v1/allocations/{alloc}/logs?limit=10&offset=1950")
+    print(f"\n[load] paged read: first-page {early * 1000:.2f}ms, "
+          f"last-page {late * 1000:.2f}ms (metrics head {first * 1000:.2f}ms)")
+    # generous bound: deep pages may cost more, but not order-of-magnitude
+    assert late < max(early * 20, 0.25)

@@ -119,6 +119,25 @@ def wait_for(predicate, timeout=60, interval=0.3, desc="condition"):
     raise AssertionError(f"timed out waiting for {desc}")
 
 
+def read_master_snapshot(data_dir):
+    """The persisted master state, whichever store backend is active
+    (sqlite kv table, or the legacy snapshot.json)."""
+    db = data_dir / "master.db"
+    if db.exists():
+        import sqlite3
+
+        with sqlite3.connect(db) as conn:
+            row = conn.execute(
+                "SELECT value FROM kv WHERE key='snapshot'").fetchone()
+        if row:
+            return json.loads(row[0])
+        return None
+    snap = data_dir / "snapshot.json"
+    if snap.exists():
+        return json.loads(snap.read_text())
+    return None
+
+
 def test_anonymous_api_rejected(cluster):
     port = cluster["port"]
     for method, path in [
@@ -161,12 +180,14 @@ def test_password_change_uses_kdf(cluster):
     status, _ = raw_request(port, "POST", "/api/v1/auth/login",
                             {"username": "kdfuser", "password": "second"})
     assert status == 200
-    # the persisted hash is the KDF format, not a bare FNV hex (snapshot.json)
-    snap = cluster["tmp"] / "master-data" / "snapshot.json"
-    wait_for(lambda: snap.exists() and "kdfuser" in snap.read_text(),
-             desc="snapshot with kdfuser")
-    stored = [u for u in json.loads(snap.read_text())["users"]
-              if u["username"] == "kdfuser"][0]
+    # the persisted hash is the KDF format, not a bare FNV hex
+    data_dir = cluster["tmp"] / "master-data"
+    snap = wait_for(
+        lambda: (lambda s: s if s and any(
+            u["username"] == "kdfuser" for u in s.get("users", []))
+            else None)(read_master_snapshot(data_dir)),
+        desc="snapshot with kdfuser")
+    stored = [u for u in snap["users"] if u["username"] == "kdfuser"][0]
     assert stored["password_hash"].startswith("pbkdf2_sha256$")
 
 
@@ -218,12 +239,12 @@ def test_alloc_token_is_readonly_scoped(cluster):
     session = cluster["session"]
     port = cluster["port"]
     task = session.create_task("shell", name="scope-sh")
-    snap = cluster["tmp"] / "master-data" / "snapshot.json"
+    data_dir = cluster["tmp"] / "master-data"
     alloc_token = wait_for(
         lambda: next((a.get("token") for a in
-                      json.loads(snap.read_text()).get("allocations", [])
-                      if a["id"] == task["id"] and a.get("token")), None)
-        if snap.exists() else None,
+                      (read_master_snapshot(data_dir) or {}).get(
+                          "allocations", [])
+                      if a["id"] == task["id"] and a.get("token")), None),
         desc="allocation token persisted")
     headers = {"Authorization": f"Bearer {alloc_token}"}
     status, _ = raw_request(port, "GET", "/api/v1/experiments",
