@@ -322,6 +322,24 @@ HttpResponse Master::route(const HttpRequest& req) {
           }
         }
       }
+      // topology requests must agree with the slot count, or capacity
+      // gating (slots) and chip-grid bookkeeping (shape) silently diverge
+      if (config["resources"].is_object() &&
+          !config["resources"]["topology"].as_string().empty()) {
+        const std::string& topo = config["resources"]["topology"].as_string();
+        int slots = static_cast<int>(
+            config["resources"]["slots_per_trial"].as_int(1));
+        SliceShape shape = parse_topology(topo, slots);
+        if (shape.gen.empty()) {
+          return bad_request("unrecognized topology '" + topo +
+                             "' (expected e.g. v5e-8)");
+        }
+        if (shape.chips() != slots) {
+          return bad_request(
+              "topology " + topo + " is " + std::to_string(shape.chips()) +
+              " chips but slots_per_trial is " + std::to_string(slots));
+        }
+      }
       // validate the context upload BEFORE any state mutates — a 400 must
       // truly leave no side effects (no trials, allocations, workspaces)
       if (body["context"].is_array() && body["context"].size() > 0) {

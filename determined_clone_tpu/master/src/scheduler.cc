@@ -5,8 +5,16 @@
 namespace dct {
 namespace {
 
+// Shape-aware (SURVEY §7): a requested topology is satisfied by any agent
+// whose slice CONTAINS it — "v5e-4" fits inside a v5e-8 slice as a 2x2
+// sub-torus. Generations must match when both are named; plain string
+// equality (the reference's semantics) falls out as a special case.
 bool topology_ok(const Allocation& alloc, const Agent& agent) {
-  return alloc.topology.empty() || alloc.topology == agent.topology;
+  if (alloc.topology.empty() || alloc.topology == agent.topology) {
+    return true;
+  }
+  return shape_fits(parse_topology(alloc.topology, alloc.slots),
+                    parse_topology(agent.topology, agent.slots));
 }
 
 bool agent_usable(const Allocation& alloc, const Agent& agent,
@@ -21,10 +29,49 @@ bool agent_usable(const Allocation& alloc, const Agent& agent,
 
 }  // namespace
 
+std::map<std::string, ChipGrid> build_chip_grids(
+    const std::vector<Agent>& agents,
+    const std::vector<Allocation>& running) {
+  std::map<std::string, ChipGrid> grids;
+  for (const auto& a : agents) {
+    SliceShape shape = parse_topology(a.topology, a.slots);
+    if (shape.chips() != a.slots) {
+      // advertised slots disagree with the topology string (artificial
+      // slots in tests, misconfig): trust slots, flat-contiguous grid
+      shape = SliceShape{};
+      shape.rows = 1;
+      shape.cols = std::max(1, a.slots);
+    }
+    grids.emplace(a.id, ChipGrid(shape));
+  }
+  // deterministic replay: same inputs -> same placements across ticks
+  std::vector<const Allocation*> ordered;
+  for (const auto& r : running) ordered.push_back(&r);
+  std::sort(ordered.begin(), ordered.end(),
+            [](const Allocation* a, const Allocation* b) {
+              return a->queued_at != b->queued_at
+                         ? a->queued_at < b->queued_at
+                         : a->id < b->id;
+            });
+  for (const Allocation* r : ordered) {
+    for (const auto& [aid, n] : r->reservations) {
+      auto it = grids.find(aid);
+      if (it == grids.end() || n <= 0) continue;
+      if (!it->second.place(n, r->id)) {
+        // drifted state (e.g. restored pre-topology reservations that no
+        // longer tile): stay count-consistent rather than lose capacity
+        it->second.force_place(n, r->id);
+      }
+    }
+  }
+  return grids;
+}
+
 std::optional<std::map<std::string, int>> find_fit(
     const Allocation& alloc, const std::vector<Agent>& agents,
     const std::map<std::string, int>& free_slots,
-    const std::string& experiment_key) {
+    const std::string& experiment_key,
+    const std::map<std::string, ChipGrid>* grids) {
   if (alloc.slots == 0) {
     // zero-slot (cpu-only aux task): place on the least-loaded usable agent
     const Agent* best = nullptr;
@@ -40,15 +87,26 @@ std::optional<std::map<std::string, int>> find_fit(
   }
 
   // 1) best single-agent fit: smallest free-slot surplus (bin packing),
-  //    exact-capacity agents preferred (whole-slice reservations keep the
-  //    ICI torus unfragmented).
+  //    exact-capacity agents preferred, AND — with grids — a contiguous
+  //    free rectangle must exist: n free chips scattered across the torus
+  //    do not make an n-chip gang (fragmentation-aware fitting, SURVEY §7)
   const Agent* best = nullptr;
   int best_surplus = 1 << 30;
+  SliceShape req_shape = parse_topology(alloc.topology, alloc.slots);
   for (const auto& a : agents) {
     if (!agent_usable(alloc, a, experiment_key)) continue;
     auto it = free_slots.find(a.id);
     int free = it == free_slots.end() ? 0 : it->second;
     if (free < alloc.slots) continue;
+    if (grids) {
+      auto git = grids->find(a.id);
+      if (git != grids->end()) {
+        bool ok = alloc.topology.empty()
+                      ? git->second.can_place(alloc.slots)
+                      : git->second.can_place_shape(req_shape);
+        if (!ok) continue;
+      }
+    }
     int surplus = free - alloc.slots;
     // prefer exact whole-agent fits, then minimal surplus
     if (surplus < best_surplus) { best = &a; best_surplus = surplus; }
@@ -120,12 +178,34 @@ SchedulerDecision schedule_pool(
               });
   }
 
+  // chip grids: running reservations placed as rectangles, so sub-slice
+  // fits below are contiguity-aware (topology.h)
+  auto grids = build_chip_grids(agents, running);
+  auto grid_place = [&](std::map<std::string, ChipGrid>& g,
+                        const Allocation& alloc, const std::string& aid,
+                        int n) {
+    auto git = g.find(aid);
+    if (git == g.end() || n <= 0) return;
+    // place THIS AGENT's contribution (n), as the requested shape only
+    // when the whole gang lands on this one agent — a multi-agent member
+    // contributes n chips, not the full request shape
+    bool ok = (!alloc.topology.empty() && n == alloc.slots)
+                  ? git->second.place_shape(
+                        parse_topology(alloc.topology, alloc.slots),
+                        alloc.id)
+                  : git->second.place(n, alloc.id);
+    if (!ok) git->second.force_place(n, alloc.id);
+  };
+
   std::map<std::string, int> usage = share_usage;
   for (auto& alloc : pending) {
     std::string key = owner_key(alloc);
-    auto fit = find_fit(alloc, agents, free_slots, key);
+    auto fit = find_fit(alloc, agents, free_slots, key, &grids);
     if (fit) {
-      for (const auto& [aid, n] : *fit) free_slots[aid] -= n;
+      for (const auto& [aid, n] : *fit) {
+        free_slots[aid] -= n;
+        grid_place(grids, alloc, aid, n);
+      }
       usage[key] += alloc.slots;
       decision.assignments[alloc.id] = *fit;
       continue;
@@ -142,13 +222,18 @@ SchedulerDecision schedule_pool(
                   return a->queued_at > b->queued_at;
                 });
       auto trial_free = free_slots;
+      auto trial_grids = grids;
       std::vector<std::string> chosen;
+      bool fits_after = false;
       for (const auto* v : victims) {
         for (const auto& [aid, n] : v->reservations) trial_free[aid] += n;
+        for (auto& [aid, grid] : trial_grids) grid.release(v->id);
         chosen.push_back(v->id);
-        if (find_fit(alloc, agents, trial_free, key)) break;
+        fits_after =
+            find_fit(alloc, agents, trial_free, key, &trial_grids).has_value();
+        if (fits_after) break;
       }
-      if (!chosen.empty() && find_fit(alloc, agents, trial_free, key)) {
+      if (!chosen.empty() && fits_after) {
         // request preemption now; the allocation schedules on a later tick
         // once the victims have checkpointed and released
         for (const auto& id : chosen) decision.preemptions.push_back(id);

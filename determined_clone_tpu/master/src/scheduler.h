@@ -16,6 +16,7 @@
 #include <vector>
 
 #include "model.h"
+#include "topology.h"
 
 namespace dct {
 
@@ -46,9 +47,20 @@ SchedulerDecision schedule_pool(
     const std::map<std::string, std::string>& owner_of_alloc);
 
 // Gang fit for one allocation. Returns agent->slots or nullopt.
+// `grids` (optional): per-agent chip grids with the running reservations
+// placed — single-agent sub-slice fits then require a contiguous free
+// rectangle (topology.h), not just a free count. Null = count-based only.
 std::optional<std::map<std::string, int>> find_fit(
     const Allocation& alloc, const std::vector<Agent>& agents,
     const std::map<std::string, int>& free_slots,
-    const std::string& experiment_key);
+    const std::string& experiment_key,
+    const std::map<std::string, ChipGrid>* grids = nullptr);
+
+// Per-agent chip grids with every running allocation's reservation placed
+// (deterministic replay in queued_at order; rectangle placement with a
+// count-based fallback for drifted state).
+std::map<std::string, ChipGrid> build_chip_grids(
+    const std::vector<Agent>& agents,
+    const std::vector<Allocation>& running);
 
 }  // namespace dct

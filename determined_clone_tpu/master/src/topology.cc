@@ -1,0 +1,153 @@
+#include "topology.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstdlib>
+
+namespace dct {
+
+SliceShape parse_topology(const std::string& topo, int slots_hint) {
+  SliceShape flat;
+  flat.rows = 1;
+  flat.cols = std::max(1, slots_hint);
+  auto dash = topo.rfind('-');
+  if (dash == std::string::npos || dash == 0 || dash + 1 >= topo.size()) {
+    return flat;
+  }
+  int n = std::atoi(topo.c_str() + dash + 1);
+  if (n <= 0) return flat;
+  SliceShape out;
+  out.gen = topo.substr(0, dash);
+  // standard near-square slice: rows = largest divisor <= sqrt(n)
+  // (1->1x1, 4->2x2, 8->2x4, 16->4x4, 32->4x8, 64->8x8)
+  int rows = 1;
+  for (int r = 1; r * r <= n; ++r) {
+    if (n % r == 0) rows = r;
+  }
+  out.rows = rows;
+  out.cols = n / rows;
+  return out;
+}
+
+bool shape_fits(const SliceShape& req, const SliceShape& have) {
+  // generations must MATCH — an unknown/absent generation on either side
+  // is not a wildcard, or a "v5e-2" gang would schedule onto a topology-
+  // less CPU host and crash at runtime (exact-string equality is handled
+  // by the caller before shapes are consulted)
+  if (req.gen != have.gen) return false;
+  return (req.rows <= have.rows && req.cols <= have.cols) ||
+         (req.cols <= have.rows && req.rows <= have.cols);
+}
+
+ChipGrid::ChipGrid(SliceShape shape)
+    : shape_(shape),
+      owner_(static_cast<size_t>(shape.rows) * shape.cols) {}
+
+bool ChipGrid::rect_free(int r0, int c0, int r, int c) const {
+  if (r0 + r > shape_.rows || c0 + c > shape_.cols) return false;
+  for (int i = r0; i < r0 + r; ++i) {
+    for (int j = c0; j < c0 + c; ++j) {
+      if (!owner_[i * shape_.cols + j].empty()) return false;
+    }
+  }
+  return true;
+}
+
+void ChipGrid::mark(const Rect& rect, const std::string& owner) {
+  for (int i = rect.r0; i < rect.r0 + rect.r; ++i) {
+    for (int j = rect.c0; j < rect.c0 + rect.c; ++j) {
+      owner_[i * shape_.cols + j] = owner;
+    }
+  }
+}
+
+bool ChipGrid::find_rect(int area, Rect* out) const {
+  if (area <= 0) {
+    *out = Rect{0, 0, 0, 0};
+    return true;
+  }
+  // candidate rectangles of this area, squarest first (|r - c| minimal):
+  // a squarer sub-torus has the better bisection for the gang
+  std::vector<std::pair<int, int>> shapes;
+  for (int r = 1; r <= shape_.rows; ++r) {
+    if (area % r == 0 && area / r <= shape_.cols) {
+      shapes.emplace_back(r, area / r);
+    }
+  }
+  std::sort(shapes.begin(), shapes.end(), [](auto a, auto b) {
+    return std::abs(a.first - a.second) < std::abs(b.first - b.second);
+  });
+  for (auto [r, c] : shapes) {
+    for (int r0 = 0; r0 + r <= shape_.rows; ++r0) {
+      for (int c0 = 0; c0 + c <= shape_.cols; ++c0) {
+        if (rect_free(r0, c0, r, c)) {
+          *out = Rect{r0, c0, r, c};
+          return true;
+        }
+      }
+    }
+  }
+  return false;
+}
+
+bool ChipGrid::find_shape(const SliceShape& req, Rect* out) const {
+  for (auto [r, c] : {std::pair<int, int>{req.rows, req.cols},
+                      std::pair<int, int>{req.cols, req.rows}}) {
+    for (int r0 = 0; r0 + r <= shape_.rows; ++r0) {
+      for (int c0 = 0; c0 + c <= shape_.cols; ++c0) {
+        if (rect_free(r0, c0, r, c)) {
+          *out = Rect{r0, c0, r, c};
+          return true;
+        }
+      }
+    }
+  }
+  return false;
+}
+
+bool ChipGrid::place(int n, const std::string& owner) {
+  Rect rect{};
+  if (!find_rect(n, &rect)) return false;
+  mark(rect, owner);
+  return true;
+}
+bool ChipGrid::can_place(int n) const {
+  Rect rect{};
+  return find_rect(n, &rect);
+}
+bool ChipGrid::place_shape(const SliceShape& req, const std::string& owner) {
+  Rect rect{};
+  if (!find_shape(req, &rect)) return false;
+  mark(rect, owner);
+  return true;
+}
+bool ChipGrid::can_place_shape(const SliceShape& req) const {
+  Rect rect{};
+  return find_shape(req, &rect);
+}
+
+void ChipGrid::force_place(int n, const std::string& owner) {
+  for (auto& cell : owner_) {
+    if (n <= 0) break;
+    if (cell.empty()) {
+      cell = owner;
+      --n;
+    }
+  }
+}
+
+void ChipGrid::release(const std::string& owner) {
+  for (auto& cell : owner_) {
+    if (cell == owner) cell.clear();
+  }
+}
+
+int ChipGrid::free_chips() const {
+  int n = 0;
+  for (const auto& cell : owner_) {
+    if (cell.empty()) ++n;
+  }
+  return n;
+}
+
+}  // namespace dct

@@ -1,0 +1,72 @@
+// TPU slice topology: shapes and contiguous sub-slice reservation.
+//
+// SURVEY §7 names topology-aware gang fitting a hard part of the
+// TPU-native design; the reference's fitting is flat slot counts
+// (master/internal/rm/agentrm/fitting.go:71). Here an agent's slice is a
+// 2-D ICI torus (v5e-8 = 2x4, v5e-16 = 4x4, ...) and a sub-slice
+// reservation must be a contiguous rectangle — a gang scattered over
+// non-adjacent chips would put its collectives on degraded paths. The
+// consequence the scheduler must honor: n free chips do NOT imply an
+// n-chip gang fits (fragmentation), and non-rectangular counts (e.g. 5 on
+// a 2x4) never fit a sub-slice.
+#pragma once
+
+#include <string>
+#include <vector>
+
+namespace dct {
+
+struct SliceShape {
+  std::string gen;  // "v5e", "v4", ... ("" = unknown/flat)
+  int rows = 1;
+  int cols = 1;
+  int chips() const { return rows * cols; }
+};
+
+// "v5e-8" -> {v5e, 2, 4}. Chip counts map to the standard near-square
+// slice shapes (8 -> 2x4, 16 -> 4x4, 32 -> 4x8). Unparseable topologies
+// (e.g. "cpu", "") become a flat 1 x slots_hint row — every reservation
+// contiguous, the pre-topology behavior.
+SliceShape parse_topology(const std::string& topo, int slots_hint = 1);
+
+// True when a slice of shape `req` fits inside an agent slice `have`:
+// generations must match exactly (unknown is NOT a wildcard) and the
+// rectangle must fit in either orientation.
+bool shape_fits(const SliceShape& req, const SliceShape& have);
+
+// One agent's chip grid with rectangle reservations.
+class ChipGrid {
+ public:
+  explicit ChipGrid(SliceShape shape);
+
+  // Reserve n chips as one contiguous free rectangle (squarest candidate
+  // first — better bisection for the gang's collectives). False when no
+  // free rectangle of area n exists, even if n chips are free.
+  bool place(int n, const std::string& owner);
+  bool can_place(int n) const;
+  // Reserve a specific sub-slice shape (topology-requesting gangs).
+  bool place_shape(const SliceShape& req, const std::string& owner);
+  bool can_place_shape(const SliceShape& req) const;
+  // Count-based fallback for replaying persisted reservations that no
+  // longer fit a rectangle (state drift): marks the first n free cells.
+  void force_place(int n, const std::string& owner);
+  void release(const std::string& owner);
+
+  int free_chips() const;
+  const SliceShape& shape() const { return shape_; }
+
+ private:
+  struct Rect {
+    int r0, c0, r, c;
+  };
+  bool rect_free(int r0, int c0, int r, int c) const;
+  void mark(const Rect& rect, const std::string& owner);
+  // const searches; place() marks the found rectangle
+  bool find_rect(int area, Rect* out) const;
+  bool find_shape(const SliceShape& req, Rect* out) const;
+
+  SliceShape shape_;
+  std::vector<std::string> owner_;  // rows*cols cells; "" = free
+};
+
+}  // namespace dct

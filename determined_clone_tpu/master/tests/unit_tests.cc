@@ -7,8 +7,11 @@
 #include <iostream>
 #include <set>
 
+#include <random>
+
 #include "../../agent/src/docker.h"
 #include "../src/crypto.h"
+#include "../src/topology.h"
 #include "../src/json.h"
 #include "../src/master.h"
 #include "../src/provisioner.h"
@@ -700,11 +703,136 @@ void test_docker_argv() {
         std::string::npos);
 }
 
+void test_topology() {
+  // slice shapes
+  auto s8 = parse_topology("v5e-8");
+  CHECK(s8.gen == "v5e" && s8.rows == 2 && s8.cols == 4);
+  auto s16 = parse_topology("v5e-16");
+  CHECK(s16.rows == 4 && s16.cols == 4);
+  CHECK(parse_topology("v5e-1").chips() == 1);
+  auto s32 = parse_topology("v4-32");
+  CHECK(s32.gen == "v4" && s32.rows == 4 && s32.cols == 8);
+  auto flat = parse_topology("cpu", 3);  // unknown: flat row
+  CHECK(flat.gen.empty() && flat.rows == 1 && flat.cols == 3);
+  // containment: v5e-4 (2x2) fits in v5e-8 (2x4); generations must match
+  CHECK(shape_fits(parse_topology("v5e-4"), s8));
+  CHECK(!shape_fits(parse_topology("v4-4"), s8));
+  CHECK(!shape_fits(s16, s8));
+  CHECK(shape_fits(parse_topology("v5e-8"), s16));
+
+  // contiguous placement on a 2x4 torus
+  ChipGrid g(s8);
+  CHECK(g.place(4, "a"));           // 2x2 (squarest)
+  CHECK(g.place(4, "b"));           // remaining 2x2
+  CHECK(!g.can_place(1));
+  g.release("a");
+  CHECK(g.free_chips() == 4);
+  CHECK(g.place(2, "c") && g.place(2, "d"));
+  // non-rectangular counts never fit a sub-slice
+  ChipGrid g2(s8);
+  CHECK(!g2.can_place(5));          // no rectangle of area 5 in 2x4
+  CHECK(g2.can_place(3));           // 1x3 is contiguous
+  // fragmentation: free count 4 but no free rectangle of 4
+  ChipGrid g3(s8);
+  CHECK(g3.place(2, "p1") && g3.place(2, "p2") &&
+        g3.place(2, "p3") && g3.place(2, "p4"));
+  g3.release("p1");                 // opposite corners free
+  g3.release("p4");
+  CHECK(g3.free_chips() == 4);
+  CHECK(!g3.can_place(4));          // count-feasible, shape-infeasible
+  CHECK(g3.can_place(2));
+  // shape-specific reservation
+  ChipGrid g4(s16);
+  CHECK(g4.place_shape(parse_topology("v5e-8"), "x"));  // 2x4 in 4x4
+  CHECK(g4.place_shape(parse_topology("v5e-8"), "y"));
+  CHECK(!g4.can_place_shape(parse_topology("v5e-4")));
+
+  // property: random place/release sequences keep invariants (no overlap,
+  // in-bounds, counts consistent)
+  std::mt19937_64 rng(42);
+  ChipGrid pg(s16);
+  std::map<std::string, int> live;  // owner -> chips
+  int next = 0;
+  for (int step = 0; step < 500; ++step) {
+    if (live.empty() || rng() % 2 == 0) {
+      int n = static_cast<int>(rng() % 8) + 1;
+      std::string owner = "o" + std::to_string(next++);
+      int before = pg.free_chips();
+      if (pg.place(n, owner)) {
+        CHECK(pg.free_chips() == before - n);
+        live[owner] = n;
+      } else {
+        CHECK(pg.free_chips() == before);  // failed place mutates nothing
+      }
+    } else {
+      auto it = live.begin();
+      std::advance(it, rng() % live.size());
+      int before = pg.free_chips();
+      pg.release(it->first);
+      CHECK(pg.free_chips() == before + it->second);
+      live.erase(it);
+    }
+    int held = 0;
+    for (const auto& [o, n] : live) held += n;
+    CHECK(pg.free_chips() == 16 - held);
+  }
+
+  // scheduler level: fragmentation-aware single-agent fitting
+  Agent agent;
+  agent.id = "a1";
+  agent.slots = 8;
+  agent.topology = "v5e-8";
+  Allocation mk;
+  mk.task_type = "trial";
+  auto fit_with = [&](int slots, const std::string& topo,
+                      const std::vector<Allocation>& running) {
+    Allocation a = mk;
+    a.id = "want";
+    a.slots = slots;
+    a.topology = topo;
+    std::map<std::string, int> free = {{"a1", agent.slots}};
+    for (const auto& r : running) {
+      for (const auto& [aid, n] : r.reservations) free[aid] -= n;
+    }
+    auto grids = build_chip_grids({agent}, running);
+    return find_fit(a, {agent}, free, "", &grids).has_value();
+  };
+  CHECK(fit_with(8, "", {}));
+  CHECK(!fit_with(5, "", {}));       // non-rectangular: rejected up front
+  Allocation r1 = mk;
+  r1.id = "r1";
+  r1.slots = 6;
+  r1.queued_at = 1;
+  r1.reservations = {{"a1", 6}};     // 2x3 rectangle
+  CHECK(fit_with(2, "", {r1}));      // 2x1 fits beside it
+  CHECK(!fit_with(4, "", {r1}));     // only 2 chips free
+  // sub-slice topology request fits inside a larger slice
+  CHECK(fit_with(4, "v5e-4", {}));
+  CHECK(!fit_with(4, "v4-4", {}));   // generation mismatch
+  // unknown generation is NOT a wildcard: a TPU gang must not land on a
+  // topology-less (CPU) host
+  Agent cpu_agent;
+  cpu_agent.id = "cpu1";
+  cpu_agent.slots = 4;
+  cpu_agent.topology = "cpu";
+  Allocation want_tpu = mk;
+  want_tpu.id = "wt";
+  want_tpu.slots = 2;
+  want_tpu.topology = "v5e-2";
+  std::map<std::string, int> cpu_free = {{"cpu1", 4}};
+  auto cpu_grids = build_chip_grids({cpu_agent}, {});
+  CHECK(!find_fit(want_tpu, {cpu_agent}, cpu_free, "", &cpu_grids));
+  // ...while a topology-less request still uses any host
+  want_tpu.topology = "";
+  CHECK(find_fit(want_tpu, {cpu_agent}, cpu_free, "", &cpu_grids));
+}
+
 int run_all() {
   test_crypto();
   test_custom_search();
   test_provisioner();
   test_docker_argv();
+  test_topology();
   test_json();
   test_hparam_sampling();
   test_search_methods();
