@@ -278,3 +278,43 @@ class MasterSession:
             "url": url, "triggers": triggers or [],
             "webhook_type": webhook_type,
         })["webhook"]
+
+    # -- groups / rbac (≈ usergroup + rbac services) ------------------------
+
+    def create_group(self, name: str,
+                     user_ids: Optional[list] = None) -> Dict[str, Any]:
+        return self.post("/api/v1/groups", {
+            "name": name, "user_ids": user_ids or [],
+        })["group"]
+
+    def list_groups(self) -> list:
+        return self.get("/api/v1/groups")["groups"]
+
+    def update_group_members(self, group_id: int,
+                             add: Optional[list] = None,
+                             remove: Optional[list] = None) -> Dict[str, Any]:
+        return self.post(f"/api/v1/groups/{group_id}/members", {
+            "add": add or [], "remove": remove or [],
+        })["group"]
+
+    def delete_group(self, group_id: int) -> None:
+        self.request("DELETE", f"/api/v1/groups/{group_id}")
+
+    def list_roles(self) -> list:
+        return self.get("/api/v1/rbac/roles")["roles"]
+
+    def assign_role(self, role: str, *, user_id: int = 0, group_id: int = 0,
+                    workspace_id: int = 0) -> Dict[str, Any]:
+        return self.post("/api/v1/rbac/assignments", {
+            "role": role, "user_id": user_id, "group_id": group_id,
+            "workspace_id": workspace_id,
+        })["assignment"]
+
+    def list_role_assignments(self) -> list:
+        return self.get("/api/v1/rbac/assignments")["assignments"]
+
+    def remove_role_assignment(self, assignment_id: int) -> None:
+        self.request("DELETE", f"/api/v1/rbac/assignments/{assignment_id}")
+
+    def my_permissions(self, workspace_id: int = 0) -> Dict[str, Any]:
+        return self.get(f"/api/v1/rbac/me?workspace_id={workspace_id}")

@@ -396,6 +396,60 @@ def cmd_webhook_delete(args) -> int:
     return 0
 
 
+def cmd_group_create(args) -> int:
+    g = make_session(args).create_group(args.name, user_ids=args.user or [])
+    print(f"Created group {g['name']} (id {g['id']})")
+    return 0
+
+
+def cmd_group_list(args) -> int:
+    print_table(make_session(args).list_groups(), ["id", "name", "user_ids"])
+    return 0
+
+
+def cmd_group_members(args) -> int:
+    g = make_session(args).update_group_members(
+        args.group_id, add=args.add or [], remove=args.remove or [])
+    print(f"Group {g['name']} members: {g['user_ids']}")
+    return 0
+
+
+def cmd_group_delete(args) -> int:
+    make_session(args).delete_group(args.group_id)
+    print(f"Deleted group {args.group_id}")
+    return 0
+
+
+def cmd_rbac_list_roles(args) -> int:
+    print_table(make_session(args).list_roles(), ["name", "rank"])
+    return 0
+
+
+def cmd_rbac_assign(args) -> int:
+    a = make_session(args).assign_role(
+        args.role, user_id=args.user_id or 0, group_id=args.group_id or 0,
+        workspace_id=args.workspace_id or 0)
+    print(f"Assigned {a['role']} (assignment {a['id']})")
+    return 0
+
+
+def cmd_rbac_list_assignments(args) -> int:
+    print_table(make_session(args).list_role_assignments(),
+                ["id", "role", "user_id", "group_id", "workspace_id"])
+    return 0
+
+
+def cmd_rbac_unassign(args) -> int:
+    make_session(args).remove_role_assignment(args.assignment_id)
+    print(f"Removed assignment {args.assignment_id}")
+    return 0
+
+
+def cmd_rbac_me(args) -> int:
+    print_json(make_session(args).my_permissions(args.workspace_id or 0))
+    return 0
+
+
 def cmd_deploy_up(args) -> int:
     from determined_clone_tpu.deploy import cluster_up
 
@@ -625,6 +679,43 @@ def build_parser() -> argparse.ArgumentParser:
     c = swh.add_parser("delete")
     c.add_argument("webhook_id", type=int)
     c.set_defaults(func=cmd_webhook_delete)
+
+    # group (≈ det user-group)
+    p_grp = sub.add_parser("group", help="user groups")
+    sg = p_grp.add_subparsers(dest="subcommand", required=True)
+    c = sg.add_parser("create")
+    c.add_argument("name")
+    c.add_argument("--user", action="append", type=int, default=None,
+                   help="user id to add (repeatable)")
+    c.set_defaults(func=cmd_group_create)
+    sg.add_parser("list").set_defaults(func=cmd_group_list)
+    c = sg.add_parser("members")
+    c.add_argument("group_id", type=int)
+    c.add_argument("--add", action="append", type=int, default=None)
+    c.add_argument("--remove", action="append", type=int, default=None)
+    c.set_defaults(func=cmd_group_members)
+    c = sg.add_parser("delete")
+    c.add_argument("group_id", type=int)
+    c.set_defaults(func=cmd_group_delete)
+
+    # rbac (≈ det rbac)
+    p_rbac = sub.add_parser("rbac", help="roles and assignments")
+    sr = p_rbac.add_subparsers(dest="subcommand", required=True)
+    sr.add_parser("list-roles").set_defaults(func=cmd_rbac_list_roles)
+    c = sr.add_parser("assign")
+    c.add_argument("role")
+    c.add_argument("--user-id", type=int, default=None)
+    c.add_argument("--group-id", type=int, default=None)
+    c.add_argument("--workspace-id", type=int, default=None)
+    c.set_defaults(func=cmd_rbac_assign)
+    sr.add_parser("list-assignments").set_defaults(
+        func=cmd_rbac_list_assignments)
+    c = sr.add_parser("unassign")
+    c.add_argument("assignment_id", type=int)
+    c.set_defaults(func=cmd_rbac_unassign)
+    c = sr.add_parser("me")
+    c.add_argument("--workspace-id", type=int, default=None)
+    c.set_defaults(func=cmd_rbac_me)
 
     # deploy
     p_dep = sub.add_parser("deploy", help="cluster deployment")

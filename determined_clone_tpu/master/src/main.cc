@@ -29,6 +29,8 @@ int main(int argc, char** argv) {
       config.agent_timeout_sec = std::atof(argv[++i]);
     } else if (!std::strcmp(argv[i], "--auth-required")) {
       config.auth_required = true;
+    } else if (!std::strcmp(argv[i], "--rbac")) {
+      config.rbac_enabled = true;
     } else if (!std::strcmp(argv[i], "--webui-dir") && i + 1 < argc) {
       config.webui_dir = argv[++i];
     } else if (!std::strcmp(argv[i], "--db") && i + 1 < argc) {
@@ -63,7 +65,7 @@ int main(int argc, char** argv) {
     } else if (!std::strcmp(argv[i], "--help")) {
       std::cout << "usage: dct-master [--port N] [--data-dir DIR] "
                    "[--scheduler fifo|priority|fair_share] "
-                   "[--agent-timeout SEC] [--auth-required] "
+                   "[--agent-timeout SEC] [--auth-required] [--rbac] "
                    "[--webui-dir DIR] "
                    "[--provision-accelerator TYPE [--provision-zone Z] "
                    "[--provision-project P] [--provision-slots N] "

@@ -155,6 +155,12 @@ void Master::save_snapshot_locked() {
   for (const auto& [name, cfg] : templates_) templates.set(name, cfg);
   Json webhooks = Json::array();
   for (const auto& [id, w] : webhooks_) webhooks.push_back(w.to_json());
+  Json groups = Json::array();
+  for (const auto& [id, g] : groups_) groups.push_back(g.to_json());
+  Json assignments = Json::array();
+  for (const auto& [id, a] : role_assignments_) {
+    assignments.push_back(a.to_json());
+  }
   Json snap = Json::object();
   snap.set("next_experiment_id", next_experiment_id_)
       .set("next_trial_id", next_trial_id_)
@@ -164,13 +170,16 @@ void Master::save_snapshot_locked() {
       .set("next_project_id", next_project_id_)
       .set("next_model_id", next_model_id_)
       .set("next_webhook_id", next_webhook_id_)
+      .set("next_group_id", next_group_id_)
+      .set("next_assignment_id", next_assignment_id_)
       .set("experiments", exps).set("trials", trials)
       .set("allocations", allocs).set("agents", agents)
       .set("checkpoints", ckpts).set("request_to_trial", req_map)
       .set("users", users).set("sessions", sessions)
       .set("workspaces", workspaces).set("projects", projects)
       .set("models", models).set("templates", templates)
-      .set("webhooks", webhooks);
+      .set("webhooks", webhooks).set("groups", groups)
+      .set("role_assignments", assignments);
 
   store_->save_snapshot(snap.dump());
   dirty_ = false;
@@ -251,6 +260,16 @@ void Master::load_snapshot() {
   for (const auto& w : snap["webhooks"].elements()) {
     Webhook hook = Webhook::from_json(w);
     webhooks_[hook.id] = std::move(hook);
+  }
+  next_group_id_ = snap["next_group_id"].as_int(1);
+  next_assignment_id_ = snap["next_assignment_id"].as_int(1);
+  for (const auto& g : snap["groups"].elements()) {
+    Group group = Group::from_json(g);
+    groups_[group.id] = std::move(group);
+  }
+  for (const auto& a : snap["role_assignments"].elements()) {
+    RoleAssignment ra = RoleAssignment::from_json(a);
+    role_assignments_[ra.id] = std::move(ra);
   }
   // rebuild searcher methods from snapshots
   for (auto& [id, exp] : experiments_) {

@@ -37,6 +37,10 @@ struct MasterConfig {
   // Bearer token from /api/v1/auth/login; the agent + data planes stay open
   // (the reference gives those their own allocation tokens)
   bool auth_required = false;
+  // role-based access control (≈ master/internal/rbac, an opt-in feature in
+  // the reference too): when true (and auth_required), mutating routes check
+  // the caller's resolved role at the target workspace's scope
+  bool rbac_enabled = false;
   double session_ttl_sec = 7 * 24 * 3600;
   // static WebUI assets directory ("" disables); served at / and /ui/*
   std::string webui_dir = "webui";
@@ -102,6 +106,18 @@ class Master {
 
   // -- platform helpers (routes_platform.cc) --
   User* current_user(const HttpRequest& req);   // nullptr if no valid token
+  // caller's max role rank at a workspace scope (global assignments count
+  // everywhere; workspace assignments only at that workspace). The admin
+  // flag is ClusterAdmin. 0 = no role.
+  int rbac_rank(const User* u, int64_t workspace_id);
+  // RBAC gate: true when enforcement is off, or the caller's rank at the
+  // scope is >= min_rank (use role_rank("Editor") etc.)
+  bool rbac_allows(const HttpRequest& req, int min_rank,
+                   int64_t workspace_id = 0);
+  // the cluster-admin surface (user/group/role management): legacy admin
+  // flag OR role-granted ClusterAdmin; always passes when auth is off
+  bool cluster_admin_ok(const HttpRequest& req);
+  int64_t workspace_id_by_name(const std::string& name);  // 0 if unknown
   // true when the request bears a live allocation's token (the data-plane
   // analogue of a user session; ≈ the reference's allocation session tokens,
   // master/internal/task/allocation_service.go)
@@ -149,6 +165,8 @@ class Master {
   int64_t next_project_id_ = 1;
   int64_t next_model_id_ = 1;
   int64_t next_webhook_id_ = 1;
+  int64_t next_group_id_ = 1;
+  int64_t next_assignment_id_ = 1;
   std::map<int64_t, User> users_;
   std::map<std::string, SessionToken> sessions_;
   std::map<int64_t, Workspace> workspaces_;
@@ -156,6 +174,8 @@ class Master {
   std::map<int64_t, RegisteredModel> models_;
   std::map<std::string, Json> templates_;
   std::map<int64_t, Webhook> webhooks_;
+  std::map<int64_t, Group> groups_;
+  std::map<int64_t, RoleAssignment> role_assignments_;
   // compiled log-pattern policies per experiment (lazy; not persisted)
   struct CompiledLogPolicy {
     std::regex re;

@@ -192,6 +192,71 @@ struct RegisteredModel {
   }
 };
 
+// ≈ master/internal/usergroup: named sets of users, assignable to roles
+struct Group {
+  int64_t id = 0;
+  std::string name;
+  std::vector<int64_t> user_ids;
+
+  bool has_user(int64_t uid) const {
+    return std::find(user_ids.begin(), user_ids.end(), uid) != user_ids.end();
+  }
+  Json to_json() const {
+    Json members = Json::array();
+    for (int64_t uid : user_ids) members.push_back(uid);
+    Json j = Json::object();
+    j.set("id", id).set("name", name).set("user_ids", members);
+    return j;
+  }
+  static Group from_json(const Json& j) {
+    Group g;
+    g.id = j["id"].as_int();
+    g.name = j["name"].as_string();
+    for (const auto& u : j["user_ids"].elements()) {
+      g.user_ids.push_back(u.as_int());
+    }
+    return g;
+  }
+};
+
+// ≈ master/internal/rbac: a role granted to a user OR a group, at global
+// scope (workspace_id == 0) or scoped to one workspace. Roles form a strict
+// hierarchy — rank order Viewer < Editor < WorkspaceAdmin < ClusterAdmin —
+// which covers the reference's pre-canned role set (rbac/static roles)
+// without per-permission grants.
+struct RoleAssignment {
+  int64_t id = 0;
+  std::string role;         // Viewer | Editor | WorkspaceAdmin | ClusterAdmin
+  int64_t user_id = 0;      // exactly one of user_id / group_id is non-zero
+  int64_t group_id = 0;
+  int64_t workspace_id = 0;  // 0 = global scope
+
+  Json to_json() const {
+    Json j = Json::object();
+    j.set("id", id).set("role", role).set("user_id", user_id)
+        .set("group_id", group_id).set("workspace_id", workspace_id);
+    return j;
+  }
+  static RoleAssignment from_json(const Json& j) {
+    RoleAssignment a;
+    a.id = j["id"].as_int();
+    a.role = j["role"].as_string();
+    a.user_id = j["user_id"].as_int();
+    a.group_id = j["group_id"].as_int();
+    a.workspace_id = j["workspace_id"].as_int();
+    return a;
+  }
+};
+
+// role name -> hierarchy rank; 0 for unknown roles
+inline int role_rank(const std::string& role) {
+  if (role == "Viewer") return 1;
+  if (role == "Editor") return 2;
+  if (role == "WorkspaceAdmin") return 3;
+  if (role == "ClusterAdmin") return 4;
+  return 0;
+}
+
 // ≈ master/internal/webhooks (shipper.go): fire on experiment state change
 struct Webhook {
   int64_t id = 0;

@@ -269,7 +269,8 @@ HttpResponse Master::route(const HttpRequest& req) {
   // limited). (/api/v1/auth/login mints sessions and stays open.)
   static const std::set<std::string> kAuthRoots = {
       "experiments", "tasks",  "users",    "workspaces", "models",
-      "templates",   "webhooks", "job-queue", "provisioner"};
+      "templates",   "webhooks", "job-queue", "provisioner", "groups",
+      "rbac"};
   if (config_.auth_required && kAuthRoots.count(root)) {
     bool alloc_readonly = req.method == "GET" &&
                           (root == "experiments" || root == "users") &&
@@ -305,6 +306,16 @@ HttpResponse Master::route(const HttpRequest& req) {
         config = resolve_template(body["config"]);
       } catch (const std::exception& e) {
         return bad_request(e.what());
+      }
+      {
+        // rbac: experiment creation needs Editor at the target workspace
+        std::string ws = config["workspace"].as_string();
+        if (ws.empty()) ws = "Uncategorized";
+        if (!rbac_allows(req, role_rank("Editor"), workspace_id_by_name(ws))) {
+          return HttpResponse::json(
+              403,
+              error_json("Editor role required in workspace " + ws).dump());
+        }
       }
       // validate log-pattern regexes up front — a typo'd pattern must be a
       // 400 at submission, not a silent no-op policy at runtime
@@ -422,6 +433,17 @@ HttpResponse Master::route(const HttpRequest& req) {
         return ok_json(j);
       }
       if (parts.size() == 5 && parts[4] == "kill" && req.method == "POST") {
+        // rbac: Editor at the workspace, or the submitter killing their own
+        // experiment (a revoked Editor must still be able to stop the work
+        // they started — same escape hatch as task kill)
+        User* caller = current_user(req);
+        bool own = caller && caller->username == exp.owner;
+        if (!own && !rbac_allows(req, role_rank("Editor"),
+                                 workspace_id_by_name(exp.workspace))) {
+          return HttpResponse::json(
+              403, error_json("Editor role required in workspace " +
+                              exp.workspace).dump());
+        }
         if (exp.state == RunState::Running || exp.state == RunState::Queued) {
           finish_experiment(exp, RunState::Canceled);
         }
@@ -441,6 +463,19 @@ HttpResponse Master::route(const HttpRequest& req) {
       // custom-search event queue (≈ master/pkg/searcher/custom_search.go
       // events + api_experiment.go GetSearcherEvents/PostSearcherOperations)
       if (parts.size() == 6 && parts[4] == "searcher") {
+        // rbac: the search runner mutates search state (creates/stops
+        // trials), so it needs Editor at the experiment's workspace — or to
+        // be the experiment's owner (the usual case for a remote runner)
+        if (req.method == "POST") {
+          User* caller = current_user(req);
+          bool own = caller && caller->username == exp.owner;
+          if (!own && !rbac_allows(req, role_rank("Editor"),
+                                   workspace_id_by_name(exp.workspace))) {
+            return HttpResponse::json(
+                403, error_json("Editor role required in workspace " +
+                                exp.workspace).dump());
+          }
+        }
         auto* custom = dynamic_cast<CustomSearchCpp*>(method_for(exp));
         if (parts[5] == "events" && req.method == "GET") {
           if (!custom) {
@@ -660,6 +695,11 @@ HttpResponse Master::route(const HttpRequest& req) {
   //  tensorboard,command}.go, collapsed onto the shared allocation path)
   if (root == "tasks") {
     if (parts.size() == 3 && req.method == "POST") {
+      // rbac: NTSC tasks consume cluster slots like experiments do
+      if (!rbac_allows(req, role_rank("Editor"))) {
+        return HttpResponse::json(
+            403, error_json("Editor role required to create tasks").dump());
+      }
       Json body = Json::parse(req.body);
       std::string type = body["type"].as_string();
       if (type.empty()) type = "command";
@@ -673,7 +713,12 @@ HttpResponse Master::route(const HttpRequest& req) {
       alloc.trial_id = 0;
       alloc.name = body["name"].as_string().empty() ? alloc.id
                                                     : body["name"].as_string();
-      if (!body["owner"].as_string().empty()) {
+      // owner is the authenticated caller — a client-supplied owner would
+      // make the owner-may-kill gate below spoofable. The body field is
+      // honored only when there is no session (auth off / internal use).
+      if (User* caller = current_user(req)) {
+        alloc.owner = caller->username;
+      } else if (!body["owner"].as_string().empty()) {
         alloc.owner = body["owner"].as_string();
       }
       alloc.state = RunState::Queued;
@@ -750,6 +795,14 @@ HttpResponse Master::route(const HttpRequest& req) {
         return ok_json(j);
       }
       if (parts.size() == 5 && parts[4] == "kill" && req.method == "POST") {
+        // rbac: global Editor, or the task's owner killing their own task
+        User* caller = current_user(req);
+        bool own = caller && caller->username == alloc.owner;
+        if (!own && !rbac_allows(req, role_rank("Editor"))) {
+          return HttpResponse::json(
+              403, error_json("Editor role (or task ownership) required")
+                       .dump());
+        }
         if (alloc.state == RunState::Queued || alloc.state == RunState::Pulling ||
             alloc.state == RunState::Running) {
           alloc.state = RunState::Canceled;  // heartbeat derives the kill

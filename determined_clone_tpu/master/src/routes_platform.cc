@@ -95,6 +95,50 @@ bool Master::alloc_authed(const HttpRequest& req) {
   return false;
 }
 
+int Master::rbac_rank(const User* u, int64_t workspace_id) {
+  if (!u) return 0;
+  if (u->admin) return role_rank("ClusterAdmin");
+  int best = 0;
+  for (const auto& [id, a] : role_assignments_) {
+    bool principal = a.user_id != 0 && a.user_id == u->id;
+    if (!principal && a.group_id != 0) {
+      auto git = groups_.find(a.group_id);
+      principal = git != groups_.end() && git->second.has_user(u->id);
+    }
+    if (!principal) continue;
+    // global assignments apply at every scope; workspace assignments only
+    // at their workspace (≈ rbac scope resolution in the reference)
+    if (a.workspace_id != 0 && a.workspace_id != workspace_id) continue;
+    best = std::max(best, role_rank(a.role));
+  }
+  return best;
+}
+
+bool Master::rbac_allows(const HttpRequest& req, int min_rank,
+                         int64_t workspace_id) {
+  if (!config_.rbac_enabled || !config_.auth_required) return true;
+  return rbac_rank(current_user(req), workspace_id) >= min_rank;
+}
+
+bool Master::cluster_admin_ok(const HttpRequest& req) {
+  if (!config_.auth_required) return true;
+  User* caller = current_user(req);
+  if (!caller) return false;
+  if (caller->admin) return true;
+  // role-granted ClusterAdmin only counts while RBAC is enabled — with
+  // --rbac removed, persisted assignments must be inert (rbac/me reports
+  // enforced:false), not a backdoor to the admin surface
+  return config_.rbac_enabled &&
+         rbac_rank(caller, 0) >= role_rank("ClusterAdmin");
+}
+
+int64_t Master::workspace_id_by_name(const std::string& name) {
+  for (const auto& [id, w] : workspaces_) {
+    if (w.name == name) return id;
+  }
+  return 0;
+}
+
 void Master::bootstrap_users_locked() {
   // ≈ the reference's bootstrap users (admin + determined, empty passwords)
   if (!users_.empty()) return;
@@ -246,10 +290,7 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
       return pok(j);
     }
     if (parts.size() == 3 && req.method == "POST") {
-      User* caller = current_user(req);
-      if (config_.auth_required && (!caller || !caller->admin)) {
-        return pforbidden("admin required");
-      }
+      if (!cluster_admin_ok(req)) return pforbidden("admin required");
       Json body = Json::parse(req.body);
       const std::string& username = body["username"].as_string();
       if (username.empty()) return pbad("username required");
@@ -286,8 +327,7 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
       if (parts.size() == 5 && req.method == "POST") {
         User* caller = current_user(req);
         bool self = caller && caller->id == uid;
-        if (config_.auth_required &&
-            (!caller || (!caller->admin && !self))) {
+        if (config_.auth_required && !self && !cluster_admin_ok(req)) {
           return pforbidden("admin or self required");
         }
         if (parts[4] == "password") {
@@ -298,9 +338,7 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
           return pok(Json::object());
         }
         if (parts[4] == "activate" || parts[4] == "deactivate") {
-          if (config_.auth_required && (!caller || !caller->admin)) {
-            return pforbidden("admin required");
-          }
+          if (!cluster_admin_ok(req)) return pforbidden("admin required");
           u.active = parts[4] == "activate";
           dirty_ = true;
           Json j = Json::object();
@@ -322,6 +360,9 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
       return pok(j);
     }
     if (parts.size() == 3 && req.method == "POST") {
+      if (!rbac_allows(req, role_rank("Editor"))) {
+        return pforbidden("Editor role required to create workspaces");
+      }
       Json body = Json::parse(req.body);
       const std::string& name = body["name"].as_string();
       if (name.empty()) return pbad("workspace name required");
@@ -360,6 +401,9 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
         return pok(j);
       }
       if (parts.size() == 4 && req.method == "DELETE") {
+        if (!rbac_allows(req, role_rank("WorkspaceAdmin"), wid)) {
+          return pforbidden("WorkspaceAdmin role required");
+        }
         if (w.immutable) return pbad("workspace is immutable");
         for (const auto& [eid, e] : experiments_) {
           if (e.workspace == w.name) {
@@ -373,12 +417,25 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
             ++pit;
           }
         }
+        // workspace-scoped role assignments die with the workspace — same
+        // no-dangling-grant invariant as group deletion below
+        for (auto ait = role_assignments_.begin();
+             ait != role_assignments_.end();) {
+          if (ait->second.workspace_id == wid) {
+            ait = role_assignments_.erase(ait);
+          } else {
+            ++ait;
+          }
+        }
         workspaces_.erase(it);
         dirty_ = true;
         return pok(Json::object());
       }
       if (parts.size() == 5 && req.method == "POST" &&
           (parts[4] == "archive" || parts[4] == "unarchive")) {
+        if (!rbac_allows(req, role_rank("WorkspaceAdmin"), wid)) {
+          return pforbidden("WorkspaceAdmin role required");
+        }
         if (w.immutable) return pbad("workspace is immutable");
         w.archived = parts[4] == "archive";
         dirty_ = true;
@@ -397,6 +454,9 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
           return pok(j);
         }
         if (req.method == "POST") {
+          if (!rbac_allows(req, role_rank("Editor"), wid)) {
+            return pforbidden("Editor role required in this workspace");
+          }
           Json body = Json::parse(req.body);
           const std::string& name = body["name"].as_string();
           if (name.empty()) return pbad("project name required");
@@ -458,6 +518,13 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
       Json body = Json::parse(req.body);
       const std::string& name = body["name"].as_string();
       if (name.empty()) return pbad("model name required");
+      {
+        std::string ws = body["workspace"].as_string();
+        if (ws.empty()) ws = "Uncategorized";
+        if (!rbac_allows(req, role_rank("Editor"), workspace_id_by_name(ws))) {
+          return pforbidden("Editor role required in workspace " + ws);
+        }
+      }
       for (const auto& [id, m] : models_) {
         if (m.name == name) return pbad("model name taken");
       }
@@ -482,6 +549,15 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
     if (parts.size() >= 4) {
       RegisteredModel* m = find_model(parts[3]);
       if (!m) return pnotfound("no model " + parts[3]);
+      // model mutations: Editor at the model's workspace; deletes are
+      // WorkspaceAdmin (destructive, like the reference's delete perms)
+      if (req.method != "GET") {
+        int min_rank = req.method == "DELETE" ? role_rank("WorkspaceAdmin")
+                                              : role_rank("Editor");
+        if (!rbac_allows(req, min_rank, workspace_id_by_name(m->workspace))) {
+          return pforbidden("insufficient role in workspace " + m->workspace);
+        }
+      }
       if (parts.size() == 4 && req.method == "GET") {
         Json j = Json::object();
         j.set("model", m->to_json());
@@ -580,6 +656,9 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
       return pok(j);
     }
     if (parts.size() == 3 && req.method == "POST") {
+      if (!rbac_allows(req, role_rank("WorkspaceAdmin"))) {
+        return pforbidden("WorkspaceAdmin role required");
+      }
       Json body = Json::parse(req.body);
       const std::string& name = body["name"].as_string();
       if (name.empty()) return pbad("template name required");
@@ -599,6 +678,9 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
         return pok(t);
       }
       if (req.method == "DELETE") {
+        if (!rbac_allows(req, role_rank("WorkspaceAdmin"))) {
+          return pforbidden("WorkspaceAdmin role required");
+        }
         templates_.erase(it);
         dirty_ = true;
         return pok(Json::object());
@@ -617,6 +699,9 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
       return pok(j);
     }
     if (parts.size() == 3 && req.method == "POST") {
+      if (!rbac_allows(req, role_rank("WorkspaceAdmin"))) {
+        return pforbidden("WorkspaceAdmin role required");
+      }
       Json body = Json::parse(req.body);
       const std::string& url = body["url"].as_string();
       if (url.empty()) return pbad("webhook url required");
@@ -636,6 +721,9 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
       return pcreated(j);
     }
     if (parts.size() == 4 && req.method == "DELETE") {
+      if (!rbac_allows(req, role_rank("WorkspaceAdmin"))) {
+        return pforbidden("WorkspaceAdmin role required");
+      }
       int64_t wid = 0;
       try {
         wid = std::stoll(parts[3]);
@@ -647,6 +735,215 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
       return pok(Json::object());
     }
     return pnotfound("unknown webhooks route");
+  }
+
+  // ---- user groups (≈ master/internal/usergroup) -------------------------
+  if (root == "groups") {
+    // group management is a cluster-admin surface, like user management
+    auto admin_gate = [&]() -> std::optional<HttpResponse> {
+      if (cluster_admin_ok(req)) return std::nullopt;
+      return pforbidden("cluster admin required");
+    };
+    if (parts.size() == 3 && req.method == "GET") {
+      Json arr = Json::array();
+      for (const auto& [id, g] : groups_) arr.push_back(g.to_json());
+      Json j = Json::object();
+      j.set("groups", arr);
+      return pok(j);
+    }
+    if (parts.size() == 3 && req.method == "POST") {
+      if (auto resp = admin_gate()) return *resp;
+      Json body = Json::parse(req.body);
+      const std::string& name = body["name"].as_string();
+      if (name.empty()) return pbad("group name required");
+      for (const auto& [id, g] : groups_) {
+        if (g.name == name) return pbad("group name taken");
+      }
+      Group g;
+      g.id = next_group_id_++;
+      g.name = name;
+      for (const auto& u : body["user_ids"].elements()) {
+        int64_t uid = u.as_int();
+        if (!users_.count(uid)) return pbad("no user " + std::to_string(uid));
+        if (!g.has_user(uid)) g.user_ids.push_back(uid);
+      }
+      groups_[g.id] = g;
+      dirty_ = true;
+      Json j = Json::object();
+      j.set("group", groups_[g.id].to_json());
+      return pcreated(j);
+    }
+    if (parts.size() >= 4) {
+      int64_t gid = 0;
+      try {
+        gid = std::stoll(parts[3]);
+      } catch (const std::exception&) {
+        return pbad("bad group id");
+      }
+      auto it = groups_.find(gid);
+      if (it == groups_.end()) return pnotfound("no group " + parts[3]);
+      Group& g = it->second;
+      if (parts.size() == 4 && req.method == "GET") {
+        Json j = Json::object();
+        j.set("group", g.to_json());
+        return pok(j);
+      }
+      if (parts.size() == 4 && req.method == "DELETE") {
+        if (auto resp = admin_gate()) return *resp;
+        // assignments referencing the group die with it — a dangling
+        // group_id would silently grant nothing but still list as a grant
+        for (auto ait = role_assignments_.begin();
+             ait != role_assignments_.end();) {
+          if (ait->second.group_id == gid) {
+            ait = role_assignments_.erase(ait);
+          } else {
+            ++ait;
+          }
+        }
+        groups_.erase(it);
+        dirty_ = true;
+        return pok(Json::object());
+      }
+      if (parts.size() == 5 && parts[4] == "members" && req.method == "POST") {
+        if (auto resp = admin_gate()) return *resp;
+        Json body = Json::parse(req.body);
+        // validate every id BEFORE mutating — a 400 must leave no side
+        // effects (same invariant as experiment submission, routes.cc)
+        for (const auto& u : body["add"].elements()) {
+          int64_t uid = u.as_int();
+          if (!users_.count(uid)) {
+            return pbad("no user " + std::to_string(uid));
+          }
+        }
+        for (const auto& u : body["add"].elements()) {
+          int64_t uid = u.as_int();
+          if (!g.has_user(uid)) g.user_ids.push_back(uid);
+        }
+        for (const auto& u : body["remove"].elements()) {
+          int64_t uid = u.as_int();
+          g.user_ids.erase(
+              std::remove(g.user_ids.begin(), g.user_ids.end(), uid),
+              g.user_ids.end());
+        }
+        dirty_ = true;
+        Json j = Json::object();
+        j.set("group", g.to_json());
+        return pok(j);
+      }
+    }
+    return pnotfound("unknown groups route");
+  }
+
+  // ---- rbac (≈ master/internal/rbac: static roles + scoped assignments) --
+  if (root == "rbac") {
+    const std::string& sub = parts.size() > 3 ? parts[3] : "";
+    if (sub == "roles" && req.method == "GET") {
+      Json arr = Json::array();
+      for (const char* name :
+           {"Viewer", "Editor", "WorkspaceAdmin", "ClusterAdmin"}) {
+        Json r = Json::object();
+        r.set("name", std::string(name))
+            .set("rank", static_cast<int64_t>(role_rank(name)));
+        arr.push_back(r);
+      }
+      Json j = Json::object();
+      j.set("roles", arr);
+      return pok(j);
+    }
+    if (sub == "me" && req.method == "GET") {
+      User* caller = current_user(req);
+      if (!caller) return punauthorized("not logged in");
+      int64_t ws = 0;
+      auto q = req.query.find("workspace_id");
+      if (q != req.query.end()) {
+        try {
+          ws = std::stoll(q->second);
+        } catch (const std::exception&) {
+          return pbad("bad workspace_id");
+        }
+      }
+      int rank = rbac_rank(caller, ws);
+      const char* role = rank >= 4   ? "ClusterAdmin"
+                         : rank == 3 ? "WorkspaceAdmin"
+                         : rank == 2 ? "Editor"
+                         : rank == 1 ? "Viewer"
+                                     : "";
+      Json j = Json::object();
+      j.set("rank", static_cast<int64_t>(rank)).set("role", std::string(role))
+          .set("workspace_id", ws)
+          .set("enforced", config_.rbac_enabled && config_.auth_required);
+      return pok(j);
+    }
+    if (sub == "assignments") {
+      if (parts.size() == 4 && req.method == "GET") {
+        Json arr = Json::array();
+        for (const auto& [id, a] : role_assignments_) {
+          arr.push_back(a.to_json());
+        }
+        Json j = Json::object();
+        j.set("assignments", arr);
+        return pok(j);
+      }
+      // assignment mutations: cluster-admin only
+      if (!cluster_admin_ok(req)) return pforbidden("cluster admin required");
+      if (parts.size() == 4 && req.method == "POST") {
+        Json body = Json::parse(req.body);
+        RoleAssignment a;
+        a.role = body["role"].as_string();
+        if (role_rank(a.role) == 0) {
+          return pbad("unknown role '" + a.role +
+                      "' (Viewer|Editor|WorkspaceAdmin|ClusterAdmin)");
+        }
+        a.user_id = body["user_id"].as_int();
+        a.group_id = body["group_id"].as_int();
+        if ((a.user_id == 0) == (a.group_id == 0)) {
+          return pbad("exactly one of user_id / group_id required");
+        }
+        if (a.user_id && !users_.count(a.user_id)) {
+          return pbad("no user " + std::to_string(a.user_id));
+        }
+        if (a.group_id && !groups_.count(a.group_id)) {
+          return pbad("no group " + std::to_string(a.group_id));
+        }
+        a.workspace_id = body["workspace_id"].as_int();
+        if (a.workspace_id != 0 && !workspaces_.count(a.workspace_id)) {
+          return pbad("no workspace " + std::to_string(a.workspace_id));
+        }
+        if (a.role == "ClusterAdmin" && a.workspace_id != 0) {
+          return pbad("ClusterAdmin is global-scope only");
+        }
+        for (const auto& [id, existing] : role_assignments_) {
+          if (existing.role == a.role && existing.user_id == a.user_id &&
+              existing.group_id == a.group_id &&
+              existing.workspace_id == a.workspace_id) {
+            // a duplicate would make revocation misleading: deleting one of
+            // two identical rows leaves the grant silently active
+            return pbad("assignment already exists (id " +
+                        std::to_string(id) + ")");
+          }
+        }
+        a.id = next_assignment_id_++;
+        role_assignments_[a.id] = a;
+        dirty_ = true;
+        Json j = Json::object();
+        j.set("assignment", role_assignments_[a.id].to_json());
+        return pcreated(j);
+      }
+      if (parts.size() == 5 && req.method == "DELETE") {
+        int64_t aid = 0;
+        try {
+          aid = std::stoll(parts[4]);
+        } catch (const std::exception&) {
+          return pbad("bad assignment id");
+        }
+        if (!role_assignments_.erase(aid)) {
+          return pnotfound("no assignment " + parts[4]);
+        }
+        dirty_ = true;
+        return pok(Json::object());
+      }
+    }
+    return pnotfound("unknown rbac route");
   }
 
   return std::nullopt;
