@@ -1,0 +1,514 @@
+#include "kubernetesrm.h"
+
+#include <sys/stat.h>
+#include <sys/wait.h>
+
+#include <algorithm>
+#include <cctype>
+#include <chrono>
+#include <cstdio>
+#include <fstream>
+#include <iostream>
+#include <map>
+#include <sstream>
+
+namespace dct {
+namespace {
+
+// (≈ agent.cc task_env: the DCT_* environment one task sees; here it is
+// rendered into the pod container env so the in-pod harness can do
+// rendezvous/metrics/logs against the master exactly like the agent path)
+Json pod_env(const Json& cmd, const std::string& alloc_id,
+             const KubeRmConfig& cfg, int rank) {
+  std::map<std::string, std::string> env;
+  env["DCT_MASTER_HOST"] = cfg.master_host;
+  env["DCT_MASTER_PORT"] = std::to_string(cfg.master_port);
+  env["DCT_ALLOCATION_ID"] = alloc_id;
+  env["DCT_ALLOC_TOKEN"] = cmd["alloc_token"].as_string();
+  env["DCT_AGENT_ID"] = "k8s";
+  env["DCT_SLOTS"] = std::to_string(cmd["slots"].as_int());
+  env["DCT_RANK"] = std::to_string(rank);
+  env["DCT_WORLD_SIZE"] = std::to_string(cmd["world_size"].as_int());
+  env["DCT_TASK_TYPE"] = cmd["task_type"].as_string();
+  if (cmd["trial"].is_object()) {
+    env["DCT_TRIAL_ID"] = std::to_string(cmd["trial"]["id"].as_int());
+    env["DCT_EXPERIMENT_ID"] =
+        std::to_string(cmd["trial"]["experiment_id"].as_int());
+    env["DCT_HPARAMS"] = cmd["trial"]["hparams"].dump();
+    env["DCT_TARGET_UNITS"] =
+        std::to_string(cmd["trial"]["target_units"].as_int());
+    env["DCT_LATEST_CHECKPOINT"] =
+        cmd["trial"]["latest_checkpoint"].as_string();
+    env["DCT_EXPERIMENT_CONFIG"] = cmd["config"].dump();
+  }
+  if (cmd["spec"]["env"].is_object()) {
+    for (const auto& [k, v] : cmd["spec"]["env"].items()) {
+      env[k] = v.as_string();
+    }
+  }
+  Json arr = Json::array();
+  for (const auto& [k, v] : env) {
+    Json e = Json::object();
+    e.set("name", k).set("value", v);
+    arr.push_back(e);
+  }
+  return arr;
+}
+
+// (≈ agent.cc task_argv) NTSC argv, or the trial harness module
+Json pod_command(const Json& cmd) {
+  Json out = Json::array();
+  const Json& argv = cmd["spec"]["argv"];
+  if (argv.is_array() && argv.size() > 0) return argv;
+  const std::string entrypoint = cmd["spec"]["entrypoint"].as_string();
+  if (!entrypoint.empty()) {
+    out.push_back("python");
+    out.push_back("-m");
+    out.push_back("determined_clone_tpu.exec.trial");
+    out.push_back(entrypoint);
+  }
+  return out;
+}
+
+// pod names must be DNS-1123: lowercase alphanumerics and '-'
+std::string sanitize(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    if (std::isalnum(static_cast<unsigned char>(c))) {
+      out += static_cast<char>(std::tolower(static_cast<unsigned char>(c)));
+    } else {
+      out += '-';
+    }
+  }
+  return out;
+}
+
+std::string pod_name(const std::string& alloc_id, int rank) {
+  return "dct-" + sanitize(alloc_id) + "-" + std::to_string(rank);
+}
+
+bool terminal(const Allocation& a) {
+  return a.state == RunState::Completed || a.state == RunState::Errored ||
+         a.state == RunState::Canceled;
+}
+
+struct RunResult {
+  int rc = -1;
+  std::string out;
+};
+
+RunResult run_capture(const std::string& cmd) {
+  RunResult r;
+  FILE* pipe = ::popen(cmd.c_str(), "r");
+  if (!pipe) return r;
+  char buf[4096];
+  size_t n;
+  while ((n = ::fread(buf, 1, sizeof(buf), pipe)) > 0) r.out.append(buf, n);
+  int status = ::pclose(pipe);
+  r.rc = WIFEXITED(status) ? WEXITSTATUS(status) : -1;
+  return r;
+}
+
+// feed `input` to the command's stdin (kubectl apply -f -): no temp file,
+// so no predictable-path /tmp hazard and no cross-master clobbering
+int run_with_stdin(const std::string& cmd, const std::string& input) {
+  FILE* pipe = ::popen(cmd.c_str(), "w");
+  if (!pipe) return -1;
+  ::fwrite(input.data(), 1, input.size(), pipe);
+  int status = ::pclose(pipe);
+  return WIFEXITED(status) ? WEXITSTATUS(status) : -1;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// DryRunKubectl: pods.json in state_dir is the "cluster"
+// ---------------------------------------------------------------------------
+
+DryRunKubectl::DryRunKubectl(std::string state_dir) {
+  ::mkdir(state_dir.c_str(), 0755);
+  path_ = state_dir + "/pods.json";
+}
+
+Json DryRunKubectl::load() {
+  std::ifstream in(path_);
+  if (!in) return Json::array();
+  std::stringstream ss;
+  ss << in.rdbuf();
+  try {
+    Json pods = Json::parse(ss.str());
+    return pods.is_array() ? pods : Json::array();
+  } catch (const std::exception&) {
+    return Json::array();
+  }
+}
+
+void DryRunKubectl::store(const Json& pods) {
+  std::string tmp = path_ + ".tmp";
+  {
+    std::ofstream out(tmp);
+    out << pods.dump();
+  }
+  ::rename(tmp.c_str(), path_.c_str());
+}
+
+bool DryRunKubectl::apply(const Json& manifest) {
+  Json pods = load();
+  const std::string name = manifest["metadata"]["name"].as_string();
+  for (const auto& p : pods.elements()) {
+    if (p["name"].as_string() == name) return true;  // apply is idempotent
+  }
+  Json entry = Json::object();
+  entry.set("name", name)
+      .set("alloc", manifest["metadata"]["labels"]["dct-alloc"].as_string())
+      .set("rank",
+           static_cast<int64_t>(std::stoll(
+               manifest["metadata"]["labels"]["dct-rank"].as_string())))
+      .set("phase", "Pending")
+      .set("ip", "")
+      .set("exit_code", static_cast<int64_t>(0))
+      .set("manifest", manifest);
+  pods.push_back(entry);
+  store(pods);
+  return true;
+}
+
+std::vector<KubePodStatus> DryRunKubectl::list_pods() {
+  std::vector<KubePodStatus> out;
+  const Json pods = load();  // named: elements() refs its internals
+  for (const auto& p : pods.elements()) {
+    KubePodStatus s;
+    s.name = p["name"].as_string();
+    s.alloc_id = p["alloc"].as_string();
+    s.rank = static_cast<int>(p["rank"].as_int());
+    s.phase = p["phase"].as_string();
+    s.ip = p["ip"].as_string();
+    s.exit_code = static_cast<int>(p["exit_code"].as_int());
+    out.push_back(std::move(s));
+  }
+  return out;
+}
+
+bool DryRunKubectl::delete_alloc(const std::string& alloc_id) {
+  Json pods = load();
+  Json keep = Json::array();
+  for (const auto& p : pods.elements()) {
+    if (p["alloc"].as_string() != alloc_id) keep.push_back(p);
+  }
+  store(keep);
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// LiveKubectl: real kubectl subprocesses
+// ---------------------------------------------------------------------------
+
+bool LiveKubectl::apply(const Json& manifest) {
+  int rc = run_with_stdin(
+      "kubectl -n " + ns_ + " apply -f - >/dev/null 2>&1", manifest.dump());
+  if (rc != 0) {
+    std::cerr << "[kubernetesrm] kubectl apply exited " << rc << " for pod "
+              << manifest["metadata"]["name"].as_string() << std::endl;
+    return false;
+  }
+  return true;
+}
+
+std::vector<KubePodStatus> LiveKubectl::list_pods() {
+  std::vector<KubePodStatus> out;
+  RunResult r = run_capture("kubectl -n " + ns_ +
+                            " get pods -l dct-managed=true -o json 2>/dev/null");
+  if (r.rc != 0 || r.out.empty()) return out;
+  Json doc;
+  try {
+    doc = Json::parse(r.out);
+  } catch (const std::exception&) {
+    return out;
+  }
+  for (const auto& item : doc["items"].elements()) {
+    KubePodStatus s;
+    s.name = item["metadata"]["name"].as_string();
+    s.alloc_id = item["metadata"]["labels"]["dct-alloc"].as_string();
+    try {
+      s.rank = static_cast<int>(
+          std::stoll(item["metadata"]["labels"]["dct-rank"].as_string()));
+    } catch (const std::exception&) {
+    }
+    s.phase = item["status"]["phase"].as_string();
+    s.ip = item["status"]["podIP"].as_string();
+    for (const auto& c : item["status"]["containerStatuses"].elements()) {
+      if (c["state"]["terminated"].is_object()) {
+        s.exit_code =
+            static_cast<int>(c["state"]["terminated"]["exitCode"].as_int());
+      }
+    }
+    out.push_back(std::move(s));
+  }
+  return out;
+}
+
+bool LiveKubectl::delete_alloc(const std::string& alloc_id) {
+  RunResult r = run_capture("kubectl -n " + ns_ + " delete pods -l dct-alloc=" +
+                            sanitize(alloc_id) +
+                            " --ignore-not-found --wait=false 2>&1");
+  return r.rc == 0;
+}
+
+// ---------------------------------------------------------------------------
+// AsyncKubectl
+// ---------------------------------------------------------------------------
+
+AsyncKubectl::AsyncKubectl(std::unique_ptr<KubectlRunner> inner,
+                           double poll_interval_sec)
+    : inner_(std::move(inner)), interval_(poll_interval_sec) {
+  worker_ = std::thread([this] { loop(); });
+}
+
+AsyncKubectl::~AsyncKubectl() {
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    stop_ = true;
+  }
+  cv_.notify_all();
+  if (worker_.joinable()) worker_.join();
+}
+
+void AsyncKubectl::loop() {
+  std::unique_lock<std::mutex> lock(mu_);
+  while (!stop_) {
+    // drain queued apply/delete ops (off the lock: they block on kubectl)
+    while (!queue_.empty()) {
+      auto op = std::move(queue_.front());
+      queue_.erase(queue_.begin());
+      lock.unlock();
+      op();
+      lock.lock();
+      if (stop_) return;
+    }
+    lock.unlock();
+    auto pods = inner_->list_pods();
+    lock.lock();
+    if (stop_) return;
+    // ops enqueued while we were polling may already have echoed pods the
+    // poll predates; only replace the snapshot when the queue is quiet
+    if (queue_.empty()) {
+      snapshot_ = std::move(pods);
+      have_snapshot_ = true;
+    }
+    cv_.wait_for(lock, std::chrono::duration<double>(interval_),
+                 [this] { return stop_ || !queue_.empty(); });
+  }
+}
+
+bool AsyncKubectl::apply(const Json& manifest) {
+  KubePodStatus echo;
+  echo.name = manifest["metadata"]["name"].as_string();
+  echo.alloc_id = manifest["metadata"]["labels"]["dct-alloc"].as_string();
+  try {
+    echo.rank = static_cast<int>(
+        std::stoll(manifest["metadata"]["labels"]["dct-rank"].as_string()));
+  } catch (const std::exception&) {
+  }
+  echo.phase = "Pending";
+  std::lock_guard<std::mutex> lock(mu_);
+  bool known = false;
+  for (const auto& p : snapshot_) known = known || p.name == echo.name;
+  if (!known) snapshot_.push_back(std::move(echo));
+  queue_.push_back([this, manifest] { inner_->apply(manifest); });
+  cv_.notify_all();
+  return true;
+}
+
+std::vector<KubePodStatus> AsyncKubectl::list_pods() {
+  std::lock_guard<std::mutex> lock(mu_);
+  return snapshot_;
+}
+
+bool AsyncKubectl::delete_alloc(const std::string& alloc_id) {
+  std::lock_guard<std::mutex> lock(mu_);
+  snapshot_.erase(std::remove_if(snapshot_.begin(), snapshot_.end(),
+                                 [&](const KubePodStatus& p) {
+                                   return p.alloc_id == alloc_id;
+                                 }),
+                  snapshot_.end());
+  queue_.push_back([this, alloc_id] { inner_->delete_alloc(alloc_id); });
+  cv_.notify_all();
+  return true;
+}
+
+bool AsyncKubectl::ready() {
+  std::lock_guard<std::mutex> lock(mu_);
+  return have_snapshot_;
+}
+
+// ---------------------------------------------------------------------------
+// KubernetesRM
+// ---------------------------------------------------------------------------
+
+KubernetesRM::KubernetesRM(KubeRmConfig config,
+                           std::unique_ptr<KubectlRunner> runner)
+    : config_(std::move(config)), runner_(std::move(runner)) {}
+
+Json KubernetesRM::pod_manifest(const Allocation& alloc, const Json& start_cmd,
+                                int rank, int world, int pod_slots) const {
+  Json labels = Json::object();
+  labels.set("dct-managed", "true")
+      .set("dct-alloc", sanitize(alloc.id))
+      .set("dct-rank", std::to_string(rank));
+
+  Json container = Json::object();
+  container.set("name", "task")
+      .set("image", config_.image)
+      .set("command", pod_command(start_cmd))
+      .set("env", pod_env(start_cmd, alloc.id, config_, rank));
+  if (pod_slots > 0) {
+    Json limits = Json::object();
+    limits.set("google.com/tpu", std::to_string(pod_slots));
+    Json resources = Json::object();
+    resources.set("limits", limits);
+    container.set("resources", resources);
+  }
+
+  Json spec = Json::object();
+  Json containers = Json::array();
+  containers.push_back(container);
+  spec.set("restartPolicy", "Never").set("containers", containers);
+  if (pod_slots > 0) {
+    // GKE TPU node-pool selectors: the k8s scheduler (not us) picks nodes,
+    // but it must pick within the right slice topology
+    Json sel = Json::object();
+    sel.set("cloud.google.com/gke-tpu-accelerator", config_.accelerator);
+    if (!alloc.topology.empty()) {
+      sel.set("cloud.google.com/gke-tpu-topology", alloc.topology);
+    }
+    spec.set("nodeSelector", sel);
+  }
+
+  Json meta = Json::object();
+  meta.set("name", pod_name(alloc.id, rank))
+      .set("namespace", config_.ns)
+      .set("labels", labels);
+
+  Json pod = Json::object();
+  pod.set("apiVersion", "v1").set("kind", "Pod").set("metadata", meta)
+      .set("spec", spec);
+  (void)world;
+  return pod;
+}
+
+void KubernetesRM::tick(RmContext& ctx) {
+  if (!runner_->ready()) return;  // async runner: no cluster view yet
+  auto pods = runner_->list_pods();
+  std::map<std::string, std::vector<const KubePodStatus*>> by_alloc;
+  for (const auto& p : pods) by_alloc[p.alloc_id].push_back(&p);
+
+  for (auto& [alloc_id, alloc] : *ctx.allocations) {
+    auto mine_it = by_alloc.find(sanitize(alloc_id));
+    const std::vector<const KubePodStatus*>* mine =
+        mine_it == by_alloc.end() ? nullptr : &mine_it->second;
+
+    if (terminal(alloc)) {
+      if (mine) runner_->delete_alloc(sanitize(alloc_id));
+      continue;
+    }
+
+    if (alloc.state == RunState::Queued) {
+      if (mine && !mine->empty()) {
+        // reattach after master restart (≈ ReattachAllocationPods,
+        // pods.go:266): the pods are already there — re-adopt them, with
+        // the same per-pod split the submit path used (last pod takes the
+        // remainder; 0-slot tasks reserve 0)
+        int slots = std::max(alloc.slots, 0);
+        int per_pod = std::min(std::max(1, config_.slots_per_pod),
+                               std::max(1, slots));
+        alloc.reservations.clear();
+        for (const auto* p : *mine) {
+          int pod_slots =
+              slots == 0 ? 0
+                         : std::max(0, std::min(per_pod,
+                                                slots - p->rank * per_pod));
+          alloc.reservations[p->name] = pod_slots;
+        }
+        alloc.world_size = static_cast<int>(mine->size());
+        alloc.state = RunState::Pulling;
+        if (alloc.trial_id && ctx.trials->count(alloc.trial_id)) {
+          (*ctx.trials)[alloc.trial_id].state = RunState::Pulling;
+        }
+        ctx.mark_dirty();
+      } else {
+        // submit: one pod per TPU host; the last pod takes the remainder
+        int slots = std::max(alloc.slots, 0);
+        int per_pod = std::min(std::max(1, config_.slots_per_pod),
+                               std::max(1, slots));
+        int world = slots == 0 ? 1 : (slots + per_pod - 1) / per_pod;
+        alloc.world_size = world;
+        bool ok = true;
+        for (int rank = 0; rank < world && ok; ++rank) {
+          int pod_slots =
+              slots == 0 ? 0
+                         : std::min(per_pod, slots - rank * per_pod);
+          Json cmd = ctx.start_command(alloc, rank);
+          cmd.set("slots", pod_slots);  // per-member share, not the gang total
+          Json manifest = pod_manifest(alloc, cmd, rank, world, pod_slots);
+          ok = runner_->apply(manifest);
+          if (ok) alloc.reservations[pod_name(alloc.id, rank)] = pod_slots;
+        }
+        if (ok) {
+          alloc.state = RunState::Pulling;
+          if (alloc.trial_id && ctx.trials->count(alloc.trial_id)) {
+            (*ctx.trials)[alloc.trial_id].state = RunState::Pulling;
+          }
+          ctx.mark_dirty();
+        } else {
+          // partial submit: tear down and retry next tick
+          runner_->delete_alloc(sanitize(alloc.id));
+          alloc.reservations.clear();
+          alloc.world_size = 0;
+        }
+      }
+      continue;
+    }
+
+    if (alloc.state == RunState::Pulling || alloc.state == RunState::Running) {
+      if (!mine || mine->empty()) {
+        // pods vanished (node reclaimed, kubectl delete out-of-band):
+        // requeue; trial max_restarts accounting happens via on_task_done
+        // only on real exits, so a reclaim is a silent retry like the
+        // agent-amnesia path
+        alloc.state = RunState::Queued;
+        alloc.reservations.clear();
+        alloc.rendezvous.clear();
+        if (alloc.trial_id && ctx.trials->count(alloc.trial_id)) {
+          (*ctx.trials)[alloc.trial_id].state = RunState::Queued;
+        }
+        ctx.mark_dirty();
+        continue;
+      }
+      int running = 0, succeeded = 0;
+      const KubePodStatus* failed = nullptr;
+      for (const auto* p : *mine) {
+        if (p->phase == "Running") ++running;
+        if (p->phase == "Succeeded") ++succeeded;
+        if (p->phase == "Failed" && !failed) failed = p;
+      }
+      int world = std::max(1, alloc.world_size);
+      if (failed) {
+        ctx.on_task_done(alloc_id,
+                         failed->exit_code ? failed->exit_code : 1,
+                         "pod " + failed->name + " failed");
+        runner_->delete_alloc(sanitize(alloc_id));
+      } else if (succeeded >= world) {
+        ctx.on_task_done(alloc_id, 0, "");
+        runner_->delete_alloc(sanitize(alloc_id));
+      } else if (alloc.state == RunState::Pulling && running >= world) {
+        alloc.state = RunState::Running;
+        if (alloc.trial_id && ctx.trials->count(alloc.trial_id)) {
+          (*ctx.trials)[alloc.trial_id].state = RunState::Running;
+        }
+        ctx.mark_dirty();
+      }
+    }
+  }
+}
+
+}  // namespace dct

@@ -1,0 +1,133 @@
+// Kubernetes resource manager: allocations become TPU pods.
+//
+// ≈ the reference kubernetesrm (master/internal/rm/kubernetesrm/pods.go:240
+// StartTaskPod / ReattachAllocationPods, spec.go pod-spec build,
+// informer.go state tracking), redesigned for GKE TPU node pools: each gang
+// member is one pod requesting `google.com/tpu` chips with the GKE TPU
+// nodeSelectors, scheduling itself is delegated to the k8s scheduler, and
+// pod phases drive allocation state. The kubectl interaction sits behind a
+// seam (like the provisioner's gcloud seam): a dry-run runner backed by a
+// JSON state file for tests, and a live runner that shells out to kubectl.
+#pragma once
+
+#include <condition_variable>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "rm.h"
+
+namespace dct {
+
+struct KubePodStatus {
+  std::string name;
+  std::string alloc_id;   // dct-alloc label
+  int rank = 0;           // dct-rank label
+  std::string phase;      // Pending | Running | Succeeded | Failed
+  std::string ip;
+  int exit_code = 0;
+};
+
+// The kubectl seam. Only three verbs are needed: apply a pod manifest,
+// list managed pods, delete an allocation's pods.
+class KubectlRunner {
+ public:
+  virtual ~KubectlRunner() = default;
+  virtual bool apply(const Json& manifest) = 0;
+  virtual std::vector<KubePodStatus> list_pods() = 0;
+  virtual bool delete_alloc(const std::string& alloc_id) = 0;
+  // false until the runner has a usable view of the cluster (async runner:
+  // first poll not yet completed); the RM skips its tick meanwhile
+  virtual bool ready() { return true; }
+};
+
+struct KubeRmConfig {
+  std::string ns = "default";
+  std::string image = "determined-clone-tpu:latest";
+  // address pods use to reach the master (a Service name on a real
+  // cluster; 127.0.0.1 in tests)
+  std::string master_host = "dct-master";
+  int master_port = 8080;
+  int slots_per_pod = 8;  // chips per TPU-VM host (v5e-8 host)
+  std::string accelerator = "tpu-v5-lite-podslice";  // GKE accelerator label
+  // dry-run: pod state lives in <state_dir>/pods.json; tests play kubelet
+  // by editing phases. Empty state_dir + dry_run=false = real kubectl.
+  bool dry_run = true;
+  std::string state_dir = "kube_state";
+};
+
+// Dry-run runner: manifests and phases persist in <state_dir>/pods.json.
+class DryRunKubectl : public KubectlRunner {
+ public:
+  explicit DryRunKubectl(std::string state_dir);
+  bool apply(const Json& manifest) override;
+  std::vector<KubePodStatus> list_pods() override;
+  bool delete_alloc(const std::string& alloc_id) override;
+
+ private:
+  Json load();
+  void store(const Json& pods);
+  std::string path_;
+};
+
+// Live runner: shells out to kubectl (apply -f -, get -o json, delete -l).
+// BLOCKING — wrap in AsyncKubectl so subprocess latency never runs under
+// the master lock.
+class LiveKubectl : public KubectlRunner {
+ public:
+  explicit LiveKubectl(std::string ns) : ns_(std::move(ns)) {}
+  bool apply(const Json& manifest) override;
+  std::vector<KubePodStatus> list_pods() override;
+  bool delete_alloc(const std::string& alloc_id) override;
+
+ private:
+  std::string ns_;
+};
+
+// Decouples the master tick from kubectl latency (≈ the reference's
+// request_queue.go worker pool + informer cache): apply/delete enqueue onto
+// a worker thread, list_pods returns the poller's latest snapshot. Applied
+// pods are echoed into the snapshot immediately so the RM never sees its
+// own submission as "pods vanished".
+class AsyncKubectl : public KubectlRunner {
+ public:
+  explicit AsyncKubectl(std::unique_ptr<KubectlRunner> inner,
+                        double poll_interval_sec = 1.0);
+  ~AsyncKubectl() override;
+  bool apply(const Json& manifest) override;
+  std::vector<KubePodStatus> list_pods() override;
+  bool delete_alloc(const std::string& alloc_id) override;
+  bool ready() override;
+
+ private:
+  void loop();
+  std::unique_ptr<KubectlRunner> inner_;
+  double interval_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool stop_ = false;
+  bool have_snapshot_ = false;
+  std::vector<std::function<void()>> queue_;  // runs on the worker thread
+  std::vector<KubePodStatus> snapshot_;
+  std::thread worker_;
+};
+
+class KubernetesRM : public ResourceManager {
+ public:
+  KubernetesRM(KubeRmConfig config, std::unique_ptr<KubectlRunner> runner);
+  std::string name() const override { return "kubernetes"; }
+  void tick(RmContext& ctx) override;
+
+  // exposed for unit tests
+  Json pod_manifest(const Allocation& alloc, const Json& start_cmd, int rank,
+                    int world, int pod_slots) const;
+
+ private:
+  KubeRmConfig config_;
+  std::unique_ptr<KubectlRunner> runner_;
+};
+
+}  // namespace dct

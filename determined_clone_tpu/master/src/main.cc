@@ -62,11 +62,34 @@ int main(int argc, char** argv) {
       config.provisioner.cooldown_sec = std::atof(argv[++i]);
     } else if (!std::strcmp(argv[i], "--provision-live")) {
       config.provisioner.dry_run = false;  // actually exec gcloud
+    } else if (!std::strcmp(argv[i], "--rm") && i + 1 < argc) {
+      config.rm = argv[++i];
+      if (config.rm != "agent" && config.rm != "kubernetes") {
+        std::cerr << "unknown --rm '" << config.rm
+                  << "' (agent|kubernetes)\n";
+        return 2;
+      }
+    } else if (!std::strcmp(argv[i], "--kube-namespace") && i + 1 < argc) {
+      config.kube.ns = argv[++i];
+    } else if (!std::strcmp(argv[i], "--kube-image") && i + 1 < argc) {
+      config.kube.image = argv[++i];
+    } else if (!std::strcmp(argv[i], "--kube-master-host") && i + 1 < argc) {
+      config.kube.master_host = argv[++i];
+    } else if (!std::strcmp(argv[i], "--kube-slots-per-pod") && i + 1 < argc) {
+      config.kube.slots_per_pod = std::max(1, std::atoi(argv[++i]));
+    } else if (!std::strcmp(argv[i], "--kube-accelerator") && i + 1 < argc) {
+      config.kube.accelerator = argv[++i];
+    } else if (!std::strcmp(argv[i], "--kube-live")) {
+      config.kube.dry_run = false;  // actually exec kubectl
     } else if (!std::strcmp(argv[i], "--help")) {
       std::cout << "usage: dct-master [--port N] [--data-dir DIR] "
                    "[--scheduler fifo|priority|fair_share] "
                    "[--agent-timeout SEC] [--auth-required] [--rbac] "
                    "[--webui-dir DIR] "
+                   "[--rm agent|kubernetes [--kube-namespace NS] "
+                   "[--kube-image IMG] [--kube-master-host H] "
+                   "[--kube-slots-per-pod N] [--kube-accelerator A] "
+                   "[--kube-live]] "
                    "[--provision-accelerator TYPE [--provision-zone Z] "
                    "[--provision-project P] [--provision-slots N] "
                    "[--provision-min N] [--provision-max N] "
@@ -74,6 +97,13 @@ int main(int argc, char** argv) {
                    "[--provision-cooldown SEC] [--provision-live]]\n";
       return 0;
     }
+  }
+  if (config.rm == "kubernetes" && config.provisioner.enabled) {
+    // the TPU-VM provisioner only runs inside the agent RM's tick; letting
+    // the flags pass would silently never autoscale
+    std::cerr << "--provision-* flags require --rm agent (kubernetes "
+                 "autoscaling belongs to the cluster autoscaler)\n";
+    return 2;
   }
   // env overrides (≈ viper env config in the reference)
   if (const char* p = std::getenv("DCT_MASTER_PORT")) config.port = std::atoi(p);

@@ -62,6 +62,23 @@ Master::Master(MasterConfig config) : config_(std::move(config)) {
     provisioner_ = std::make_unique<Provisioner>(config_.provisioner,
                                                  std::move(client));
   }
+  // resource manager selection (≈ rm.New, master/internal/rm/setup.go:17)
+  if (config_.rm == "kubernetes") {
+    KubeRmConfig kube = config_.kube;
+    kube.master_port = config_.port;
+    std::unique_ptr<KubectlRunner> runner;
+    if (kube.dry_run) {
+      runner = std::make_unique<DryRunKubectl>(config_.data_dir + "/" +
+                                               kube.state_dir);
+    } else {
+      // kubectl subprocesses must never run under the master lock
+      runner = std::make_unique<AsyncKubectl>(
+          std::make_unique<LiveKubectl>(kube.ns));
+    }
+    rm_ = std::make_unique<KubernetesRM>(std::move(kube), std::move(runner));
+  } else {
+    rm_ = std::make_unique<AgentRM>();
+  }
 }
 
 Master::~Master() { stop(); }
@@ -751,6 +768,26 @@ void Master::tick_locked() {
     }
   }
 
+  // resource management: agent gang scheduling or kubernetes pods (rm.h)
+  RmContext ctx;
+  ctx.now = now;
+  ctx.allocations = &allocations_;
+  ctx.trials = &trials_;
+  ctx.mark_dirty = [this] { dirty_ = true; };
+  ctx.on_task_done = [this](const std::string& id, int code,
+                            const std::string& err) {
+    on_task_done(id, code, err);
+  };
+  ctx.start_command = [this](const Allocation& alloc, int rank) {
+    Json cmd = allocation_start_command(alloc, "");
+    cmd.set("rank", rank);
+    return cmd;
+  };
+  ctx.agent_tick = [this](double t) { agent_rm_tick_locked(t); };
+  rm_->tick(ctx);
+}
+
+void Master::agent_rm_tick_locked(double now) {
   // group by pool and schedule (≈ resource_pool.go:360 schedulerTick)
   std::map<std::string, std::vector<Agent>> pool_agents;
   for (const auto& [aid, agent] : agents_) {

@@ -18,9 +18,11 @@
 
 #include "http.h"
 #include "json.h"
+#include "kubernetesrm.h"
 #include "model.h"
 #include "platform.h"
 #include "provisioner.h"
+#include "rm.h"
 #include "scheduler.h"
 #include "searcher.h"
 #include "store.h"
@@ -49,6 +51,10 @@ struct MasterConfig {
   // persistence backend: "auto" (sqlite when libsqlite3 loads, else files),
   // "sqlite", or "files" (store.h)
   std::string db = "auto";
+  // resource manager: "agent" (gang scheduler over dct-agents) or
+  // "kubernetes" (allocations become TPU pods; ≈ rm/setup.go:17-28)
+  std::string rm = "agent";
+  KubeRmConfig kube;
 };
 
 class Master {
@@ -73,6 +79,9 @@ class Master {
   void on_task_done(const std::string& alloc_id, int exit_code,
                     const std::string& error);
   void tick_locked();
+  // the agentrm scheduling pass (schedule_pool + provisioner), extracted so
+  // the RM seam can swap it out for kubernetesrm (rm.h)
+  void agent_rm_tick_locked(double now);
   Json allocation_start_command(const Allocation& alloc,
                                 const std::string& agent_id);
 
@@ -143,6 +152,7 @@ class Master {
   std::thread tick_thread_;
   std::atomic<bool> running_{false};
   std::unique_ptr<Provisioner> provisioner_;  // null unless enabled
+  std::unique_ptr<ResourceManager> rm_;       // agent | kubernetes
   std::unique_ptr<Store> store_;  // created in the ctor (routes need it
                                   // even when start() is never called)
 

@@ -1,0 +1,53 @@
+// Pluggable resource-manager seam.
+//
+// ≈ the reference's rm.ResourceManager interface (master/internal/rm/
+// resource_manager_iface.go:12) and rm.New's agentrm-vs-kubernetesrm
+// selection (master/internal/rm/setup.go:17-28). The master owns all
+// cluster state under one lock; an RM is a strategy object invoked from
+// the master tick with a narrow context of references + callbacks, so
+// each RM stays testable without threading master internals through it.
+#pragma once
+
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+
+#include "json.h"
+#include "model.h"
+
+namespace dct {
+
+struct RmContext {
+  double now = 0;
+  std::map<std::string, Allocation>* allocations = nullptr;
+  std::map<int64_t, Trial>* trials = nullptr;
+  std::function<void()> mark_dirty;
+  // terminal-state idempotent task-exit handler (master.cc on_task_done)
+  std::function<void(const std::string& alloc_id, int exit_code,
+                     const std::string& error)> on_task_done;
+  // full start command for one allocation member (allocation_start_command
+  // + rank) — the same payload an agent heartbeat would deliver
+  std::function<Json(const Allocation&, int rank)> start_command;
+  // the whole agent-scheduling tick (schedule_pool + provisioner); only
+  // AgentRM calls it
+  std::function<void(double now)> agent_tick;
+};
+
+class ResourceManager {
+ public:
+  virtual ~ResourceManager() = default;
+  virtual std::string name() const = 0;
+  // called every master tick, under the master lock
+  virtual void tick(RmContext& ctx) = 0;
+};
+
+// The default RM: gang scheduling over registered dct-agents
+// (scheduler.cc + topology.cc + provisioner.cc stay the implementation).
+class AgentRM : public ResourceManager {
+ public:
+  std::string name() const override { return "agent"; }
+  void tick(RmContext& ctx) override { ctx.agent_tick(ctx.now); }
+};
+
+}  // namespace dct

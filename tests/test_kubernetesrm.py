@@ -1,0 +1,237 @@
+"""Kubernetes resource manager: allocations become TPU pods.
+
+Drives a C++ master started with --rm kubernetes (dry-run kubectl seam:
+the "cluster" is <data-dir>/kube_state/pods.json; this test plays kubelet
+by flipping pod phases) — ≈ the reference's kubernetesrm tests over mocked
+pods services (master/internal/rm/kubernetesrm/pods_test.go).
+"""
+import json
+import time
+from pathlib import Path
+
+import pytest
+
+from tests.test_platform import build_binaries, start_master
+
+EXP_CONFIG = {
+    "name": "kube-exp",
+    "entrypoint": "model:Trial",
+    "searcher": {"name": "single", "metric": "loss",
+                 "max_length": {"batches": 1}},
+    "resources": {"slots_per_trial": 16, "topology": "v5e-16"},
+}
+
+
+def wait_for(predicate, timeout=30, interval=0.1, desc="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        result = predicate()
+        if result:
+            return result
+        time.sleep(interval)
+    pytest.fail(f"timed out waiting for {desc}")
+
+
+class KubeSim:
+    """The test's kubelet: reads/writes the dry-run seam's pods.json."""
+
+    def __init__(self, data_dir: Path):
+        self.path = data_dir / "kube_state" / "pods.json"
+
+    def pods(self):
+        if not self.path.exists():
+            return []
+        return json.loads(self.path.read_text() or "[]")
+
+    def set_phase(self, phase, ip_base="10.0.0.", exit_code=0,
+                  only_name=None):
+        pods = self.pods()
+        for i, p in enumerate(pods):
+            if only_name and p["name"] != only_name:
+                continue
+            p["phase"] = phase
+            p["ip"] = f"{ip_base}{i + 1}"
+            p["exit_code"] = exit_code
+        self.path.write_text(json.dumps(pods))
+
+
+def complete_searcher_op(session, exp_id):
+    """Play the in-pod harness: report the searcher op's validation so the
+    trial's clean exit closes it (pods run no real harness in dry-run)."""
+    trial = session.get_experiment(exp_id)["trials"][0]
+    session.post(f"/api/v1/trials/{trial['id']}/searcher/completed_op",
+                 {"metric": 0.1, "units": trial["target_units"]})
+
+
+@pytest.fixture()
+def kube_master(tmp_path):
+    if not build_binaries():
+        pytest.skip("C++ master build unavailable")
+    proc, session, port = start_master(
+        tmp_path, "--rm", "kubernetes", "--kube-master-host", "127.0.0.1",
+        "--kube-slots-per-pod", "8", "--kube-namespace", "tpu-ns")
+    sim = KubeSim(tmp_path / "master-data")
+    yield {"proc": proc, "session": session, "port": port,
+           "tmp": tmp_path, "sim": sim}
+    proc.kill()
+    proc.wait(timeout=10)
+
+
+def test_allocation_becomes_tpu_pods(kube_master):
+    session, sim = kube_master["session"], kube_master["sim"]
+    exp = session.create_experiment(EXP_CONFIG)
+
+    # 16 chips at 8 chips/pod -> a 2-pod gang
+    pods = wait_for(lambda: len(sim.pods()) == 2 and sim.pods(),
+                    desc="2 pods submitted")
+    names = {p["name"] for p in pods}
+    assert all(n.startswith("dct-trial-") for n in names)
+
+    # pod spec: TPU resource limits, GKE selectors, DCT env, trial command
+    m = pods[0]["manifest"]
+    assert m["kind"] == "Pod" and m["metadata"]["namespace"] == "tpu-ns"
+    assert m["metadata"]["labels"]["dct-managed"] == "true"
+    spec = m["spec"]
+    assert spec["restartPolicy"] == "Never"
+    sel = spec["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-accelerator"]
+    assert sel["cloud.google.com/gke-tpu-topology"] == "v5e-16"
+    c = spec["containers"][0]
+    assert c["resources"]["limits"]["google.com/tpu"] == "8"
+    assert c["command"][:3] == ["python", "-m",
+                                "determined_clone_tpu.exec.trial"]
+    env = {e["name"]: e["value"] for e in c["env"]}
+    assert env["DCT_MASTER_HOST"] == "127.0.0.1"
+    assert env["DCT_MASTER_PORT"] == str(kube_master["port"])
+    assert env["DCT_WORLD_SIZE"] == "2"
+    assert env["DCT_SLOTS"] == "8"
+    assert env["DCT_ALLOC_TOKEN"]
+    assert env["DCT_RANK"] in ("0", "1")
+
+    # allocation is Pulling while pods are Pending
+    exp_state = session.get_experiment(exp["id"])
+    assert exp_state["trials"][0]["state"] in ("QUEUED", "PULLING")
+
+    # kubelet: pods come up -> allocation Running
+    sim.set_phase("Running")
+    wait_for(lambda: session.get_experiment(exp["id"])["trials"][0]["state"]
+             == "RUNNING", desc="trial running")
+
+    # kubelet: pods finish -> experiment completes, pods deleted
+    complete_searcher_op(session, exp["id"])
+    sim.set_phase("Succeeded")
+    wait_for(lambda: session.get_experiment(exp["id"])["experiment"]["state"]
+             == "COMPLETED", desc="experiment completed")
+    wait_for(lambda: sim.pods() == [], desc="pods garbage-collected")
+
+
+def test_pod_failure_restarts_trial(kube_master):
+    session, sim = kube_master["session"], kube_master["sim"]
+    config = dict(EXP_CONFIG)
+    config["name"] = "kube-fail"
+    config["resources"] = {"slots_per_trial": 8}
+    config["max_restarts"] = 1
+    exp = session.create_experiment(config)
+
+    pods = wait_for(lambda: sim.pods(), desc="pod submitted")
+    first_gen = {p["name"] for p in pods}
+    sim.set_phase("Running")
+    wait_for(lambda: session.get_experiment(exp["id"])["trials"][0]["state"]
+             == "RUNNING", desc="running")
+    sim.set_phase("Failed", exit_code=137)
+
+    # trial restarts: a fresh allocation leg -> a fresh pod generation
+    def new_generation():
+        pods_now = sim.pods()
+        return pods_now and {p["name"] for p in pods_now} != first_gen
+    wait_for(new_generation, desc="restart pods")
+    assert session.get_experiment(exp["id"])["trials"][0]["restarts"] == 1
+
+    # second failure exhausts max_restarts -> experiment errored
+    sim.set_phase("Running")
+    time.sleep(0.3)
+    sim.set_phase("Failed", exit_code=137)
+    wait_for(lambda: session.get_experiment(exp["id"])["experiment"]["state"]
+             == "ERRORED", desc="experiment errored")
+
+
+def test_kill_deletes_pods(kube_master):
+    session, sim = kube_master["session"], kube_master["sim"]
+    config = dict(EXP_CONFIG)
+    config["name"] = "kube-kill"
+    config["resources"] = {"slots_per_trial": 8}
+    exp = session.create_experiment(config)
+    wait_for(lambda: sim.pods(), desc="pod submitted")
+    sim.set_phase("Running")
+    wait_for(lambda: session.get_experiment(exp["id"])["trials"][0]["state"]
+             == "RUNNING", desc="running")
+    session.kill_experiment(exp["id"])
+
+    # kill is graceful: the master raises the preempt flag; the in-pod
+    # harness checkpoints and exits (here: the kubelet sim marks the pods
+    # finished), and only then are the pods garbage-collected
+    trial = session.get_experiment(exp["id"])["trials"][0]
+    alloc_id = f"trial-{trial['id']}.{trial['restarts']}"
+    wait_for(lambda: session.get(
+        f"/api/v1/allocations/{alloc_id}/preempt")["preempt"],
+        desc="preempt flag raised")
+    sim.set_phase("Succeeded")
+    wait_for(lambda: sim.pods() == [], desc="pods deleted on kill")
+    assert session.get_experiment(exp["id"])["experiment"]["state"] == \
+        "CANCELED"
+
+
+def test_reattach_after_master_restart(kube_master):
+    session, sim = kube_master["session"], kube_master["sim"]
+    config = dict(EXP_CONFIG)
+    config["name"] = "kube-reattach"
+    config["resources"] = {"slots_per_trial": 8}
+    exp = session.create_experiment(config)
+    wait_for(lambda: sim.pods(), desc="pod submitted")
+    sim.set_phase("Running")
+    wait_for(lambda: session.get_experiment(exp["id"])["trials"][0]["state"]
+             == "RUNNING", desc="running")
+
+    kube_master["proc"].terminate()
+    kube_master["proc"].wait(timeout=10)
+    assert sim.pods(), "pods must survive a master restart"
+
+    proc, session, port = start_master(
+        kube_master["tmp"], "--rm", "kubernetes", "--kube-master-host",
+        "127.0.0.1", "--kube-slots-per-pod", "8")
+    kube_master.update(proc=proc, session=session, port=port)
+
+    # restored master re-adopts the running pods instead of resubmitting
+    wait_for(lambda: session.get_experiment(exp["id"])["trials"][0]["state"]
+             == "RUNNING", desc="reattached running")
+    assert len(sim.pods()) == 1
+
+    # and the task can still finish normally
+    complete_searcher_op(session, exp["id"])
+    sim.set_phase("Succeeded")
+    wait_for(lambda: session.get_experiment(exp["id"])["experiment"]["state"]
+             == "COMPLETED", desc="completed after reattach")
+
+
+def test_pods_vanishing_requeues_allocation(kube_master):
+    session, sim = kube_master["session"], kube_master["sim"]
+    config = dict(EXP_CONFIG)
+    config["name"] = "kube-vanish"
+    config["resources"] = {"slots_per_trial": 8}
+    exp = session.create_experiment(config)
+    pods = wait_for(lambda: sim.pods(), desc="pod submitted")
+    first_gen = {p["name"] for p in pods}
+    sim.set_phase("Running")
+    wait_for(lambda: session.get_experiment(exp["id"])["trials"][0]["state"]
+             == "RUNNING", desc="running")
+
+    # out-of-band deletion (node reclaim): pods disappear without exiting
+    sim.path.write_text("[]")
+
+    # silent retry: the allocation requeues and new pods are submitted,
+    # with no restart charged (no real task exit happened)
+    def resubmitted():
+        pods_now = sim.pods()
+        return pods_now and {p["name"] for p in pods_now} == first_gen
+    wait_for(resubmitted, desc="pods resubmitted")
+    assert session.get_experiment(exp["id"])["trials"][0]["restarts"] == 0
