@@ -145,6 +145,15 @@ class MasterSession:
         resp = b.get_job_queue(self, b.V1GetJobQueueRequest())
         return [t.to_json() for t in resp.queue]
 
+    def set_job_priority(self, allocation_id: str, priority: int) -> Dict[str, Any]:
+        return self.post(f"/api/v1/job-queue/{_q(allocation_id)}/priority",
+                         {"priority": priority})["job"]
+
+    def move_job(self, allocation_id: str, *, ahead_of: str = "",
+                 behind: str = "") -> Dict[str, Any]:
+        return self.post(f"/api/v1/job-queue/{_q(allocation_id)}/move",
+                         {"ahead_of": ahead_of, "behind": behind})["job"]
+
     def task_logs(self, allocation_id: str, limit: int = 1000) -> list:
         return self.get(
             f"/api/v1/allocations/{allocation_id}/logs?limit={limit}")["logs"]

@@ -258,6 +258,20 @@ def cmd_job_list(args) -> int:
     return 0
 
 
+def cmd_job_move(args) -> int:
+    job = make_session(args).move_job(
+        args.allocation_id, ahead_of=args.ahead_of, behind=args.behind)
+    print(f"Moved {job['id']} (queued_at {job['queued_at']})")
+    return 0
+
+
+def cmd_job_set_priority(args) -> int:
+    job = make_session(args).set_job_priority(args.allocation_id,
+                                              args.priority)
+    print(f"Set {job['id']} priority to {job['priority']}")
+    return 0
+
+
 def cmd_user_login(args) -> int:
     session = make_session(args)
     import getpass
@@ -598,6 +612,16 @@ def build_parser() -> argparse.ArgumentParser:
     p_job = sub.add_parser("job", aliases=["j"], help="job queue")
     sj = p_job.add_subparsers(dest="subcommand", required=True)
     sj.add_parser("list").set_defaults(func=cmd_job_list)
+    c = sj.add_parser("move")
+    c.add_argument("allocation_id")
+    g = c.add_mutually_exclusive_group(required=True)
+    g.add_argument("--ahead-of", default="")
+    g.add_argument("--behind", default="")
+    c.set_defaults(func=cmd_job_move)
+    c = sj.add_parser("set-priority")
+    c.add_argument("allocation_id")
+    c.add_argument("priority", type=int)
+    c.set_defaults(func=cmd_job_set_priority)
 
     # user
     p_user = sub.add_parser("user", aliases=["u"], help="users")
@@ -728,7 +752,7 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--port", type=int, default=None)
     c.add_argument("--topology", default=None)
     c.add_argument("--scheduler", default="fifo",
-                   choices=["fifo", "priority", "fair_share"])
+                   choices=["fifo", "priority", "fair_share", "round_robin"])
     c.add_argument("--auth-required", action="store_true")
     c.set_defaults(func=cmd_deploy_up)
     sdl.add_parser("cluster-down").set_defaults(func=cmd_deploy_down)

@@ -24,6 +24,10 @@ from determined_clone_tpu.core._searcher import (
     SearcherOperationSource,
 )
 from determined_clone_tpu.core._serialization import load_pytree, save_pytree
+from determined_clone_tpu.core._unmanaged import (
+    LogShipperHandler,
+    init_unmanaged,
+)
 from determined_clone_tpu.core._train import (
     LocalMetricsBackend,
     MetricsBackend,
@@ -37,6 +41,8 @@ __all__ = [
     "NullCheckpointRegistry",
     "Context",
     "init",
+    "init_unmanaged",
+    "LogShipperHandler",
     "DistributedContext",
     "DistributedError",
     "FilePreemptionSource",

@@ -1,0 +1,184 @@
+"""Unmanaged trials: off-cluster runs that report in to the master.
+
+≈ the reference's unmanaged-experiment support: `core_v2.init()` with a
+master URL (harness/determined/experimental/core_v2/_unmanaged.py), the
+background heartbeat (harness/determined/core/_heartbeat.py:15) and the
+client-side log shipper (harness/determined/core/_log_shipper.py:18). The
+training loop runs wherever the user launched it — a dev box, a notebook,
+a TPU VM the master does not manage — while metrics, checkpoints, logs and
+liveness land in the master exactly like a managed trial's.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import traceback
+from typing import Any, Dict, Iterator, Optional
+
+from determined_clone_tpu.api.client import MasterSession
+from determined_clone_tpu.config.experiment import ExperimentConfig
+
+
+class _HeartbeatThread(threading.Thread):
+    """Periodic liveness pings; the response piggybacks the preempt flag."""
+
+    def __init__(self, session: MasterSession, trial_id: int,
+                 interval: float = 5.0) -> None:
+        super().__init__(daemon=True, name="dct-unmanaged-heartbeat")
+        self._session = session
+        self._trial_id = trial_id
+        self._interval = interval
+        self._stop = threading.Event()
+        self.preempt_requested = False
+
+    def run(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                resp = self._session.post(
+                    f"/api/v1/trials/{self._trial_id}/heartbeat", {})
+                self.preempt_requested = bool(resp.get("preempt"))
+            except Exception:
+                pass  # master unreachable: keep trying, training continues
+
+    def finish(self, state: str, error: str = "") -> None:
+        self._stop.set()
+        body: Dict[str, Any] = {"state": state}
+        if error:
+            body["error"] = error
+        try:
+            self._session.post(
+                f"/api/v1/trials/{self._trial_id}/heartbeat", body)
+        except Exception:
+            pass
+
+
+class LogShipperHandler(logging.Handler):
+    """Batches log records and ships them to the master's task-log store
+    (the same JSONL the WebUI and `det trial logs` read). Attach to any
+    logger; `init_unmanaged` attaches it to the root logger."""
+
+    def __init__(self, session: MasterSession, allocation_id: str,
+                 flush_interval: float = 2.0, max_batch: int = 500) -> None:
+        super().__init__()
+        self._session = session
+        self._allocation_id = allocation_id
+        self._buf: list = []
+        self._lock = threading.Lock()
+        self._max_batch = max_batch
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, args=(flush_interval,), daemon=True,
+            name="dct-unmanaged-logs")
+        self._thread.start()
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            line = self.format(record)
+        except Exception:
+            return
+        with self._lock:
+            self._buf.append(line)
+            overflow = len(self._buf) >= self._max_batch
+        if overflow:
+            self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            batch, self._buf = self._buf, []
+        if not batch:
+            return
+        try:
+            self._session.post(
+                f"/api/v1/allocations/{self._allocation_id}/logs",
+                {"logs": batch})
+        except Exception:
+            pass  # drop rather than block or crash the training loop
+
+    def _loop(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            self.flush()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.flush()
+        super().close()
+
+
+@contextlib.contextmanager
+def init_unmanaged(
+    *,
+    master_host: str = "127.0.0.1",
+    master_port: int = 8080,
+    config: Optional[Dict[str, Any]] = None,
+    name: str = "unmanaged",
+    ship_logs: bool = True,
+    heartbeat_interval: float = 5.0,
+    token: Optional[str] = None,
+) -> Iterator[Any]:
+    """Register an unmanaged experiment+trial and yield a master-backed
+    core.Context. On clean exit the trial (and experiment) complete; on an
+    exception they error with the traceback recorded."""
+    from determined_clone_tpu import core
+    from determined_clone_tpu.core._master_backed import (
+        MasterCheckpointRegistry,
+        MasterMetricsBackend,
+        MasterPreemptionSource,
+        MasterSearcherSource,
+    )
+
+    session = MasterSession(master_host, master_port)
+    if token:
+        session.token = token
+
+    cfg: Dict[str, Any] = dict(config or {})
+    cfg.setdefault("name", name)
+    cfg.setdefault("entrypoint", "unmanaged")
+    cfg.setdefault("searcher", {"name": "single", "metric": "loss",
+                                "max_length": {"batches": 1}})
+    cfg["unmanaged"] = True
+    resp = session.post("/api/v1/experiments", {"config": cfg})
+    unmanaged = resp.get("unmanaged") or []
+    if not unmanaged:
+        raise RuntimeError("master did not return unmanaged trial handles")
+    handle = unmanaged[0]
+    trial_id = int(handle["trial_id"])
+    allocation_id = handle["allocation_id"]
+    # the data-plane token authenticates the shipper/heartbeat when the
+    # master runs with --auth-required
+    data_session = MasterSession(master_host, master_port)
+    data_session.token = handle["token"]
+
+    heartbeat = _HeartbeatThread(data_session, trial_id, heartbeat_interval)
+    heartbeat.start()
+    shipper: Optional[LogShipperHandler] = None
+    if ship_logs:
+        shipper = LogShipperHandler(data_session, allocation_id)
+        shipper.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+        logging.getLogger().addHandler(shipper)
+
+    exp_config = ExperimentConfig.from_dict(cfg)
+    try:
+        with core.init(
+            config=exp_config,
+            metrics_backend=MasterMetricsBackend(session, trial_id),
+            preemption_source=MasterPreemptionSource(session, allocation_id),
+            searcher_source=MasterSearcherSource(session, trial_id),
+            checkpoint_registry=MasterCheckpointRegistry(session, trial_id),
+            trial_id=trial_id,
+        ) as ctx:
+            ctx.experiment_id = resp["experiment"]["id"]
+            ctx.trial_id = trial_id
+            ctx.allocation_id = allocation_id
+            yield ctx
+    except BaseException:
+        heartbeat.finish("ERRORED", error=traceback.format_exc(limit=5))
+        raise
+    else:
+        heartbeat.finish("COMPLETED")
+    finally:
+        if shipper is not None:
+            logging.getLogger().removeHandler(shipper)
+            shipper.close()

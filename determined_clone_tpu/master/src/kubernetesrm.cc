@@ -92,6 +92,24 @@ bool terminal(const Allocation& a) {
          a.state == RunState::Canceled;
 }
 
+// one gang member's chip share: hosts carry `slots_per_pod` chips, the
+// last pod takes the remainder, zero-slot tasks reserve nothing. Shared by
+// the submit and reattach paths so a master restart never changes the
+// recorded split.
+int member_pod_slots(int total_slots, int slots_per_pod, int rank) {
+  int slots = std::max(total_slots, 0);
+  if (slots == 0) return 0;
+  int per_pod = std::min(std::max(1, slots_per_pod), slots);
+  return std::max(0, std::min(per_pod, slots - rank * per_pod));
+}
+
+int gang_world(int total_slots, int slots_per_pod) {
+  int slots = std::max(total_slots, 0);
+  if (slots == 0) return 1;
+  int per_pod = std::min(std::max(1, slots_per_pod), slots);
+  return (slots + per_pod - 1) / per_pod;
+}
+
 struct RunResult {
   int rc = -1;
   std::string out;
@@ -403,6 +421,7 @@ void KubernetesRM::tick(RmContext& ctx) {
   for (const auto& p : pods) by_alloc[p.alloc_id].push_back(&p);
 
   for (auto& [alloc_id, alloc] : *ctx.allocations) {
+    if (alloc.task_type == "unmanaged") continue;  // client-run, no pods
     auto mine_it = by_alloc.find(sanitize(alloc_id));
     const std::vector<const KubePodStatus*>* mine =
         mine_it == by_alloc.end() ? nullptr : &mine_it->second;
@@ -416,18 +435,11 @@ void KubernetesRM::tick(RmContext& ctx) {
       if (mine && !mine->empty()) {
         // reattach after master restart (≈ ReattachAllocationPods,
         // pods.go:266): the pods are already there — re-adopt them, with
-        // the same per-pod split the submit path used (last pod takes the
-        // remainder; 0-slot tasks reserve 0)
-        int slots = std::max(alloc.slots, 0);
-        int per_pod = std::min(std::max(1, config_.slots_per_pod),
-                               std::max(1, slots));
+        // the same per-pod split the submit path used
         alloc.reservations.clear();
         for (const auto* p : *mine) {
-          int pod_slots =
-              slots == 0 ? 0
-                         : std::max(0, std::min(per_pod,
-                                                slots - p->rank * per_pod));
-          alloc.reservations[p->name] = pod_slots;
+          alloc.reservations[p->name] =
+              member_pod_slots(alloc.slots, config_.slots_per_pod, p->rank);
         }
         alloc.world_size = static_cast<int>(mine->size());
         alloc.state = RunState::Pulling;
@@ -437,16 +449,12 @@ void KubernetesRM::tick(RmContext& ctx) {
         ctx.mark_dirty();
       } else {
         // submit: one pod per TPU host; the last pod takes the remainder
-        int slots = std::max(alloc.slots, 0);
-        int per_pod = std::min(std::max(1, config_.slots_per_pod),
-                               std::max(1, slots));
-        int world = slots == 0 ? 1 : (slots + per_pod - 1) / per_pod;
+        int world = gang_world(alloc.slots, config_.slots_per_pod);
         alloc.world_size = world;
         bool ok = true;
         for (int rank = 0; rank < world && ok; ++rank) {
           int pod_slots =
-              slots == 0 ? 0
-                         : std::min(per_pod, slots - rank * per_pod);
+              member_pod_slots(alloc.slots, config_.slots_per_pod, rank);
           Json cmd = ctx.start_command(alloc, rank);
           cmd.set("slots", pod_slots);  // per-member share, not the gang total
           Json manifest = pod_manifest(alloc, cmd, rank, world, pod_slots);

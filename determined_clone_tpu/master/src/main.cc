@@ -83,7 +83,7 @@ int main(int argc, char** argv) {
       config.kube.dry_run = false;  // actually exec kubectl
     } else if (!std::strcmp(argv[i], "--help")) {
       std::cout << "usage: dct-master [--port N] [--data-dir DIR] "
-                   "[--scheduler fifo|priority|fair_share] "
+                   "[--scheduler fifo|priority|fair_share|round_robin] "
                    "[--agent-timeout SEC] [--auth-required] [--rbac] "
                    "[--webui-dir DIR] "
                    "[--rm agent|kubernetes [--kube-namespace NS] "

@@ -421,6 +421,29 @@ void Master::queue_trial_leg(Trial& trial) {
     }
   }
   const Experiment& exp = experiments_[trial.experiment_id];
+  if (exp.config["unmanaged"].as_bool(false)) {
+    // unmanaged trial (≈ harness core_v2/_unmanaged.py + the reference's
+    // unmanaged experiments): the client runs the training itself and
+    // reports in; the master records a zero-slot Running allocation so
+    // logs/metrics/preemption ride the normal data-plane routes, and never
+    // schedules anything
+    Allocation alloc;
+    alloc.id = "unmanaged-" + std::to_string(trial.id) + "." +
+               std::to_string(trial.restarts);
+    alloc.trial_id = trial.id;
+    alloc.task_type = "unmanaged";
+    alloc.state = RunState::Running;
+    alloc.slots = 0;
+    alloc.world_size = 1;
+    alloc.resource_pool = "unmanaged";
+    alloc.queued_at = now_sec();
+    alloc.last_activity = alloc.queued_at;
+    alloc.token = crypto::random_token();
+    allocations_[alloc.id] = alloc;
+    trial.state = RunState::Running;
+    dirty_ = true;
+    return;
+  }
   const Json& resources = exp.config["resources"];
   Allocation alloc;
   alloc.id = "trial-" + std::to_string(trial.id) + "." +

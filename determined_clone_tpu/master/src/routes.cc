@@ -406,6 +406,24 @@ HttpResponse Master::route(const HttpRequest& req) {
       dirty_ = true;
       Json j = Json::object();
       j.set("experiment", experiments_[id].to_json());
+      if (config["unmanaged"].as_bool(false)) {
+        // hand the unmanaged client its trial ids + data-plane tokens
+        Json arr = Json::array();
+        for (const auto& [tid, t] : trials_) {
+          if (t.experiment_id != id) continue;
+          for (const auto& [aid, alloc] : allocations_) {
+            if (alloc.trial_id != tid || alloc.task_type != "unmanaged") {
+              continue;
+            }
+            Json u = Json::object();
+            u.set("trial_id", tid).set("allocation_id", aid)
+                .set("token", alloc.token)
+                .set("target_units", t.target_units);
+            arr.push_back(u);
+          }
+        }
+        j.set("unmanaged", arr);
+      }
       return HttpResponse::json(201, j.dump());
     }
     if (parts.size() == 3 && req.method == "GET") {
@@ -564,6 +582,64 @@ HttpResponse Master::route(const HttpRequest& req) {
     if (parts.size() == 4 && req.method == "GET") {
       Json j = Json::object();
       j.set("trial", trial.to_json());
+      return ok_json(j);
+    }
+    // unmanaged-trial heartbeat: liveness + client-driven completion
+    // (≈ harness/determined/core/_heartbeat.py:15 + unmanaged experiment
+    // close semantics; the response carries the preempt flag so the client
+    // needs no separate long-poll)
+    if (parts.size() == 5 && parts[4] == "heartbeat" && req.method == "POST") {
+      Allocation* ua = nullptr;
+      for (auto& [aid, a] : allocations_) {
+        if (a.trial_id == id && a.task_type == "unmanaged" &&
+            a.state == RunState::Running) {
+          ua = &a;
+        }
+      }
+      if (!ua) return bad_request("trial has no live unmanaged allocation");
+      // the client authenticates with the allocation's data-plane token
+      // (dct core._unmanaged ships it from the create-experiment response);
+      // a user session with Editor rights may also drive the trial
+      bool token_ok =
+          crypto::constant_time_eq(bearer_token(req), ua->token);
+      if (config_.auth_required && !token_ok &&
+          !(current_user(req) &&
+            rbac_allows(req, role_rank("Editor"),
+                        workspace_id_by_name(exp.workspace)))) {
+        return HttpResponse::json(
+            401, error_json("allocation token or Editor session required")
+                     .dump());
+      }
+      ua->last_activity = now_sec();
+      Json body = req.body.empty() ? Json::object() : Json::parse(req.body);
+      const std::string& state = body["state"].as_string();
+      Json j = Json::object();
+      j.set("preempt", ua->preempt_requested);
+      if (state == "COMPLETED" || state == "ERRORED") {
+        bool failed = state == "ERRORED";
+        ua->state = failed ? RunState::Errored : RunState::Completed;
+        ua->exit_code = failed ? 1 : 0;
+        trial.state = ua->state;
+        trial.ended_at = now_sec();
+        if (failed && body["error"].is_string()) {
+          trial.error = body["error"].as_string();
+        }
+        // the experiment's final state reflects EVERY trial, not just the
+        // last reporter: one errored trial makes the experiment errored
+        bool all_done = true, any_errored = false;
+        for (const auto& [tid, t] : trials_) {
+          if (t.experiment_id != exp.id) continue;
+          all_done = all_done && (t.state == RunState::Completed ||
+                                  t.state == RunState::Errored ||
+                                  t.state == RunState::Canceled);
+          any_errored = any_errored || t.state == RunState::Errored;
+        }
+        if (all_done && exp.state == RunState::Running) {
+          finish_experiment(exp, any_errored ? RunState::Errored
+                                             : RunState::Completed);
+        }
+        dirty_ = true;
+      }
       return ok_json(j);
     }
     // report metrics (≈ ReportTrialMetrics api_trials.go:1330)
@@ -1050,19 +1126,108 @@ HttpResponse Master::route(const HttpRequest& req) {
     return ok_json(provisioner_->status());
   }
 
-  // ---- job queue (≈ jobservice) ------------------------------------------
-  if (root == "job-queue" && req.method == "GET") {
-    Json arr = Json::array();
-    for (const auto& [id, alloc] : allocations_) {
-      if (alloc.state == RunState::Queued || alloc.state == RunState::Pulling ||
-          alloc.state == RunState::Running) {
-        Json j = alloc.to_json();
-        arr.push_back(j);
+  // ---- job queue (≈ jobservice + RM GetJobQ/MoveJob/SetGroupPriority,
+  //      resource_manager_iface.go:47-51) -----------------------------------
+  if (root == "job-queue") {
+    if (parts.size() == 3 && req.method == "GET") {
+      Json arr = Json::array();
+      for (const auto& [id, alloc] : allocations_) {
+        if (alloc.task_type == "unmanaged") continue;  // no resources held
+        if (alloc.state == RunState::Queued ||
+            alloc.state == RunState::Pulling ||
+            alloc.state == RunState::Running) {
+          Json j = alloc.to_json();
+          arr.push_back(j);
+        }
       }
+      Json j = Json::object();
+      j.set("queue", arr);
+      return ok_json(j);
     }
-    Json j = Json::object();
-    j.set("queue", arr);
-    return ok_json(j);
+    if (parts.size() == 5 && req.method == "POST") {
+      auto it = allocations_.find(parts[3]);
+      if (it == allocations_.end()) {
+        return not_found("no allocation " + parts[3]);
+      }
+      Allocation& alloc = it->second;
+      // queue mutations are an operator surface
+      if (!rbac_allows(req, role_rank("WorkspaceAdmin"))) {
+        return HttpResponse::json(
+            403, error_json("WorkspaceAdmin role required").dump());
+      }
+      if (parts[4] == "priority") {
+        Json body = Json::parse(req.body);
+        if (!body["priority"].is_number()) {
+          return bad_request("priority required");
+        }
+        alloc.priority = static_cast<int>(body["priority"].as_int());
+        dirty_ = true;
+        Json j = Json::object();
+        j.set("job", alloc.to_json());
+        return ok_json(j);
+      }
+      if (parts[4] == "move") {
+        // move ahead_of/behind an anchor job: queue position IS queued_at,
+        // and the new position lands BETWEEN the anchor and its actual
+        // queue neighbor (the reference's place-between-neighbors decimal
+        // positions, time-valued) — a fixed offset could overshoot jobs
+        // submitted close together or collide on repeated moves
+        Json body = Json::parse(req.body);
+        const std::string& ahead_of = body["ahead_of"].as_string();
+        const std::string& behind = body["behind"].as_string();
+        if ((ahead_of.empty()) == (behind.empty())) {
+          return bad_request("exactly one of ahead_of / behind required");
+        }
+        const std::string& anchor_id = ahead_of.empty() ? behind : ahead_of;
+        auto anchor_it = allocations_.find(anchor_id);
+        auto in_queue = [](const Allocation& a) {
+          return a.task_type != "unmanaged" &&
+                 (a.state == RunState::Queued ||
+                  a.state == RunState::Pulling ||
+                  a.state == RunState::Running);
+        };
+        if (anchor_it == allocations_.end() ||
+            !in_queue(anchor_it->second) || anchor_id == alloc.id) {
+          return bad_request("anchor must be a different job currently in "
+                             "the queue");
+        }
+        if (alloc.state != RunState::Queued) {
+          return bad_request("only queued jobs can be moved");
+        }
+        const Allocation& anchor = anchor_it->second;
+        // nearest queue neighbor on the target side of the anchor
+        double neighbor = ahead_of.empty() ? anchor.queued_at + 2.0
+                                           : anchor.queued_at - 2.0;
+        bool have_neighbor = false;
+        for (const auto& [oid, other] : allocations_) {
+          if (oid == alloc.id || oid == anchor_id || !in_queue(other)) {
+            continue;
+          }
+          if (ahead_of.empty()) {  // behind: first job after the anchor
+            if (other.queued_at > anchor.queued_at &&
+                (!have_neighbor || other.queued_at < neighbor)) {
+              neighbor = other.queued_at;
+              have_neighbor = true;
+            }
+          } else {  // ahead_of: last job before the anchor
+            if (other.queued_at < anchor.queued_at &&
+                (!have_neighbor || other.queued_at > neighbor)) {
+              neighbor = other.queued_at;
+              have_neighbor = true;
+            }
+          }
+        }
+        alloc.queued_at = (anchor.queued_at + neighbor) / 2.0;
+        // in priority mode, ordering is priority-first: adopt the anchor's
+        // priority so the move is effective there too
+        alloc.priority = anchor.priority;
+        dirty_ = true;
+        Json j = Json::object();
+        j.set("job", alloc.to_json());
+        return ok_json(j);
+      }
+      return not_found("unknown job-queue action " + parts[4]);
+    }
   }
 
   return not_found("unknown route " + req.method + " " + req.path);

@@ -160,6 +160,32 @@ SchedulerDecision schedule_pool(
                 return a.queued_at != b.queued_at ? a.queued_at < b.queued_at
                                                   : a.id < b.id;
               });
+  } else if (policy.type == "round_robin") {
+    // interleave owners: first job of each owner, then second of each, ...
+    // (≈ round_robin.go: rotate among groups in arrival order)
+    std::sort(pending.begin(), pending.end(),
+              [](const Allocation& a, const Allocation& b) {
+                return a.queued_at != b.queued_at ? a.queued_at < b.queued_at
+                                                  : a.id < b.id;
+              });
+    std::map<std::string, int> seen;   // owner -> jobs already taken
+    std::vector<std::pair<std::pair<int, double>, Allocation>> keyed;
+    for (auto& a : pending) {
+      int round = seen[owner_key(a)]++;
+      keyed.push_back({{round, a.queued_at}, std::move(a)});
+    }
+    std::sort(keyed.begin(), keyed.end(),
+              [](const auto& x, const auto& y) {
+                if (x.first.first != y.first.first) {
+                  return x.first.first < y.first.first;
+                }
+                if (x.first.second != y.first.second) {
+                  return x.first.second < y.first.second;
+                }
+                return x.second.id < y.second.id;
+              });
+    pending.clear();
+    for (auto& [key, a] : keyed) pending.push_back(std::move(a));
   } else if (policy.type == "fair_share") {
     // owners with fewer held slots go first (≈ fair_share.go:51)
     std::map<std::string, int> usage = share_usage;

@@ -28,7 +28,8 @@ struct SchedulerDecision {
 };
 
 struct PoolPolicy {
-  std::string type = "priority";  // fifo | priority | fair_share
+  // fifo | priority | fair_share | round_robin
+  std::string type = "priority";
   bool preemption_enabled = true;
 };
 

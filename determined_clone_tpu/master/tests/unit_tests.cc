@@ -308,6 +308,24 @@ void test_scheduler() {
     CHECK(dec.assignments.count("a") && dec.assignments.count("b"));
     CHECK(!dec.assignments.count("c"));  // only two v5e-8 agents
   }
+  {  // round robin: owners interleave — A's 2nd job waits for B's 1st
+    PoolPolicy pol;
+    pol.type = "round_robin";
+    std::map<std::string, std::string> owners = {
+        {"a-1", "exp-A"}, {"a-2", "exp-A"}, {"b-1", "exp-B"}};
+    std::map<std::string, int> free3 = {{"a1", 8}, {"a2", 8}};
+    std::vector<Agent> two = {make_agent("a1", 8, "v5e-8"),
+                              make_agent("a2", 8, "v5e-8")};
+    // arrival order: a-1, a-2, b-1 — fifo would starve B's first job
+    auto dec = schedule_pool(pol, two, free3,
+                             {make_alloc("a-1", 8, 42, 1.0),
+                              make_alloc("a-2", 8, 42, 2.0),
+                              make_alloc("b-1", 8, 42, 3.0)},
+                             {}, {}, owners);
+    CHECK(dec.assignments.count("a-1"));
+    CHECK(dec.assignments.count("b-1"));  // round 0 of B beats round 1 of A
+    CHECK(!dec.assignments.count("a-2"));
+  }
   {  // fair share: owner with less usage goes first
     PoolPolicy pol;
     pol.type = "fair_share";

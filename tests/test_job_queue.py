@@ -1,0 +1,78 @@
+"""Job-queue operator actions: move ahead/behind and reprioritize.
+
+≈ the reference's job queue service over RM GetJobQ/MoveJob/
+SetGroupPriority (resource_manager_iface.go:47-51), driven over REST like
+e2e_tests/tests/cluster/test_job_queue.py.
+"""
+import pytest
+
+from tests.test_platform import build_binaries, start_master
+
+from determined_clone_tpu.api.client import MasterError
+
+
+@pytest.fixture(scope="module")
+def master(tmp_path_factory):
+    if not build_binaries():
+        pytest.skip("C++ master build unavailable")
+    tmp = tmp_path_factory.mktemp("jobq")
+    proc, session, port = start_master(tmp)
+    yield {"session": session, "port": port, "proc": proc}
+    proc.kill()
+    proc.wait(timeout=10)
+
+
+def queued(session):
+    return sorted((j for j in session.job_queue() if j["state"] == "QUEUED"),
+                  key=lambda j: (j["queued_at"], j["id"]))
+
+
+def test_move_and_reprioritize(master):
+    session = master["session"]
+    # no agents: command tasks stay queued, letting us reorder them
+    t1 = session.create_task("command", cmd=["echo", "1"], slots=1)
+    t2 = session.create_task("command", cmd=["echo", "2"], slots=1)
+    t3 = session.create_task("command", cmd=["echo", "3"], slots=1)
+    ids = [t["id"] for t in (t1, t2, t3)]
+    assert [j["id"] for j in queued(session)] == ids
+
+    # move t3 ahead of t1 -> order t3, t1, t2; it adopts t1's priority
+    moved = session.move_job(t3["id"], ahead_of=t1["id"])
+    assert moved["priority"] == t1["priority"]
+    assert [j["id"] for j in queued(session)] == [ids[2], ids[0], ids[1]]
+
+    # move t1 behind t2 -> order t3, t2, t1
+    session.move_job(t1["id"], behind=t2["id"])
+    assert [j["id"] for j in queued(session)] == [ids[2], ids[1], ids[0]]
+
+    # reprioritize
+    job = session.set_job_priority(t2["id"], 7)
+    assert job["priority"] == 7
+    assert next(j for j in session.job_queue()
+                if j["id"] == t2["id"])["priority"] == 7
+
+    # validation
+    with pytest.raises(MasterError):
+        session.move_job(t1["id"])  # no anchor
+    with pytest.raises(MasterError):
+        session.move_job(t1["id"], ahead_of=t2["id"], behind=t3["id"])
+    with pytest.raises(MasterError):
+        session.move_job(t1["id"], ahead_of="task-command-999")
+    with pytest.raises(MasterError):
+        session.set_job_priority("task-command-999", 3)
+
+    for tid in ids:
+        session.kill_task(tid)
+
+
+def test_only_queued_jobs_move(master):
+    session = master["session"]
+    t1 = session.create_task("command", cmd=["echo", "x"], slots=1)
+    t2 = session.create_task("command", cmd=["echo", "y"], slots=1)
+    # fake the anchor running via the agent surface is overkill; instead
+    # kill t2 (terminal) and confirm a terminal job cannot be moved
+    session.kill_task(t2["id"])
+    with pytest.raises(MasterError) as err:
+        session.move_job(t2["id"], ahead_of=t1["id"])
+    assert err.value.status == 400
+    session.kill_task(t1["id"])

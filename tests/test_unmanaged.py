@@ -1,0 +1,121 @@
+"""Unmanaged trials: off-cluster runs reporting in to a real C++ master.
+
+≈ the reference's unmanaged experiments (core_v2/_unmanaged.py,
+core/_heartbeat.py:15, core/_log_shipper.py:18): no agent is involved —
+the "trial" runs inside this test process and the master records it.
+"""
+import logging
+import time
+
+import pytest
+
+from tests.test_platform import build_binaries, start_master
+
+from determined_clone_tpu import core
+
+
+@pytest.fixture(scope="module")
+def master(tmp_path_factory):
+    if not build_binaries():
+        pytest.skip("C++ master build unavailable")
+    tmp = tmp_path_factory.mktemp("unmanaged")
+    proc, session, port = start_master(tmp)
+    yield {"session": session, "port": port, "proc": proc}
+    proc.kill()
+    proc.wait(timeout=10)
+
+
+def test_unmanaged_trial_reports_in(master, tmp_path):
+    session = master["session"]
+    with core.init_unmanaged(
+        master_port=master["port"],
+        name="laptop-run",
+        config={"searcher": {"name": "single", "metric": "loss",
+                             "max_length": {"batches": 10}},
+                "checkpoint_storage": {"type": "shared_fs",
+                                       "host_path": str(tmp_path)}},
+        heartbeat_interval=0.2,
+    ) as ctx:
+        exp_id = ctx.experiment_id
+        # visible as a live experiment, held by no scheduler
+        exp = session.get_experiment(exp_id)
+        assert exp["experiment"]["state"] == "RUNNING"
+        assert exp["trials"][0]["state"] == "RUNNING"
+        assert all(j["id"] != ctx.allocation_id for j in session.job_queue())
+
+        for step in range(1, 4):
+            ctx.train.report_training_metrics(
+                steps_completed=step, metrics={"loss": 1.0 / step})
+        logging.getLogger("unmanaged-test").warning("hello from off-cluster")
+        assert ctx.preempt.should_preempt() is False
+
+    # clean exit completes trial + experiment
+    exp = session.get_experiment(exp_id)
+    assert exp["experiment"]["state"] == "COMPLETED"
+    assert exp["trials"][0]["state"] == "COMPLETED"
+
+    metrics = session.trial_metrics(exp["trials"][0]["id"])
+    assert any(m["metrics"]["loss"] == 1.0 for m in metrics)
+
+    logs = session.task_logs(f"unmanaged-{exp['trials'][0]['id']}.0")
+    assert any("hello from off-cluster" in str(line["log"]) for line in logs)
+
+
+def test_unmanaged_failure_marks_errored(master):
+    session = master["session"]
+    with pytest.raises(RuntimeError, match="boom"):
+        with core.init_unmanaged(master_port=master["port"],
+                                 name="failing-run",
+                                 heartbeat_interval=0.2) as ctx:
+            exp_id = ctx.experiment_id
+            raise RuntimeError("boom")
+    exp = session.get_experiment(exp_id)
+    assert exp["experiment"]["state"] == "ERRORED"
+    assert "boom" in exp["trials"][0]["error"]
+
+
+def test_unmanaged_heartbeat_requires_token_under_auth(tmp_path):
+    if not build_binaries():
+        pytest.skip("C++ master build unavailable")
+    proc, session, port = start_master(tmp_path, "--auth-required")
+    try:
+        user = session.login("admin")
+        with core.init_unmanaged(master_port=port, name="authed-run",
+                                 heartbeat_interval=0.2,
+                                 token=session.token) as ctx:
+            trial_id = ctx.trial_id
+            # anonymous mutation is rejected; the shipped data-plane token
+            # (used internally by the heartbeat thread) is what authorizes
+            from determined_clone_tpu.api.client import (
+                MasterError, MasterSession)
+
+            anon = MasterSession("127.0.0.1", port)
+            anon.token = None
+            with pytest.raises(MasterError) as err:
+                anon.post(f"/api/v1/trials/{trial_id}/heartbeat",
+                          {"state": "ERRORED"})
+            assert err.value.status == 401
+        assert user["username"] == "admin"
+        assert session.get_experiment(ctx.experiment_id)["experiment"][
+            "state"] == "COMPLETED"
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+def test_unmanaged_preemption_flag(master):
+    session = master["session"]
+    with core.init_unmanaged(master_port=master["port"], name="preempt-run",
+                             heartbeat_interval=0.1) as ctx:
+        session.kill_experiment(ctx.experiment_id)
+        # the next heartbeat observes the preempt flag; the data-plane
+        # preempt long-poll sees it too
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if session.get(
+                    f"/api/v1/allocations/{ctx.allocation_id}/preempt"
+            )["preempt"]:
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("preempt flag never raised for unmanaged trial")
