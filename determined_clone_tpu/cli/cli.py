@@ -272,6 +272,58 @@ def cmd_job_set_priority(args) -> int:
     return 0
 
 
+def _deploy_runner(args):
+    from determined_clone_tpu.deploy import DryRunRunner, SubprocessRunner
+
+    return SubprocessRunner() if args.live else DryRunRunner()
+
+
+def _print_plan(plan) -> int:
+    if plan.get("dry_run"):
+        print("# dry run — pass --live to execute:")
+        for cmd in plan.get("commands", []):
+            print(cmd)
+    else:
+        print("done")
+    return 0
+
+
+def cmd_deploy_gcp_up(args) -> int:
+    from determined_clone_tpu.deploy import gcp_up
+
+    return _print_plan(gcp_up(
+        cluster_name=args.cluster_name, project=args.project, zone=args.zone,
+        accelerator_type=args.accelerator_type, n_agents=args.agents,
+        auth_required=args.auth_required, runner=_deploy_runner(args)))
+
+
+def cmd_deploy_gcp_down(args) -> int:
+    from determined_clone_tpu.deploy import gcp_down
+
+    return _print_plan(gcp_down(
+        cluster_name=args.cluster_name, project=args.project, zone=args.zone,
+        n_agents=args.agents, runner=_deploy_runner(args)))
+
+
+def cmd_deploy_gke_up(args) -> int:
+    from determined_clone_tpu.deploy import gke_up
+
+    return _print_plan(gke_up(
+        cluster=args.cluster, project=args.project, zone=args.zone,
+        namespace=args.namespace, image=args.image,
+        accelerator_type=args.accelerator_type,
+        tpu_topology=args.tpu_topology, manifest_path=args.manifests_out,
+        runner=_deploy_runner(args)))
+
+
+def cmd_deploy_gke_down(args) -> int:
+    from determined_clone_tpu.deploy import gke_down
+
+    return _print_plan(gke_down(
+        cluster=args.cluster, project=args.project, zone=args.zone,
+        namespace=args.namespace, runner=_deploy_runner(args)))
+
+
 def cmd_user_login(args) -> int:
     session = make_session(args)
     import getpass
@@ -757,6 +809,38 @@ def build_parser() -> argparse.ArgumentParser:
     c.set_defaults(func=cmd_deploy_up)
     sdl.add_parser("cluster-down").set_defaults(func=cmd_deploy_down)
     sdl.add_parser("status").set_defaults(func=cmd_deploy_status)
+    p_gcp = sd.add_parser("gcp", help="GCP TPU-VM cluster (dry-run default)")
+    sdg = p_gcp.add_subparsers(dest="action", required=True)
+    for action, fn in (("up", cmd_deploy_gcp_up),
+                       ("down", cmd_deploy_gcp_down)):
+        c = sdg.add_parser(action)
+        c.add_argument("--project", required=True)
+        c.add_argument("--zone", required=True)
+        c.add_argument("--cluster-name", default="dct")
+        c.add_argument("--agents", type=int, default=1)
+        if action == "up":
+            c.add_argument("--accelerator-type", default="v5litepod-8")
+            c.add_argument("--auth-required", action="store_true")
+        c.add_argument("--live", action="store_true",
+                       help="actually run gcloud (default: print the plan)")
+        c.set_defaults(func=fn)
+    p_gke = sd.add_parser("gke", help="GKE + kubernetes RM (dry-run default)")
+    sdk = p_gke.add_subparsers(dest="action", required=True)
+    for action, fn in (("up", cmd_deploy_gke_up),
+                       ("down", cmd_deploy_gke_down)):
+        c = sdk.add_parser(action)
+        c.add_argument("--project", required=True)
+        c.add_argument("--zone", required=True)
+        c.add_argument("--cluster", default="dct")
+        c.add_argument("--namespace", default="dct")
+        if action == "up":
+            c.add_argument("--image", default="determined-clone-tpu:latest")
+            c.add_argument("--accelerator-type", default="v5litepod-8")
+            c.add_argument("--tpu-topology", default="2x4")
+            c.add_argument("--manifests-out", default=None,
+                           help="write the k8s manifests to this file")
+        c.add_argument("--live", action="store_true")
+        c.set_defaults(func=fn)
 
     return parser
 

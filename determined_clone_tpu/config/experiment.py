@@ -149,7 +149,7 @@ class ResourcesConfig:
 # harness/determined/common/storage backends)
 # ---------------------------------------------------------------------------
 
-_STORAGE_TYPES = {"shared_fs", "directory", "gcs", "s3"}
+_STORAGE_TYPES = {"shared_fs", "directory", "gcs", "s3", "azure"}
 
 
 @dataclasses.dataclass
@@ -159,7 +159,9 @@ class CheckpointStorageConfig:
     storage_path: Optional[str] = None    # shared_fs subdir / directory path
     container_path: Optional[str] = None  # directory
     bucket: Optional[str] = None          # gcs / s3
-    prefix: Optional[str] = None          # gcs / s3
+    prefix: Optional[str] = None          # gcs / s3 / azure
+    container: Optional[str] = None       # azure blob container
+    connection_string: Optional[str] = None  # azure
     save_experiment_best: int = 0
     save_trial_best: int = 1
     save_trial_latest: int = 1
@@ -178,6 +180,8 @@ class CheckpointStorageConfig:
             container_path=raw.get("container_path"),
             bucket=raw.get("bucket"),
             prefix=raw.get("prefix"),
+            container=raw.get("container"),
+            connection_string=raw.get("connection_string"),
             save_experiment_best=int(raw.get("save_experiment_best", 0)),
             save_trial_best=int(raw.get("save_trial_best", 1)),
             save_trial_latest=int(raw.get("save_trial_latest", 1)),
@@ -190,6 +194,10 @@ class CheckpointStorageConfig:
             )
         if t in ("gcs", "s3") and not cfg.bucket:
             raise ConfigError(f"checkpoint_storage.bucket is required for {t} storage")
+        if t == "azure" and not cfg.container:
+            raise ConfigError(
+                "checkpoint_storage.container is required for azure storage"
+            )
         return cfg
 
     def to_dict(self) -> Dict[str, Any]:

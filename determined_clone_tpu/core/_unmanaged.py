@@ -21,7 +21,8 @@ from determined_clone_tpu.config.experiment import ExperimentConfig
 
 
 class _HeartbeatThread(threading.Thread):
-    """Periodic liveness pings; the response piggybacks the preempt flag."""
+    """Periodic liveness pings; the response piggybacks the preempt flag
+    (read by _HeartbeatPreemptionSource — no separate long-poll needed)."""
 
     def __init__(self, session: MasterSession, trial_id: int,
                  interval: float = 5.0) -> None:
@@ -53,6 +54,17 @@ class _HeartbeatThread(threading.Thread):
             pass
 
 
+class _HeartbeatPreemptionSource:
+    """PreemptionSource over the heartbeat's piggybacked preempt flag —
+    the unmanaged client keeps a single periodic request to the master."""
+
+    def __init__(self, heartbeat: _HeartbeatThread) -> None:
+        self._heartbeat = heartbeat
+
+    def poll(self) -> bool:
+        return self._heartbeat.preempt_requested
+
+
 class LogShipperHandler(logging.Handler):
     """Batches log records and ships them to the master's task-log store
     (the same JSONL the WebUI and `det trial logs` read). Attach to any
@@ -67,6 +79,7 @@ class LogShipperHandler(logging.Handler):
         self._lock = threading.Lock()
         self._max_batch = max_batch
         self._stop = threading.Event()
+        self._wake = threading.Event()  # overflow: nudge the shipper thread
         self._thread = threading.Thread(
             target=self._loop, args=(flush_interval,), daemon=True,
             name="dct-unmanaged-logs")
@@ -81,7 +94,10 @@ class LogShipperHandler(logging.Handler):
             self._buf.append(line)
             overflow = len(self._buf) >= self._max_batch
         if overflow:
-            self.flush()
+            # never flush on the caller's thread: emit runs under the
+            # logging handler lock, and a slow master would block every
+            # thread that logs — signal the background shipper instead
+            self._wake.set()
 
     def flush(self) -> None:
         with self._lock:
@@ -96,11 +112,14 @@ class LogShipperHandler(logging.Handler):
             pass  # drop rather than block or crash the training loop
 
     def _loop(self, interval: float) -> None:
-        while not self._stop.wait(interval):
+        while not self._stop.is_set():
+            self._wake.wait(interval)
+            self._wake.clear()
             self.flush()
 
     def close(self) -> None:
         self._stop.set()
+        self._wake.set()  # break the wait promptly
         self._thread.join(timeout=5)
         self.flush()
         super().close()
@@ -124,7 +143,6 @@ def init_unmanaged(
     from determined_clone_tpu.core._master_backed import (
         MasterCheckpointRegistry,
         MasterMetricsBackend,
-        MasterPreemptionSource,
         MasterSearcherSource,
     )
 
@@ -164,7 +182,7 @@ def init_unmanaged(
         with core.init(
             config=exp_config,
             metrics_backend=MasterMetricsBackend(session, trial_id),
-            preemption_source=MasterPreemptionSource(session, allocation_id),
+            preemption_source=_HeartbeatPreemptionSource(heartbeat),
             searcher_source=MasterSearcherSource(session, trial_id),
             checkpoint_registry=MasterCheckpointRegistry(session, trial_id),
             trial_id=trial_id,

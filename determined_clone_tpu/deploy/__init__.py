@@ -1,10 +1,28 @@
 """Deploy tooling (≈ harness/determined/deploy): local process cluster
-(the devcluster analogue); cloud TPU-VM provisioning is config-generation
-only in this environment (zero egress)."""
+(the devcluster analogue), GCP TPU-VM provisioning, and GKE manifests —
+cloud modes run through a dry-run seam in this zero-egress environment."""
+from determined_clone_tpu.deploy.gcp import (
+    DryRunRunner,
+    SubprocessRunner,
+    gcp_down,
+    gcp_up,
+)
+from determined_clone_tpu.deploy.gke import gke_down, gke_manifests, gke_up
 from determined_clone_tpu.deploy.local import (
     cluster_down,
     cluster_status,
     cluster_up,
 )
 
-__all__ = ["cluster_down", "cluster_status", "cluster_up"]
+__all__ = [
+    "DryRunRunner",
+    "SubprocessRunner",
+    "cluster_down",
+    "cluster_status",
+    "cluster_up",
+    "gcp_down",
+    "gcp_up",
+    "gke_down",
+    "gke_manifests",
+    "gke_up",
+]

@@ -1,0 +1,147 @@
+"""`det deploy gcp` — provision a cluster on GCP TPU VMs.
+
+≈ the reference's `det deploy aws/gcp` (harness/determined/deploy/gcp:
+Terraform-driven master+agents). TPU-native redesign: the master runs on a
+plain GCE VM, each agent is a `gcloud compute tpus tpu-vm` instance whose
+startup script launches dct-agent against the master's address. Every
+gcloud invocation goes through a runner seam — dry-run (default, records
+the exact argv plan) or live subprocess — matching the zero-egress test
+environment and the C++ provisioner's gcloud seam.
+"""
+from __future__ import annotations
+
+import json
+import shlex
+import subprocess
+from typing import Any, Dict, List, Optional
+
+
+class CommandRunner:
+    """Seam for gcloud invocations."""
+
+    def run(self, argv: List[str]) -> None:
+        raise NotImplementedError
+
+
+class DryRunRunner(CommandRunner):
+    def __init__(self) -> None:
+        self.commands: List[List[str]] = []
+
+    def run(self, argv: List[str]) -> None:
+        self.commands.append(list(argv))
+
+
+class SubprocessRunner(CommandRunner):  # pragma: no cover - needs gcloud
+    def run(self, argv: List[str]) -> None:
+        subprocess.run(argv, check=True)
+
+
+MASTER_STARTUP = """#!/bin/bash
+set -e
+cd /opt/dct
+make -C determined_clone_tpu/master
+nohup determined_clone_tpu/master/build/dct-master \\
+  --port {port} --data-dir /var/lib/dct {extra_flags} \\
+  > /var/log/dct-master.log 2>&1 &
+"""
+
+AGENT_STARTUP = """#!/bin/bash
+set -e
+cd /opt/dct
+make -C determined_clone_tpu/master
+nohup determined_clone_tpu/master/build/dct-agent \\
+  --master-host {master_host} --master-port {port} \\
+  --id $(hostname) --resource-pool {pool} \\
+  > /var/log/dct-agent.log 2>&1 &
+"""
+
+
+def _master_name(cluster: str) -> str:
+    return f"{cluster}-master"
+
+
+def _agent_name(cluster: str, i: int) -> str:
+    return f"{cluster}-agent-{i}"
+
+
+def gcp_up(*, cluster_name: str = "dct", project: str, zone: str,
+           accelerator_type: str = "v5litepod-8",
+           runtime_version: str = "tpu-ubuntu2204-base",
+           n_agents: int = 1, master_machine_type: str = "n2-standard-8",
+           master_port: int = 8080, master_address: Optional[str] = None,
+           auth_required: bool = False, resource_pool: str = "default",
+           runner: Optional[CommandRunner] = None) -> Dict[str, Any]:
+    """Returns the executed plan; with the default dry-run runner nothing
+    leaves this machine — the plan is the deliverable."""
+    runner = runner or DryRunRunner()
+    # master lands on a GCE VM; agents find it by instance name (internal
+    # DNS resolves <name>.<zone>.c.<project>.internal; a static address can
+    # be passed instead)
+    master_host = master_address or _master_name(cluster_name)
+    extra = "--auth-required" if auth_required else ""
+    runner.run([
+        "gcloud", "compute", "instances", "create",
+        _master_name(cluster_name),
+        "--project", project, "--zone", zone,
+        "--machine-type", master_machine_type,
+        "--tags", cluster_name,  # the firewall rule below targets this tag
+        "--metadata", "startup-script=" + MASTER_STARTUP.format(
+            port=master_port, extra_flags=extra),
+    ])
+    runner.run([
+        "gcloud", "compute", "firewall-rules", "create",
+        f"{cluster_name}-master-api",
+        "--project", project,
+        "--allow", f"tcp:{master_port}",
+        "--target-tags", cluster_name,
+    ])
+    for i in range(n_agents):
+        runner.run([
+            "gcloud", "compute", "tpus", "tpu-vm", "create",
+            _agent_name(cluster_name, i),
+            "--project", project, "--zone", zone,
+            "--accelerator-type", accelerator_type,
+            "--version", runtime_version,
+            "--metadata", "startup-script=" + AGENT_STARTUP.format(
+                master_host=master_host, port=master_port,
+                pool=resource_pool),
+        ])
+    plan = {
+        "cluster_name": cluster_name,
+        "project": project,
+        "zone": zone,
+        "master": _master_name(cluster_name),
+        "agents": [_agent_name(cluster_name, i) for i in range(n_agents)],
+        "accelerator_type": accelerator_type,
+        "dry_run": isinstance(runner, DryRunRunner),
+    }
+    if isinstance(runner, DryRunRunner):
+        plan["commands"] = [" ".join(shlex.quote(a) for a in argv)
+                            for argv in runner.commands]
+    return plan
+
+
+def gcp_down(*, cluster_name: str = "dct", project: str, zone: str,
+             n_agents: int = 1,
+             runner: Optional[CommandRunner] = None) -> Dict[str, Any]:
+    runner = runner or DryRunRunner()
+    for i in range(n_agents):
+        runner.run([
+            "gcloud", "compute", "tpus", "tpu-vm", "delete",
+            _agent_name(cluster_name, i),
+            "--project", project, "--zone", zone, "--quiet",
+        ])
+    runner.run([
+        "gcloud", "compute", "instances", "delete",
+        _master_name(cluster_name),
+        "--project", project, "--zone", zone, "--quiet",
+    ])
+    runner.run([
+        "gcloud", "compute", "firewall-rules", "delete",
+        f"{cluster_name}-master-api", "--project", project, "--quiet",
+    ])
+    plan = {"dry_run": isinstance(runner, DryRunRunner)}
+    if isinstance(runner, DryRunRunner):
+        plan["commands"] = [" ".join(shlex.quote(a) for a in argv)
+                            for argv in runner.commands]
+    return plan

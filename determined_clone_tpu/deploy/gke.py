@@ -1,0 +1,151 @@
+"""`det deploy gke` — run the master on GKE with the kubernetes RM.
+
+≈ the reference's `det deploy gke` + helm chart (helm/charts/determined):
+manifests for the master Deployment/Service plus the RBAC the kubernetes
+resource manager needs to create TPU pods, and the gcloud commands that
+create the cluster's TPU node pool. Manifests are emitted as dicts (the
+deliverable in a zero-egress environment); `gke_up` records/executes the
+kubectl + gcloud plan through the same runner seam as deploy.gcp.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import tempfile
+from typing import Any, Dict, List, Optional
+
+from determined_clone_tpu.deploy.gcp import CommandRunner, DryRunRunner
+
+
+def gke_manifests(*, namespace: str = "dct",
+                  image: str = "determined-clone-tpu:latest",
+                  master_port: int = 8080,
+                  accelerator: str = "tpu-v5-lite-podslice",
+                  slots_per_pod: int = 8,
+                  auth_required: bool = False) -> List[Dict[str, Any]]:
+    """The k8s objects for a master running `--rm kubernetes` in-cluster."""
+    labels = {"app": "dct-master"}
+    args = [
+        "--port", str(master_port),
+        "--data-dir", "/var/lib/dct",
+        "--rm", "kubernetes",
+        "--kube-live",
+        "--kube-namespace", namespace,
+        "--kube-image", image,
+        "--kube-master-host", "dct-master",  # the Service name below
+        "--kube-accelerator", accelerator,
+        "--kube-slots-per-pod", str(slots_per_pod),
+    ]
+    if auth_required:
+        args.append("--auth-required")
+    return [
+        {"apiVersion": "v1", "kind": "Namespace",
+         "metadata": {"name": namespace}},
+        # the RM creates/lists/deletes task pods in its namespace
+        {"apiVersion": "v1", "kind": "ServiceAccount",
+         "metadata": {"name": "dct-master", "namespace": namespace}},
+        {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "Role",
+         "metadata": {"name": "dct-master-pods", "namespace": namespace},
+         "rules": [{"apiGroups": [""], "resources": ["pods"],
+                    "verbs": ["create", "get", "list", "watch", "delete"]}]},
+        {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "RoleBinding",
+         "metadata": {"name": "dct-master-pods", "namespace": namespace},
+         "subjects": [{"kind": "ServiceAccount", "name": "dct-master",
+                       "namespace": namespace}],
+         "roleRef": {"apiGroup": "rbac.authorization.k8s.io", "kind": "Role",
+                     "name": "dct-master-pods"}},
+        {"apiVersion": "apps/v1", "kind": "Deployment",
+         "metadata": {"name": "dct-master", "namespace": namespace,
+                      "labels": labels},
+         "spec": {
+             "replicas": 1,
+             "selector": {"matchLabels": labels},
+             "template": {
+                 "metadata": {"labels": labels},
+                 "spec": {
+                     "serviceAccountName": "dct-master",
+                     "containers": [{
+                         "name": "master",
+                         "image": image,
+                         "command": ["dct-master"] + args,
+                         "ports": [{"containerPort": master_port}],
+                         "volumeMounts": [{"name": "data",
+                                           "mountPath": "/var/lib/dct"}],
+                     }],
+                     "volumes": [{"name": "data", "emptyDir": {}}],
+                 },
+             },
+         }},
+        {"apiVersion": "v1", "kind": "Service",
+         "metadata": {"name": "dct-master", "namespace": namespace},
+         "spec": {"selector": labels,
+                  "ports": [{"port": master_port,
+                             "targetPort": master_port}]}},
+    ]
+
+
+def gke_up(*, cluster: str = "dct", project: str, zone: str,
+           namespace: str = "dct", image: str = "determined-clone-tpu:latest",
+           accelerator_type: str = "v5litepod-8",
+           tpu_topology: str = "2x4", n_tpu_nodes: int = 1,
+           master_port: int = 8080, auth_required: bool = False,
+           manifest_path: Optional[str] = None,
+           runner: Optional[CommandRunner] = None) -> Dict[str, Any]:
+    runner = runner or DryRunRunner()
+    # ct5lp-hightpu hosts come in 1t/4t/8t; multi-host slices use 8t hosts
+    # with a larger --tpu-topology, so derive the HOST chip count, not the
+    # slice total
+    try:
+        slice_chips = int(accelerator_type.rsplit("-", 1)[-1])
+    except ValueError:
+        slice_chips = 8
+    host_chips = 8 if slice_chips >= 8 else (4 if slice_chips >= 4 else 1)
+    n_nodes = max(n_tpu_nodes, (slice_chips + host_chips - 1) // host_chips)
+    runner.run([
+        "gcloud", "container", "node-pools", "create", f"{cluster}-tpus",
+        "--cluster", cluster, "--project", project, "--zone", zone,
+        "--machine-type", f"ct5lp-hightpu-{host_chips}t",
+        "--tpu-topology", tpu_topology,
+        "--num-nodes", str(n_nodes),
+    ])
+    manifests = gke_manifests(namespace=namespace, image=image,
+                              master_port=master_port,
+                              auth_required=auth_required)
+    # the manifests must exist on disk for kubectl (streaming to `-f -`
+    # would hang a live run with no stdin wired)
+    if manifest_path is None:
+        fd, manifest_path = tempfile.mkstemp(prefix="dct-gke-",
+                                             suffix=".json")
+        os.close(fd)
+    with open(manifest_path, "w") as f:
+        json.dump(manifests, f, indent=2)
+    runner.run(["kubectl", "apply", "-f", manifest_path])
+    plan = {
+        "cluster": cluster,
+        "namespace": namespace,
+        "manifests": manifests,
+        "dry_run": isinstance(runner, DryRunRunner),
+    }
+    if isinstance(runner, DryRunRunner):
+        plan["commands"] = [" ".join(shlex.quote(a) for a in argv)
+                            for argv in runner.commands]
+    return plan
+
+
+def gke_down(*, cluster: str = "dct", project: str, zone: str,
+             namespace: str = "dct",
+             runner: Optional[CommandRunner] = None) -> Dict[str, Any]:
+    runner = runner or DryRunRunner()
+    runner.run(["kubectl", "delete", "namespace", namespace,
+                "--ignore-not-found"])
+    runner.run([
+        "gcloud", "container", "node-pools", "delete", f"{cluster}-tpus",
+        "--cluster", cluster, "--project", project, "--zone", zone,
+        "--quiet",
+    ])
+    plan = {"dry_run": isinstance(runner, DryRunRunner)}
+    if isinstance(runner, DryRunRunner):
+        plan["commands"] = [" ".join(shlex.quote(a) for a in argv)
+                            for argv in runner.commands]
+    return plan

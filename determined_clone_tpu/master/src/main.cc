@@ -27,6 +27,8 @@ int main(int argc, char** argv) {
       config.default_pool.type = argv[++i];
     } else if (!std::strcmp(argv[i], "--agent-timeout") && i + 1 < argc) {
       config.agent_timeout_sec = std::atof(argv[++i]);
+    } else if (!std::strcmp(argv[i], "--unmanaged-timeout") && i + 1 < argc) {
+      config.unmanaged_timeout_sec = std::atof(argv[++i]);
     } else if (!std::strcmp(argv[i], "--auth-required")) {
       config.auth_required = true;
     } else if (!std::strcmp(argv[i], "--rbac")) {

@@ -767,6 +767,26 @@ void Master::tick_locked() {
     }
   }
 
+  // unmanaged-trial watchdog: a client that stops heartbeating (SIGKILL,
+  // network gone) must not leave a RUNNING experiment behind forever —
+  // the agent-timeout and idle-watcher paths both skip these zero-slot
+  // client-driven allocations
+  for (auto& [id, alloc] : allocations_) {
+    if (alloc.task_type != "unmanaged" || alloc.state != RunState::Running) {
+      continue;
+    }
+    if (now - std::max(alloc.last_activity, alloc.queued_at) >
+        config_.unmanaged_timeout_sec) {
+      // the client is not coming back and no scheduler can restart it, so
+      // bypass on_task_done's restart logic (which would mint a fresh
+      // unmanaged allocation that times out again, restarts times over)
+      if (alloc.trial_id && trials_.count(alloc.trial_id)) {
+        trials_[alloc.trial_id].no_retries = true;
+      }
+      on_task_done(id, 1, "unmanaged client heartbeat lost");
+    }
+  }
+
   // agent liveness: reconnect-with-amnesia (≈ agent.go:330): a timed-out
   // agent's reservations are released and its allocations requeued
   for (auto& [aid, agent] : agents_) {

@@ -34,6 +34,8 @@ struct MasterConfig {
   std::string data_dir = "master_data";
   PoolPolicy default_pool;
   double agent_timeout_sec = 60;   // heartbeat "amnesia" window
+  // unmanaged trials: errored when the client's heartbeats stop this long
+  double unmanaged_timeout_sec = 300;
   double tick_interval_sec = 0.5;  // ≈ resource_pool.go:62 schedulerTick
   // when true, user-facing routes (experiments/tasks/registry/...) require a
   // Bearer token from /api/v1/auth/login; the agent + data planes stay open

@@ -1,5 +1,6 @@
 """Checkpoint storage backends (≈ harness/determined/common/storage)."""
 from determined_clone_tpu.storage.base import (
+    AzureStorageManager,
     DirectoryStorageManager,
     GCSStorageManager,
     S3StorageManager,
@@ -9,6 +10,7 @@ from determined_clone_tpu.storage.base import (
 )
 
 __all__ = [
+    "AzureStorageManager",
     "DirectoryStorageManager",
     "GCSStorageManager",
     "S3StorageManager",

@@ -125,20 +125,24 @@ class DirectoryStorageManager(SharedFSStorageManager):
         super().__init__(container_path)
 
 
-class GCSStorageManager(StorageManager):  # pragma: no cover - gated on client lib
-    """GCS backend; requires google-cloud-storage (not in this image)."""
+class GCSStorageManager(StorageManager):
+    """GCS backend. The client is injectable (tests use an in-memory fake);
+    by default it needs google-cloud-storage + application-default creds."""
 
-    def __init__(self, bucket: str, prefix: Optional[str] = None) -> None:
-        try:
-            from google.cloud import storage as gcs  # type: ignore
+    def __init__(self, bucket: str, prefix: Optional[str] = None,
+                 client: Optional[object] = None) -> None:
+        if client is None:  # pragma: no cover - needs the real client lib
+            try:
+                from google.cloud import storage as gcs  # type: ignore
 
-            self.client = gcs.Client()
-        except Exception as e:
-            raise RuntimeError(
-                "checkpoint_storage type 'gcs' needs google-cloud-storage and "
-                "application-default credentials; on TPU VMs a shared_fs "
-                "gcsfuse mount is the zero-config alternative"
-            ) from e
+                client = gcs.Client()
+            except Exception as e:
+                raise RuntimeError(
+                    "checkpoint_storage type 'gcs' needs google-cloud-storage "
+                    "and application-default credentials; on TPU VMs a "
+                    "shared_fs gcsfuse mount is the zero-config alternative"
+                ) from e
+        self.client = client
         self.bucket = self.client.bucket(bucket)
         self.prefix = (prefix or "").strip("/")
 
@@ -176,17 +180,22 @@ class GCSStorageManager(StorageManager):  # pragma: no cover - gated on client l
         }
 
 
-class S3StorageManager(StorageManager):  # pragma: no cover - gated on client lib
-    """S3 backend; requires boto3 (not in this image)."""
+class S3StorageManager(StorageManager):
+    """S3 backend. The client is injectable (tests use an in-memory fake);
+    by default it needs boto3."""
 
-    def __init__(self, bucket: str, prefix: Optional[str] = None) -> None:
-        try:
-            import boto3  # type: ignore
-        except ImportError as e:
-            raise RuntimeError(
-                "checkpoint_storage type 's3' requires boto3 (not installed)"
-            ) from e
-        self.s3 = boto3.client("s3")
+    def __init__(self, bucket: str, prefix: Optional[str] = None,
+                 client: Optional[object] = None) -> None:
+        if client is None:  # pragma: no cover - needs the real client lib
+            try:
+                import boto3  # type: ignore
+            except ImportError as e:
+                raise RuntimeError(
+                    "checkpoint_storage type 's3' requires boto3 "
+                    "(not installed)"
+                ) from e
+            client = boto3.client("s3")
+        self.s3 = client
         self.bucket_name = bucket
         self.prefix = (prefix or "").strip("/")
 
@@ -194,15 +203,27 @@ class S3StorageManager(StorageManager):  # pragma: no cover - gated on client li
         parts = [p for p in (self.prefix, storage_id, rel) if p]
         return "/".join(parts)
 
+    def _list_all(self, prefix: str):
+        # list_objects_v2 pages at 1000 keys; sharded checkpoints can exceed
+        # that, so follow continuation tokens
+        token = None
+        while True:
+            kwargs = {"Bucket": self.bucket_name, "Prefix": prefix}
+            if token:
+                kwargs["ContinuationToken"] = token
+            resp = self.s3.list_objects_v2(**kwargs)
+            yield from resp.get("Contents", [])
+            if not resp.get("IsTruncated"):
+                return
+            token = resp.get("NextContinuationToken")
+
     def upload(self, src_dir, storage_id, paths=None):
         for rel in paths if paths is not None else _walk_relative(src_dir):
             self.s3.upload_file(os.path.join(src_dir, rel), self.bucket_name,
                                 self._key(storage_id, rel))
 
     def download(self, storage_id, dst_dir, paths=None):
-        resp = self.s3.list_objects_v2(Bucket=self.bucket_name,
-                                       Prefix=self._key(storage_id, ""))
-        for item in resp.get("Contents", []):
+        for item in self._list_all(self._key(storage_id, "")):
             rel = item["Key"].split(f"{storage_id}/", 1)[1]
             if paths is not None and rel not in paths:
                 continue
@@ -211,17 +232,77 @@ class S3StorageManager(StorageManager):  # pragma: no cover - gated on client li
             self.s3.download_file(self.bucket_name, item["Key"], out)
 
     def delete(self, storage_id):
-        resp = self.s3.list_objects_v2(Bucket=self.bucket_name,
-                                       Prefix=self._key(storage_id, ""))
-        for item in resp.get("Contents", []):
+        for item in list(self._list_all(self._key(storage_id, ""))):
             self.s3.delete_object(Bucket=self.bucket_name, Key=item["Key"])
 
     def list_files(self, storage_id):
-        resp = self.s3.list_objects_v2(Bucket=self.bucket_name,
-                                       Prefix=self._key(storage_id, ""))
         return {
             item["Key"].split(f"{storage_id}/", 1)[1]: item["Size"]
-            for item in resp.get("Contents", [])
+            for item in self._list_all(self._key(storage_id, ""))
+        }
+
+
+class AzureStorageManager(StorageManager):
+    """Azure Blob Storage backend (≈ the reference's
+    harness/determined/common/storage/azure.py over azure-storage-blob).
+    The container client is injectable (tests use an in-memory fake)."""
+
+    def __init__(self, container: str,
+                 connection_string: Optional[str] = None,
+                 prefix: Optional[str] = None,
+                 container_client: Optional[object] = None) -> None:
+        if container_client is None:  # pragma: no cover - needs client lib
+            try:
+                from azure.storage.blob import (  # type: ignore
+                    BlobServiceClient,
+                )
+            except ImportError as e:
+                raise RuntimeError(
+                    "checkpoint_storage type 'azure' requires "
+                    "azure-storage-blob (not installed)"
+                ) from e
+            if not connection_string:
+                raise RuntimeError(
+                    "checkpoint_storage type 'azure' requires a "
+                    "connection_string"
+                )
+            service = BlobServiceClient.from_connection_string(
+                connection_string)
+            container_client = service.get_container_client(container)
+        self.container = container_client
+        self.prefix = (prefix or "").strip("/")
+
+    def _key(self, storage_id: str, rel: str) -> str:
+        parts = [p for p in (self.prefix, storage_id, rel) if p]
+        return "/".join(parts)
+
+    def upload(self, src_dir, storage_id, paths=None):
+        for rel in paths if paths is not None else _walk_relative(src_dir):
+            with open(os.path.join(src_dir, rel), "rb") as f:
+                self.container.upload_blob(self._key(storage_id, rel), f,
+                                           overwrite=True)
+
+    def download(self, storage_id, dst_dir, paths=None):
+        for blob in self.container.list_blobs(
+                name_starts_with=self._key(storage_id, "")):
+            rel = blob.name.split(f"{storage_id}/", 1)[1]
+            if paths is not None and rel not in paths:
+                continue
+            out = os.path.join(dst_dir, rel)
+            os.makedirs(os.path.dirname(out), exist_ok=True)
+            with open(out, "wb") as f:
+                f.write(self.container.download_blob(blob.name).readall())
+
+    def delete(self, storage_id):
+        for blob in list(self.container.list_blobs(
+                name_starts_with=self._key(storage_id, ""))):
+            self.container.delete_blob(blob.name)
+
+    def list_files(self, storage_id):
+        return {
+            blob.name.split(f"{storage_id}/", 1)[1]: blob.size
+            for blob in self.container.list_blobs(
+                name_starts_with=self._key(storage_id, ""))
         }
 
 
@@ -243,4 +324,7 @@ def build(cfg: CheckpointStorageConfig) -> StorageManager:
         return GCSStorageManager(cfg.bucket, cfg.prefix)
     if cfg.type == "s3":
         return S3StorageManager(cfg.bucket, cfg.prefix)
+    if cfg.type == "azure":
+        return AzureStorageManager(cfg.container, cfg.connection_string,
+                                   cfg.prefix)
     raise ValueError(f"unknown storage type {cfg.type!r}")

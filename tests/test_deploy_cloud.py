@@ -1,0 +1,96 @@
+"""det deploy gcp / gke: dry-run plans and manifest generation.
+
+≈ the reference's deploy-tooling unit tests (harness/tests/determined/
+deploy): no cloud calls — the dry-run runner records the exact argv plan.
+"""
+import json
+
+from determined_clone_tpu.deploy import (
+    DryRunRunner,
+    gcp_down,
+    gcp_up,
+    gke_down,
+    gke_manifests,
+    gke_up,
+)
+
+
+def test_gcp_up_plan():
+    plan = gcp_up(project="proj-1", zone="us-east5-b",
+                  accelerator_type="v5litepod-16", n_agents=2,
+                  auth_required=True)
+    assert plan["dry_run"] is True
+    cmds = plan["commands"]
+    # one master VM, one firewall rule, two TPU-VM agents
+    assert sum("instances create" in c for c in cmds) == 1
+    assert sum("firewall-rules create" in c for c in cmds) == 1
+    tpu_creates = [c for c in cmds if "tpus tpu-vm create" in c]
+    assert len(tpu_creates) == 2
+    assert all("--accelerator-type v5litepod-16" in c for c in tpu_creates)
+    assert all("--zone us-east5-b" in c for c in tpu_creates)
+    # agents' startup script points at the master by name and pool
+    assert all("--master-host dct-master" in c for c in tpu_creates)
+    master_cmd = next(c for c in cmds if "instances create" in c)
+    assert "--auth-required" in master_cmd
+    assert plan["agents"] == ["dct-agent-0", "dct-agent-1"]
+
+
+def test_gcp_down_plan_mirrors_up():
+    plan = gcp_down(project="proj-1", zone="us-east5-b", n_agents=2)
+    cmds = plan["commands"]
+    assert sum("tpus tpu-vm delete" in c for c in cmds) == 2
+    assert sum("instances delete" in c for c in cmds) == 1
+    assert sum("firewall-rules delete" in c for c in cmds) == 1
+
+
+def test_gke_manifests_wire_kubernetes_rm():
+    docs = gke_manifests(namespace="prod", image="gcr.io/x/dct:1",
+                         slots_per_pod=4, auth_required=True)
+    by_kind = {}
+    for d in docs:
+        by_kind.setdefault(d["kind"], []).append(d)
+    assert set(by_kind) == {"Namespace", "ServiceAccount", "Role",
+                            "RoleBinding", "Deployment", "Service"}
+    dep = by_kind["Deployment"][0]
+    cmd = dep["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--rm" in cmd and cmd[cmd.index("--rm") + 1] == "kubernetes"
+    assert "--kube-live" in cmd
+    assert "--auth-required" in cmd
+    assert cmd[cmd.index("--kube-slots-per-pod") + 1] == "4"
+    # the RM's service account can manage pods
+    rules = by_kind["Role"][0]["rules"][0]
+    assert "pods" in rules["resources"] and "create" in rules["verbs"]
+    # service name matches the --kube-master-host the pods will dial
+    assert by_kind["Service"][0]["metadata"]["name"] == "dct-master"
+    assert cmd[cmd.index("--kube-master-host") + 1] == "dct-master"
+    # everything namespaced lands in the requested namespace
+    for d in docs:
+        if d["kind"] != "Namespace":
+            assert d["metadata"]["namespace"] == "prod"
+
+
+def test_gke_up_writes_manifests(tmp_path):
+    out = tmp_path / "manifests.json"
+    plan = gke_up(project="p", zone="z", manifest_path=str(out),
+                  accelerator_type="v5litepod-8", tpu_topology="2x4")
+    assert plan["dry_run"] is True
+    docs = json.loads(out.read_text())
+    assert any(d["kind"] == "Deployment" for d in docs)
+    cmds = plan["commands"]
+    assert any("node-pools create" in c and "--tpu-topology 2x4" in c
+               for c in cmds)
+    assert any(f"kubectl apply -f {out}" in c for c in cmds)
+
+
+def test_gke_down_plan():
+    plan = gke_down(project="p", zone="z")
+    cmds = plan["commands"]
+    assert any("delete namespace dct" in c for c in cmds)
+    assert any("node-pools delete" in c for c in cmds)
+
+
+def test_custom_runner_receives_argv():
+    runner = DryRunRunner()
+    gcp_up(project="p", zone="z", runner=runner)
+    assert all(isinstance(argv, list) and argv[0] == "gcloud"
+               for argv in runner.commands)

@@ -1,0 +1,226 @@
+"""Cloud checkpoint-storage backends against in-memory fake clients.
+
+≈ the reference's moto-style storage unit tests
+(harness/tests/storage/test_{s3,gcs,azure}.py): the GCS/S3/Azure managers
+take injectable clients, so the full upload/download/delete/list and
+store_path/restore_path surfaces run without cloud credentials. The fakes
+mimic each SDK's exact call signatures (boto3 list_objects_v2 pagination
+included).
+"""
+import os
+
+import pytest
+
+from determined_clone_tpu.storage import (
+    AzureStorageManager,
+    GCSStorageManager,
+    S3StorageManager,
+    build,
+)
+from determined_clone_tpu.config.experiment import (
+    CheckpointStorageConfig,
+    ConfigError,
+)
+
+
+# ---------------------------------------------------------------------------
+# fakes
+# ---------------------------------------------------------------------------
+
+class FakeGCSBlob:
+    def __init__(self, store, name):
+        self.store, self.name = store, name
+
+    @property
+    def size(self):
+        return len(self.store[self.name])
+
+    def upload_from_filename(self, path):
+        with open(path, "rb") as f:
+            self.store[self.name] = f.read()
+
+    def download_to_filename(self, path):
+        with open(path, "wb") as f:
+            f.write(self.store[self.name])
+
+    def delete(self):
+        del self.store[self.name]
+
+
+class FakeGCSBucket:
+    def __init__(self, store):
+        self.store = store
+
+    def blob(self, name):
+        return FakeGCSBlob(self.store, name)
+
+
+class FakeGCSClient:
+    def __init__(self):
+        self.store = {}
+
+    def bucket(self, name):
+        return FakeGCSBucket(self.store)
+
+    def list_blobs(self, bucket, prefix=""):
+        for name in sorted(self.store):
+            if name.startswith(prefix):
+                yield FakeGCSBlob(self.store, name)
+
+
+class FakeS3Client:
+    """Paginates at page_size to exercise the continuation-token loop."""
+
+    def __init__(self, page_size=2):
+        self.store = {}
+        self.page_size = page_size
+
+    def upload_file(self, path, bucket, key):
+        with open(path, "rb") as f:
+            self.store[key] = f.read()
+
+    def download_file(self, bucket, key, path):
+        with open(path, "wb") as f:
+            f.write(self.store[key])
+
+    def delete_object(self, Bucket, Key):
+        del self.store[Key]
+
+    def list_objects_v2(self, Bucket, Prefix, ContinuationToken=None):
+        keys = sorted(k for k in self.store if k.startswith(Prefix))
+        start = int(ContinuationToken) if ContinuationToken else 0
+        page = keys[start:start + self.page_size]
+        resp = {"Contents": [{"Key": k, "Size": len(self.store[k])}
+                             for k in page]}
+        if start + self.page_size < len(keys):
+            resp["IsTruncated"] = True
+            resp["NextContinuationToken"] = str(start + self.page_size)
+        return resp
+
+
+class FakeAzureBlobProps:
+    def __init__(self, store, name):
+        self.name = name
+        self.size = len(store[name])
+
+
+class FakeAzureDownload:
+    def __init__(self, data):
+        self._data = data
+
+    def readall(self):
+        return self._data
+
+
+class FakeAzureContainerClient:
+    def __init__(self):
+        self.store = {}
+
+    def upload_blob(self, name, data, overwrite=False):
+        if name in self.store and not overwrite:
+            raise RuntimeError("blob exists")
+        self.store[name] = data.read()
+
+    def list_blobs(self, name_starts_with=""):
+        for name in sorted(self.store):
+            if name.startswith(name_starts_with):
+                yield FakeAzureBlobProps(self.store, name)
+
+    def download_blob(self, name):
+        return FakeAzureDownload(self.store[name])
+
+    def delete_blob(self, name):
+        del self.store[name]
+
+
+def make_backends():
+    gcs_client = FakeGCSClient()
+    s3_client = FakeS3Client()
+    azure_client = FakeAzureContainerClient()
+    return [
+        ("gcs", GCSStorageManager("bkt", "ckpts", client=gcs_client),
+         gcs_client.store),
+        ("s3", S3StorageManager("bkt", "ckpts", client=s3_client),
+         s3_client.store),
+        ("azure", AzureStorageManager("cont", prefix="ckpts",
+                                      container_client=azure_client),
+         azure_client.store),
+    ]
+
+
+def seed(tmp_path):
+    src = tmp_path / "src"
+    (src / "sub").mkdir(parents=True)
+    (src / "weights.bin").write_bytes(b"W" * 64)
+    (src / "sub" / "opt.bin").write_bytes(b"O" * 32)
+    (src / "meta.json").write_text("{}")
+    return str(src)
+
+
+@pytest.mark.parametrize("name,mgr,store", make_backends(),
+                         ids=lambda v: v if isinstance(v, str) else "")
+def test_roundtrip_delete_and_prefix(name, mgr, store, tmp_path):
+    src = seed(tmp_path)
+    mgr.upload(src, "uuid-1")
+
+    # keys carry the prefix and uuid
+    assert all(k.startswith("ckpts/uuid-1/") for k in store)
+    assert mgr.list_files("uuid-1") == {
+        "meta.json": 2, "sub/opt.bin": 32, "weights.bin": 64}
+
+    dst = tmp_path / "dst"
+    dst.mkdir()
+    mgr.download("uuid-1", str(dst))
+    assert (dst / "weights.bin").read_bytes() == b"W" * 64
+    assert (dst / "sub" / "opt.bin").read_bytes() == b"O" * 32
+
+    # selective download (sharded-restore path)
+    part = tmp_path / "part"
+    part.mkdir()
+    mgr.download("uuid-1", str(part), paths=["meta.json"])
+    assert os.listdir(part) == ["meta.json"]
+
+    # selective upload
+    mgr.upload(src, "uuid-2", paths=["meta.json"])
+    assert mgr.list_files("uuid-2") == {"meta.json": 2}
+
+    mgr.delete("uuid-1")
+    assert mgr.list_files("uuid-1") == {}
+    assert mgr.list_files("uuid-2") == {"meta.json": 2}  # untouched
+
+
+@pytest.mark.parametrize("name,mgr,store", make_backends(),
+                         ids=lambda v: v if isinstance(v, str) else "")
+def test_store_and_restore_path(name, mgr, store, tmp_path):
+    with mgr.store_path("ck-1") as path:
+        with open(os.path.join(path, "model.bin"), "wb") as f:
+            f.write(b"M" * 16)
+    assert mgr.list_files("ck-1") == {"model.bin": 16}
+    with mgr.restore_path("ck-1") as path:
+        with open(os.path.join(path, "model.bin"), "rb") as f:
+            assert f.read() == b"M" * 16
+
+
+def test_s3_pagination_covers_all_keys(tmp_path):
+    client = FakeS3Client(page_size=2)
+    mgr = S3StorageManager("bkt", client=client)
+    src = tmp_path / "many"
+    src.mkdir()
+    for i in range(7):  # 7 keys > 3 pages of 2
+        (src / f"shard-{i}.bin").write_bytes(b"x" * (i + 1))
+    mgr.upload(str(src), "big")
+    assert len(mgr.list_files("big")) == 7
+    mgr.delete("big")
+    assert client.store == {}
+
+
+def test_azure_config_build_and_validation():
+    cfg = CheckpointStorageConfig.from_dict(
+        {"type": "azure", "container": "ckpts",
+         "connection_string": "UseDevelopmentStorage=true"})
+    assert cfg.container == "ckpts"
+    with pytest.raises(ConfigError):
+        CheckpointStorageConfig.from_dict({"type": "azure"})
+    # build() reaches the azure branch (gated on the client lib here)
+    with pytest.raises(RuntimeError):
+        build(cfg)

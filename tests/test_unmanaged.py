@@ -74,6 +74,42 @@ def test_unmanaged_failure_marks_errored(master):
     assert "boom" in exp["trials"][0]["error"]
 
 
+def test_dead_client_reaped_by_watchdog(tmp_path):
+    """A SIGKILLed client must not leave a RUNNING experiment forever."""
+    if not build_binaries():
+        pytest.skip("C++ master build unavailable")
+    proc, session, port = start_master(tmp_path, "--unmanaged-timeout", "1")
+    try:
+        # register an unmanaged trial and then never heartbeat (the raw API
+        # stands in for a client that got SIGKILLed immediately)
+        resp = session.post("/api/v1/experiments", {"config": {
+            "name": "dead-client", "entrypoint": "unmanaged",
+            "unmanaged": True,
+            "searcher": {"name": "single", "metric": "loss",
+                         "max_length": {"batches": 1}}}})
+        exp_id = resp["experiment"]["id"]
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            exp = session.get_experiment(exp_id)
+            if exp["experiment"]["state"] == "ERRORED":
+                break
+            time.sleep(0.3)
+        else:
+            pytest.fail("watchdog never errored the silent unmanaged trial")
+        assert "heartbeat lost" in exp["trials"][0]["error"]
+        # the watchdog must not restart-loop: state and restart count are
+        # stable after further watchdog periods
+        restarts = exp["trials"][0]["restarts"]
+        assert restarts <= 1
+        time.sleep(2.5)
+        exp = session.get_experiment(exp_id)
+        assert exp["experiment"]["state"] == "ERRORED"
+        assert exp["trials"][0]["restarts"] == restarts
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
 def test_unmanaged_heartbeat_requires_token_under_auth(tmp_path):
     if not build_binaries():
         pytest.skip("C++ master build unavailable")
