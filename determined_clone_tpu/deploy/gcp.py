@@ -10,7 +10,6 @@ environment and the C++ provisioner's gcloud seam.
 """
 from __future__ import annotations
 
-import json
 import shlex
 import subprocess
 from typing import Any, Dict, List, Optional
@@ -70,6 +69,7 @@ def gcp_up(*, cluster_name: str = "dct", project: str, zone: str,
            n_agents: int = 1, master_machine_type: str = "n2-standard-8",
            master_port: int = 8080, master_address: Optional[str] = None,
            auth_required: bool = False, resource_pool: str = "default",
+           api_source_ranges: str = "10.128.0.0/9",
            runner: Optional[CommandRunner] = None) -> Dict[str, Any]:
     """Returns the executed plan; with the default dry-run runner nothing
     leaves this machine — the plan is the deliverable."""
@@ -94,6 +94,10 @@ def gcp_up(*, cluster_name: str = "dct", project: str, zone: str,
         "--project", project,
         "--allow", f"tcp:{master_port}",
         "--target-tags", cluster_name,
+        # never default to 0.0.0.0/0: auth is off unless requested, and the
+        # API submits arbitrary task argv — internal VPC only unless the
+        # operator widens it deliberately
+        "--source-ranges", api_source_ranges,
     ])
     for i in range(n_agents):
         runner.run([

@@ -109,8 +109,11 @@ def gke_up(*, cluster: str = "dct", project: str, zone: str,
         "--tpu-topology", tpu_topology,
         "--num-nodes", str(n_nodes),
     ])
+    # the master's per-pod slot count must match the node pool's host size
+    # or every task pod requests more chips than any node has
     manifests = gke_manifests(namespace=namespace, image=image,
                               master_port=master_port,
+                              slots_per_pod=host_chips,
                               auth_required=auth_required)
     # the manifests must exist on disk for kubectl (streaming to `-f -`
     # would hang a live run with no stdin wired)
@@ -120,6 +123,12 @@ def gke_up(*, cluster: str = "dct", project: str, zone: str,
         os.close(fd)
     with open(manifest_path, "w") as f:
         json.dump(manifests, f, indent=2)
+    # pin kubectl to the cluster we just modified — the operator's current
+    # context may point anywhere
+    runner.run([
+        "gcloud", "container", "clusters", "get-credentials", cluster,
+        "--project", project, "--zone", zone,
+    ])
     runner.run(["kubectl", "apply", "-f", manifest_path])
     plan = {
         "cluster": cluster,

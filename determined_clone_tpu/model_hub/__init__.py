@@ -1,12 +1,31 @@
 """Model hub — ready-made trials for external model families.
 
-≈ the reference's model_hub package (model_hub/model_hub/huggingface/:
-HF-transformers fine-tuning trials; mmdetection has no JAX ecosystem
-equivalent, its role — a second adapted family — is filled by the
-built-in model zoo in determined_clone_tpu.models)."""
+≈ the reference's model_hub package: HF-transformers fine-tuning trials
+(model_hub/model_hub/huggingface/) and a vision/detection domain filling
+the mmdetection role (model_hub/model_hub/mmdetection/) the TPU-native
+way — ViT classification + an anchor-free single-stage detector."""
 from determined_clone_tpu.model_hub.huggingface import (
     HFCausalLMTrial,
     lm_batches,
 )
+from determined_clone_tpu.model_hub.vision import (
+    DetectorConfig,
+    SingleStageDetectionTrial,
+    ViTClassificationTrial,
+    detection_loss,
+    detector_apply,
+    detector_init,
+    synthetic_detection_batches,
+)
 
-__all__ = ["HFCausalLMTrial", "lm_batches"]
+__all__ = [
+    "DetectorConfig",
+    "HFCausalLMTrial",
+    "SingleStageDetectionTrial",
+    "ViTClassificationTrial",
+    "detection_loss",
+    "detector_apply",
+    "detector_init",
+    "lm_batches",
+    "synthetic_detection_batches",
+]

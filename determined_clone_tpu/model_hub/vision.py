@@ -1,0 +1,219 @@
+"""Vision model hub: ready-made classification + detection trials.
+
+The second model-hub domain, filling the role of the reference's
+mmdetection adapters (model_hub/model_hub/mmdetection/_trial.py: ready-
+made object-detection trials over a config) the TPU-native way: a ViT
+classifier (models/vit.py) and a compact anchor-free single-stage
+detector — per-cell objectness / class / box regression over a conv
+backbone, the FCOS/YOLO family shape — implemented as pure jitted
+functions. Subclass, provide data, train.
+
+    class MyDetection(SingleStageDetectionTrial):
+        def training_data(self):
+            yield {"image": ..., "boxes": ..., "labels": ...}
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from determined_clone_tpu.models import vit
+from determined_clone_tpu.ops import layers
+from determined_clone_tpu.training.trial import JaxTrial
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+
+class ViTClassificationTrial(JaxTrial):
+    """Image classification on a ViT backbone. Hyperparameters mirror
+    ViTConfig fields (image_size, patch_size, d_model, ...)."""
+
+    def vit_config(self) -> vit.ViTConfig:
+        hp = self.context.get_hparam
+        return vit.ViTConfig(
+            image_size=int(hp("image_size", 32)),
+            patch_size=int(hp("patch_size", 8)),
+            channels=int(hp("channels", 3)),
+            n_classes=int(hp("n_classes", 10)),
+            d_model=int(hp("d_model", 64)),
+            n_layers=int(hp("n_layers", 2)),
+            n_heads=int(hp("n_heads", 4)),
+            d_ff=int(hp("d_ff", 128)),
+            compute_dtype=jnp.float32 if hp("full_precision", False)
+            else jnp.bfloat16,
+            remat=bool(hp("remat", False)),
+        )
+
+    def initial_params(self, rng: jax.Array) -> Params:
+        self._cfg = self.vit_config()
+        return vit.init(rng, self._cfg)
+
+    def optimizer(self) -> optax.GradientTransformation:
+        lr = float(self.context.get_hparam("lr", 1e-3))
+        return optax.adamw(lr, weight_decay=float(
+            self.context.get_hparam("weight_decay", 0.01)))
+
+    def loss(self, params, batch, rng):
+        del rng
+        logits = vit.apply(params, self._cfg, batch["image"])
+        loss = layers.softmax_cross_entropy(logits, batch["label"]).mean()
+        return loss, {"accuracy": layers.accuracy(logits, batch["label"])}
+
+    def training_data(self) -> Iterable[Any]:
+        raise NotImplementedError("subclass provides training_data()")
+
+
+# ---------------------------------------------------------------------------
+# detection
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class DetectorConfig:
+    image_size: int = 64
+    channels: int = 3
+    n_classes: int = 4
+    widths: Tuple[int, ...] = (16, 32, 64)  # conv stages, each /2
+    compute_dtype: Any = jnp.float32
+
+    @property
+    def grid(self) -> int:
+        return self.image_size // (2 ** len(self.widths))
+
+
+def detector_init(key: jax.Array, cfg: DetectorConfig) -> Params:
+    ks = jax.random.split(key, len(cfg.widths) + 1)
+    backbone = []
+    in_ch = cfg.channels
+    for i, out_ch in enumerate(cfg.widths):
+        backbone.append(layers.conv_init(ks[i], in_ch, out_ch, 3))
+        in_ch = out_ch
+    # per-cell head: 1 objectness + 4 box (cx, cy, w, h) + n_classes
+    head = layers.conv_init(ks[-1], in_ch, 5 + cfg.n_classes, 1)
+    return {"backbone": backbone, "head": head}
+
+
+def detector_apply(params: Params, cfg: DetectorConfig,
+                   images: jax.Array) -> Dict[str, jax.Array]:
+    """[B,H,W,C] -> per-cell predictions on the [grid, grid] feature map:
+    obj logits [B,g,g], boxes [B,g,g,4] — sigmoid-squashed GLOBAL image
+    fractions (cx, cy, w, h), regressed directly against ground truth in
+    detection_loss (no cell-origin offset) — and class logits
+    [B,g,g,n_classes]."""
+    x = images.astype(cfg.compute_dtype)
+    for conv in params["backbone"]:
+        x = layers.conv2d(conv, x, stride=2)
+        x = jax.nn.relu(x)
+    out = layers.conv2d(params["head"], x)
+    obj = out[..., 0]
+    box = jax.nn.sigmoid(out[..., 1:5])
+    cls = out[..., 5:]
+    return {"objectness": obj, "boxes": box, "class_logits": cls}
+
+
+def detection_loss(params: Params, cfg: DetectorConfig, images: jax.Array,
+                   boxes: jax.Array, labels: jax.Array,
+                   mask: jax.Array) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Anchor-free cell assignment: each ground-truth box (cx,cy,w,h in
+    image fractions; [B,M,4] with validity mask [B,M]) is matched to the
+    cell containing its center. Loss = BCE(objectness) + L1(box) +
+    CE(class) on matched cells (≈ the FCOS/YOLO recipe the mmdetection
+    single-stage trials wrap)."""
+    g = cfg.grid
+    preds = detector_apply(params, cfg, images)
+    b, m = boxes.shape[0], boxes.shape[1]
+
+    cell = jnp.clip((boxes[..., :2] * g).astype(jnp.int32), 0, g - 1)  # [B,M,2]
+    # objectness target grid: scatter 1 at matched cells
+    batch_idx = jnp.arange(b)[:, None] * jnp.ones((1, m), jnp.int32)
+    flat = batch_idx * g * g + cell[..., 1] * g + cell[..., 0]  # y-major
+    obj_target = jnp.zeros((b * g * g,), jnp.float32)
+    obj_target = obj_target.at[flat.reshape(-1)].max(
+        mask.reshape(-1).astype(jnp.float32))
+    obj_target = obj_target.reshape(b, g, g)
+
+    obj_loss = optax.sigmoid_binary_cross_entropy(
+        preds["objectness"], obj_target).mean()
+
+    # gather predictions at matched cells: [B,M,...]
+    def gather_cells(t):
+        return t.reshape(b, g * g, *t.shape[3:])[
+            jnp.arange(b)[:, None], cell[..., 1] * g + cell[..., 0]]
+
+    pred_box = gather_cells(preds["boxes"])
+    pred_cls = gather_cells(preds["class_logits"])
+    denom = jnp.maximum(mask.sum(), 1.0)
+    box_loss = (jnp.abs(pred_box - boxes).sum(-1) * mask).sum() / denom
+    cls_loss = (layers.softmax_cross_entropy(pred_cls, labels)
+                * mask).sum() / denom
+    total = obj_loss + box_loss + cls_loss
+    return total, {"obj_loss": obj_loss, "box_loss": box_loss,
+                   "cls_loss": cls_loss}
+
+
+class SingleStageDetectionTrial(JaxTrial):
+    """Object detection with the compact anchor-free detector. Batches:
+    {"image": [B,H,W,C], "boxes": [B,M,4], "labels": [B,M], "mask": [B,M]}.
+    """
+
+    def detector_config(self) -> DetectorConfig:
+        hp = self.context.get_hparam
+        widths = hp("widths", (16, 32, 64))
+        return DetectorConfig(
+            image_size=int(hp("image_size", 64)),
+            channels=int(hp("channels", 3)),
+            n_classes=int(hp("n_classes", 4)),
+            widths=tuple(int(w) for w in widths),
+        )
+
+    def initial_params(self, rng: jax.Array) -> Params:
+        self._cfg = self.detector_config()
+        return detector_init(rng, self._cfg)
+
+    def optimizer(self) -> optax.GradientTransformation:
+        return optax.adam(float(self.context.get_hparam("lr", 1e-3)))
+
+    def loss(self, params, batch, rng):
+        del rng
+        return detection_loss(params, self._cfg, batch["image"],
+                              batch["boxes"], batch["labels"], batch["mask"])
+
+    def training_data(self) -> Iterable[Any]:
+        raise NotImplementedError("subclass provides training_data()")
+
+
+def synthetic_detection_batches(cfg: DetectorConfig, *, batch_size: int,
+                                n_batches: int, max_boxes: int = 3,
+                                seed: int = 0) -> Iterable[Dict[str, np.ndarray]]:
+    """Deterministic synthetic shapes-on-canvas data: colored axis-aligned
+    rectangles whose class is their color — learnable signal for tests and
+    smoke benchmarks (the no_op/fixtures role of the reference's e2e data)."""
+    rng = np.random.RandomState(seed)
+    s = cfg.image_size
+    for _ in range(n_batches):
+        images = np.zeros((batch_size, s, s, cfg.channels), np.float32)
+        boxes = np.zeros((batch_size, max_boxes, 4), np.float32)
+        labels = np.zeros((batch_size, max_boxes), np.int32)
+        mask = np.zeros((batch_size, max_boxes), np.float32)
+        for b in range(batch_size):
+            for m in range(rng.randint(1, max_boxes + 1)):
+                w, h = rng.uniform(0.15, 0.4, 2)
+                cx = rng.uniform(w / 2, 1 - w / 2)
+                cy = rng.uniform(h / 2, 1 - h / 2)
+                cls = rng.randint(cfg.n_classes)
+                x0, x1 = int((cx - w / 2) * s), int((cx + w / 2) * s)
+                y0, y1 = int((cy - h / 2) * s), int((cy + h / 2) * s)
+                images[b, y0:y1, x0:x1, cls % cfg.channels] = 1.0
+                boxes[b, m] = (cx, cy, w, h)
+                labels[b, m] = cls
+                mask[b, m] = 1.0
+        yield {"image": images, "boxes": boxes, "labels": labels,
+               "mask": mask}

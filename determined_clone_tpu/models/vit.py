@@ -1,0 +1,142 @@
+"""Vision Transformer — the vision-domain flagship backbone.
+
+TPU-native ViT: patch embedding is one big matmul (MXU-friendly — no
+im2col gather), the encoder is a `lax.scan` over stacked per-layer params
+like models/gpt.py (one compiled block body regardless of depth), and all
+matmuls run in bfloat16 by default. Fills the vision slot the reference's
+model_hub/mmdetection covers (model_hub/model_hub/mmdetection/ adapters);
+the architecture itself follows the standard ViT recipe.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from determined_clone_tpu.ops import layers
+from determined_clone_tpu.ops.attention import mha
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    n_classes: int = 1000
+    d_model: int = 384
+    n_layers: int = 12
+    n_heads: int = 6
+    d_ff: int = 1536
+    dropout: float = 0.0
+    compute_dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.channels * self.patch_size ** 2
+
+    @staticmethod
+    def tiny() -> "ViTConfig":
+        return ViTConfig(image_size=32, patch_size=8, channels=3,
+                         n_classes=10, d_model=64, n_layers=2, n_heads=4,
+                         d_ff=128, compute_dtype=jnp.float32)
+
+
+def init(key: jax.Array, cfg: ViTConfig) -> Params:
+    ks = jax.random.split(key, 8)
+
+    def stacked(k, shape, stddev=0.02):
+        return layers.trunc_normal(k, (cfg.n_layers, *shape), stddev)
+
+    d, f = cfg.d_model, cfg.d_ff
+    return {
+        "patch_proj": layers.dense_init(ks[0], cfg.patch_dim, d),
+        "pos_embed": layers.trunc_normal(ks[1], (cfg.n_patches + 1, d)),
+        "cls_token": layers.trunc_normal(ks[2], (d,)),
+        "blocks": {
+            "ln1_scale": jnp.ones((cfg.n_layers, d)),
+            "ln1_bias": jnp.zeros((cfg.n_layers, d)),
+            "wqkv": stacked(ks[3], (d, 3 * d)),
+            "wo": stacked(ks[4], (d, d), stddev=0.02 / (2 * cfg.n_layers) ** 0.5),
+            "ln2_scale": jnp.ones((cfg.n_layers, d)),
+            "ln2_bias": jnp.zeros((cfg.n_layers, d)),
+            "w1": stacked(ks[5], (d, f)),
+            "w2": stacked(ks[6], (f, d), stddev=0.02 / (2 * cfg.n_layers) ** 0.5),
+        },
+        "ln_f": layers.layernorm_init(d),
+        "head": layers.dense_init(ks[7], d, cfg.n_classes),
+    }
+
+
+def patchify(cfg: ViTConfig, images: jax.Array) -> jax.Array:
+    """[B,H,W,C] -> [B, n_patches, patch_dim] without gathers."""
+    b = images.shape[0]
+    p, g = cfg.patch_size, cfg.image_size // cfg.patch_size
+    x = images.reshape(b, g, p, g, p, cfg.channels)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # [B,g,g,p,p,C]
+    return x.reshape(b, g * g, cfg.patch_dim)
+
+
+def _block(cfg: ViTConfig, bp: Params, x: jax.Array) -> jax.Array:
+    d, h = cfg.d_model, cfg.n_heads
+    y = layers.layernorm({"scale": bp["ln1_scale"], "bias": bp["ln1_bias"]}, x)
+    y = y.astype(cfg.compute_dtype)
+    qkv = y @ bp["wqkv"].astype(cfg.compute_dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(*t.shape[:-1], h, d // h)
+
+    attn = mha(heads(q), heads(k), heads(v), causal=False)
+    attn = attn.reshape(*attn.shape[:-2], d)
+    x = x + (attn @ bp["wo"].astype(cfg.compute_dtype)).astype(x.dtype)
+
+    y = layers.layernorm({"scale": bp["ln2_scale"], "bias": bp["ln2_bias"]}, x)
+    y = y.astype(cfg.compute_dtype)
+    y = layers.gelu(y @ bp["w1"].astype(cfg.compute_dtype))
+    x = x + (y @ bp["w2"].astype(cfg.compute_dtype)).astype(x.dtype)
+    return x
+
+
+def encode(params: Params, cfg: ViTConfig, images: jax.Array) -> jax.Array:
+    """[B,H,W,C] -> [B, 1+n_patches, d_model] encoded tokens (f32)."""
+    x = patchify(cfg, images).astype(cfg.compute_dtype)
+    x = layers.dense(params["patch_proj"], x, compute_dtype=cfg.compute_dtype)
+    x = x.astype(jnp.float32)
+    cls = jnp.broadcast_to(params["cls_token"], (x.shape[0], 1, cfg.d_model))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos_embed"]
+
+    block_fn = _block
+    if cfg.remat:
+        block_fn = jax.checkpoint(block_fn, static_argnums=(0,))
+
+    def scan_body(x, layer_params):
+        return block_fn(cfg, layer_params, x), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    return layers.layernorm(params["ln_f"], x)
+
+
+def apply(params: Params, cfg: ViTConfig, images: jax.Array) -> jax.Array:
+    """Classification logits [B, n_classes] from the CLS token."""
+    tokens = encode(params, cfg, images)
+    return layers.dense(params["head"], tokens[:, 0, :])
+
+
+def loss_fn(params: Params, cfg: ViTConfig, images: jax.Array,
+            labels: jax.Array) -> jax.Array:
+    logits = apply(params, cfg, images)
+    return layers.softmax_cross_entropy(logits, labels).mean()
+
+
+def param_count(params: Params) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params)
+               if hasattr(p, "size"))

@@ -150,6 +150,10 @@ class GCSStorageManager(StorageManager):
         parts = [p for p in (self.prefix, storage_id, rel) if p]
         return "/".join(parts)
 
+    def _list_prefix(self, storage_id: str) -> str:
+        # trailing slash: without it, 'ck-1' would match 'ck-12/...' too
+        return self._key(storage_id, "") + "/"
+
     def upload(self, src_dir, storage_id, paths=None):
         for rel in paths if paths is not None else _walk_relative(src_dir):
             self.bucket.blob(self._key(storage_id, rel)).upload_from_filename(
@@ -157,7 +161,8 @@ class GCSStorageManager(StorageManager):
             )
 
     def download(self, storage_id, dst_dir, paths=None):
-        it = self.client.list_blobs(self.bucket, prefix=self._key(storage_id, ""))
+        it = self.client.list_blobs(self.bucket,
+                                    prefix=self._list_prefix(storage_id))
         for blob in it:
             rel = blob.name.split(f"{storage_id}/", 1)[1]
             if paths is not None and rel not in paths:
@@ -167,15 +172,15 @@ class GCSStorageManager(StorageManager):
             blob.download_to_filename(out)
 
     def delete(self, storage_id):
-        for blob in self.client.list_blobs(self.bucket,
-                                           prefix=self._key(storage_id, "")):
+        for blob in self.client.list_blobs(
+                self.bucket, prefix=self._list_prefix(storage_id)):
             blob.delete()
 
     def list_files(self, storage_id):
         return {
             blob.name.split(f"{storage_id}/", 1)[1]: blob.size
             for blob in self.client.list_blobs(
-                self.bucket, prefix=self._key(storage_id, "")
+                self.bucket, prefix=self._list_prefix(storage_id)
             )
         }
 
@@ -203,6 +208,10 @@ class S3StorageManager(StorageManager):
         parts = [p for p in (self.prefix, storage_id, rel) if p]
         return "/".join(parts)
 
+    def _list_prefix(self, storage_id: str) -> str:
+        # trailing slash: without it, 'ck-1' would match 'ck-12/...' too
+        return self._key(storage_id, "") + "/"
+
     def _list_all(self, prefix: str):
         # list_objects_v2 pages at 1000 keys; sharded checkpoints can exceed
         # that, so follow continuation tokens
@@ -223,7 +232,7 @@ class S3StorageManager(StorageManager):
                                 self._key(storage_id, rel))
 
     def download(self, storage_id, dst_dir, paths=None):
-        for item in self._list_all(self._key(storage_id, "")):
+        for item in self._list_all(self._list_prefix(storage_id)):
             rel = item["Key"].split(f"{storage_id}/", 1)[1]
             if paths is not None and rel not in paths:
                 continue
@@ -232,13 +241,13 @@ class S3StorageManager(StorageManager):
             self.s3.download_file(self.bucket_name, item["Key"], out)
 
     def delete(self, storage_id):
-        for item in list(self._list_all(self._key(storage_id, ""))):
+        for item in list(self._list_all(self._list_prefix(storage_id))):
             self.s3.delete_object(Bucket=self.bucket_name, Key=item["Key"])
 
     def list_files(self, storage_id):
         return {
             item["Key"].split(f"{storage_id}/", 1)[1]: item["Size"]
-            for item in self._list_all(self._key(storage_id, ""))
+            for item in self._list_all(self._list_prefix(storage_id))
         }
 
 
@@ -276,6 +285,10 @@ class AzureStorageManager(StorageManager):
         parts = [p for p in (self.prefix, storage_id, rel) if p]
         return "/".join(parts)
 
+    def _list_prefix(self, storage_id: str) -> str:
+        # trailing slash: without it, 'ck-1' would match 'ck-12/...' too
+        return self._key(storage_id, "") + "/"
+
     def upload(self, src_dir, storage_id, paths=None):
         for rel in paths if paths is not None else _walk_relative(src_dir):
             with open(os.path.join(src_dir, rel), "rb") as f:
@@ -284,7 +297,7 @@ class AzureStorageManager(StorageManager):
 
     def download(self, storage_id, dst_dir, paths=None):
         for blob in self.container.list_blobs(
-                name_starts_with=self._key(storage_id, "")):
+                name_starts_with=self._list_prefix(storage_id)):
             rel = blob.name.split(f"{storage_id}/", 1)[1]
             if paths is not None and rel not in paths:
                 continue
@@ -295,14 +308,14 @@ class AzureStorageManager(StorageManager):
 
     def delete(self, storage_id):
         for blob in list(self.container.list_blobs(
-                name_starts_with=self._key(storage_id, ""))):
+                name_starts_with=self._list_prefix(storage_id))):
             self.container.delete_blob(blob.name)
 
     def list_files(self, storage_id):
         return {
             blob.name.split(f"{storage_id}/", 1)[1]: blob.size
             for blob in self.container.list_blobs(
-                name_starts_with=self._key(storage_id, ""))
+                name_starts_with=self._list_prefix(storage_id))
         }
 
 

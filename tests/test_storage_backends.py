@@ -201,6 +201,21 @@ def test_store_and_restore_path(name, mgr, store, tmp_path):
             assert f.read() == b"M" * 16
 
 
+@pytest.mark.parametrize("name,mgr,store", make_backends(),
+                         ids=lambda v: v if isinstance(v, str) else "")
+def test_prefix_sibling_ids_do_not_collide(name, mgr, store, tmp_path):
+    """'ck-1' must never match 'ck-12' blobs (trailing-slash listing)."""
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "w.bin").write_bytes(b"A")
+    mgr.upload(str(src), "ck-1")
+    mgr.upload(str(src), "ck-12")
+    assert set(mgr.list_files("ck-1")) == {"w.bin"}
+    mgr.delete("ck-1")
+    assert mgr.list_files("ck-1") == {}
+    assert set(mgr.list_files("ck-12")) == {"w.bin"}  # sibling untouched
+
+
 def test_s3_pagination_covers_all_keys(tmp_path):
     client = FakeS3Client(page_size=2)
     mgr = S3StorageManager("bkt", client=client)
