@@ -31,6 +31,7 @@
 #include <thread>
 #include <vector>
 
+#include "../../master/src/config_file.h"
 #include "../../master/src/http.h"
 #include "../../master/src/json.h"
 #include "docker.h"
@@ -696,10 +697,49 @@ class Agent {
 }  // namespace
 }  // namespace dct
 
+namespace {
+// agent config file (≈ agent.yaml via viper, options.go:47); the parser is
+// shared with the master (config_file.h) so the format cannot drift
+int apply_agent_config_file(const std::string& path,
+                            dct::AgentConfig* config) {
+  std::map<std::string, std::string> values;
+  try {
+    values = dct::configfile::parse(path);
+  } catch (const std::exception& e) {
+    std::cerr << e.what() << "\n";
+    return 2;
+  }
+  for (const auto& [key, value] : values) {
+    if (key == "master_host") config->master_host = value;
+    else if (key == "master_port") config->master_port = std::atoi(value.c_str());
+    else if (key == "id") config->id = value;
+    else if (key == "resource_pool") config->resource_pool = value;
+    else if (key == "slots") config->slots = std::atoi(value.c_str());
+    else if (key == "topology") config->topology = value;
+    else if (key == "work_dir") config->work_dir = value;
+    else if (key == "runtime") config->runtime = value;
+    else if (key == "docker_image") config->docker_image = value;
+    else {
+      std::cerr << "unknown config key '" << key << "' in " << path << "\n";
+      return 2;
+    }
+  }
+  return 0;
+}
+}  // namespace
+
 int main(int argc, char** argv) {
   dct::AgentConfig config;
   for (int i = 1; i < argc; ++i) {
-    if (!std::strcmp(argv[i], "--master-host") && i + 1 < argc) {
+    if (!std::strcmp(argv[i], "--config") && i + 1 < argc) {
+      int rc = apply_agent_config_file(argv[i + 1], &config);
+      if (rc) return rc;
+    }
+  }
+  for (int i = 1; i < argc; ++i) {
+    if (!std::strcmp(argv[i], "--config") && i + 1 < argc) {
+      ++i;  // applied above; flags override
+    } else if (!std::strcmp(argv[i], "--master-host") && i + 1 < argc) {
       config.master_host = argv[++i];
     } else if (!std::strcmp(argv[i], "--master-port") && i + 1 < argc) {
       config.master_port = std::atoi(argv[++i]);
@@ -724,7 +764,8 @@ int main(int argc, char** argv) {
     } else if (!std::strcmp(argv[i], "--docker-image") && i + 1 < argc) {
       config.docker_image = argv[++i];
     } else if (!std::strcmp(argv[i], "--help")) {
-      std::cout << "usage: dct-agent [--master-host H] [--master-port P] "
+      std::cout << "usage: dct-agent [--config FILE] "
+                   "[--master-host H] [--master-port P] "
                    "[--id ID] [--resource-pool POOL] [--slots N] "
                    "[--topology T] [--work-dir DIR] "
                    "[--runtime process|container|docker] "

@@ -145,6 +145,28 @@ class MasterSession:
         resp = b.get_job_queue(self, b.V1GetJobQueueRequest())
         return [t.to_json() for t in resp.queue]
 
+    def allgather(self, allocation_id: str, rank: int, data: Any, *,
+                  round: int = 0, timeout: float = 300.0,
+                  interval: float = 0.2) -> list:
+        """Master-mediated allgather barrier: post our payload, poll until
+        every member of the gang has posted, return the rank-ordered list
+        (≈ master/internal/task/allgather)."""
+        import time as _time
+
+        deadline = _time.time() + timeout
+        while True:
+            resp = self.post(
+                f"/api/v1/allocations/{_q(allocation_id)}/allgather",
+                {"rank": rank, "round": round, "data": data},
+                retryable=True)  # idempotent re-registration
+            if resp.get("ready"):
+                return list(resp.get("data", []))
+            if _time.time() > deadline:
+                raise MasterError(
+                    408, f"allgather round {round} timed out with "
+                         f"{resp.get('world_size')} members expected")
+            _time.sleep(interval)
+
     def set_job_priority(self, allocation_id: str, priority: int) -> Dict[str, Any]:
         return self.post(f"/api/v1/job-queue/{_q(allocation_id)}/priority",
                          {"priority": priority})["job"]

@@ -255,11 +255,24 @@ class ExperimentConfig:
     environment: Dict[str, Any] = dataclasses.field(default_factory=dict)
     data: Dict[str, Any] = dataclasses.field(default_factory=dict)
     raw: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # what the v0->v1 shim rewrote (surfaced as deprecation notices)
+    deprecations: List[str] = dataclasses.field(default_factory=list)
 
     @staticmethod
     def from_dict(raw: Dict[str, Any]) -> "ExperimentConfig":
         if not isinstance(raw, dict):
             raise ConfigError(f"experiment config must be a mapping, got {type(raw).__name__}")
+        # schema-first pipeline (≈ expconf parse.go): shim legacy (v0)
+        # spellings to the current version, then validate against the
+        # schema-as-data before the dataclass layer parses values
+        from determined_clone_tpu.config import schema as schema_mod
+        from determined_clone_tpu.config import shims
+
+        raw, deprecations = shims.shim(raw)
+        errors = schema_mod.validate(raw)
+        if errors:
+            raise ConfigError("invalid experiment config:\n  " +
+                              "\n  ".join(errors))
         profiling = raw.get("profiling", {})
         cfg = ExperimentConfig(
             name=raw.get("name", "unnamed-experiment"),
@@ -297,6 +310,7 @@ class ExperimentConfig:
             environment=raw.get("environment", {}) or {},
             data=raw.get("data", {}) or {},
             raw=raw,
+            deprecations=deprecations,
         )
         cfg.validate()
         return cfg

@@ -1,0 +1,271 @@
+"""Experiment-config JSON schema: the schema as data + a small validator.
+
+≈ the reference's schema-first expconf (schemas/expconf/v0/*.json sourcing
+code-generated structs, master/pkg/schemas validation/defaulting). Here the
+schema is a Python literal in the same JSON-Schema subset (type, enum,
+required, properties, items, union via oneOf discriminated on a field),
+validated by ``validate()`` before the dataclass layer parses values —
+errors carry JSON paths, unknown keys are reported at known objects, and
+unions resolve by their discriminator exactly like the reference's
+searcher/storage/hparam union types (expconf/searcher_config.go:16-28).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+# -- schema subset ----------------------------------------------------------
+# {"type": "object", "properties": {...}, "required": [...], "open": bool}
+# {"type": "string" | "number" | "integer" | "boolean" | "array", ...}
+# {"union": {"field": <discriminator>, "variants": {value: schema}}}
+# {"type": ..., "enum": [...]}  /  {"any": True}
+
+LENGTH_SCHEMA = {
+    "type": "object",
+    "open": False,
+    "properties": {
+        "batches": {"type": "integer"},
+        "records": {"type": "integer"},
+        "epochs": {"type": "integer"},
+    },
+}
+
+SEARCHER_SCHEMA = {
+    "union": {
+        "field": "name",
+        "variants": {
+            "single": {
+                "type": "object", "open": True,
+                "properties": {
+                    "metric": {"type": "string"},
+                    "smaller_is_better": {"type": "boolean"},
+                    "max_length": LENGTH_SCHEMA,
+                },
+            },
+            "random": {
+                "type": "object", "open": True,
+                "properties": {
+                    "metric": {"type": "string"},
+                    "max_trials": {"type": "integer"},
+                    "max_length": LENGTH_SCHEMA,
+                },
+            },
+            "grid": {
+                "type": "object", "open": True,
+                "properties": {
+                    "metric": {"type": "string"},
+                    "max_length": LENGTH_SCHEMA,
+                },
+            },
+            "asha": {
+                "type": "object", "open": True,
+                "properties": {
+                    "metric": {"type": "string"},
+                    "max_trials": {"type": "integer"},
+                    "num_rungs": {"type": "integer"},
+                    "divisor": {"type": "number"},
+                    "max_length": LENGTH_SCHEMA,
+                },
+            },
+            "adaptive_asha": {
+                "type": "object", "open": True,
+                "properties": {
+                    "metric": {"type": "string"},
+                    "max_trials": {"type": "integer"},
+                    "mode": {"type": "string",
+                             "enum": ["aggressive", "standard",
+                                      "conservative"]},
+                    "max_length": LENGTH_SCHEMA,
+                },
+            },
+            "custom": {"type": "object", "open": True, "properties": {}},
+        },
+    },
+}
+
+STORAGE_SCHEMA = {
+    "union": {
+        "field": "type",
+        "variants": {
+            "shared_fs": {
+                "type": "object", "open": True,
+                "properties": {"host_path": {"type": "string"},
+                               "storage_path": {"type": "string"}},
+                "required": ["host_path"],
+            },
+            "directory": {
+                "type": "object", "open": True,
+                "properties": {"container_path": {"type": "string"}},
+                "required": ["container_path"],
+            },
+            "gcs": {
+                "type": "object", "open": True,
+                "properties": {"bucket": {"type": "string"},
+                               "prefix": {"type": "string"}},
+                "required": ["bucket"],
+            },
+            "s3": {
+                "type": "object", "open": True,
+                "properties": {"bucket": {"type": "string"},
+                               "prefix": {"type": "string"}},
+                "required": ["bucket"],
+            },
+            "azure": {
+                "type": "object", "open": True,
+                "properties": {"container": {"type": "string"},
+                               "connection_string": {"type": "string"},
+                               "prefix": {"type": "string"}},
+                "required": ["container"],
+            },
+        },
+    },
+}
+
+EXPERIMENT_SCHEMA = {
+    "type": "object",
+    "open": False,
+    "properties": {
+        "config_version": {"type": "integer", "enum": [0, 1]},
+        "name": {"type": "string"},
+        "entrypoint": {"type": "string"},
+        "template": {"type": "string"},
+        "workspace": {"type": "string"},
+        "project": {"type": "string"},
+        "unmanaged": {"type": "boolean"},
+        "labels": {"type": "array", "items": {"type": "string"}},
+        "searcher": SEARCHER_SCHEMA,
+        "checkpoint_storage": STORAGE_SCHEMA,
+        "checkpoint_policy": {"type": "string",
+                              "enum": ["best", "all", "none"]},
+        "min_validation_period": LENGTH_SCHEMA,
+        "min_checkpoint_period": LENGTH_SCHEMA,
+        "perform_initial_validation": {"type": "boolean"},
+        "max_restarts": {"type": "integer"},
+        "records_per_epoch": {"type": "integer"},
+        "scheduling_unit": {"type": "integer"},
+        "reproducibility": {
+            "type": "object", "open": False,
+            "properties": {"experiment_seed": {"type": "integer"}},
+        },
+        "resources": {
+            "type": "object", "open": False,
+            "properties": {
+                "slots_per_trial": {"type": "integer"},
+                "resource_pool": {"type": "string"},
+                "priority": {"type": "integer"},
+                "topology": {"type": "string"},
+                "max_slots": {"type": "integer"},
+            },
+        },
+        "hyperparameters": {"any": True},
+        "log_policies": {
+            "type": "array",
+            "items": {
+                "type": "object", "open": False,
+                "properties": {
+                    "pattern": {"type": "string"},
+                    # string form or the reference's {"type": ...} object
+                    "action": {"anyOf": [
+                        {"type": "string",
+                         "enum": ["cancel_retries", "exclude_node"]},
+                        {"type": "object", "open": False,
+                         "properties": {
+                             "type": {"type": "string",
+                                      "enum": ["cancel_retries",
+                                               "exclude_node"]}},
+                         "required": ["type"]},
+                    ]},
+                },
+                "required": ["pattern", "action"],
+            },
+        },
+        "profiling": {
+            "type": "object", "open": False,
+            "properties": {"enabled": {"type": "boolean"}},
+        },
+        "environment": {"any": True},
+        "data": {"any": True},
+    },
+}
+
+
+class SchemaError(ValueError):
+    """Validation failure with a JSON path."""
+
+
+_TYPES = {
+    "string": str,
+    "boolean": bool,
+    "array": list,
+    "object": dict,
+}
+
+
+def _type_ok(schema_type: str, value: Any) -> bool:
+    if schema_type == "integer":
+        return isinstance(value, int) and not isinstance(value, bool)
+    if schema_type == "number":
+        return (isinstance(value, (int, float))
+                and not isinstance(value, bool))
+    return isinstance(value, _TYPES[schema_type])
+
+
+def validate(value: Any, schema: Dict[str, Any] = EXPERIMENT_SCHEMA,
+             path: str = "<config>",
+             discriminator: str = "") -> List[str]:
+    """Returns a list of error strings (empty = valid)."""
+    errors: List[str] = []
+    if schema.get("any"):
+        return errors
+
+    if "anyOf" in schema:
+        attempts = [validate(value, sub, path) for sub in schema["anyOf"]]
+        if any(not a for a in attempts):
+            return []
+        return [f"{path}: no alternative matched: " +
+                "; ".join(a[0] for a in attempts if a)]
+
+    if "union" in schema:
+        field = schema["union"]["field"]
+        variants = schema["union"]["variants"]
+        if not isinstance(value, dict):
+            return [f"{path}: expected an object"]
+        tag = value.get(field)
+        if tag not in variants:
+            return [f"{path}.{field}: expected one of "
+                    f"{sorted(variants)}, got {tag!r}"]
+        return validate(value, variants[tag], path, discriminator=field)
+
+    stype = schema["type"]
+    if not _type_ok(stype, value):
+        return [f"{path}: expected {stype}, got {type(value).__name__}"]
+
+    if "enum" in schema and value not in schema["enum"]:
+        errors.append(f"{path}: expected one of {schema['enum']}, "
+                      f"got {value!r}")
+
+    if stype == "object":
+        props = schema.get("properties", {})
+        for req in schema.get("required", []):
+            if req not in value:
+                errors.append(f"{path}.{req}: required field missing")
+        for key, sub in value.items():
+            if key in props:
+                errors.extend(validate(sub, props[key], f"{path}.{key}"))
+            elif discriminator and key == discriminator:
+                pass  # the union's tag field, already checked above
+            elif not schema.get("open", False):
+                errors.append(f"{path}.{key}: unknown field "
+                              f"(known: {sorted(props)})")
+    elif stype == "array" and "items" in schema:
+        for i, item in enumerate(value):
+            errors.extend(validate(item, schema["items"], f"{path}[{i}]"))
+
+    return errors
+
+
+def check(raw: Dict[str, Any]) -> None:
+    """Raise SchemaError listing every violation, or return silently."""
+    errors = validate(raw)
+    if errors:
+        raise SchemaError("invalid experiment config:\n  " +
+                          "\n  ".join(errors))

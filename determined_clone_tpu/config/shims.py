@@ -1,0 +1,83 @@
+"""Versioned experiment-config shims: v0 spellings → current (v1).
+
+≈ the reference's expconf versioning (schemas/expconf/v0 + legacy shims,
+master/pkg/schemas/expconf/legacy.go): old configs keep submitting
+unchanged — ``shim()`` rewrites legacy spellings into the current schema
+before validation, and records what it changed so the API can surface
+deprecation notices. A config opts into a version with ``config_version``
+(absent = 0, the permissive legacy format; shimmed configs come out as 1).
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Tuple
+
+CURRENT_VERSION = 1
+
+# v0 searcher names that became adaptive_asha (the reference retired
+# adaptive/adaptive_simple/sync_halving the same way)
+_LEGACY_ADAPTIVE = {"adaptive", "adaptive_simple", "sync_halving"}
+
+
+def _shim_length(value: Any, notes: List[str], where: str) -> Any:
+    # v0 allowed a bare integer meaning batches
+    if isinstance(value, int) and not isinstance(value, bool):
+        notes.append(f"{where}: bare integer lengths are v0; "
+                     f"use {{'batches': {value}}}")
+        return {"batches": value}
+    return value
+
+
+def shim(raw: Dict[str, Any]) -> Tuple[Dict[str, Any], List[str]]:
+    """Returns (current-version config, deprecation notes). Input is not
+    mutated. A config already at CURRENT_VERSION passes through untouched
+    (no silent rewriting of modern configs)."""
+    version = raw.get("config_version", 0)
+    if version >= CURRENT_VERSION:
+        return raw, []
+
+    cfg = copy.deepcopy(raw)
+    notes: List[str] = []
+
+    searcher = cfg.get("searcher")
+    if isinstance(searcher, dict):
+        name = searcher.get("name")
+        if name in _LEGACY_ADAPTIVE:
+            searcher["name"] = "adaptive_asha"
+            notes.append(f"searcher.name {name!r} is v0; shimmed to "
+                         "'adaptive_asha'")
+        if "max_steps" in searcher and "max_length" not in searcher:
+            searcher["max_length"] = {"batches": searcher.pop("max_steps")}
+            notes.append("searcher.max_steps is v0; shimmed to "
+                         "max_length.batches")
+        if "max_length" in searcher:
+            searcher["max_length"] = _shim_length(
+                searcher["max_length"], notes, "searcher.max_length")
+        if "smaller_is_better" not in searcher and "metric" in searcher:
+            pass  # defaulting, not a shim
+
+    for period in ("min_validation_period", "min_checkpoint_period"):
+        if period in cfg:
+            cfg[period] = _shim_length(cfg[period], notes, period)
+
+    # v0 `batches_per_step` became scheduling_unit
+    if "batches_per_step" in cfg and "scheduling_unit" not in cfg:
+        cfg["scheduling_unit"] = cfg.pop("batches_per_step")
+        notes.append("batches_per_step is v0; shimmed to scheduling_unit")
+
+    # v0 nested `optimizations` block: aggregation_frequency maps onto
+    # nothing (XLA owns fusion); keep submissions working, note the drop
+    if "optimizations" in cfg:
+        cfg.pop("optimizations")
+        notes.append("optimizations is v0 and has no TPU equivalent "
+                     "(XLA owns fusion/aggregation); ignored")
+
+    # v0 flat `slots` became resources.slots_per_trial
+    if "slots" in cfg:
+        resources = cfg.setdefault("resources", {})
+        resources.setdefault("slots_per_trial", cfg.pop("slots"))
+        notes.append("top-level slots is v0; shimmed to "
+                     "resources.slots_per_trial")
+
+    cfg["config_version"] = CURRENT_VERSION
+    return cfg, notes

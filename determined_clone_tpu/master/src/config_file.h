@@ -1,0 +1,66 @@
+// Shared YAML-subset config-file parser for the master and agent binaries
+// (≈ viper's yaml config loading, master root.go:69-117 / agent
+// options.go:47). One parser, two key-apply tables — the format cannot
+// drift between the binaries.
+//
+// Format: `key: value` lines; one nesting level as an indented section
+// under `section:` or as dotted keys (`kube.namespace: x`); '#' comments;
+// matching single/double quotes around values are stripped.
+#pragma once
+
+#include <fstream>
+#include <map>
+#include <stdexcept>
+#include <string>
+
+namespace dct {
+namespace configfile {
+
+inline std::string trim(std::string s) {
+  size_t a = s.find_first_not_of(" \t");
+  size_t b = s.find_last_not_of(" \t\r");
+  return a == std::string::npos ? std::string() : s.substr(a, b - a + 1);
+}
+
+inline bool parse_bool(const std::string& v) {
+  return v == "true" || v == "1" || v == "yes" || v == "on";
+}
+
+// Returns dotted-key -> value; throws std::runtime_error with file:line on
+// lines it cannot parse.
+inline std::map<std::string, std::string> parse(const std::string& path) {
+  std::ifstream in(path);
+  if (!in) throw std::runtime_error("cannot open config file " + path);
+  std::map<std::string, std::string> out;
+  std::string line, section;
+  int lineno = 0;
+  while (std::getline(in, line)) {
+    ++lineno;
+    auto hash = line.find('#');
+    if (hash != std::string::npos) line = line.substr(0, hash);
+    if (trim(line).empty()) continue;
+    bool indented = line[0] == ' ' || line[0] == '\t';
+    auto colon = line.find(':');
+    if (colon == std::string::npos) {
+      throw std::runtime_error(path + ":" + std::to_string(lineno) +
+                               ": expected 'key: value'");
+    }
+    std::string key = trim(line.substr(0, colon));
+    std::string value = trim(line.substr(colon + 1));
+    if (value.size() >= 2 &&
+        (value.front() == '"' || value.front() == '\'') &&
+        value.back() == value.front()) {
+      value = value.substr(1, value.size() - 2);
+    }
+    if (value.empty() && !indented) {
+      section = key;  // `kube:` opens a section
+      continue;
+    }
+    if (!indented) section.clear();
+    out[indented && !section.empty() ? section + "." + key : key] = value;
+  }
+  return out;
+}
+
+}  // namespace configfile
+}  // namespace dct

@@ -486,6 +486,7 @@ void KubernetesRM::tick(RmContext& ctx) {
         alloc.state = RunState::Queued;
         alloc.reservations.clear();
         alloc.rendezvous.clear();
+        if (ctx.clear_barriers) ctx.clear_barriers(alloc_id);
         if (alloc.trial_id && ctx.trials->count(alloc.trial_id)) {
           (*ctx.trials)[alloc.trial_id].state = RunState::Queued;
         }

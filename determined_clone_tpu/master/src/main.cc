@@ -4,9 +4,13 @@
 #include <csignal>
 #include <cstdlib>
 #include <cstring>
+#include <fstream>
 #include <iostream>
+#include <map>
+#include <sstream>
 #include <thread>
 
+#include "config_file.h"
 #include "master.h"
 
 namespace {
@@ -14,12 +18,76 @@ namespace {
 // actual (mutex/join-heavy) shutdown
 volatile std::sig_atomic_t g_stop = 0;
 void handle_signal(int) { g_stop = 1; }
+
+using dct::configfile::parse_bool;
+
+void apply_config_file(const std::string& path, dct::MasterConfig* config) {
+  for (const auto& [key, value] : dct::configfile::parse(path)) {
+    if (key == "port") config->port = std::atoi(value.c_str());
+    else if (key == "data_dir") config->data_dir = value;
+    else if (key == "scheduler") config->default_pool.type = value;
+    else if (key == "preemption") {
+      config->default_pool.preemption_enabled = parse_bool(value);
+    } else if (key == "agent_timeout") {
+      config->agent_timeout_sec = std::atof(value.c_str());
+    } else if (key == "unmanaged_timeout") {
+      config->unmanaged_timeout_sec = std::atof(value.c_str());
+    } else if (key == "auth_required") config->auth_required = parse_bool(value);
+    else if (key == "rbac") config->rbac_enabled = parse_bool(value);
+    else if (key == "session_ttl") {
+      config->session_ttl_sec = std::atof(value.c_str());
+    } else if (key == "webui_dir") config->webui_dir = value;
+    else if (key == "db") config->db = value;
+    else if (key == "rm") config->rm = value;
+    else if (key == "kube.namespace") config->kube.ns = value;
+    else if (key == "kube.image") config->kube.image = value;
+    else if (key == "kube.master_host") config->kube.master_host = value;
+    else if (key == "kube.slots_per_pod") {
+      config->kube.slots_per_pod = std::max(1, std::atoi(value.c_str()));
+    } else if (key == "kube.accelerator") config->kube.accelerator = value;
+    else if (key == "kube.live") config->kube.dry_run = !parse_bool(value);
+    else if (key == "provisioner.accelerator_type") {
+      config->provisioner.enabled = true;
+      config->provisioner.accelerator_type = value;
+    } else if (key == "provisioner.zone") config->provisioner.zone = value;
+    else if (key == "provisioner.project") config->provisioner.project = value;
+    else if (key == "provisioner.slots_per_instance") {
+      config->provisioner.slots_per_instance =
+          std::max(1, std::atoi(value.c_str()));
+    } else if (key == "provisioner.min_instances") {
+      config->provisioner.min_instances = std::atoi(value.c_str());
+    } else if (key == "provisioner.max_instances") {
+      config->provisioner.max_instances = std::atoi(value.c_str());
+    } else if (key == "provisioner.idle_timeout") {
+      config->provisioner.idle_timeout_sec = std::atof(value.c_str());
+    } else if (key == "provisioner.cooldown") {
+      config->provisioner.cooldown_sec = std::atof(value.c_str());
+    } else if (key == "provisioner.live") {
+      config->provisioner.dry_run = !parse_bool(value);
+    } else {
+      throw std::runtime_error("unknown config key '" + key + "' in " + path);
+    }
+  }
+}
 }  // namespace
 
 int main(int argc, char** argv) {
   dct::MasterConfig config;
+  // config file first, flags override (viper precedence: flags > file)
   for (int i = 1; i < argc; ++i) {
-    if (!std::strcmp(argv[i], "--port") && i + 1 < argc) {
+    if (!std::strcmp(argv[i], "--config") && i + 1 < argc) {
+      try {
+        apply_config_file(argv[i + 1], &config);
+      } catch (const std::exception& e) {
+        std::cerr << e.what() << "\n";
+        return 2;
+      }
+    }
+  }
+  for (int i = 1; i < argc; ++i) {
+    if (!std::strcmp(argv[i], "--config") && i + 1 < argc) {
+      ++i;  // handled above
+    } else if (!std::strcmp(argv[i], "--port") && i + 1 < argc) {
       config.port = std::atoi(argv[++i]);
     } else if (!std::strcmp(argv[i], "--data-dir") && i + 1 < argc) {
       config.data_dir = argv[++i];
@@ -84,7 +152,8 @@ int main(int argc, char** argv) {
     } else if (!std::strcmp(argv[i], "--kube-live")) {
       config.kube.dry_run = false;  // actually exec kubectl
     } else if (!std::strcmp(argv[i], "--help")) {
-      std::cout << "usage: dct-master [--port N] [--data-dir DIR] "
+      std::cout << "usage: dct-master [--config FILE] [--port N] "
+                   "[--data-dir DIR] "
                    "[--scheduler fifo|priority|fair_share|round_robin] "
                    "[--agent-timeout SEC] [--auth-required] [--rbac] "
                    "[--webui-dir DIR] "

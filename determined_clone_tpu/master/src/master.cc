@@ -678,6 +678,10 @@ void Master::on_task_done(const std::string& alloc_id, int exit_code,
   auto ait = allocations_.find(alloc_id);
   if (ait == allocations_.end()) return;
   Allocation& alloc = ait->second;
+  // any exit (clean, failed, canceled) invalidates the gang's barrier
+  // payloads — a restarted incarnation must never rendezvous against a
+  // dead incarnation's addresses
+  allgather_.erase(alloc_id);
   if (alloc.state == RunState::Completed || alloc.state == RunState::Errored) {
     return;  // idempotent: exits may arrive twice (task_event + heartbeat)
   }
@@ -801,6 +805,7 @@ void Master::tick_locked() {
           alloc.state = RunState::Queued;
           alloc.reservations.clear();
           alloc.rendezvous.clear();
+          allgather_.erase(id);  // stale barrier payloads die with the leg
           if (alloc.trial_id) {
             auto tit = trials_.find(alloc.trial_id);
             if (tit != trials_.end()) tit->second.state = RunState::Queued;
@@ -825,6 +830,9 @@ void Master::tick_locked() {
     Json cmd = allocation_start_command(alloc, "");
     cmd.set("rank", rank);
     return cmd;
+  };
+  ctx.clear_barriers = [this](const std::string& id) {
+    allgather_.erase(id);
   };
   ctx.agent_tick = [this](double t) { agent_rm_tick_locked(t); };
   rm_->tick(ctx);

@@ -8,6 +8,7 @@
 #pragma once
 
 #include <atomic>
+#include <deque>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -107,6 +108,10 @@ class Master {
   HttpResponse proxy_route(const HttpRequest& req);
   // GET /metrics — Prometheus text exposition of cluster state gauges
   HttpResponse metrics_route();
+  // GET /debug/requests | /debug/stats — request tracing (≈ the
+  // reference's otel spans + prom middleware, core.go:1014,1189)
+  HttpResponse debug_route(const HttpRequest& req);
+  void record_span(const HttpRequest& req, int status, double dur_ms);
   // GET / and /ui/* — WebUI static assets (webui/, served by the master the
   // way the reference master serves the built React bundle)
   HttpResponse static_route(const HttpRequest& req);
@@ -188,6 +193,28 @@ class Master {
   std::map<int64_t, Webhook> webhooks_;
   std::map<int64_t, Group> groups_;
   std::map<int64_t, RoleAssignment> role_assignments_;
+  // -- request tracing (own mutex: never contends the state lock) --
+  struct RouteStats {
+    int64_t count = 0;
+    int64_t errors = 0;  // status >= 500
+    double total_ms = 0;
+    double max_ms = 0;
+    std::vector<double> samples;  // ring, capped (p95 source)
+    size_t next_sample = 0;
+  };
+  struct Span {
+    double at = 0;
+    double dur_ms = 0;
+    int status = 0;
+    std::string method, path, route;
+  };
+  std::mutex trace_mu_;
+  std::deque<Span> recent_spans_;              // newest last, capped
+  std::map<std::string, RouteStats> route_stats_;
+  // master-mediated allgather barriers (≈ master/internal/task/allgather):
+  // alloc id -> round -> rank -> payload. Transient (not persisted).
+  std::map<std::string, std::map<int64_t, std::map<int, Json>>> allgather_;
+
   // compiled log-pattern policies per experiment (lazy; not persisted)
   struct CompiledLogPolicy {
     std::regex re;

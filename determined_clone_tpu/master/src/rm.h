@@ -29,6 +29,10 @@ struct RmContext {
   // full start command for one allocation member (allocation_start_command
   // + rank) — the same payload an agent heartbeat would deliver
   std::function<Json(const Allocation&, int rank)> start_command;
+  // invalidate an allocation's master-mediated barrier state (allgather
+  // rounds) when the RM requeues a leg — a restarted incarnation must not
+  // see a dead incarnation's payloads
+  std::function<void(const std::string& alloc_id)> clear_barriers;
   // the whole agent-scheduling tick (schedule_pool + provisioner); only
   // AgentRM calls it
   std::function<void(double now)> agent_tick;

@@ -8,6 +8,7 @@
 
 #include <algorithm>
 #include <cctype>
+#include <chrono>
 #include <fstream>
 #include <regex>
 #include <set>
@@ -66,23 +67,128 @@ std::string url_encode(const std::string& s) {
 }  // namespace
 
 HttpResponse Master::handle(const HttpRequest& req) {
+  // every request is traced (≈ otel middleware around the echo server,
+  // core.go:1014): duration + status recorded under trace_mu_, never the
+  // state lock
+  auto t0 = std::chrono::steady_clock::now();
+  HttpResponse resp;
   try {
     if (req.path_parts.size() >= 2 && req.path_parts[0] == "proxy") {
-      return proxy_route(req);
+      resp = proxy_route(req);
+    } else if (req.path_parts.size() == 1 && req.path_parts[0] == "metrics" &&
+               req.method == "GET") {
+      resp = metrics_route();
+    } else if (!req.path_parts.empty() && req.path_parts[0] == "debug" &&
+               req.method == "GET") {
+      // operator surface: spans carry request paths (experiment/trial
+      // ids), so it sits behind the session gate like the API roots
+      bool authed = true;
+      if (config_.auth_required) {
+        std::lock_guard<std::mutex> lock(mu_);
+        authed = current_user(req) != nullptr;
+      }
+      resp = authed ? debug_route(req)
+                    : HttpResponse::json(
+                          401, error_json("authentication required").dump());
+    } else if (req.method == "GET" && !config_.webui_dir.empty() &&
+               (req.path == "/" ||
+                (!req.path_parts.empty() && req.path_parts[0] == "ui"))) {
+      resp = static_route(req);
+    } else {
+      resp = route(req);
     }
-    if (req.path_parts.size() == 1 && req.path_parts[0] == "metrics" &&
-        req.method == "GET") {
-      return metrics_route();
-    }
-    if (req.method == "GET" && !config_.webui_dir.empty() &&
-        (req.path == "/" ||
-         (!req.path_parts.empty() && req.path_parts[0] == "ui"))) {
-      return static_route(req);
-    }
-    return route(req);
   } catch (const std::exception& e) {
-    return HttpResponse::json(500, error_json(e.what()).dump());
+    resp = HttpResponse::json(500, error_json(e.what()).dump());
   }
+  double dur_ms = std::chrono::duration<double, std::milli>(
+                      std::chrono::steady_clock::now() - t0).count();
+  record_span(req, resp.status, dur_ms);
+  return resp;
+}
+
+namespace {
+
+// normalize a path into a route key: id-ish segments become ':id' so
+// /api/v1/experiments/17 and /23 aggregate together
+std::string route_key(const HttpRequest& req) {
+  std::string out = req.method;
+  for (const auto& part : req.path_parts) {
+    bool id_like = !part.empty() &&
+                   part.find_first_not_of("0123456789") == std::string::npos;
+    // allocation/task ids: "trial-3.0", "task-command-7", "unmanaged-9.1"
+    id_like = id_like || part.find('.') != std::string::npos ||
+              (part.find('-') != std::string::npos &&
+               part.find_first_of("0123456789") != std::string::npos);
+    out += "/" + (id_like ? std::string(":id") : part);
+  }
+  return out;
+}
+
+}  // namespace
+
+void Master::record_span(const HttpRequest& req, int status, double dur_ms) {
+  constexpr size_t kRecentCap = 256, kSampleCap = 512;
+  std::lock_guard<std::mutex> lock(trace_mu_);
+  Span span;
+  span.at = now_sec();
+  span.dur_ms = dur_ms;
+  span.status = status;
+  span.method = req.method;
+  span.path = req.path;
+  span.route = route_key(req);
+  recent_spans_.push_back(std::move(span));
+  if (recent_spans_.size() > kRecentCap) recent_spans_.pop_front();
+  RouteStats& stats = route_stats_[recent_spans_.back().route];
+  stats.count++;
+  if (status >= 500) stats.errors++;
+  stats.total_ms += dur_ms;
+  stats.max_ms = std::max(stats.max_ms, dur_ms);
+  if (stats.samples.size() < kSampleCap) {
+    stats.samples.push_back(dur_ms);
+  } else {
+    stats.samples[stats.next_sample] = dur_ms;
+    stats.next_sample = (stats.next_sample + 1) % kSampleCap;
+  }
+}
+
+HttpResponse Master::debug_route(const HttpRequest& req) {
+  const std::string& what = req.path_parts.size() > 1 ? req.path_parts[1] : "";
+  std::lock_guard<std::mutex> lock(trace_mu_);
+  if (what == "requests") {
+    Json arr = Json::array();
+    for (const auto& s : recent_spans_) {
+      Json j = Json::object();
+      j.set("at", s.at).set("duration_ms", s.dur_ms)
+          .set("status", static_cast<int64_t>(s.status))
+          .set("method", s.method).set("path", s.path)
+          .set("route", s.route);
+      arr.push_back(j);
+    }
+    Json out = Json::object();
+    out.set("requests", arr);
+    return ok_json(out);
+  }
+  if (what == "stats") {
+    Json arr = Json::array();
+    for (const auto& [route, stats] : route_stats_) {
+      std::vector<double> sorted = stats.samples;
+      std::sort(sorted.begin(), sorted.end());
+      double p95 = sorted.empty()
+                       ? 0
+                       : sorted[static_cast<size_t>(
+                             (sorted.size() - 1) * 0.95)];
+      Json j = Json::object();
+      j.set("route", route).set("count", stats.count)
+          .set("errors", stats.errors)
+          .set("mean_ms", stats.count ? stats.total_ms / stats.count : 0)
+          .set("p95_ms", p95).set("max_ms", stats.max_ms);
+      arr.push_back(j);
+    }
+    Json out = Json::object();
+    out.set("routes", arr);
+    return ok_json(out);
+  }
+  return not_found("unknown debug route (requests|stats)");
 }
 
 // Prometheus text exposition (≈ the reference's /prom/det-state-metrics
@@ -1055,6 +1161,41 @@ HttpResponse Master::route(const HttpRequest& req) {
     if (parts[4] == "preempt" && req.method == "GET") {
       Json j = Json::object();
       j.set("preempt", alloc.preempt_requested);
+      return ok_json(j);
+    }
+    // general allgather barrier (≈ master/internal/task/allgather): every
+    // member posts {rank, round, data}; once world_size members of a round
+    // have posted, all receive the rank-ordered payload list. Used by the
+    // harness before its own control network exists (e.g. to share ports).
+    if (parts[4] == "allgather" && req.method == "POST") {
+      Json body = Json::parse(req.body);
+      int rank = static_cast<int>(body["rank"].as_int());
+      int64_t round = body["round"].as_int(0);
+      int world = std::max(1, alloc.world_size);
+      if (rank < 0 || rank >= world) {
+        return bad_request("rank " + std::to_string(rank) +
+                           " out of range for world size " +
+                           std::to_string(world));
+      }
+      auto& rounds = allgather_[alloc_id];
+      rounds[round][rank] = body["data"];
+      // older rounds are complete and fetched once a later round starts
+      for (auto it2 = rounds.begin(); it2 != rounds.end();) {
+        if (it2->first < round - 1) {
+          it2 = rounds.erase(it2);
+        } else {
+          ++it2;
+        }
+      }
+      const auto& members = rounds[round];
+      bool ready = static_cast<int>(members.size()) >= world;
+      Json data = Json::array();
+      if (ready) {
+        for (const auto& [r, payload] : members) data.push_back(payload);
+      }
+      Json j = Json::object();
+      j.set("ready", ready).set("round", round)
+          .set("world_size", static_cast<int64_t>(world)).set("data", data);
       return ok_json(j);
     }
     // proxy address registration (≈ prep_container.py:231 proxy regs)

@@ -1,0 +1,151 @@
+"""Schema-as-data expconf validation + v0->v1 shims.
+
+≈ the reference's schema test cases (schemas/test_cases/*.yaml run by
+schema_test.go) and legacy-shim tests (expconf/legacy.go behavior).
+"""
+import pytest
+
+from determined_clone_tpu.config import schema, shims
+from determined_clone_tpu.config.experiment import (
+    ConfigError,
+    ExperimentConfig,
+)
+
+
+GOOD = {
+    "name": "exp",
+    "entrypoint": "m:T",
+    "searcher": {"name": "single", "metric": "loss",
+                 "max_length": {"batches": 10}},
+    "resources": {"slots_per_trial": 8, "topology": "v5e-8"},
+    "checkpoint_storage": {"type": "gcs", "bucket": "b"},
+}
+
+
+class TestSchema:
+    def test_valid_config_passes(self):
+        assert schema.validate(GOOD) == []
+
+    def test_unknown_top_level_key_reported_with_path(self):
+        errors = schema.validate({**GOOD, "slotz": 3})
+        assert len(errors) == 1
+        assert "<config>.slotz" in errors[0] and "unknown field" in errors[0]
+
+    def test_wrong_type_reported(self):
+        errors = schema.validate({**GOOD, "max_restarts": "five"})
+        assert any("max_restarts: expected integer" in e for e in errors)
+
+    def test_bool_is_not_an_integer(self):
+        errors = schema.validate({**GOOD, "max_restarts": True})
+        assert errors
+
+    def test_union_discriminator(self):
+        errors = schema.validate(
+            {**GOOD, "searcher": {"name": "mystery", "metric": "loss"}})
+        assert any("searcher.name" in e for e in errors)
+
+    def test_union_variant_requirements(self):
+        errors = schema.validate(
+            {**GOOD, "checkpoint_storage": {"type": "shared_fs"}})
+        assert any("host_path: required" in e for e in errors)
+
+    def test_nested_array_paths(self):
+        errors = schema.validate(
+            {**GOOD,
+             "log_policies": [{"pattern": "x", "action": "explode"}]})
+        assert any("log_policies[0].action" in e for e in errors)
+
+    def test_enum(self):
+        errors = schema.validate({**GOOD, "checkpoint_policy": "some"})
+        assert any("checkpoint_policy" in e for e in errors)
+
+    def test_discriminator_not_exempt_outside_unions(self):
+        # "type"/"name" are only free passes at a union root, not in every
+        # closed object
+        errors = schema.validate({**GOOD, "resources": {"type": "x"}})
+        assert any("resources.type" in e and "unknown" in e for e in errors)
+        errors = schema.validate({**GOOD, "type": "bogus"})
+        assert any("<config>.type" in e for e in errors)
+
+    def test_log_policy_action_accepts_both_forms(self):
+        base = {**GOOD, "log_policies": [
+            {"pattern": "x", "action": "cancel_retries"}]}
+        assert schema.validate(base) == []
+        obj = {**GOOD, "log_policies": [
+            {"pattern": "x", "action": {"type": "exclude_node"}}]}
+        assert schema.validate(obj) == []
+        bad = {**GOOD, "log_policies": [
+            {"pattern": "x", "action": {"type": "explode"}}]}
+        assert schema.validate(bad)
+
+    def test_all_errors_reported_at_once(self):
+        errors = schema.validate({
+            **GOOD, "max_restarts": "x", "checkpoint_policy": "y",
+            "bogus": 1})
+        assert len(errors) == 3
+
+
+class TestShims:
+    def test_legacy_adaptive_searcher(self):
+        cfg, notes = shims.shim({
+            "searcher": {"name": "adaptive_simple", "metric": "loss",
+                         "max_steps": 500}})
+        assert cfg["searcher"]["name"] == "adaptive_asha"
+        assert cfg["searcher"]["max_length"] == {"batches": 500}
+        assert cfg["config_version"] == shims.CURRENT_VERSION
+        assert len(notes) == 2
+
+    def test_bare_int_lengths(self):
+        cfg, notes = shims.shim({
+            "searcher": {"name": "single", "metric": "loss",
+                         "max_length": 100},
+            "min_validation_period": 50})
+        assert cfg["searcher"]["max_length"] == {"batches": 100}
+        assert cfg["min_validation_period"] == {"batches": 50}
+        assert len(notes) == 2
+
+    def test_flat_slots_and_batches_per_step(self):
+        cfg, notes = shims.shim({"slots": 8, "batches_per_step": 200,
+                                 "optimizations": {"aggregation": 2}})
+        assert cfg["resources"]["slots_per_trial"] == 8
+        assert cfg["scheduling_unit"] == 200
+        assert "optimizations" not in cfg
+        assert len(notes) == 3
+
+    def test_current_version_untouched(self):
+        raw = {"config_version": 1,
+               "searcher": {"name": "single", "metric": "loss",
+                            "max_length": 100}}
+        cfg, notes = shims.shim(raw)
+        assert cfg is raw and notes == []  # modern configs never rewritten
+
+    def test_input_not_mutated(self):
+        raw = {"slots": 4}
+        shims.shim(raw)
+        assert raw == {"slots": 4}
+
+
+class TestPipeline:
+    def test_from_dict_runs_shims_then_schema(self):
+        cfg = ExperimentConfig.from_dict({
+            "entrypoint": "m:T",
+            "searcher": {"name": "adaptive", "metric": "loss",
+                         "max_steps": 64},
+            "slots": 2,
+        })
+        assert cfg.searcher.name == "adaptive_asha"
+        assert cfg.searcher.max_length.value == 64
+        assert cfg.resources.slots_per_trial == 2
+        assert cfg.deprecations  # surfaced, not silent
+
+    def test_from_dict_rejects_unknown_keys_with_paths(self):
+        with pytest.raises(ConfigError) as err:
+            ExperimentConfig.from_dict({**GOOD, "scheduler_unit": 3})
+        assert "scheduler_unit" in str(err.value)
+
+    def test_modern_config_requires_modern_spellings(self):
+        # a config_version 1 config skips the shims: v0 spellings now fail
+        with pytest.raises(ConfigError):
+            ExperimentConfig.from_dict({
+                "config_version": 1, "entrypoint": "m:T",
+                "searcher": {"name": "adaptive", "metric": "loss"}})
