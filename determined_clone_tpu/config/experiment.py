@@ -268,7 +268,10 @@ class ExperimentConfig:
         from determined_clone_tpu.config import schema as schema_mod
         from determined_clone_tpu.config import shims
 
-        raw, deprecations = shims.shim(raw)
+        try:
+            raw, deprecations = shims.shim(raw)
+        except ValueError as e:
+            raise ConfigError(str(e)) from None
         errors = schema_mod.validate(raw)
         if errors:
             raise ConfigError("invalid experiment config:\n  " +

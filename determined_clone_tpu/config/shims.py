@@ -74,8 +74,17 @@ def shim(raw: Dict[str, Any]) -> Tuple[Dict[str, Any], List[str]]:
 
     # v0 flat `slots` became resources.slots_per_trial
     if "slots" in cfg:
+        slots = cfg.pop("slots")
         resources = cfg.setdefault("resources", {})
-        resources.setdefault("slots_per_trial", cfg.pop("slots"))
+        existing = resources.get("slots_per_trial")
+        if existing is not None and existing != slots:
+            # silently preferring either value would lie to the user about
+            # their gang size — make the conflict explicit
+            raise ValueError(
+                f"config sets both legacy top-level slots ({slots}) and "
+                f"resources.slots_per_trial ({existing}); remove the "
+                "legacy key")
+        resources.setdefault("slots_per_trial", slots)
         notes.append("top-level slots is v0; shimmed to "
                      "resources.slots_per_trial")
 

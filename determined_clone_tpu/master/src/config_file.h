@@ -36,8 +36,21 @@ inline std::map<std::string, std::string> parse(const std::string& path) {
   int lineno = 0;
   while (std::getline(in, line)) {
     ++lineno;
-    auto hash = line.find('#');
-    if (hash != std::string::npos) line = line.substr(0, hash);
+    // strip comments OUTSIDE quotes only: `dir: "/data/#shared"` keeps its #
+    {
+      char quote = 0;
+      for (size_t i = 0; i < line.size(); ++i) {
+        char c = line[i];
+        if (quote) {
+          if (c == quote) quote = 0;
+        } else if (c == '"' || c == '\'') {
+          quote = c;
+        } else if (c == '#') {
+          line = line.substr(0, i);
+          break;
+        }
+      }
+    }
     if (trim(line).empty()) continue;
     bool indented = line[0] == ' ' || line[0] == '\t';
     auto colon = line.find(':');
@@ -47,13 +60,12 @@ inline std::map<std::string, std::string> parse(const std::string& path) {
     }
     std::string key = trim(line.substr(0, colon));
     std::string value = trim(line.substr(colon + 1));
-    if (value.size() >= 2 &&
-        (value.front() == '"' || value.front() == '\'') &&
-        value.back() == value.front()) {
-      value = value.substr(1, value.size() - 2);
-    }
-    if (value.empty() && !indented) {
-      section = key;  // `kube:` opens a section
+    bool quoted = value.size() >= 2 &&
+                  (value.front() == '"' || value.front() == '\'') &&
+                  value.back() == value.front();
+    if (quoted) value = value.substr(1, value.size() - 2);
+    if (value.empty() && !quoted && !indented) {
+      section = key;  // `kube:` opens a section (but `key: ""` is a value)
       continue;
     }
     if (!indented) section.clear();

@@ -127,7 +127,7 @@ std::string route_key(const HttpRequest& req) {
 }  // namespace
 
 void Master::record_span(const HttpRequest& req, int status, double dur_ms) {
-  constexpr size_t kRecentCap = 256, kSampleCap = 512;
+  constexpr size_t kRecentCap = 256, kSampleCap = 512, kRouteCap = 256;
   std::lock_guard<std::mutex> lock(trace_mu_);
   Span span;
   span.at = now_sec();
@@ -136,6 +136,11 @@ void Master::record_span(const HttpRequest& req, int status, double dur_ms) {
   span.method = req.method;
   span.path = req.path;
   span.route = route_key(req);
+  // bound the per-route table: unauthenticated scanners probing arbitrary
+  // paths must not grow master memory one RouteStats per unique path
+  if (!route_stats_.count(span.route) && route_stats_.size() >= kRouteCap) {
+    span.route = "OTHER";
+  }
   recent_spans_.push_back(std::move(span));
   if (recent_spans_.size() > kRecentCap) recent_spans_.pop_front();
   RouteStats& stats = route_stats_[recent_spans_.back().route];
@@ -1168,6 +1173,16 @@ HttpResponse Master::route(const HttpRequest& req) {
     // have posted, all receive the rank-ordered payload list. Used by the
     // harness before its own control network exists (e.g. to share ports).
     if (parts[4] == "allgather" && req.method == "POST") {
+      // only a live gang may post: a lingering member of a requeued leg
+      // must not repopulate the barrier clear_barriers just wiped (its
+      // payload would be a dead incarnation's address)
+      if (alloc.state != RunState::Pulling &&
+          alloc.state != RunState::Running) {
+        return HttpResponse::json(
+            409, error_json("allocation is not live (state " +
+                            std::string(to_string(alloc.state)) + ")")
+                     .dump());
+      }
       Json body = Json::parse(req.body);
       int rank = static_cast<int>(body["rank"].as_int());
       int64_t round = body["round"].as_int(0);

@@ -141,6 +141,41 @@ def test_typed_calls_against_live_master(master):
     assert killed.experiment.state == "CANCELED"
 
 
+def test_rbac_and_jobqueue_bindings(master):
+    """The round's new surfaces ride the generated client too."""
+    roles = b.list_roles(master, b.V1ListRolesRequest())
+    assert [r.name for r in roles.roles] == [
+        "Viewer", "Editor", "WorkspaceAdmin", "ClusterAdmin"]
+
+    g = b.create_group(master, b.V1CreateGroupRequest(name="binding-group"))
+    assert g.group.id > 0
+    a = b.assign_role(master, b.V1AssignRoleRequest(
+        role="Editor", group_id=g.group.id))
+    assert a.assignment.role == "Editor"
+    listed = b.list_role_assignments(master,
+                                     b.V1ListRoleAssignmentsRequest())
+    assert any(x.id == a.assignment.id for x in listed.assignments)
+
+    t1 = b.create_task(master, b.V1CreateTaskRequest(
+        type="command", cmd=["echo", "1"], slots=1)).task
+    t2 = b.create_task(master, b.V1CreateTaskRequest(
+        type="command", cmd=["echo", "2"], slots=1)).task
+    moved = b.move_job(master, b.V1MoveJobRequest(id=t2.id, ahead_of=t1.id))
+    assert moved.job.queued_at < t1.queued_at
+    prio = b.set_job_priority(master,
+                              b.V1SetJobPriorityRequest(id=t1.id, priority=3))
+    assert prio.job.priority == 3
+    # allgather only accepts live (scheduled) gangs
+    from determined_clone_tpu.api.client import MasterError
+
+    with pytest.raises(MasterError) as err:
+        b.all_gather(master, b.V1AllGatherRequest(
+            id=t1.id, rank=0, round=0, data={"port": 99}))
+    assert err.value.status == 409
+    for t in (t1, t2):
+        b.kill_task(master, b.V1KillTaskRequest(id=t.id))
+
+
 def test_stream_task_logs_pages(master):
     task = b.create_task(master, b.V1CreateTaskRequest(
         type="shell", name="logstream")).task

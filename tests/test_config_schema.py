@@ -124,6 +124,15 @@ class TestShims:
         shims.shim(raw)
         assert raw == {"slots": 4}
 
+    def test_conflicting_slots_is_an_error(self):
+        with pytest.raises(ValueError, match="both"):
+            shims.shim({"slots": 8,
+                        "resources": {"slots_per_trial": 4}})
+        # agreeing values are fine
+        cfg, _ = shims.shim({"slots": 4,
+                             "resources": {"slots_per_trial": 4}})
+        assert cfg["resources"]["slots_per_trial"] == 4
+
 
 class TestPipeline:
     def test_from_dict_runs_shims_then_schema(self):

@@ -54,20 +54,18 @@ def test_request_tracing(master):
     assert stats["GET/api/v1/experiments/:id"]["errors"] == 0
 
 
-def test_allgather_barrier(master):
-    session = master["session"]
-    # a 3-member gang: create a fake allocation via the task surface
-    task = session.create_task("command", cmd=["sleep", "1"], slots=0)
-    alloc_id = task["id"]
-    # no agent: world_size is still 0/1 -> patch it via the master's view:
-    # rank validation uses world_size, so use a single-member barrier first
-    out = session.allgather(alloc_id, 0, {"port": 1234}, timeout=5)
-    assert out == [{"port": 1234}]
+def test_allgather_requires_live_allocation(master):
+    """A queued (not yet scheduled) gang cannot populate the barrier — a
+    lingering member of a requeued leg must not resurrect stale state."""
+    from determined_clone_tpu.api.client import MasterError
 
-    # multi-member: simulate 3 ranks of one allocation in threads, with
-    # world_size taken from the allocation (kept 1 here) — exercise rounds
-    out2 = session.allgather(alloc_id, 0, "second", round=1, timeout=5)
-    assert out2 == ["second"]
+    session = master["session"]
+    task = session.create_task("command", cmd=["sleep", "1"], slots=1)
+    with pytest.raises(MasterError) as err:
+        session.post(f"/api/v1/allocations/{task['id']}/allgather",
+                     {"rank": 0, "round": 0, "data": {}})
+    assert err.value.status == 409
+    session.kill_task(task["id"])
 
 
 def test_allgather_multi_rank(tmp_path):

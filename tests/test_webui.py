@@ -113,3 +113,21 @@ def test_view_api_shapes(master):
     assert "queue" in json.loads(body)
     _, _, body = fetch(master, "/api/v1/tasks")
     assert "tasks" in json.loads(body)
+    # admin view fetches
+    _, _, body = fetch(master, "/api/v1/users")
+    assert "users" in json.loads(body)
+    _, _, body = fetch(master, "/api/v1/groups")
+    assert "groups" in json.loads(body)
+    _, _, body = fetch(master, "/api/v1/rbac/roles")
+    assert "roles" in json.loads(body)
+    _, _, body = fetch(master, "/api/v1/rbac/assignments")
+    assert "assignments" in json.loads(body)
+
+
+def test_admin_nav_and_view_shipped(master):
+    _, _, body = fetch(master, "/ui/index.html")
+    assert 'data-nav="admin"' in body.decode()
+    _, _, body = fetch(master, "/ui/app.js")
+    js = body.decode()
+    assert "viewAdmin" in js and "rbac/assignments" in js
+    assert "job-queue/" in js  # queue operator actions wired

@@ -38,6 +38,31 @@ function showLogin() {
   document.getElementById("login").classList.remove("hidden");
 }
 
+// action-handler failures (403 under rbac, 400 validation) surface as a
+// dismissable banner instead of a silent unhandled rejection
+function flashError(err) {
+  const old = document.getElementById("flash-error");
+  if (old) old.remove();
+  const div = document.createElement("div");
+  div.id = "flash-error";
+  div.className = "error banner";
+  div.textContent = String(err.message || err);
+  div.addEventListener("click", () => div.remove());
+  $view.prepend(div);
+}
+
+// wrap an async UI action: on failure flash, on success re-render
+function action(fn, rerender) {
+  return async (...args) => {
+    try {
+      await fn(...args);
+      rerender();
+    } catch (err) {
+      if (String(err.message) !== "authentication required") flashError(err);
+    }
+  };
+}
+
 document.getElementById("login-form").addEventListener("submit", async (e) => {
   e.preventDefault();
   const form = new FormData(e.target);
@@ -379,13 +404,117 @@ async function viewCluster() {
       </table>` : `<p class="muted">no agents registered</p>`}
     <h2>Job queue</h2>
     ${queue.queue.length ? `<table><tr><th>ID</th><th>Type</th><th>State</th>
-      <th>Slots</th><th>Priority</th><th>Pool</th></tr>
+      <th>Slots</th><th>Priority</th><th>Pool</th><th>Actions</th></tr>
       ${queue.queue.map((j) => `<tr><td>${esc(j.id)}</td>
         <td>${esc(j.task_type)}</td><td>${stateBadge(j.state)}</td>
-        <td>${j.slots}</td><td>${j.priority}</td>
-        <td>${esc(j.resource_pool)}</td></tr>`).join("")}
+        <td>${j.slots}</td>
+        <td><input class="prio" data-id="${esc(j.id)}" type="number"
+             value="${j.priority}" style="width:4em"></td>
+        <td>${esc(j.resource_pool)}</td>
+        <td>${j.state === "QUEUED"
+              ? `<button class="movefront" data-id="${esc(j.id)}">
+                 to front</button>` : ""}</td></tr>`).join("")}
       </table>` : `<p class="muted">queue is empty</p>`}`;
+  // operator actions (≈ the reference job-queue page's move/priority)
+  const queued = queue.queue.filter((j) => j.state === "QUEUED");
+  $view.querySelectorAll("button.movefront").forEach((btn) => {
+    btn.addEventListener("click", action(async () => {
+      const first = queued
+          .slice().sort((a, b) => a.queued_at - b.queued_at)[0];
+      if (first && first.id !== btn.dataset.id) {
+        await api("POST", `/api/v1/job-queue/${btn.dataset.id}/move`,
+                  { ahead_of: first.id });
+      }
+    }, viewCluster));
+  });
+  $view.querySelectorAll("input.prio").forEach((inp) => {
+    inp.addEventListener("change", action(async () => {
+      await api("POST", `/api/v1/job-queue/${inp.dataset.id}/priority`,
+                { priority: Number(inp.value) });
+    }, viewCluster));
+  });
   scheduleRefresh(viewCluster, true);
+}
+
+async function viewAdmin() {
+  const gen = renderGen;
+  const [users, groups, roles, assignments] = await Promise.all([
+    api("GET", "/api/v1/users"),
+    api("GET", "/api/v1/groups"),
+    api("GET", "/api/v1/rbac/roles"),
+    api("GET", "/api/v1/rbac/assignments"),
+  ]);
+  if (gen !== renderGen) return;
+  const userName = (id) =>
+      (users.users.find((u) => u.id === id) || { username: id }).username;
+  const groupName = (id) =>
+      (groups.groups.find((g) => g.id === id) || { name: id }).name;
+  $view.innerHTML = `<h1>Admin</h1>
+    <h2>Users</h2>
+    <table><tr><th>ID</th><th>Username</th><th>Admin</th><th>Active</th></tr>
+      ${users.users.map((u) => `<tr><td>${u.id}</td>
+        <td>${esc(u.username)}</td><td>${u.admin ? "yes" : ""}</td>
+        <td>${u.active ? "yes" : "no"}</td></tr>`).join("")}
+    </table>
+    <h2>Groups</h2>
+    ${groups.groups.length ? `<table><tr><th>ID</th><th>Name</th>
+      <th>Members</th></tr>
+      ${groups.groups.map((g) => `<tr><td>${g.id}</td><td>${esc(g.name)}</td>
+        <td>${g.user_ids.map(userName).map(esc).join(", ")}</td></tr>`)
+        .join("")}
+      </table>` : `<p class="muted">no groups</p>`}
+    <form id="group-form" class="inline-form">
+      <input name="name" placeholder="new group name" required>
+      <button type="submit">Create group</button>
+    </form>
+    <h2>Role assignments</h2>
+    ${assignments.assignments.length ? `<table><tr><th>Role</th>
+      <th>Principal</th><th>Scope</th><th></th></tr>
+      ${assignments.assignments.map((a) => `<tr>
+        <td>${esc(a.role)}</td>
+        <td>${a.user_id ? "user " + esc(userName(a.user_id))
+                        : "group " + esc(groupName(a.group_id))}</td>
+        <td>${a.workspace_id ? "workspace " + a.workspace_id : "global"}</td>
+        <td><button class="revoke" data-id="${a.id}">revoke</button></td>
+        </tr>`).join("")}
+      </table>` : `<p class="muted">no role assignments</p>`}
+    <form id="assign-form" class="inline-form">
+      <select name="role">${roles.roles.map((r) =>
+          `<option>${esc(r.name)}</option>`).join("")}</select>
+      <select name="principal">
+        ${users.users.map((u) =>
+            `<option value="u${u.id}">user ${esc(u.username)}</option>`)
+          .join("")}
+        ${groups.groups.map((g) =>
+            `<option value="g${g.id}">group ${esc(g.name)}</option>`)
+          .join("")}
+      </select>
+      <input name="workspace_id" type="number" placeholder="workspace id"
+             style="width:8em">
+      <button type="submit">Assign</button>
+    </form>`;
+  document.getElementById("group-form").addEventListener("submit",
+      action(async (e) => {
+        e.preventDefault();
+        await api("POST", "/api/v1/groups",
+                  { name: e.target.name.value });
+      }, viewAdmin));
+  document.getElementById("assign-form").addEventListener("submit",
+      action(async (e) => {
+        e.preventDefault();
+        const p = e.target.principal.value;
+        await api("POST", "/api/v1/rbac/assignments", {
+          role: e.target.role.value,
+          user_id: p[0] === "u" ? Number(p.slice(1)) : 0,
+          group_id: p[0] === "g" ? Number(p.slice(1)) : 0,
+          workspace_id: Number(e.target.workspace_id.value || 0),
+        });
+      }, viewAdmin));
+  $view.querySelectorAll("button.revoke").forEach((btn) => {
+    btn.addEventListener("click", action(async () => {
+      await api("DELETE", `/api/v1/rbac/assignments/${btn.dataset.id}`);
+    }, viewAdmin));
+  });
 }
 
 // ---------------------------------------------------------------------------
@@ -402,6 +531,14 @@ function scheduleRefresh(fn, active) {
   if (refreshTimer) clearTimeout(refreshTimer);
   if (!active) return;
   refreshTimer = setTimeout(() => {
+    // an operator mid-edit (priority input focused) must not have the
+    // re-render clobber their typing — wait for the next interval
+    const el = document.activeElement;
+    if (el && $view.contains(el) &&
+        (el.tagName === "INPUT" || el.tagName === "SELECT")) {
+      scheduleRefresh(fn, true);
+      return;
+    }
     // a transient fetch failure must not kill the refresh loop — retry on
     // the next interval
     Promise.resolve(fn()).catch(() => scheduleRefresh(fn, true));
@@ -427,6 +564,8 @@ async function route() {
       await viewTasks();
     } else if (parts[0] === "cluster") {
       await viewCluster();
+    } else if (parts[0] === "admin") {
+      await viewAdmin();
     } else {
       await viewDashboard();
     }
