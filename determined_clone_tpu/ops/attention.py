@@ -53,7 +53,13 @@ def _online_softmax_block(carry, qkv_block, *, scale):
     """
     acc, m, l = carry
     q, k_blk, v_blk, block_mask = qkv_block
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
+    # f32 accumulation ON the dot (the MXU's native bf16-in/f32-out mode),
+    # not a bf16 dot cast afterwards: under jit, XLA fuses the cast into
+    # the scan backward in a way that overflows bf16 intermediates
+    # (non-finite dq/dk on real TPU); preferred_element_type sidesteps the
+    # bf16 intermediate entirely and is faster
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
+                   preferred_element_type=jnp.float32) * scale
     s = jnp.where(block_mask, s, NEG_INF)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1))
     # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1
