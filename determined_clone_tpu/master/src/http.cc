@@ -1,6 +1,7 @@
 #include "http.h"
 
 #include <arpa/inet.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
@@ -79,7 +80,10 @@ const char* status_text(int code) {
     case 200: return "OK";
     case 201: return "Created";
     case 204: return "No Content";
+    case 302: return "Found";
     case 400: return "Bad Request";
+    case 401: return "Unauthorized";
+    case 403: return "Forbidden";
     case 404: return "Not Found";
     case 405: return "Method Not Allowed";
     case 409: return "Conflict";
@@ -229,12 +233,31 @@ void HttpServer::serve_connection(int fd) {
     out << "HTTP/1.1 " << resp.status << ' ' << status_text(resp.status)
         << "\r\nContent-Type: " << resp.content_type
         << "\r\nContent-Length: " << resp.body.size()
-        << "\r\nConnection: " << (keep_alive ? "keep-alive" : "close")
-        << "\r\n\r\n" << resp.body;
+        << "\r\nConnection: " << (keep_alive ? "keep-alive" : "close");
+    for (const auto& [name, value] : resp.headers) {
+      out << "\r\n" << name << ": " << value;
+    }
+    out << "\r\n\r\n" << resp.body;
     if (!send_all(fd, out.str())) break;
     if (!keep_alive) break;
   }
   ::close(fd);
+}
+
+bool split_host_port(const std::string& s, std::string* host, int* port) {
+  auto colon = s.rfind(':');
+  if (colon == std::string::npos || colon == 0 || colon + 1 >= s.size()) {
+    return false;
+  }
+  const std::string port_str = s.substr(colon + 1);
+  if (port_str.find_first_not_of("0123456789") != std::string::npos) {
+    return false;
+  }
+  long p = std::strtol(port_str.c_str(), nullptr, 10);
+  if (p < 1 || p > 65535) return false;
+  *host = s.substr(0, colon);
+  *port = static_cast<int>(p);
+  return true;
 }
 
 std::optional<HttpClientResponse> http_request(
@@ -250,8 +273,18 @@ std::optional<HttpClientResponse> http_request(
   addr.sin_family = AF_INET;
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
-    ::close(fd);
-    return std::nullopt;
+    // not an IPv4 literal: resolve the hostname (SSO issuers, webhook
+    // targets, and k8s service names are rarely raw addresses)
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (::getaddrinfo(host.c_str(), nullptr, &hints, &res) != 0 || !res) {
+      ::close(fd);
+      return std::nullopt;
+    }
+    addr.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+    ::freeaddrinfo(res);
   }
   if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
     ::close(fd);

@@ -33,6 +33,8 @@ struct HttpResponse {
   int status = 200;
   std::string content_type = "application/json";
   std::string body;
+  // extra response headers (e.g. Location on a 302 redirect)
+  std::map<std::string, std::string> headers;
 
   static HttpResponse json(int status, const std::string& body) {
     HttpResponse r;
@@ -75,6 +77,11 @@ struct HttpClientResponse {
   std::string body;
   std::string content_type = "application/json";  // from the response headers
 };
+
+// "host:port" -> (host, port). False when the colon or a valid (1-65535)
+// numeric port is missing — shared by every config surface that takes an
+// address so validation cannot drift.
+bool split_host_port(const std::string& s, std::string* host, int* port);
 
 // Returns nullopt on connect/transport error. `extra_headers` are appended
 // to the request (e.g. the proxy path's x-alloc-token injection).

@@ -34,6 +34,13 @@ void apply_config_file(const std::string& path, dct::MasterConfig* config) {
       config->unmanaged_timeout_sec = std::atof(value.c_str());
     } else if (key == "auth_required") config->auth_required = parse_bool(value);
     else if (key == "rbac") config->rbac_enabled = parse_bool(value);
+    else if (key == "sso.issuer") {
+      if (!dct::split_host_port(value, &config->sso_issuer_host,
+                                &config->sso_issuer_port)) {
+        throw std::runtime_error("sso.issuer expects host:port");
+      }
+    } else if (key == "sso.client_id") config->sso_client_id = value;
+    else if (key == "sso.client_secret") config->sso_client_secret = value;
     else if (key == "session_ttl") {
       config->session_ttl_sec = std::atof(value.c_str());
     } else if (key == "webui_dir") config->webui_dir = value;
@@ -101,6 +108,17 @@ int main(int argc, char** argv) {
       config.auth_required = true;
     } else if (!std::strcmp(argv[i], "--rbac")) {
       config.rbac_enabled = true;
+    } else if (!std::strcmp(argv[i], "--sso-issuer") && i + 1 < argc) {
+      // host:port of an OIDC-shaped identity provider
+      if (!dct::split_host_port(argv[++i], &config.sso_issuer_host,
+                                &config.sso_issuer_port)) {
+        std::cerr << "--sso-issuer expects host:port\n";
+        return 2;
+      }
+    } else if (!std::strcmp(argv[i], "--sso-client-id") && i + 1 < argc) {
+      config.sso_client_id = argv[++i];
+    } else if (!std::strcmp(argv[i], "--sso-client-secret") && i + 1 < argc) {
+      config.sso_client_secret = argv[++i];
     } else if (!std::strcmp(argv[i], "--webui-dir") && i + 1 < argc) {
       config.webui_dir = argv[++i];
     } else if (!std::strcmp(argv[i], "--db") && i + 1 < argc) {

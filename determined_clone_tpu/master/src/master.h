@@ -47,6 +47,15 @@ struct MasterConfig {
   // the caller's resolved role at the target workspace's scope
   bool rbac_enabled = false;
   double session_ttl_sec = 7 * 24 * 3600;
+  // SSO via an OIDC-shaped identity provider (≈ the reference's
+  // OIDC/SAML plugin hooks): the master redirects to
+  // <issuer>/authorize and exchanges the callback code at
+  // <issuer>/token for the identity; users auto-provision on first
+  // login. Empty host disables.
+  std::string sso_issuer_host;
+  int sso_issuer_port = 0;
+  std::string sso_client_id = "dct";
+  std::string sso_client_secret;
   // static WebUI assets directory ("" disables); served at / and /ui/*
   std::string webui_dir = "webui";
   // TPU-VM autoscaling (provisioner.h); disabled unless enabled=true
@@ -119,6 +128,10 @@ class Master {
   // registry, templates, webhooks (routes_platform.cc). Returns nullopt when
   // the path is not one of its roots.
   std::optional<HttpResponse> route_platform(const HttpRequest& req);
+  // GET /api/v1/auth/sso/callback — dispatched from handle() BEFORE the
+  // state lock: the IdP token exchange is a blocking outbound request and
+  // must never run under mu_ (locks only around state reads/writes)
+  HttpResponse sso_callback_route(const HttpRequest& req);
 
   // -- platform helpers (routes_platform.cc) --
   User* current_user(const HttpRequest& req);   // nullptr if no valid token
@@ -193,6 +206,8 @@ class Master {
   std::map<int64_t, Webhook> webhooks_;
   std::map<int64_t, Group> groups_;
   std::map<int64_t, RoleAssignment> role_assignments_;
+  // outstanding SSO login attempts: state nonce -> expiry (transient)
+  std::map<std::string, double> sso_states_;
   // -- request tracing (own mutex: never contends the state lock) --
   struct RouteStats {
     int64_t count = 0;

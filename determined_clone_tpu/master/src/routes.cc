@@ -94,6 +94,11 @@ HttpResponse Master::handle(const HttpRequest& req) {
                (req.path == "/" ||
                 (!req.path_parts.empty() && req.path_parts[0] == "ui"))) {
       resp = static_route(req);
+    } else if (req.method == "GET" &&
+               req.path == "/api/v1/auth/sso/callback") {
+      // the IdP token exchange blocks on an outbound request — it manages
+      // its own locking instead of running under route()'s state lock
+      resp = sso_callback_route(req);
     } else {
       resp = route(req);
     }

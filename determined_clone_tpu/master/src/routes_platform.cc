@@ -277,6 +277,53 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
       j.set("user", u->to_json());
       return pok(j);
     }
+    // ---- SSO (OIDC-shaped; ≈ the reference's OIDC plugin hooks) ----------
+    if (parts.size() >= 5 && parts[3] == "sso") {
+      if (config_.sso_issuer_host.empty()) {
+        return pbad("sso is not configured (--sso-issuer)");
+      }
+      const double now = now_sec();
+      for (auto it = sso_states_.begin(); it != sso_states_.end();) {
+        it = it->second < now ? sso_states_.erase(it) : std::next(it);
+      }
+      if (parts[4] == "login" && req.method == "GET") {
+        // mint a state nonce and bounce the browser to the IdP. The
+        // redirect_uri must be ABSOLUTE (a browser resolves a relative
+        // Location against the IdP's origin, not ours): rebuild it from
+        // the Host header the browser used to reach us.
+        std::string state = crypto::random_token();
+        // bound outstanding states: anonymous login spam must not grow
+        // master memory — evict the nearest-expiry entries beyond the cap
+        constexpr size_t kMaxStates = 1024;
+        while (sso_states_.size() >= kMaxStates) {
+          auto oldest = sso_states_.begin();
+          for (auto it = sso_states_.begin(); it != sso_states_.end(); ++it) {
+            if (it->second < oldest->second) oldest = it;
+          }
+          sso_states_.erase(oldest);
+        }
+        sso_states_[state] = now + 600;
+        auto host_it = req.headers.find("host");
+        std::string self_host = host_it != req.headers.end()
+                                    ? host_it->second
+                                    : "127.0.0.1:" +
+                                          std::to_string(config_.port);
+        std::string redirect =
+            "http://" + config_.sso_issuer_host + ":" +
+            std::to_string(config_.sso_issuer_port) +
+            "/authorize?client_id=" + config_.sso_client_id +
+            "&state=" + state + "&redirect_uri=http%3A%2F%2F" + self_host +
+            "%2Fapi%2Fv1%2Fauth%2Fsso%2Fcallback";
+        HttpResponse resp;
+        resp.status = 302;
+        resp.headers["Location"] = redirect;
+        resp.body = "";
+        return resp;
+      }
+      // (the callback is dispatched from handle() before the state lock —
+      // its token exchange must not block the master; sso_callback_route)
+      return pnotfound("unknown sso route");
+    }
     return pnotfound("unknown auth route");
   }
 
@@ -947,6 +994,82 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
   }
 
   return std::nullopt;
+}
+
+HttpResponse Master::sso_callback_route(const HttpRequest& req) {
+  // phase 1 (locked): validate config, consume the state nonce
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    if (config_.sso_issuer_host.empty()) {
+      return pbad("sso is not configured (--sso-issuer)");
+    }
+    auto state_it = req.query.find("state");
+    auto code_it = req.query.find("code");
+    if (state_it == req.query.end() || code_it == req.query.end()) {
+      return pbad("missing state/code");
+    }
+    const double now = now_sec();
+    for (auto it = sso_states_.begin(); it != sso_states_.end();) {
+      it = it->second < now ? sso_states_.erase(it) : std::next(it);
+    }
+    if (!sso_states_.erase(state_it->second)) {
+      return punauthorized("unknown or expired sso state");
+    }
+  }
+  // phase 2 (UNLOCKED): exchange the code at the issuer's token endpoint —
+  // a blocking outbound request that must never stall the master
+  Json body = Json::object();
+  body.set("grant_type", "authorization_code")
+      .set("code", req.query.at("code"))
+      .set("client_id", config_.sso_client_id)
+      .set("client_secret", config_.sso_client_secret);
+  auto resp = http_request(config_.sso_issuer_host, config_.sso_issuer_port,
+                           "POST", "/token", body.dump(), 15);
+  if (!resp || resp->status != 200) {
+    return punauthorized("sso token exchange failed");
+  }
+  Json identity;
+  try {
+    identity = Json::parse(resp->body);
+  } catch (const std::exception&) {
+    return punauthorized("sso issuer returned malformed identity");
+  }
+  std::string username = identity["username"].as_string();
+  if (username.empty()) username = identity["email"].as_string();
+  if (username.empty()) {
+    return punauthorized("sso identity has no username/email");
+  }
+  // phase 3 (locked): find-or-provision the user, mint the session
+  std::lock_guard<std::mutex> lock(mu_);
+  User* user = nullptr;
+  for (auto& [id, u] : users_) {
+    if (u.username == username) user = &u;
+  }
+  if (user && !user->active) return punauthorized("user deactivated");
+  if (!user) {
+    // never admin; roles come from rbac
+    User u;
+    u.id = next_user_id_++;
+    u.username = username;
+    u.display_name = identity["name"].as_string();
+    // no password entry: SSO users authenticate via the issuer only
+    u.password_hash = "sso";
+    users_[u.id] = u;
+    user = &users_[u.id];
+  }
+  SessionToken tok;
+  tok.token = new_token();
+  tok.user_id = user->id;
+  tok.expires_at = now_sec() + config_.session_ttl_sec;
+  sessions_[tok.token] = tok;
+  dirty_ = true;
+  // hand the token to the SPA via the URL fragment (never sent to the
+  // server, read once by app.js and moved to localStorage)
+  HttpResponse out;
+  out.status = 302;
+  out.headers["Location"] = "/#sso_token=" + tok.token;
+  out.body = "";
+  return out;
 }
 
 Json Master::resolve_template(const Json& config) {

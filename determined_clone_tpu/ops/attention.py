@@ -142,11 +142,45 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *, axis_name: str,
     acc0 = jnp.zeros((B, T, H, D), jnp.float32)
     m0 = jnp.full((B, H, T), NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, H, T), jnp.float32)
+    # the zero-init carry is a replicated constant but every loop output
+    # varies over the sp axis — mark it varying or shard_map's vma check
+    # rejects the fori_loop carry
+    acc0, m0, l0 = jax.tree.map(
+        lambda x: jax.lax.pvary(x, axis_name), (acc0, m0, l0))
     acc, m, l, _, _ = jax.lax.fori_loop(
         0, axis_size, body, (acc0, m0, l0, k, v)
     )
     out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                      axis_name: str, causal: bool = True) -> jax.Array:
+    """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism inside
+    shard_map: the complement of ring_attention for long sequences.
+
+    The sequence axis arrives sharded over ``axis_name``; one all-to-all
+    reshards to head-parallel layout ([B, T, H/sp, D] — every device holds
+    the FULL sequence for a slice of heads), attention runs locally with
+    zero communication, and a second all-to-all reshards back. Two
+    all-to-alls total versus the ring's axis_size ppermute hops — the
+    better trade when the head count divides the axis and the full
+    sequence fits per device.
+
+    q,k,v: [B, T_local, H, D]; H must be divisible by the axis size.
+    """
+
+    def a2a(x, scatter_dim, concat_dim):
+        return jax.lax.all_to_all(x, axis_name, split_axis=scatter_dim,
+                                  concat_axis=concat_dim, tiled=True)
+
+    # [B, T/sp, H, D] -> [B, T, H/sp, D]: scatter heads, gather sequence
+    qh = a2a(q, 2, 1)
+    kh = a2a(k, 2, 1)
+    vh = a2a(v, 2, 1)
+    out = mha(qh, kh, vh, causal=causal)
+    # [B, T, H/sp, D] -> [B, T/sp, H, D]
+    return a2a(out, 1, 2)
 
 
 def rotary_embedding(x: jax.Array, positions: jax.Array, *,

@@ -1,0 +1,172 @@
+"""SSO login against an OIDC-shaped fake identity provider.
+
+≈ the reference's OIDC plugin hooks (user service SSO integration): the
+master redirects to the issuer's /authorize, exchanges the callback code
+at /token, auto-provisions the user, and hands the session token to the
+SPA via a URL fragment. The IdP here is an in-process HTTP server.
+"""
+import json
+import threading
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from tests.test_platform import build_binaries, start_master
+
+
+class FakeIdP(BaseHTTPRequestHandler):
+    """Authorization server: /authorize bounces straight back with a code;
+    /token redeems it for an identity."""
+
+    codes = {}
+    identity = {"username": "sso-user", "email": "sso-user@example.com",
+                "name": "S. So"}
+    token_requests = []
+
+    def do_GET(self):
+        url = urllib.parse.urlparse(self.path)
+        q = dict(urllib.parse.parse_qsl(url.query))
+        if url.path == "/authorize":
+            code = f"code-{len(self.codes)}"
+            self.codes[code] = True
+            dest = (f"{q['redirect_uri']}?code={code}"
+                    f"&state={q['state']}")
+            self.send_response(302)
+            self.send_header("Location", dest)
+            self.end_headers()
+        else:
+            self.send_response(404)
+            self.end_headers()
+
+    def do_POST(self):
+        body = json.loads(self.rfile.read(
+            int(self.headers.get("Content-Length", 0))))
+        type(self).token_requests.append(body)
+        if self.path == "/token" and self.codes.pop(body.get("code"), None):
+            payload = json.dumps(self.identity).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+        else:
+            self.send_response(401)
+            self.end_headers()
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture(scope="module")
+def idp():
+    server = HTTPServer(("127.0.0.1", 0), FakeIdP)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield server.server_port
+    server.shutdown()
+
+
+@pytest.fixture(scope="module")
+def master(tmp_path_factory, idp):
+    if not build_binaries():
+        pytest.skip("C++ master build unavailable")
+    tmp = tmp_path_factory.mktemp("sso")
+    # hostname (not IP literal) issuer: exercises the master's outbound
+    # DNS resolution on the token exchange
+    proc, session, port = start_master(
+        tmp, "--auth-required",
+        "--sso-issuer", f"localhost:{idp}",
+        "--sso-client-id", "dct-test",
+        "--sso-client-secret", "s3cret")
+    yield {"session": session, "port": port, "proc": proc}
+    proc.kill()
+    proc.wait(timeout=10)
+
+
+def fetch(port, path, follow=False):
+    class NoRedirect(urllib.request.HTTPRedirectHandler):
+        def redirect_request(self, *a, **kw):
+            return None
+
+    opener = (urllib.request.build_opener() if follow
+              else urllib.request.build_opener(NoRedirect))
+    try:
+        resp = opener.open(f"http://127.0.0.1:{port}{path}", timeout=10)
+        return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def test_sso_login_flow(master, idp):
+    port = master["port"]
+    # 1. the login route bounces to the issuer with a state nonce
+    status, headers, _ = fetch(port, "/api/v1/auth/sso/login")
+    assert status == 302
+    auth_url = headers["Location"]
+    assert auth_url.startswith(f"http://localhost:{idp}/authorize")
+    q = dict(urllib.parse.parse_qsl(urllib.parse.urlparse(auth_url).query))
+    assert q["client_id"] == "dct-test" and q["state"]
+    # the callback target is ABSOLUTE — a browser resolves a relative
+    # Location against the IdP's origin, which would lose the flow
+    assert q["redirect_uri"].startswith("http://")
+    assert urllib.parse.urlparse(q["redirect_uri"]).port == port
+
+    # 2. the browser visits the IdP, which redirects back with a code
+    idp_status, idp_headers, _ = fetch(
+        idp, "/authorize?" + urllib.parse.urlencode(q))
+    assert idp_status == 302
+    callback_url = urllib.parse.urlparse(idp_headers["Location"])
+    assert callback_url.port == port  # back to the master, not the IdP
+    callback = f"{callback_url.path}?{callback_url.query}"
+
+    # 3. the callback exchanges the code and mints a session
+    status, headers, _ = fetch(port, callback)
+    assert status == 302
+    assert headers["Location"].startswith("/#sso_token=")
+    token = headers["Location"].split("=", 1)[1]
+    # the exchange carried the client secret to the issuer
+    assert FakeIdP.token_requests[-1]["client_secret"] == "s3cret"
+
+    # 4. the token is a live session for the auto-provisioned user
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api/v1/auth/me",
+        headers={"Authorization": f"Bearer {token}"})
+    me = json.loads(urllib.request.urlopen(req, timeout=10).read())
+    assert me["user"]["username"] == "sso-user"
+    assert me["user"]["admin"] is False
+
+    # 5. a replayed callback (state consumed) is rejected
+    status, _, _ = fetch(port, callback)
+    assert status == 401
+
+
+def test_sso_user_cannot_password_login(master):
+    from determined_clone_tpu.api.client import MasterError
+
+    with pytest.raises(MasterError) as err:
+        master["session"].login("sso-user", "")
+    assert err.value.status == 401
+    with pytest.raises(MasterError):
+        master["session"].login("sso-user", "sso")
+
+
+def test_sso_forged_state_rejected(master):
+    status, _, _ = fetch(
+        master["port"],
+        "/api/v1/auth/sso/callback?code=code-x&state=forged")
+    assert status == 401
+
+
+def test_sso_unconfigured_master_declines(tmp_path):
+    if not build_binaries():
+        pytest.skip("C++ master build unavailable")
+    proc, session, port = start_master(tmp_path)
+    try:
+        status, _, body = fetch(port, "/api/v1/auth/sso/login")
+        assert status == 400
+        assert b"not configured" in body
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)

@@ -576,6 +576,13 @@ async function route() {
   }
 }
 
+// SSO callback lands here with the session token in the URL fragment
+// (never sent to any server); move it to localStorage and clean the URL
+if (location.hash.startsWith("#sso_token=")) {
+  localStorage.setItem("dct-token", location.hash.slice("#sso_token=".length));
+  history.replaceState(null, "", location.pathname + "#/dashboard");
+}
+
 window.addEventListener("hashchange", route);
 api("GET", "/api/v1/auth/me")
     .then((out) => {
