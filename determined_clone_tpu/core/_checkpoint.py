@@ -83,6 +83,8 @@ class CheckpointContext:
         self._storage = storage
         self._registry = registry or NullCheckpointRegistry()
         self._trial_id = trial_id
+        # in-flight async uploads: [{thread, error holder, chief record}]
+        self._pending: List[Dict[str, Any]] = []
 
     # -- save ---------------------------------------------------------------
 
@@ -97,34 +99,54 @@ class CheckpointContext:
         reference's _upload_sharded/merge_resources
         (core/_checkpoint.py:280,127).
         """
+        storage_id, upload_paths = self._coordinate(ckpt_dir, metadata, shard)
+        if upload_paths is not None:
+            self._storage.upload(ckpt_dir, storage_id, paths=upload_paths
+                                 if shard else None)
+        self._dist.barrier()
+        self._publish(storage_id, metadata)
+        return storage_id
+
+    def _coordinate(self, ckpt_dir: Optional[str],
+                    metadata: Optional[Dict[str, Any]],
+                    shard: bool) -> tuple:
+        """The collective part of a save, shared by the sync and async
+        paths: broadcast the storage id, exchange shard manifests, reject
+        conflicts, write metadata. Returns (storage_id, upload_paths) where
+        upload_paths is None when THIS rank has nothing to upload (and a
+        list for sharded uploads; the sync non-shard chief passes the whole
+        directory)."""
         storage_id = self._dist.broadcast(
             str(uuid.uuid4()) if self._dist.is_chief else None
         )
         if shard:
             my_files = _relative_files(ckpt_dir) if ckpt_dir else []
-            my_files = [f for f in my_files if f != METADATA_FILE or self._dist.is_chief]
+            my_files = [f for f in my_files
+                        if f != METADATA_FILE or self._dist.is_chief]
             all_files = self._dist.allgather(my_files)
             _check_shard_conflicts(all_files)
-            if ckpt_dir:
-                self._write_metadata(ckpt_dir, metadata)
-                upload_files = my_files + (
-                    [METADATA_FILE] if self._dist.is_chief else []
-                )
-                self._storage.upload(ckpt_dir, storage_id, paths=sorted(set(upload_files)))
-        else:
-            if self._dist.is_chief:
-                self._write_metadata(ckpt_dir, metadata)
-                self._storage.upload(ckpt_dir, storage_id)
-        self._dist.barrier()
-        if self._dist.is_chief:
-            self._registry.report({
-                "storage_id": storage_id,
-                "trial_id": self._trial_id,
-                "metadata": metadata or {},
-                "time": time.time(),
-                "resources": self._storage.list_files(storage_id),
-            })
-        return storage_id
+            if not ckpt_dir:
+                return storage_id, None
+            self._write_metadata(ckpt_dir, metadata)
+            return storage_id, sorted(set(
+                my_files + ([METADATA_FILE] if self._dist.is_chief else [])))
+        if not self._dist.is_chief:
+            return storage_id, None
+        self._write_metadata(ckpt_dir, metadata)
+        return storage_id, []
+
+    def _publish(self, storage_id: str,
+                 metadata: Optional[Dict[str, Any]]) -> None:
+        """Chief-only registry record — one shape for sync and async."""
+        if not self._dist.is_chief:
+            return
+        self._registry.report({
+            "storage_id": storage_id,
+            "trial_id": self._trial_id,
+            "metadata": metadata or {},
+            "time": time.time(),
+            "resources": self._storage.list_files(storage_id),
+        })
 
     @contextlib.contextmanager
     def store_path(self, metadata: Optional[Dict[str, Any]] = None, *,
@@ -145,6 +167,100 @@ class CheckpointContext:
             )
         finally:
             shutil.rmtree(tmp, ignore_errors=True)
+
+    @contextlib.contextmanager
+    def store_path_async(self, metadata: Optional[Dict[str, Any]] = None, *,
+                         shard: bool = False) -> Iterator[tuple]:
+        """Orbax-style async save: yield (local_dir, holder); on exit the
+        files are HANDED OFF to a background thread and training resumes
+        immediately — the upload overlaps the next steps' compute. Call
+        ``wait_async()`` (the Trainer does, on preemption and at exit)
+        to drain in-flight uploads and publish registry records.
+
+        All distributed coordination (storage-id broadcast, shard-manifest
+        allgather, conflict check) happens on the CALLER's thread before
+        handoff — the background thread does pure storage I/O, so it can
+        never race the training loop's own collectives. The holder carries
+        ``storage_id`` immediately on exit.
+        """
+        import shutil
+        import tempfile
+        import threading
+
+        tmp = tempfile.mkdtemp()
+        holder: Dict[str, str] = {}
+        try:
+            yield tmp, holder
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        # caller-thread coordination (shared with upload())
+        storage_id, upload_paths = self._coordinate(tmp, metadata, shard)
+        holder["storage_id"] = storage_id
+        if upload_paths is None:  # nothing to upload from this rank
+            shutil.rmtree(tmp, ignore_errors=True)
+            # still tracked: every rank must join the wait_async exchange
+            self._pending.append({"thread": None, "error": {},
+                                  "storage_id": storage_id,
+                                  "metadata": metadata or {}})
+            return
+
+        error: Dict[str, BaseException] = {}
+
+        def io(tmp=tmp, storage_id=storage_id,
+               paths=(upload_paths if shard else None)):
+            try:
+                self._storage.upload(tmp, storage_id, paths=paths)
+            except BaseException as e:  # noqa: BLE001 - surfaced at wait
+                error["error"] = e
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+
+        thread = threading.Thread(target=io, daemon=True,
+                                  name="dct-async-ckpt")
+        thread.start()
+        self._pending.append({
+            "thread": thread,
+            "error": error,
+            "storage_id": storage_id,
+            "metadata": metadata or {},
+        })
+
+    def wait_async(self) -> List[str]:
+        """Drain in-flight async uploads: join the I/O threads, exchange
+        per-checkpoint success across the gang (a checkpoint with ANY
+        rank's shard missing must never be published as restorable), then
+        the chief publishes the registry records for the fully-uploaded
+        ones. Raises on failure — local or remote. MUST run before process
+        exit on preemption — the reference's flush-then-exit rule
+        (SURVEY §7)."""
+        local_failed: List[bool] = []
+        first_error: Optional[BaseException] = None
+        for entry in self._pending:
+            if entry["thread"] is not None:
+                entry["thread"].join()
+            err = entry["error"].get("error")
+            local_failed.append(err is not None)
+            if err is not None and first_error is None:
+                first_error = err
+        # allgather doubles as the barrier; per-entry failure flags align
+        # because saves are collective (same count/order on every rank)
+        all_failed = self._dist.allgather(local_failed)
+        drained: List[str] = []
+        for i, entry in enumerate(self._pending):
+            if any(flags[i] for flags in all_failed if i < len(flags)):
+                continue  # incomplete on some rank: never published
+            drained.append(entry["storage_id"])
+            self._publish(entry["storage_id"], entry["metadata"])
+        n_entries = len(self._pending)
+        self._pending.clear()
+        if first_error is not None:
+            raise first_error
+        if len(drained) != n_entries:
+            raise RuntimeError(
+                "async checkpoint upload failed on another rank; "
+                "incomplete checkpoints were not published")
+        return drained
 
     def _write_metadata(self, ckpt_dir: str, metadata: Optional[Dict[str, Any]]) -> None:
         if not self._dist.is_chief:

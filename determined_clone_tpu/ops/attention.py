@@ -159,13 +159,14 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism inside
     shard_map: the complement of ring_attention for long sequences.
 
-    The sequence axis arrives sharded over ``axis_name``; one all-to-all
-    reshards to head-parallel layout ([B, T, H/sp, D] — every device holds
-    the FULL sequence for a slice of heads), attention runs locally with
-    zero communication, and a second all-to-all reshards back. Two
-    all-to-alls total versus the ring's axis_size ppermute hops — the
-    better trade when the head count divides the axis and the full
-    sequence fits per device.
+    The sequence axis arrives sharded over ``axis_name``; all-to-alls
+    reshard q/k/v to head-parallel layout ([B, T, H/sp, D] — every device
+    holds the FULL sequence for a slice of heads), attention runs locally
+    with zero communication, and a final all-to-all reshards the output
+    back. Four all-to-alls total (the standard Ulysses accounting) versus
+    the ring's axis_size ppermute hops per K/V tensor — the better trade
+    when the head count divides the axis and the full sequence fits per
+    device.
 
     q,k,v: [B, T_local, H, D]; H must be divisible by the axis size.
     """

@@ -8,6 +8,7 @@ from determined_clone_tpu.parallel.mesh import (
     MeshSpec,
     data_parallel_submesh_size,
     make_mesh,
+    make_multislice_mesh,
     mesh_axis_size,
     single_device_mesh,
 )
@@ -31,6 +32,7 @@ __all__ = [
     "MeshSpec",
     "data_parallel_submesh_size",
     "make_mesh",
+    "make_multislice_mesh",
     "mesh_axis_size",
     "single_device_mesh",
     "pipeline_apply",

@@ -100,6 +100,51 @@ def make_mesh(
     return Mesh(dev_array, AXES)
 
 
+def make_multislice_mesh(
+    ici_spec: MeshSpec,
+    dcn_spec: MeshSpec,
+    devices: Optional[Sequence[Any]] = None,
+) -> Mesh:
+    """Hybrid ICI×DCN mesh for multislice TPU (SURVEY.md §7 step 7).
+
+    ``ici_spec`` factorizes the chips WITHIN one slice (tp/sp innermost —
+    their collectives stay on the slice's ICI torus); ``dcn_spec``
+    factorizes ACROSS slices (normally only dp/fsdp/pp > 1 — gradient
+    reduction and pipeline hops are the traffic that tolerates DCN
+    latency). Each combined mesh axis is dcn-major: neighboring indices
+    stay within a slice, so XLA emits hierarchical collectives (intra-slice
+    ICI reduce, inter-slice DCN exchange) from the same PartitionSpecs
+    used single-slice.
+
+    Devices must enumerate slice-major (jax.devices() does on multislice;
+    tests model slices as contiguous groups of CPU devices).
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n_slices = math.prod(s for s in dcn_spec.axis_sizes() if s != -1)
+    if any(s == -1 for s in dcn_spec.axis_sizes()):
+        raise ValueError("dcn_spec must be fully specified (no -1 axes)")
+    if len(devices) % n_slices != 0:
+        raise ValueError(
+            f"{len(devices)} devices do not split into {n_slices} slices")
+    per_slice = len(devices) // n_slices
+    ici = ici_spec.resolve(per_slice)
+
+    # [*dcn_sizes, *ici_sizes] -> interleave (dcn_i, ici_i) per axis ->
+    # merge into combined per-axis sizes (dcn-major within each axis)
+    dcn_sizes = dcn_spec.axis_sizes()
+    ici_sizes = ici.axis_sizes()
+    arr = np.asarray(devices, dtype=object).reshape(*dcn_sizes, *ici_sizes)
+    n = len(AXES)
+    order = []
+    for i in range(n):
+        order.extend([i, n + i])
+    arr = arr.transpose(order)
+    combined = tuple(d * s for d, s in zip(dcn_sizes, ici_sizes))
+    return Mesh(arr.reshape(combined), AXES)
+
+
 def single_device_mesh(device: Optional[Any] = None) -> Mesh:
     """A 1×1×…×1 mesh over one device; lets the same pjit code path run
     unsharded (the reference's single-slot trial case)."""

@@ -96,6 +96,21 @@ class Trainer:
     # -- the loop -----------------------------------------------------------
 
     def fit(self, latest_checkpoint: Optional[str] = None) -> Dict[str, Any]:
+        try:
+            return self._fit_inner(latest_checkpoint)
+        except BaseException:
+            # drain in-flight async checkpoint uploads even when the loop
+            # raised: the uploaders are daemon threads, so an unhandled
+            # exception would kill them mid-upload on process exit. Best
+            # effort — the loop's error stays the primary failure.
+            try:
+                self.core.checkpoint.wait_async()
+            except Exception:
+                pass
+            raise
+
+    def _fit_inner(self, latest_checkpoint: Optional[str] = None
+                   ) -> Dict[str, Any]:
         trial, config = self.trial, self.config
         dist = self.core.distributed
         mesh = self.mesh
@@ -275,6 +290,11 @@ class Trainer:
             metric = (last_val.get(searcher_metric)
                       if last_val_at == batches_trained else None)
             self._save(state, batches_trained, "final", metric=metric)
+
+        # drain any in-flight async checkpoint uploads before the process
+        # can exit — the flush-then-exit rule (SURVEY §7 hard parts); a
+        # preempted run must not lose the checkpoint it just handed off
+        self.core.checkpoint.wait_async()
 
         result.update(
             batches_trained=batches_trained,
