@@ -191,11 +191,13 @@ class CheckpointContext:
         holder: Dict[str, str] = {}
         try:
             yield tmp, holder
+            # caller-thread coordination (shared with upload())
+            storage_id, upload_paths = self._coordinate(tmp, metadata, shard)
         except BaseException:
+            # body OR coordination failed (e.g. shard-manifest conflict):
+            # nothing was handed off, so the local files go with the error
             shutil.rmtree(tmp, ignore_errors=True)
             raise
-        # caller-thread coordination (shared with upload())
-        storage_id, upload_paths = self._coordinate(tmp, metadata, shard)
         holder["storage_id"] = storage_id
         if upload_paths is None:  # nothing to upload from this rank
             shutil.rmtree(tmp, ignore_errors=True)
@@ -234,6 +236,8 @@ class CheckpointContext:
         ones. Raises on failure — local or remote. MUST run before process
         exit on preemption — the reference's flush-then-exit rule
         (SURVEY §7)."""
+        if not self._pending and self._dist.size == 1:
+            return []  # nothing in flight: skip the collective entirely
         local_failed: List[bool] = []
         first_error: Optional[BaseException] = None
         for entry in self._pending:
@@ -246,21 +250,40 @@ class CheckpointContext:
         # allgather doubles as the barrier; per-entry failure flags align
         # because saves are collective (same count/order on every rank)
         all_failed = self._dist.allgather(local_failed)
-        drained: List[str] = []
-        for i, entry in enumerate(self._pending):
-            if any(flags[i] for flags in all_failed if i < len(flags)):
-                continue  # incomplete on some rank: never published
-            drained.append(entry["storage_id"])
-            self._publish(entry["storage_id"], entry["metadata"])
         n_entries = len(self._pending)
+        aligned = all(len(flags) == n_entries for flags in all_failed)
+        drained: List[str] = []
+        if aligned:
+            for i, entry in enumerate(self._pending):
+                if any(flags[i] for flags in all_failed):
+                    continue  # incomplete on some rank: never published
+                drained.append(entry["storage_id"])
+                self._publish(entry["storage_id"], entry["metadata"])
         self._pending.clear()
         if first_error is not None:
             raise first_error
+        if not aligned:
+            # a rank lost entries (its save body raised): pending lists no
+            # longer correspond — publishing anything would risk blessing
+            # an incomplete checkpoint
+            raise RuntimeError(
+                "async checkpoint drain misaligned across ranks "
+                f"({[len(f) for f in all_failed]} pending entries); "
+                "nothing was published")
         if len(drained) != n_entries:
             raise RuntimeError(
                 "async checkpoint upload failed on another rank; "
                 "incomplete checkpoints were not published")
         return drained
+
+    def abort_async(self) -> None:
+        """Crash-path drain: join local uploader threads so in-flight files
+        are fully written or cleaned up, WITHOUT any collective — safe to
+        call when other ranks may be wedged or dead. Nothing is published."""
+        for entry in self._pending:
+            if entry["thread"] is not None:
+                entry["thread"].join()
+        self._pending.clear()
 
     def _write_metadata(self, ckpt_dir: str, metadata: Optional[Dict[str, Any]]) -> None:
         if not self._dist.is_chief:

@@ -21,11 +21,43 @@ void handle_signal(int) { g_stop = 1; }
 
 using dct::configfile::parse_bool;
 
+// "<scheduler>[:nopreempt]" -> PoolPolicy; returns an error string or "".
+// One parser for the CLI flag and the config file so validation can't
+// drift between them.
+std::string parse_pool_policy(const std::string& value,
+                              dct::PoolPolicy* policy) {
+  auto colon = value.find(':');
+  policy->type = value.substr(0, colon);
+  if (policy->type != "fifo" && policy->type != "priority" &&
+      policy->type != "fair_share" && policy->type != "round_robin") {
+    return "unknown pool scheduler '" + policy->type +
+           "' (fifo|priority|fair_share|round_robin)";
+  }
+  policy->preemption_enabled = true;
+  if (colon != std::string::npos) {
+    const std::string suffix = value.substr(colon + 1);
+    if (suffix != "nopreempt") {
+      // a typo'd suffix silently leaving preemption ON would betray the
+      // operator's intent — reject it
+      return "unknown pool option '" + suffix + "' (only :nopreempt)";
+    }
+    policy->preemption_enabled = false;
+  }
+  return "";
+}
+
 void apply_config_file(const std::string& path, dct::MasterConfig* config) {
   for (const auto& [key, value] : dct::configfile::parse(path)) {
     if (key == "port") config->port = std::atoi(value.c_str());
     else if (key == "data_dir") config->data_dir = value;
     else if (key == "scheduler") config->default_pool.type = value;
+    else if (key.rfind("pool.", 0) == 0) {
+      // pool.<name>: <scheduler>[:nopreempt]
+      dct::PoolPolicy policy;
+      std::string err = parse_pool_policy(value, &policy);
+      if (!err.empty()) throw std::runtime_error(err + " in " + path);
+      config->pools[key.substr(5)] = policy;
+    }
     else if (key == "preemption") {
       config->default_pool.preemption_enabled = parse_bool(value);
     } else if (key == "agent_timeout") {
@@ -100,6 +132,21 @@ int main(int argc, char** argv) {
       config.data_dir = argv[++i];
     } else if (!std::strcmp(argv[i], "--scheduler") && i + 1 < argc) {
       config.default_pool.type = argv[++i];
+    } else if (!std::strcmp(argv[i], "--pool") && i + 1 < argc) {
+      // per-pool scheduler override: --pool name=fifo[:nopreempt]
+      std::string arg = argv[++i];
+      auto eq = arg.find('=');
+      if (eq == std::string::npos || eq == 0) {
+        std::cerr << "--pool expects name=scheduler[:nopreempt]\n";
+        return 2;
+      }
+      dct::PoolPolicy policy;
+      std::string err = parse_pool_policy(arg.substr(eq + 1), &policy);
+      if (!err.empty()) {
+        std::cerr << err << "\n";
+        return 2;
+      }
+      config.pools[arg.substr(0, eq)] = policy;
     } else if (!std::strcmp(argv[i], "--agent-timeout") && i + 1 < argc) {
       config.agent_timeout_sec = std::atof(argv[++i]);
     } else if (!std::strcmp(argv[i], "--unmanaged-timeout") && i + 1 < argc) {

@@ -873,8 +873,12 @@ void Master::agent_rm_tick_locked(double now) {
   }
 
   for (auto& [pool, pending] : pool_pending) {
+    auto policy_it = config_.pools.find(pool);
+    const PoolPolicy& policy = policy_it != config_.pools.end()
+                                   ? policy_it->second
+                                   : config_.default_pool;
     auto decision = schedule_pool(
-        config_.default_pool, pool_agents[pool], pool_free[pool], pending,
+        policy, pool_agents[pool], pool_free[pool], pending,
         pool_running[pool], share_usage, owner_of);
     for (const auto& [alloc_id, fit] : decision.assignments) {
       // reservation only; start commands are derived from state at each

@@ -34,6 +34,10 @@ struct MasterConfig {
   int port = 8080;
   std::string data_dir = "master_data";
   PoolPolicy default_pool;
+  // per-resource-pool scheduler overrides (≈ the reference's per-pool
+  // configs, rm/agentrm/resource_pool.go); pools not listed here use
+  // default_pool
+  std::map<std::string, PoolPolicy> pools;
   double agent_timeout_sec = 60;   // heartbeat "amnesia" window
   // unmanaged trials: errored when the client's heartbeats stop this long
   double unmanaged_timeout_sec = 300;

@@ -99,12 +99,12 @@ class Trainer:
         try:
             return self._fit_inner(latest_checkpoint)
         except BaseException:
-            # drain in-flight async checkpoint uploads even when the loop
-            # raised: the uploaders are daemon threads, so an unhandled
-            # exception would kill them mid-upload on process exit. Best
-            # effort — the loop's error stays the primary failure.
+            # join local uploader threads so the crash doesn't kill them
+            # mid-upload — WITHOUT collectives (other ranks may be mid-loop
+            # or dead; a collective here would hang or corrupt their
+            # exchanges). Nothing is published; the error stays primary.
             try:
-                self.core.checkpoint.wait_async()
+                self.core.checkpoint.abort_async()
             except Exception:
                 pass
             raise

@@ -177,3 +177,41 @@ def test_agent_config_file(tmp_path):
                        capture_output=True, text=True, timeout=10)
     assert r.returncode == 2
     assert "bogus_key" in r.stderr
+
+
+def test_per_pool_scheduler_flags(tmp_path):
+    """--pool name=scheduler[:nopreempt] overrides per resource pool
+    (≈ per-pool configs, rm/agentrm/resource_pool.go)."""
+    if not build_binaries():
+        pytest.skip("C++ master build unavailable")
+    import subprocess
+    from tests.test_platform import MASTER_BIN
+
+    # bad scheduler name is rejected up front
+    r = subprocess.run(
+        [str(MASTER_BIN), "--pool", "batch=bogus"],
+        capture_output=True, text=True, timeout=10)
+    assert r.returncode == 2 and "bogus" in r.stderr
+
+    # valid per-pool flags boot (incl. config-file form)
+    cfg = tmp_path / "m.yaml"
+    cfg.write_text("pool.batch: fifo\npool.research: priority:nopreempt\n")
+    proc, session, port = start_master(
+        tmp_path, "--config", str(cfg), "--pool", "interactive=round_robin")
+    try:
+        assert session.master_info()["cluster_name"] == "dct"
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+def test_pool_suffix_typo_rejected(tmp_path):
+    if not build_binaries():
+        pytest.skip("C++ master build unavailable")
+    import subprocess
+    from tests.test_platform import MASTER_BIN
+
+    r = subprocess.run(
+        [str(MASTER_BIN), "--pool", "batch=fifo:nopremept"],
+        capture_output=True, text=True, timeout=10)
+    assert r.returncode == 2 and "nopremept" in r.stderr
