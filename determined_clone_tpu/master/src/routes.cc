@@ -403,11 +403,54 @@ HttpResponse Master::route(const HttpRequest& req) {
   }
 
   // ---- master info -------------------------------------------------------
-  if (root == "master" && req.method == "GET") {
+  if (root == "master" && parts.size() == 3 && req.method == "GET") {
     Json j = Json::object();
     j.set("version", "0.1.0").set("cluster_name", "dct")
         .set("agents", static_cast<int64_t>(agents_.size()))
         .set("experiments", static_cast<int64_t>(experiments_.size()));
+    return ok_json(j);
+  }
+  // active config, secrets omitted (≈ GetMasterConfig api_master.go);
+  // operator surface: admin-gated under auth
+  if (root == "master" && parts.size() == 4 && parts[3] == "config" &&
+      req.method == "GET") {
+    if (config_.auth_required) {
+      // 401 for no/expired session (client should re-login), 403 only for
+      // an authenticated non-admin — the same split as the API roots
+      if (!current_user(req)) {
+        return HttpResponse::json(
+            401, error_json("authentication required").dump());
+      }
+      if (!cluster_admin_ok(req)) {
+        return HttpResponse::json(
+            403, error_json("admin required").dump());
+      }
+    }
+    Json pools = Json::object();
+    for (const auto& [name, policy] : config_.pools) {
+      Json p = Json::object();
+      p.set("scheduler", policy.type)
+          .set("preemption", policy.preemption_enabled);
+      pools.set(name, p);
+    }
+    Json j = Json::object();
+    j.set("port", static_cast<int64_t>(config_.port))
+        .set("data_dir", config_.data_dir)
+        .set("scheduler", config_.default_pool.type)
+        .set("preemption", config_.default_pool.preemption_enabled)
+        .set("pools", pools)
+        .set("auth_required", config_.auth_required)
+        .set("rbac", config_.rbac_enabled)
+        .set("rm", config_.rm)
+        .set("db", store_->kind())
+        .set("agent_timeout_sec", config_.agent_timeout_sec)
+        .set("unmanaged_timeout_sec", config_.unmanaged_timeout_sec)
+        .set("webui_dir", config_.webui_dir)
+        .set("sso_issuer",
+             config_.sso_issuer_host.empty()
+                 ? ""
+                 : config_.sso_issuer_host + ":" +
+                       std::to_string(config_.sso_issuer_port));
     return ok_json(j);
   }
 

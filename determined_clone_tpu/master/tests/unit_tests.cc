@@ -10,7 +10,9 @@
 #include <random>
 
 #include "../../agent/src/docker.h"
+#include "../src/config_file.h"
 #include "../src/crypto.h"
+#include "../src/kubernetesrm.h"
 #include "../src/topology.h"
 #include "../src/json.h"
 #include "../src/master.h"
@@ -845,7 +847,101 @@ void test_topology() {
   CHECK(find_fit(want_tpu, {cpu_agent}, cpu_free, "", &cpu_grids));
 }
 
+void test_config_file_parser() {
+  const char* path = "/tmp/dct-configfile-test.yaml";
+  {
+    FILE* f = fopen(path, "w");
+    fputs("# comment\n"
+          "port: 9000\n"
+          "data_dir: \"/data/#shared\"  # quoted hash survives\n"
+          "empty: \"\"\n"
+          "kube:\n"
+          "  namespace: prod\n"
+          "  image: 'img:tag'\n"
+          "flat_after: x\n",
+          f);
+    fclose(f);
+  }
+  auto kv = configfile::parse(path);
+  CHECK(kv.at("port") == "9000");
+  CHECK(kv.at("data_dir") == "/data/#shared");  // comment strip is quote-aware
+  CHECK(kv.at("empty") == "");                  // quoted empty != section
+  CHECK(kv.at("kube.namespace") == "prod");
+  CHECK(kv.at("kube.image") == "img:tag");
+  CHECK(kv.at("flat_after") == "x");            // section closed by outdent
+  CHECK(kv.count("empty") == 1);
+  ::remove(path);
+
+  bool threw = false;
+  try {
+    configfile::parse("/nonexistent/nope.yaml");
+  } catch (const std::exception&) {
+    threw = true;
+  }
+  CHECK(threw);
+
+  std::string host;
+  int port = 0;
+  CHECK(split_host_port("idp.example:8443", &host, &port));
+  CHECK(host == "idp.example" && port == 8443);
+  CHECK(!split_host_port("nocolon", &host, &port));
+  CHECK(!split_host_port("host:", &host, &port));
+  CHECK(!split_host_port("host:99999", &host, &port));
+  CHECK(!split_host_port("host:8a0", &host, &port));
+}
+
+void test_kubernetesrm_manifest() {
+  KubeRmConfig cfg;
+  cfg.ns = "tpu-ns";
+  cfg.image = "dct:1";
+  cfg.master_host = "dct-master";
+  cfg.master_port = 8080;
+  cfg.slots_per_pod = 8;
+  KubernetesRM rm(cfg, std::make_unique<DryRunKubectl>(
+                           "/tmp/dct-kube-unit-test"));
+
+  Allocation alloc;
+  alloc.id = "trial-9.0";
+  alloc.task_type = "trial";
+  alloc.slots = 12;  // 2 pods: 8 + 4
+  alloc.topology = "v5e-16";
+  alloc.world_size = 2;
+  alloc.token = "tok";
+  alloc.spec.set("entrypoint", "m:T");
+
+  Json cmd = Json::object();
+  cmd.set("alloc_token", alloc.token).set("slots", 8)
+      .set("world_size", 2).set("task_type", alloc.task_type)
+      .set("spec", alloc.spec);
+  Json pod = rm.pod_manifest(alloc, cmd, 0, 2, 8);
+  CHECK(pod["kind"].as_string() == "Pod");
+  CHECK(pod["metadata"]["namespace"].as_string() == "tpu-ns");
+  CHECK(pod["metadata"]["name"].as_string() == "dct-trial-9-0-0");
+  CHECK(pod["metadata"]["labels"]["dct-managed"].as_string() == "true");
+  CHECK(pod["spec"]["restartPolicy"].as_string() == "Never");
+  CHECK(pod["spec"]["containers"].elements()[0]["resources"]["limits"]
+           ["google.com/tpu"].as_string() == "8");
+  CHECK(pod["spec"]["nodeSelector"]["cloud.google.com/gke-tpu-topology"]
+            .as_string() == "v5e-16");
+  // trial argv derives from the entrypoint
+  const auto& argv =
+      pod["spec"]["containers"].elements()[0]["command"].elements();
+  CHECK(argv.size() == 4 && argv[3].as_string() == "m:T");
+  // env carries the data-plane credentials
+  bool saw_token = false;
+  for (const auto& e :
+       pod["spec"]["containers"].elements()[0]["env"].elements()) {
+    if (e["name"].as_string() == "DCT_ALLOC_TOKEN") {
+      saw_token = e["value"].as_string() == "tok";
+    }
+  }
+  CHECK(saw_token);
+  ::system("rm -rf /tmp/dct-kube-unit-test");
+}
+
 int run_all() {
+  test_config_file_parser();
+  test_kubernetesrm_manifest();
   test_crypto();
   test_custom_search();
   test_provisioner();

@@ -215,3 +215,41 @@ def test_pool_suffix_typo_rejected(tmp_path):
         [str(MASTER_BIN), "--pool", "batch=fifo:nopremept"],
         capture_output=True, text=True, timeout=10)
     assert r.returncode == 2 and "nopremept" in r.stderr
+
+
+def test_master_config_endpoint(tmp_path):
+    """GET /api/v1/master/config exposes the active config, secrets
+    omitted, admin-gated under auth (≈ GetMasterConfig)."""
+    if not build_binaries():
+        pytest.skip("C++ master build unavailable")
+    proc, session, port = start_master(
+        tmp_path, "--auth-required", "--rbac",
+        "--pool", "batch=fifo:nopreempt",
+        "--sso-issuer", "idp.internal:443",
+        "--sso-client-secret", "sup3rsecret")
+    try:
+        import urllib.error
+        import urllib.request
+
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/v1/master/config", timeout=5)
+        assert err.value.code == 401  # no session: re-login, not denied
+        # an authenticated non-admin is the 403 case
+        from determined_clone_tpu.api.client import MasterError, MasterSession
+        session.login("admin")
+        session.create_user("cfg-nobody", "pw")
+        s2 = MasterSession("127.0.0.1", port, timeout=5, retries=1)
+        s2.login("cfg-nobody", "pw")
+        with pytest.raises(MasterError) as err2:
+            s2.get("/api/v1/master/config")
+        assert err2.value.status == 403
+        cfg = session.get("/api/v1/master/config")
+        assert cfg["auth_required"] is True and cfg["rbac"] is True
+        assert cfg["pools"]["batch"] == {"scheduler": "fifo",
+                                         "preemption": False}
+        assert cfg["sso_issuer"] == "idp.internal:443"
+        assert "sup3rsecret" not in json.dumps(cfg)  # secrets never leave
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
