@@ -115,6 +115,22 @@ class MasterSession:
         return b.get_experiment(
             self, b.V1GetExperimentRequest(id=exp_id)).to_json()
 
+    def pause_experiment(self, exp_id: int) -> Dict[str, Any]:
+        return self.post(f"/api/v1/experiments/{exp_id}/pause")["experiment"]
+
+    def activate_experiment(self, exp_id: int) -> Dict[str, Any]:
+        return self.post(
+            f"/api/v1/experiments/{exp_id}/activate")["experiment"]
+
+    def archive_experiment(self, exp_id: int, archive: bool = True
+                           ) -> Dict[str, Any]:
+        action = "archive" if archive else "unarchive"
+        return self.post(
+            f"/api/v1/experiments/{exp_id}/{action}")["experiment"]
+
+    def delete_experiment(self, exp_id: int) -> None:
+        self.request("DELETE", f"/api/v1/experiments/{exp_id}")
+
     def kill_experiment(self, exp_id: int) -> Dict[str, Any]:
         b = _b()
         return b.kill_experiment(

@@ -95,6 +95,11 @@ def load_config_file(path: str) -> Dict[str, Any]:
 # command implementations
 # ---------------------------------------------------------------------------
 
+def cmd_master_config(args) -> int:
+    print_json(make_session(args).get("/api/v1/master/config"))
+    return 0
+
+
 def cmd_master_info(args) -> int:
     print_json(make_session(args).master_info())
     return 0
@@ -138,6 +143,31 @@ def cmd_experiment_list(args) -> int:
 
 def cmd_experiment_describe(args) -> int:
     print_json(make_session(args).get_experiment(args.experiment_id))
+    return 0
+
+
+def cmd_experiment_pause(args) -> int:
+    exp = make_session(args).pause_experiment(args.experiment_id)
+    print(f"Experiment {exp['id']} is {exp['state']}")
+    return 0
+
+
+def cmd_experiment_activate(args) -> int:
+    exp = make_session(args).activate_experiment(args.experiment_id)
+    print(f"Experiment {exp['id']} is {exp['state']}")
+    return 0
+
+
+def cmd_experiment_archive(args) -> int:
+    exp = make_session(args).archive_experiment(
+        args.experiment_id, archive=not args.unarchive)
+    print(f"Experiment {exp['id']} archived={exp['archived']}")
+    return 0
+
+
+def cmd_experiment_delete(args) -> int:
+    make_session(args).delete_experiment(args.experiment_id)
+    print(f"Deleted experiment {args.experiment_id}")
     return 0
 
 
@@ -560,6 +590,7 @@ def build_parser() -> argparse.ArgumentParser:
     p_master = sub.add_parser("master", help="master info")
     sm = p_master.add_subparsers(dest="subcommand", required=True)
     sm.add_parser("info").set_defaults(func=cmd_master_info)
+    sm.add_parser("config").set_defaults(func=cmd_master_config)
 
     # experiment
     p_exp = sub.add_parser("experiment", aliases=["e"], help="experiments")
@@ -582,6 +613,16 @@ def build_parser() -> argparse.ArgumentParser:
     c = se.add_parser("kill")
     c.add_argument("experiment_id", type=int)
     c.set_defaults(func=cmd_experiment_kill)
+    for action, fn in (("pause", cmd_experiment_pause),
+                       ("activate", cmd_experiment_activate),
+                       ("delete", cmd_experiment_delete)):
+        c = se.add_parser(action)
+        c.add_argument("experiment_id", type=int)
+        c.set_defaults(func=fn)
+    c = se.add_parser("archive")
+    c.add_argument("experiment_id", type=int)
+    c.add_argument("--unarchive", action="store_true")
+    c.set_defaults(func=cmd_experiment_archive)
 
     # trial
     p_trial = sub.add_parser("trial", aliases=["t"], help="trials")

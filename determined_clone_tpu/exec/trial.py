@@ -183,6 +183,15 @@ def main(argv=None) -> int:
         checkpoint_registry=MasterCheckpointRegistry(session, info.trial_id),
         trial_id=info.trial_id,
     ) as cctx:
+        # SIGTERM -> graceful preemption (≈ exec/launch.py:18-27's SLURM
+        # SIGTERM semantics): the agent belt-and-braces a SIGTERM alongside
+        # the preempt flag; without this handler python's default action
+        # would kill the trial mid-step instead of letting it checkpoint
+        import signal as signal_mod
+
+        signal_mod.signal(signal_mod.SIGTERM,
+                          lambda signum, frame: cctx.preempt.signal())
+
         # observability: profiler (opt-in via `profiling` config) +
         # tensorboard event shipping (chief only, needs a storage backend)
         from determined_clone_tpu import profiler as profiler_mod

@@ -421,6 +421,13 @@ void Master::queue_trial_leg(Trial& trial) {
     }
   }
   const Experiment& exp = experiments_[trial.experiment_id];
+  if (exp.state == RunState::Paused) {
+    // a paused experiment schedules nothing; searcher ops landing mid-pause
+    // (e.g. a straggler's completed_op promoting an ASHA rung) park the
+    // trial until activate re-queues it
+    trial.state = RunState::Paused;
+    return;
+  }
   if (exp.config["unmanaged"].as_bool(false)) {
     // unmanaged trial (≈ harness core_v2/_unmanaged.py + the reference's
     // unmanaged experiments): the client runs the training itself and
@@ -429,7 +436,7 @@ void Master::queue_trial_leg(Trial& trial) {
     // schedules anything
     Allocation alloc;
     alloc.id = "unmanaged-" + std::to_string(trial.id) + "." +
-               std::to_string(trial.restarts);
+               std::to_string(trial.legs++);
     alloc.trial_id = trial.id;
     alloc.task_type = "unmanaged";
     alloc.state = RunState::Running;
@@ -447,7 +454,7 @@ void Master::queue_trial_leg(Trial& trial) {
   const Json& resources = exp.config["resources"];
   Allocation alloc;
   alloc.id = "trial-" + std::to_string(trial.id) + "." +
-             std::to_string(trial.restarts);
+             std::to_string(trial.legs++);
   alloc.trial_id = trial.id;
   alloc.task_type = "trial";
   alloc.state = RunState::Queued;
@@ -613,7 +620,13 @@ void Master::gc_checkpoints_locked(Experiment& exp) {
   }
   if (doomed.empty()) return;
   dirty_ = true;
+  spawn_gc_task_locked(exp, doomed);
+}
 
+void Master::spawn_gc_task_locked(const Experiment& exp,
+                                  const std::vector<std::string>& doomed) {
+  const Json& storage = exp.config["checkpoint_storage"];
+  if (!storage.is_object() || doomed.empty()) return;
   // zero-slot GC task deletes the files from storage in-container
   // (≈ runCheckpointGCTask → exec/gc_checkpoints.py:97)
   Allocation gc;
@@ -694,8 +707,12 @@ void Master::on_task_done(const std::string& alloc_id, int exit_code,
     }
     if (alloc.trial_id && trials_.count(alloc.trial_id)) {
       Trial& t = trials_[alloc.trial_id];
-      if (t.state != RunState::Completed && t.state != RunState::Errored &&
-          t.state != RunState::Canceled) {
+      if (t.state == RunState::Paused) {
+        // the allocation was canceled BY a pause: the trial stays parked
+        // (activate re-queues it); only a real cancel closes it out
+      } else if (t.state != RunState::Completed &&
+                 t.state != RunState::Errored &&
+                 t.state != RunState::Canceled) {
         t.state = RunState::Canceled;
         t.ended_at = now_sec();
         dirty_ = true;
@@ -714,6 +731,14 @@ void Master::on_task_done(const std::string& alloc_id, int exit_code,
   Experiment& exp = experiments_[trial.experiment_id];
 
   if (trial.state == RunState::Completed || trial.state == RunState::Errored) {
+    return;
+  }
+  if (failed && exp.state == RunState::Paused) {
+    // the pause's preempt killed a harness that had not yet installed its
+    // SIGTERM handler (startup window): that is the pause taking effect,
+    // not a trial failure — park it; activate re-queues from the latest
+    // checkpoint and no restart is charged
+    trial.state = RunState::Paused;
     return;
   }
   if (failed) {
@@ -741,6 +766,20 @@ void Master::on_task_done(const std::string& alloc_id, int exit_code,
     if (trial.units_done >= trial.target_units &&
         trial.state != RunState::Completed) {
       trial.state = RunState::Paused;
+    } else if (exp.state == RunState::Paused &&
+               trial.state != RunState::Completed) {
+      // preempted by an experiment pause: the trial parks too (activate
+      // re-queues it from latest_checkpoint)
+      trial.state = RunState::Paused;
+    } else if (exp.state == RunState::Running &&
+               trial.state != RunState::Completed &&
+               trial.units_done < trial.target_units) {
+      // clean exit below target with the experiment live: a preemption
+      // victim (priority eviction, or an activate racing the pause's
+      // drain). Without a re-queue the trial would strand with no live
+      // allocation — resume it from the latest checkpoint, restart-free
+      // (nothing failed)
+      queue_trial_leg(trial);
     }
   }
 }

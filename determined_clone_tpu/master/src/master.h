@@ -170,6 +170,9 @@ class Master {
   // checkpoint GC per storage policy at experiment end; marks records
   // deleted and spawns a zero-slot GC task (≈ checkpoint_gc.go:27)
   void gc_checkpoints_locked(Experiment& exp);
+  // enqueue the zero-slot storage-GC task for a doomed checkpoint list
+  void spawn_gc_task_locked(const Experiment& exp,
+                            const std::vector<std::string>& doomed);
 
   MasterConfig config_;
   std::unique_ptr<HttpServer> server_;

@@ -60,6 +60,7 @@ struct Experiment {
   double created_at = 0;
   double ended_at = 0;
   std::string error;
+  bool archived = false;
 
   Json to_json() const {
     Json j = Json::object();
@@ -69,7 +70,8 @@ struct Experiment {
         .set("searcher_snapshot", searcher_snapshot)
         .set("owner", owner).set("workspace", workspace)
         .set("project", project).set("created_at", created_at)
-        .set("ended_at", ended_at).set("error", error);
+        .set("ended_at", ended_at).set("error", error)
+        .set("archived", archived);
     return j;
   }
   static Experiment from_json(const Json& j) {
@@ -86,6 +88,7 @@ struct Experiment {
     e.created_at = j["created_at"].as_number();
     e.ended_at = j["ended_at"].as_number();
     e.error = j["error"].as_string();
+    e.archived = j["archived"].as_bool(false);
     return e;
   }
 };
@@ -99,6 +102,12 @@ struct Trial {
   int64_t target_units = 0;   // current cumulative searcher target
   int64_t units_done = 0;
   int restarts = 0;
+  // allocation legs ever queued — names each leg's allocation uniquely.
+  // DISTINCT from restarts: a clean preemption (pause, priority eviction)
+  // starts a new leg without charging a restart, and id reuse would let
+  // the agent's at-least-once duplicate exit report for the old leg kill
+  // the new one.
+  int legs = 0;
   // log-pattern policy tripped: no more restart legs for this trial
   // (≈ logpattern CancelRetries, master/internal/logpattern/logpattern.go)
   bool no_retries = false;
@@ -115,7 +124,8 @@ struct Trial {
         .set("request_id", request_id).set("hparams", hparams)
         .set("state", to_string(state))
         .set("target_units", target_units).set("units_done", units_done)
-        .set("restarts", restarts).set("no_retries", no_retries)
+        .set("restarts", restarts).set("legs", legs)
+        .set("no_retries", no_retries)
         .set("latest_checkpoint", latest_checkpoint)
         .set("best_metric", best_metric).set("has_metric", has_metric)
         .set("created_at", created_at).set("ended_at", ended_at)
@@ -132,6 +142,8 @@ struct Trial {
     t.target_units = j["target_units"].as_int();
     t.units_done = j["units_done"].as_int();
     t.restarts = static_cast<int>(j["restarts"].as_int());
+    // pre-legs snapshots: seed past restarts so old leg ids never recur
+    t.legs = static_cast<int>(j["legs"].as_int(t.restarts + 1));
     t.no_retries = j["no_retries"].as_bool();
     t.latest_checkpoint = j["latest_checkpoint"].as_string();
     t.best_metric = j["best_metric"].as_number();

@@ -621,7 +621,8 @@ HttpResponse Master::route(const HttpRequest& req) {
               403, error_json("Editor role required in workspace " +
                               exp.workspace).dump());
         }
-        if (exp.state == RunState::Running || exp.state == RunState::Queued) {
+        if (exp.state == RunState::Running || exp.state == RunState::Queued ||
+            exp.state == RunState::Paused) {
           finish_experiment(exp, RunState::Canceled);
         }
         Json j = Json::object();
@@ -636,6 +637,142 @@ HttpResponse Master::route(const HttpRequest& req) {
         Json j = Json::object();
         j.set("checkpoints", arr);
         return ok_json(j);
+      }
+      // pause/activate (≈ PauseExperiment/ActivateExperiment): pause
+      // preempts running trials (they checkpoint and release their chips)
+      // and parks the experiment; activate re-queues the unfinished trials,
+      // which resume from their latest checkpoints
+      if (parts.size() == 5 &&
+          (parts[4] == "pause" || parts[4] == "activate" ||
+           parts[4] == "archive" || parts[4] == "unarchive") &&
+          req.method == "POST") {
+        User* caller = current_user(req);
+        bool own = caller && caller->username == exp.owner;
+        if (!own && !rbac_allows(req, role_rank("Editor"),
+                                 workspace_id_by_name(exp.workspace))) {
+          return HttpResponse::json(
+              403, error_json("Editor role required in workspace " +
+                              exp.workspace).dump());
+        }
+        const std::string& action = parts[4];
+        if (action == "pause") {
+          if (exp.state != RunState::Running) {
+            return bad_request("only a running experiment can pause");
+          }
+          exp.state = RunState::Paused;
+          for (auto& [aid, alloc] : allocations_) {
+            if (alloc.trial_id == 0) continue;
+            auto tit = trials_.find(alloc.trial_id);
+            if (tit == trials_.end() ||
+                tit->second.experiment_id != exp.id) {
+              continue;
+            }
+            if (alloc.state == RunState::Queued ||
+                alloc.state == RunState::Pulling) {
+              // not running yet (Pulling may have raced a start command:
+              // the heartbeat's terminal-state kill sweep covers that) —
+              // cancel outright; activate re-queues a fresh leg
+              alloc.state = RunState::Canceled;
+              alloc.reservations.clear();
+              tit->second.state = RunState::Paused;
+            } else if (alloc.state == RunState::Running) {
+              alloc.preempt_requested = true;  // graceful: ckpt then exit
+            }
+          }
+          dirty_ = true;
+        } else if (action == "activate") {
+          if (exp.state != RunState::Paused) {
+            return bad_request("only a paused experiment can activate");
+          }
+          exp.state = RunState::Running;
+          // un-preempt allocations still draining from the pause: the
+          // harness may not have polled the flag yet and can just keep
+          // training (if it already exited, the clean-exit path re-queues)
+          for (auto& [aid, alloc] : allocations_) {
+            if (alloc.trial_id == 0 || !alloc.preempt_requested) continue;
+            auto tit = trials_.find(alloc.trial_id);
+            if (tit != trials_.end() &&
+                tit->second.experiment_id == exp.id &&
+                (alloc.state == RunState::Running ||
+                 alloc.state == RunState::Pulling)) {
+              alloc.preempt_requested = false;
+            }
+          }
+          for (auto& [tid, trial] : trials_) {
+            if (trial.experiment_id != exp.id) continue;
+            bool terminal = trial.state == RunState::Completed ||
+                            trial.state == RunState::Errored ||
+                            trial.state == RunState::Canceled;
+            if (terminal || trial.units_done >= trial.target_units) {
+              continue;
+            }
+            queue_trial_leg(trial);  // resumes from latest_checkpoint
+          }
+          dirty_ = true;
+        } else if (action == "archive" || action == "unarchive") {
+          bool terminal = exp.state == RunState::Completed ||
+                          exp.state == RunState::Errored ||
+                          exp.state == RunState::Canceled;
+          if (!terminal) {
+            return bad_request("only a finished experiment can be archived");
+          }
+          exp.archived = action == "archive";
+          dirty_ = true;
+        }
+        Json j = Json::object();
+        j.set("experiment", exp.to_json());
+        return ok_json(j);
+      }
+      // delete (≈ DeleteExperiment): terminal only; every checkpoint is
+      // GC'd from storage and all records drop out of the master
+      if (parts.size() == 4 && req.method == "DELETE") {
+        if (!rbac_allows(req, role_rank("WorkspaceAdmin"),
+                         workspace_id_by_name(exp.workspace))) {
+          return HttpResponse::json(
+              403, error_json("WorkspaceAdmin role required").dump());
+        }
+        bool terminal = exp.state == RunState::Completed ||
+                        exp.state == RunState::Errored ||
+                        exp.state == RunState::Canceled;
+        if (!terminal) {
+          return bad_request("kill the experiment before deleting it");
+        }
+        std::vector<std::string> doomed;
+        for (auto& c : checkpoints_) {
+          if (c.experiment_id == id && !c.deleted) {
+            c.deleted = true;
+            doomed.push_back(c.uuid);
+          }
+        }
+        spawn_gc_task_locked(exp, doomed);
+        checkpoints_.erase(
+            std::remove_if(checkpoints_.begin(), checkpoints_.end(),
+                           [&](const CheckpointRecord& c) {
+                             return c.experiment_id == id;
+                           }),
+            checkpoints_.end());
+        for (auto tit = trials_.begin(); tit != trials_.end();) {
+          if (tit->second.experiment_id == id) {
+            tit = trials_.erase(tit);
+          } else {
+            ++tit;
+          }
+        }
+        for (auto ait = allocations_.begin(); ait != allocations_.end();) {
+          if (ait->second.trial_id != 0 &&
+              !trials_.count(ait->second.trial_id)) {
+            allgather_.erase(ait->first);
+            ait = allocations_.erase(ait);
+          } else {
+            ++ait;
+          }
+        }
+        methods_.erase(id);
+        request_to_trial_.erase(id);
+        log_policy_cache_.erase(id);
+        experiments_.erase(id);
+        dirty_ = true;
+        return ok_json(Json::object());
       }
       // custom-search event queue (≈ master/pkg/searcher/custom_search.go
       // events + api_experiment.go GetSearcherEvents/PostSearcherOperations)

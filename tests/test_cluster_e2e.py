@@ -213,3 +213,75 @@ def test_kill_experiment(cluster):
         desc="experiment cancel", timeout=60,
     )
     assert detail["experiment"]["state"] == "CANCELED"
+
+
+SLOW_TRIAL = TRIAL_MODULE.replace(
+    "    def training_data(self):\n"
+    "        for _ in range(64):\n"
+    "            yield np.zeros((2, 1), np.float32)",
+    "    def training_data(self):\n"
+    "        import time\n"
+    "        for _ in range(64):\n"
+    "            time.sleep(0.25)\n"
+    "            yield np.zeros((2, 1), np.float32)")
+
+
+def test_pause_activate_archive_delete(cluster):
+    """≈ PauseExperiment/ActivateExperiment/Archive/Delete: pause preempts
+    the running trial (it checkpoints and frees the chip), activate
+    resumes from that checkpoint, archive/delete need a terminal state."""
+    session = cluster["session"]
+    assert SLOW_TRIAL != TRIAL_MODULE  # the replace really took
+    (cluster["workdir"] / "slow_def.py").write_text(SLOW_TRIAL)
+    cfg = exp_config(cluster, {"name": "single", "metric": "loss",
+                               "max_length": {"batches": 30}},
+                     name="pausable")
+    cfg["entrypoint"] = "slow_def:Trial"
+    exp = session.create_experiment(cfg)
+    eid = exp["id"]
+
+    # wait for real training progress (past compile) so the pause
+    # exercises the graceful checkpoint-and-exit path, not the startup race
+    wait_for(lambda: session.get_experiment(eid)["trials"] and
+             session.get_experiment(eid)["trials"][0]["units_done"] > 0,
+             desc="trial made progress")
+
+    # cannot archive or delete while live
+    from determined_clone_tpu.api.client import MasterError
+
+    with pytest.raises(MasterError):
+        session.archive_experiment(eid)
+    with pytest.raises(MasterError):
+        session.delete_experiment(eid)
+
+    paused = session.pause_experiment(eid)
+    assert paused["state"] == "PAUSED"
+    # the trial preempts gracefully: checkpoints, exits, parks
+    wait_for(lambda: session.get_experiment(eid)["trials"][0]["state"]
+             == "PAUSED", desc="trial paused")
+    trial = session.get_experiment(eid)["trials"][0]
+    assert 0 < trial["units_done"] < 30  # mid-run, progress persisted
+    assert trial["latest_checkpoint"]    # preemption checkpoint landed
+    # the chip is free again (no live allocation for this trial)
+    assert not any(j["id"].startswith(f"trial-{trial['id']}.")
+                   for j in session.job_queue())
+
+    # double-pause is a no-op error; activate resumes from the checkpoint
+    with pytest.raises(MasterError):
+        session.pause_experiment(eid)
+    activated = session.activate_experiment(eid)
+    assert activated["state"] == "RUNNING"
+    wait_for(lambda: session.get_experiment(eid)["experiment"]["state"]
+             == "COMPLETED", desc="completed after resume")
+    trial = session.get_experiment(eid)["trials"][0]
+    assert trial["units_done"] >= 30
+
+    # archive, then delete: records and checkpoints drop out
+    assert session.archive_experiment(eid)["archived"] is True
+    assert session.archive_experiment(eid, archive=False)[
+        "archived"] is False
+    assert session.get_experiment(eid)["experiment"]  # still queryable
+    session.delete_experiment(eid)
+    with pytest.raises(MasterError) as err:
+        session.get_experiment(eid)
+    assert err.value.status == 404

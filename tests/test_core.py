@@ -65,25 +65,37 @@ class TestDistributedContext:
             DistributedContext(rank=5, size=2)
 
     def test_tcp_transport(self):
-        # real sockets on localhost: chief + 2 workers
+        # real sockets on localhost: chief + 2 workers. Retried on fresh
+        # ports: a random port can collide with another process, and on
+        # this single-core box concurrent suites can starve the threads
+        # past any single attempt's timeout — only repeated hangs fail.
         import random
+        from concurrent.futures import TimeoutError as FutTimeout
 
-        port = random.randint(20000, 40000)
+        def attempt(port):
+            def fn(rank):
+                d = DistributedContext.from_tcp("127.0.0.1", port, rank, 3)
+                try:
+                    got = d.allgather(f"rank{rank}")
+                    bc = d.broadcast("hello" if rank == 0 else None)
+                    return got, bc
+                finally:
+                    d.close()
 
-        def fn(rank):
-            d = DistributedContext.from_tcp("127.0.0.1", port, rank, 3)
+            with ThreadPoolExecutor(max_workers=3) as pool:
+                futs = [pool.submit(fn, r) for r in range(3)]
+                return [f.result(timeout=120) for f in futs]
+
+        last_exc = None
+        for _ in range(3):
             try:
-                got = d.allgather(f"rank{rank}")
-                bc = d.broadcast("hello" if rank == 0 else None)
-                return got, bc
-            finally:
-                d.close()
-
-        with ThreadPoolExecutor(max_workers=3) as pool:
-            futs = [pool.submit(fn, r) for r in range(3)]
-            # generous timeout: the suite's XLA compiles can starve these
-            # threads on a loaded box; only a hang should fail this
-            results = [f.result(timeout=120) for f in futs]
+                results = attempt(random.randint(20000, 40000))
+                break
+            except (FutTimeout, OSError) as e:
+                last_exc = e
+        else:
+            raise AssertionError(
+                f"tcp transport failed 3 attempts: {last_exc!r}")
         for got, bc in results:
             assert got == ["rank0", "rank1", "rank2"]
             assert bc == "hello"
