@@ -17,7 +17,7 @@ the master's reverse proxy) when DCT_ALLOC_TOKEN is set.
 - ``tensorboard``: GET /data → metric history for the requested
                    experiments, fetched live from the master (the reference
                    TB task fetches tfevents from checkpoint storage;
-                   tfevents fetching is wired in tensorboard/fetchers)
+                   tfevents fetching is wired in tensorboard.manager.fetch_events)
 
 Usage (by the agent, argv built master-side in routes.cc "tasks"):
     python -m determined_clone_tpu.exec.task <mode> [--experiment-ids 1,2]
@@ -207,7 +207,7 @@ class TaskHandler(BaseHTTPRequestHandler):
             return
         if self.path.startswith("/scalars") and self.mode == "tensorboard":
             # tfevents fetched from checkpoint storage via the per-backend
-            # fetcher path (≈ tensorboard/fetchers/), then parsed locally
+            # fetcher path (≈ the reference tensorboard/fetchers/), parsed locally
             self._send(200, {"experiments":
                              fetch_tb_scalars(self.experiment_ids)})
             return

@@ -2,7 +2,7 @@
 
 ≈ harness/determined/tensorboard/base.py:22 (TensorboardManager: watches a
 local logdir, ships event files to the experiment's checkpoint storage) and
-the per-backend fetchers (tensorboard/fetchers/) that the `det tensorboard`
+the per-backend fetch path (fetch_events below) that the `det tensorboard`
 task uses to pull them back down. Both directions ride the StorageManager
 abstraction, so every backend (shared_fs/gcs/s3/directory) works unchanged.
 """
@@ -106,7 +106,7 @@ class TensorboardManager:
 def fetch_trial_events(storage_raw: Dict[str, Any], experiment_id: int,
                        trial_id: int, dst_dir: str) -> List[str]:
     """Download one trial's event files (the fetcher side,
-    tensorboard/fetchers/). Returns the fetched file paths."""
+    the reference's tensorboard/fetchers package). Returns the fetched file paths."""
     paths, _ = sync_trial_events(storage_raw, experiment_id, trial_id,
                                  dst_dir, prev_sizes=None)
     return paths

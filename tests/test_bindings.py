@@ -194,3 +194,30 @@ def test_stream_task_logs_pages(master):
     # the session-level generator flattens the same stream
     flat = list(master.stream_task_logs(task.id, page_size=10))
     assert [r["log"] for r in flat] == [f"line-{i}" for i in range(25)]
+
+
+def test_experiment_lifecycle_bindings(master):
+    """pause/activate/archive/delete ride the generated client."""
+    resp = b.create_experiment(master, b.V1CreateExperimentRequest(config={
+        "name": "bindings-lifecycle", "entrypoint": "x:Trial",
+        "searcher": {"name": "single", "metric": "loss",
+                     "max_length": {"batches": 1}},
+    }))
+    eid = resp.experiment.id
+    paused = b.pause_experiment(master, b.V1PauseExperimentRequest(id=eid))
+    assert paused.experiment.state == "PAUSED"
+    active = b.activate_experiment(master,
+                                   b.V1ActivateExperimentRequest(id=eid))
+    assert active.experiment.state == "RUNNING"
+    b.kill_experiment(master, b.V1KillExperimentRequest(id=eid))
+    archived = b.archive_experiment(master,
+                                    b.V1ArchiveExperimentRequest(id=eid))
+    assert archived.experiment.archived is True
+    unarchived = b.unarchive_experiment(
+        master, b.V1UnarchiveExperimentRequest(id=eid))
+    assert unarchived.experiment.archived is False
+    b.delete_experiment(master, b.V1DeleteExperimentRequest(id=eid))
+    from determined_clone_tpu.api.client import MasterError
+
+    with pytest.raises(MasterError):
+        b.get_experiment(master, b.V1GetExperimentRequest(id=eid))

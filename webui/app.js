@@ -278,7 +278,10 @@ function experimentTable(exps) {
   return `<table><tr><th>ID</th><th>Name</th><th>State</th><th>Owner</th>
     <th>Workspace</th></tr>
     ${exps.map((e) => `<tr class="rowlink" data-href="#/experiments/${e.id}">
-      <td>${e.id}</td><td>${esc(e.name)}</td><td>${stateBadge(e.state)}</td>
+      <td>${e.id}</td>
+      <td>${esc(e.name)}${e.archived
+          ? ` <span class="muted">(archived)</span>` : ""}</td>
+      <td>${stateBadge(e.state)}</td>
       <td>${esc(e.owner)}</td><td>${esc(e.workspace)}</td></tr>`).join("")}
   </table>`;
 }
@@ -299,10 +302,19 @@ async function viewExperimentDetail(id) {
   const exp = detail.experiment;
   const trials = detail.trials || [];
   const metric = (exp.config.searcher || {}).metric || "loss";
+  const live = ["RUNNING", "QUEUED", "PULLING", "PAUSED"].includes(exp.state);
+  const actions = [
+    exp.state === "RUNNING" ? `<button id="exp-pause">pause</button>` : "",
+    exp.state === "PAUSED" ? `<button id="exp-activate">resume</button>` : "",
+    live ? `<button id="exp-kill">kill</button>` : "",
+    !live ? `<button id="exp-archive">
+               ${exp.archived ? "unarchive" : "archive"}</button>
+             <button id="exp-delete">delete</button>` : "",
+  ].join(" ");
   $view.innerHTML = `
     <a class="backlink" href="#/experiments">← experiments</a>
     <h1>${esc(exp.name)} <span class="muted">#${exp.id}</span>
-      ${stateBadge(exp.state)}</h1>
+      ${stateBadge(exp.state)} <span class="actions">${actions}</span></h1>
     <div class="cards">
       ${card(trials.length, "trials")}
       ${card(detail.progress !== undefined
@@ -320,6 +332,27 @@ async function viewExperimentDetail(id) {
         <td>${t.restarts}</td>
         <td class="muted">${esc(JSON.stringify(t.hparams))}</td></tr>`).join("")}
     </table>`;
+
+  // lifecycle actions (≈ the reference experiment-detail header buttons)
+  for (const [btn, verb] of [["exp-pause", "pause"],
+                             ["exp-activate", "activate"],
+                             ["exp-kill", "kill"],
+                             ["exp-archive",
+                              exp.archived ? "unarchive" : "archive"]]) {
+    const el = document.getElementById(btn);
+    if (el) {
+      el.addEventListener("click", action(async () => {
+        await api("POST", `/api/v1/experiments/${id}/${verb}`);
+      }, () => viewExperimentDetail(id)));
+    }
+  }
+  const delBtn = document.getElementById("exp-delete");
+  if (delBtn) {
+    delBtn.addEventListener("click", action(async () => {
+      await api("DELETE", `/api/v1/experiments/${id}`);
+      location.hash = "#/experiments";
+    }, () => {}));
+  }
 
   // live metrics: searcher-metric series per trial (validation group),
   // fetched concurrently and reused for the training-loss fallback
