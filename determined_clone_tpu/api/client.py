@@ -320,10 +320,11 @@ class MasterSession:
         self.request("DELETE", f"/api/v1/templates/{_q(name)}")
 
     def create_webhook(self, url: str, triggers: Optional[list] = None,
-                       webhook_type: str = "default") -> Dict[str, Any]:
+                       webhook_type: str = "default",
+                       log_pattern: str = "") -> Dict[str, Any]:
         return self.post("/api/v1/webhooks", {
             "url": url, "triggers": triggers or [],
-            "webhook_type": webhook_type,
+            "webhook_type": webhook_type, "log_pattern": log_pattern,
         })["webhook"]
 
     # -- groups / rbac (≈ usergroup + rbac services) ------------------------

@@ -475,6 +475,47 @@ void Master::queue_trial_leg(Trial& trial) {
 }
 
 void Master::apply_log_policies(const Allocation& alloc, const Json& logs) {
+  // cluster-level log-pattern webhooks fire for ANY task's logs
+  // (≈ the reference's TRIGGER_TYPE_TASK_LOG webhooks)
+  for (const auto& [wid, hook] : webhooks_) {
+    if (hook.log_pattern.empty()) continue;
+    auto wit = webhook_pattern_cache_.find(wid);
+    if (wit == webhook_pattern_cache_.end()) {
+      try {
+        wit = webhook_pattern_cache_
+                  .emplace(wid, std::regex(hook.log_pattern)).first;
+      } catch (const std::regex_error&) {
+        continue;  // validated at creation; restored bad state stays inert
+      }
+    }
+    for (const auto& line : logs.elements()) {
+      // bound the matching input: this path runs for EVERY task's logs
+      // under the route lock, and std::regex backtracking is superlinear —
+      // a truncated prefix caps the worst case (and error_complexity must
+      // degrade to "no match", never 500 the whole log batch)
+      std::string subject = line.as_string().substr(0, 512);
+      bool hit = false;
+      try {
+        hit = std::regex_search(subject, wit->second);
+      } catch (const std::regex_error&) {
+      }
+      if (!hit) continue;
+      Json payload = Json::object();
+      if (hook.webhook_type == "slack") {
+        payload.set("text", "task " + alloc.id + " log matched '" +
+                                hook.log_pattern + "': " + subject);
+      } else {
+        payload.set("event", "task_log_pattern")
+            .set("allocation_id", alloc.id)
+            .set("trial_id", alloc.trial_id)
+            .set("pattern", hook.log_pattern)
+            .set("line", line.as_string());
+      }
+      post_webhook(hook, payload);
+      break;  // one firing per batch per hook, not per matching line
+    }
+  }
+
   if (alloc.trial_id == 0) return;
   auto tit = trials_.find(alloc.trial_id);
   if (tit == trials_.end()) return;
@@ -491,7 +532,11 @@ void Master::apply_log_policies(const Allocation& alloc, const Json& logs) {
     std::vector<CompiledLogPolicy> compiled;
     for (const auto& policy : policies.elements()) {
       const std::string& pattern = policy["pattern"].as_string();
-      const std::string& action = policy["action"]["type"].as_string();
+      // both spellings are valid config: "action": "cancel_retries" and
+      // the reference's {"type": "cancel_retries"} object form
+      std::string action = policy["action"].is_string()
+                               ? policy["action"].as_string()
+                               : policy["action"]["type"].as_string();
       if (pattern.empty()) continue;
       try {
         compiled.push_back({std::regex(pattern), pattern, action});

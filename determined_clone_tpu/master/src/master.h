@@ -162,6 +162,8 @@ class Master {
                       const std::string& owner);
   // fires matching webhooks for a terminal experiment (detached threads)
   void fire_webhooks(const Experiment& exp);
+  // POST a payload to one webhook's URL (detached thread, off the lock)
+  void post_webhook(const Webhook& hook, const Json& payload);
   // merges a named template under the config (throws on unknown template)
   Json resolve_template(const Json& config);
   // log-pattern policies on a shipped log batch (routes.cc):
@@ -244,6 +246,8 @@ class Master {
     std::string action;
   };
   std::map<int64_t, std::vector<CompiledLogPolicy>> log_policy_cache_;
+  // compiled log_pattern regexes per webhook id (lazy; not persisted)
+  std::map<int64_t, std::regex> webhook_pattern_cache_;
   bool dirty_ = false;
 };
 

@@ -264,13 +264,16 @@ struct Webhook {
   std::string webhook_type = "default";  // default | slack
   // triggers: experiment states that fire it (e.g. COMPLETED, ERRORED)
   std::vector<std::string> triggers;
+  // non-empty: also fires when a task-log line matches this regex
+  // (≈ the reference's TRIGGER_TYPE_TASK_LOG webhooks)
+  std::string log_pattern;
 
   Json to_json() const {
     Json ts = Json::array();
     for (const auto& t : triggers) ts.push_back(t);
     Json j = Json::object();
     j.set("id", id).set("url", url).set("webhook_type", webhook_type)
-        .set("triggers", ts);
+        .set("triggers", ts).set("log_pattern", log_pattern);
     return j;
   }
   static Webhook from_json(const Json& j) {
@@ -281,6 +284,7 @@ struct Webhook {
     for (const auto& t : j["triggers"].elements()) {
       w.triggers.push_back(t.as_string());
     }
+    w.log_pattern = j["log_pattern"].as_string();
     return w;
   }
 };

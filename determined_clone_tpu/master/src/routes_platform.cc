@@ -183,35 +183,43 @@ void Master::ensure_project(const std::string& name, int64_t workspace_id,
   dirty_ = true;
 }
 
+void Master::post_webhook(const Webhook& hook, const Json& payload) {
+  // parse http://host[:port][/path]
+  std::string url = hook.url;
+  const std::string scheme = "http://";
+  if (url.rfind(scheme, 0) == 0) url = url.substr(scheme.size());
+  std::string hostport = url, path = "/";
+  auto slash = url.find('/');
+  if (slash != std::string::npos) {
+    hostport = url.substr(0, slash);
+    path = url.substr(slash);
+  }
+  std::string host = hostport;
+  int port = 80;
+  auto colon = hostport.rfind(':');
+  if (colon != std::string::npos) {
+    host = hostport.substr(0, colon);
+    try {
+      port = std::stoi(hostport.substr(colon + 1));
+    } catch (const std::exception&) {
+      return;  // unparseable port: skip rather than POST to port 0
+    }
+  }
+  std::string body = payload.dump();
+  // fire-and-forget off the master lock (≈ shipper's async queue)
+  std::thread([host, port, path, body] {
+    http_request(host, port, "POST", path, body, 10);
+  }).detach();
+}
+
 void Master::fire_webhooks(const Experiment& exp) {
   const std::string state = to_string(exp.state);
   for (const auto& [id, hook] : webhooks_) {
-    bool match = hook.triggers.empty();
+    bool match = hook.triggers.empty() && hook.log_pattern.empty();
     for (const auto& t : hook.triggers) {
       if (t == state) match = true;
     }
     if (!match) continue;
-    // parse http://host[:port][/path]
-    std::string url = hook.url;
-    const std::string scheme = "http://";
-    if (url.rfind(scheme, 0) == 0) url = url.substr(scheme.size());
-    std::string hostport = url, path = "/";
-    auto slash = url.find('/');
-    if (slash != std::string::npos) {
-      hostport = url.substr(0, slash);
-      path = url.substr(slash);
-    }
-    std::string host = hostport;
-    int port = 80;
-    auto colon = hostport.rfind(':');
-    if (colon != std::string::npos) {
-      host = hostport.substr(0, colon);
-      try {
-        port = std::stoi(hostport.substr(colon + 1));
-      } catch (const std::exception&) {
-        continue;
-      }
-    }
     Json payload = Json::object();
     if (hook.webhook_type == "slack") {
       // ≈ webhooks/shipper.go slack formatting
@@ -224,11 +232,7 @@ void Master::fire_webhooks(const Experiment& exp) {
       payload.set("state", state);
       payload.set("workspace", exp.workspace);
     }
-    std::string body = payload.dump();
-    // fire-and-forget off the master lock (≈ shipper's async queue)
-    std::thread([host, port, path, body] {
-      http_request(host, port, "POST", path, body, 10);
-    }).detach();
+    post_webhook(hook, payload);
   }
 }
 
@@ -761,6 +765,15 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
       for (const auto& t : body["triggers"].elements()) {
         w.triggers.push_back(t.as_string());
       }
+      w.log_pattern = body["log_pattern"].as_string();
+      if (!w.log_pattern.empty()) {
+        try {
+          std::regex re(w.log_pattern);
+        } catch (const std::regex_error& e) {
+          return pbad("invalid log_pattern '" + w.log_pattern +
+                      "': " + e.what());
+        }
+      }
       webhooks_[w.id] = w;
       dirty_ = true;
       Json j = Json::object();
@@ -778,6 +791,7 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
         return pbad("bad webhook id");
       }
       if (!webhooks_.erase(wid)) return pnotfound("no webhook " + parts[3]);
+      webhook_pattern_cache_.erase(wid);
       dirty_ = true;
       return pok(Json::object());
     }

@@ -124,6 +124,18 @@ class ExperimentRef:
     def kill(self) -> None:
         self._session.kill_experiment(self.id)
 
+    def pause(self) -> None:
+        self._session.pause_experiment(self.id)
+
+    def activate(self) -> None:
+        self._session.activate_experiment(self.id)
+
+    def archive(self, archived: bool = True) -> None:
+        self._session.archive_experiment(self.id, archive=archived)
+
+    def delete(self) -> None:
+        self._session.delete_experiment(self.id)
+
     def trials(self) -> List[TrialRef]:
         return [TrialRef(self._session, t["id"])
                 for t in self.describe()["trials"]]

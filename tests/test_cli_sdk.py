@@ -181,6 +181,18 @@ def test_sdk_experiment_with_context_upload(cluster, tmp_path):
     top = exp.top_checkpoint()
     assert top is not None
 
+    # lifecycle surface: archive the finished experiment, then delete it
+    exp.archive()
+    assert exp.describe()["experiment"]["archived"] is True
+    exp.archive(archived=False)
+    exp.delete()
+    import pytest as _pytest
+
+    from determined_clone_tpu.api.client import MasterError
+
+    with _pytest.raises(MasterError):
+        exp.describe()
+
 
 def test_cli_full_surface(cluster, det, tmp_path, capsys):
     import yaml

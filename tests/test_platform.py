@@ -232,6 +232,52 @@ def test_webhook_fires_on_experiment_completion(master):
     assert received[0]["state"] == "CANCELED"
 
 
+def test_log_pattern_webhook_fires_on_matching_log(master):
+    """A webhook with a log_pattern fires on matching task-log lines
+    (≈ the reference's TRIGGER_TYPE_TASK_LOG webhooks)."""
+    session = master["session"]
+    received = []
+
+    class Hook(BaseHTTPRequestHandler):
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", "0"))
+            received.append(json.loads(self.rfile.read(length)))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    server = HTTPServer(("127.0.0.1", 0), Hook)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    hook_port = server.server_address[1]
+
+    from determined_clone_tpu.api.client import MasterError
+
+    with pytest.raises(MasterError):  # bad regex rejected at creation
+        session.create_webhook(f"http://127.0.0.1:{hook_port}/lp",
+                               log_pattern="CUDA [")
+    hook = session.create_webhook(f"http://127.0.0.1:{hook_port}/lp",
+                                  log_pattern=r"OOM|CUDA error")
+    assert hook["log_pattern"] == r"OOM|CUDA error"
+
+    task = session.create_task("command", cmd=["sleep", "1"], slots=0)
+    session.post(f"/api/v1/allocations/{task['id']}/logs",
+                 {"logs": ["all fine", "device OOM while allocating",
+                           "another OOM line"]})
+    deadline = time.time() + 10
+    while time.time() < deadline and not received:
+        time.sleep(0.2)
+    time.sleep(1.0)  # settle: a per-line double-fire must get time to land
+    server.shutdown()
+    assert received, "log-pattern webhook never fired"
+    assert received[0]["event"] == "task_log_pattern"
+    assert received[0]["allocation_id"] == task["id"]
+    assert "OOM" in received[0]["line"]
+    assert len(received) == 1  # one firing per batch, not per line
+    session.kill_task(task["id"])
+
+
 def test_auth_enforcement_and_persistence(tmp_path):
     """--auth-required master: anonymous writes are 401; sessions survive a
     master restart (snapshot persistence)."""
