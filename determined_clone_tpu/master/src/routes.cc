@@ -878,6 +878,18 @@ HttpResponse Master::route(const HttpRequest& req) {
     if (parts.size() == 4 && req.method == "GET") {
       Json j = Json::object();
       j.set("trial", trial.to_json());
+      // the newest allocation leg (log stream target; managed legs are
+      // trial-<id>.<leg>, unmanaged ones unmanaged-<id>.<leg> — clients
+      // should not reconstruct the naming)
+      std::string latest;
+      double latest_at = -1;
+      for (const auto& [aid, alloc] : allocations_) {
+        if (alloc.trial_id == id && alloc.queued_at > latest_at) {
+          latest = aid;
+          latest_at = alloc.queued_at;
+        }
+      }
+      j.set("latest_allocation", latest);
       return ok_json(j);
     }
     // unmanaged-trial heartbeat: liveness + client-driven completion

@@ -131,3 +131,11 @@ def test_admin_nav_and_view_shipped(master):
     js = body.decode()
     assert "viewAdmin" in js and "rbac/assignments" in js
     assert "job-queue/" in js  # queue operator actions wired
+
+
+def test_trial_logs_view_shipped(master):
+    _, _, body = fetch(master, "/ui/app.js")
+    js = body.decode()
+    assert "viewTrialLogs" in js
+    # the view derives the live leg's allocation id from trial.legs
+    assert "trial.legs" in js and "allocations/" in js

@@ -324,13 +324,15 @@ async function viewExperimentDetail(id) {
     <div id="chart"></div>
     <h2>Trials</h2>
     <table><tr><th>ID</th><th>State</th><th>Units</th>
-      <th>Best ${esc(metric)}</th><th>Restarts</th><th>Hparams</th></tr>
+      <th>Best ${esc(metric)}</th><th>Restarts</th><th>Hparams</th>
+      <th></th></tr>
       ${trials.map((t) => `<tr>
         <td>${t.id}</td><td>${stateBadge(t.state)}</td>
         <td>${t.units_done}/${t.target_units}</td>
         <td>${t.has_metric ? Number(t.best_metric).toPrecision(5) : "—"}</td>
         <td>${t.restarts}</td>
-        <td class="muted">${esc(JSON.stringify(t.hparams))}</td></tr>`).join("")}
+        <td class="muted">${esc(JSON.stringify(t.hparams))}</td>
+        <td><a href="#/trials/${t.id}/logs">logs</a></td></tr>`).join("")}
     </table>`;
 
   // lifecycle actions (≈ the reference experiment-detail header buttons)
@@ -401,13 +403,11 @@ async function viewTasks() {
 
 async function viewTaskLogs(id) {
   const gen = renderGen;
-  const [task, logs] = await Promise.all([
+  const [task, lines] = await Promise.all([
     api("GET", `/api/v1/tasks/${id}`),
-    api("GET", `/api/v1/allocations/${id}/logs?limit=2000`),
+    fetchLogLines(id),
   ]);
   if (gen !== renderGen) return;
-  const lines = logs.logs.map((r) =>
-      typeof r.log === "string" ? r.log : JSON.stringify(r.log));
   $view.innerHTML = `
     <a class="backlink" href="#/tasks">← tasks</a>
     <h1>${esc(task.task.name)} <span class="muted">${esc(id)}</span>
@@ -416,6 +416,40 @@ async function viewTaskLogs(id) {
     <pre class="logs">${esc(lines.join("\n")) || "no logs yet"}</pre>`;
   scheduleRefresh(() => viewTaskLogs(id),
                   ["RUNNING", "PULLING", "QUEUED"].includes(task.task.state));
+}
+
+async function fetchLogLines(allocId) {
+  const logs = await api(
+      "GET", `/api/v1/allocations/${allocId}/logs?limit=2000`);
+  return logs.logs.map((r) =>
+      typeof r.log === "string" ? r.log : JSON.stringify(r.log));
+}
+
+async function viewTrialLogs(id) {
+  const gen = renderGen;
+  const detail = await api("GET", `/api/v1/trials/${id}`);
+  if (gen !== renderGen) return;
+  const trial = detail.trial;
+  // the server names the live leg (managed and unmanaged legs differ)
+  const allocId = detail.latest_allocation ||
+      `trial-${trial.id}.${Math.max(0, (trial.legs || 1) - 1)}`;
+  let lines = [];
+  try {
+    lines = await fetchLogLines(allocId);
+  } catch (err) {
+    if (String(err.message) === "authentication required") throw err;
+    lines = [`(no logs for ${allocId}: ${err.message})`];
+  }
+  if (gen !== renderGen) return;
+  $view.innerHTML = `
+    <a class="backlink"
+       href="#/experiments/${trial.experiment_id}">← experiment
+       ${trial.experiment_id}</a>
+    <h1>Trial ${trial.id} logs <span class="muted">${esc(allocId)}</span>
+      ${stateBadge(trial.state)}</h1>
+    <pre class="logs">${esc(lines.join("\n")) || "no logs yet"}</pre>`;
+  scheduleRefresh(() => viewTrialLogs(id),
+                  ["RUNNING", "PULLING", "QUEUED"].includes(trial.state));
 }
 
 async function viewCluster() {
@@ -591,6 +625,8 @@ async function route() {
       await viewExperimentDetail(parts[1]);
     } else if (parts[0] === "experiments") {
       await viewExperiments();
+    } else if (parts[0] === "trials" && parts[1] && parts[2] === "logs") {
+      await viewTrialLogs(parts[1]);
     } else if (parts[0] === "tasks" && parts[1]) {
       await viewTaskLogs(parts.slice(1).join("/"));
     } else if (parts[0] === "tasks") {
