@@ -141,6 +141,9 @@ class MasterSession:
         return b.get_trial(self, b.V1GetTrialRequest(id=trial_id)
                            ).trial.to_json()
 
+    def kill_trial(self, trial_id: int) -> Dict[str, Any]:
+        return self.post(f"/api/v1/trials/{trial_id}/kill")["trial"]
+
     def trial_metrics(self, trial_id: int, limit: int = 1000) -> list:
         # raw dicts, not V1MetricsRecord: metric records carry arbitrary
         # harness-defined keys the typed message would drop

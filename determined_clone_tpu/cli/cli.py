@@ -177,6 +177,12 @@ def cmd_experiment_kill(args) -> int:
     return 0
 
 
+def cmd_trial_kill(args) -> int:
+    trial = make_session(args).kill_trial(args.trial_id)
+    print(f"Trial {trial['id']} is {trial['state']}")
+    return 0
+
+
 def cmd_trial_describe(args) -> int:
     print_json(make_session(args).get_trial(args.trial_id))
     return 0
@@ -630,6 +636,9 @@ def build_parser() -> argparse.ArgumentParser:
     c = st.add_parser("describe")
     c.add_argument("trial_id", type=int)
     c.set_defaults(func=cmd_trial_describe)
+    c = st.add_parser("kill")
+    c.add_argument("trial_id", type=int)
+    c.set_defaults(func=cmd_trial_kill)
     c = st.add_parser("metrics")
     c.add_argument("trial_id", type=int)
     c.add_argument("--limit", type=int, default=1000)

@@ -378,7 +378,10 @@ void Master::apply_search_ops(Experiment& exp, std::vector<SearchOp> ops) {
         if (tit == request_to_trial_[exp.id].end()) break;
         Trial& trial = trials_[tit->second];
         if (trial.state == RunState::Completed ||
-            trial.state == RunState::Errored) {
+            trial.state == RunState::Errored ||
+            trial.state == RunState::Canceled) {
+          // Canceled: killed via /trials/:id/kill — a straggling
+          // ValidateAfter must not resurrect it with a fresh leg
           break;
         }
         trial.target_units = op.units;
@@ -389,7 +392,10 @@ void Master::apply_search_ops(Experiment& exp, std::vector<SearchOp> ops) {
         auto tit = request_to_trial_[exp.id].find(op.request_id);
         if (tit == request_to_trial_[exp.id].end()) break;
         Trial& trial = trials_[tit->second];
-        if (trial.state != RunState::Errored) {
+        if (trial.state != RunState::Errored &&
+            trial.state != RunState::Canceled) {
+          // (a killed trial already told the searcher via exited_early —
+          // overwriting CANCELED with COMPLETED would double-account)
           bool was_terminal = trial.state == RunState::Completed;
           trial.state = RunState::Completed;
           trial.ended_at = now_sec();
@@ -775,7 +781,11 @@ void Master::on_task_done(const std::string& alloc_id, int exit_code,
   Trial& trial = tit->second;
   Experiment& exp = experiments_[trial.experiment_id];
 
-  if (trial.state == RunState::Completed || trial.state == RunState::Errored) {
+  if (trial.state == RunState::Completed ||
+      trial.state == RunState::Errored ||
+      trial.state == RunState::Canceled) {
+    // settled (incl. killed via /trials/:id/kill while its harness was
+    // still draining): no restart logic may resurrect it
     return;
   }
   if (failed && exp.state == RunState::Paused) {
@@ -807,18 +817,16 @@ void Master::on_task_done(const std::string& alloc_id, int exit_code,
       }
     }
   } else {
-    // clean exit: if the searcher has no outstanding target the trial pauses
-    if (trial.units_done >= trial.target_units &&
-        trial.state != RunState::Completed) {
+    // clean exit (the terminal-state early return above already settled
+    // completed/errored/killed trials)
+    if (trial.units_done >= trial.target_units) {
+      // the searcher has no outstanding target: the trial parks
       trial.state = RunState::Paused;
-    } else if (exp.state == RunState::Paused &&
-               trial.state != RunState::Completed) {
+    } else if (exp.state == RunState::Paused) {
       // preempted by an experiment pause: the trial parks too (activate
       // re-queues it from latest_checkpoint)
       trial.state = RunState::Paused;
-    } else if (exp.state == RunState::Running &&
-               trial.state != RunState::Completed &&
-               trial.units_done < trial.target_units) {
+    } else if (exp.state == RunState::Running) {
       // clean exit below target with the experiment live: a preemption
       // victim (priority eviction, or an activate racing the pause's
       // drain). Without a re-queue the trial would strand with no live

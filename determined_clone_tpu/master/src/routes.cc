@@ -892,6 +892,45 @@ HttpResponse Master::route(const HttpRequest& req) {
       j.set("latest_allocation", latest);
       return ok_json(j);
     }
+    // kill one trial without touching its experiment (≈ KillTrial): the
+    // searcher is told the trial exited early so HP search can continue
+    if (parts.size() == 5 && parts[4] == "kill" && req.method == "POST") {
+      User* caller = current_user(req);
+      bool own = caller && caller->username == exp.owner;
+      if (!own && !rbac_allows(req, role_rank("Editor"),
+                               workspace_id_by_name(exp.workspace))) {
+        return HttpResponse::json(
+            403, error_json("Editor role required in workspace " +
+                            exp.workspace).dump());
+      }
+      bool terminal = trial.state == RunState::Completed ||
+                      trial.state == RunState::Errored ||
+                      trial.state == RunState::Canceled;
+      if (!terminal) {
+        for (auto& [aid, alloc] : allocations_) {
+          if (alloc.trial_id != id) continue;
+          if (alloc.state == RunState::Queued ||
+              alloc.state == RunState::Pulling) {
+            alloc.state = RunState::Canceled;
+            alloc.reservations.clear();
+          } else if (alloc.state == RunState::Running) {
+            // graceful: the harness checkpoints and exits; the Canceled
+            // trial state below keeps on_task_done from re-queuing
+            alloc.preempt_requested = true;
+          }
+        }
+        trial.state = RunState::Canceled;
+        trial.ended_at = now_sec();
+        if (exp.state == RunState::Running) {
+          apply_search_ops(
+              exp, method_for(exp)->on_trial_exited_early(trial.request_id));
+        }
+        dirty_ = true;
+      }
+      Json j = Json::object();
+      j.set("trial", trial.to_json());
+      return ok_json(j);
+    }
     // unmanaged-trial heartbeat: liveness + client-driven completion
     // (≈ harness/determined/core/_heartbeat.py:15 + unmanaged experiment
     // close semantics; the response carries the preempt flag so the client
@@ -1025,6 +1064,14 @@ HttpResponse Master::route(const HttpRequest& req) {
         return ok_json(j);
       }
       if (parts[5] == "completed_op" && req.method == "POST") {
+        if (trial.state == RunState::Canceled) {
+          // a killed trial's draining harness may still report its last
+          // op — the searcher was already told it exited early; accepting
+          // this would double-account (and could spawn successor trials)
+          Json j = Json::object();
+          j.set("trial", trial.to_json());
+          return ok_json(j);
+        }
         Json body = Json::parse(req.body);
         double metric = body["metric"].as_number();
         int64_t units = body["units"].as_int(trial.target_units);

@@ -285,3 +285,32 @@ def test_pause_activate_archive_delete(cluster):
     with pytest.raises(MasterError) as err:
         session.get_experiment(eid)
     assert err.value.status == 404
+
+
+def test_kill_single_trial_search_continues(cluster):
+    """≈ KillTrial: killing one trial of a random search cancels only that
+    trial; the searcher is told it exited early and the experiment still
+    finishes."""
+    session = cluster["session"]
+    exp = session.create_experiment(exp_config(
+        cluster, {"name": "random", "metric": "loss", "max_trials": 3,
+                  "max_length": {"batches": 4}},
+        hparams={"lr": {"type": "double", "minval": 0.05, "maxval": 0.3}},
+        name="trial-kill"))
+    eid = exp["id"]
+    trials = wait_for(lambda: session.get_experiment(eid)["trials"] or None,
+                      desc="trials created")
+    victim = trials[0]["id"]
+    killed = session.kill_trial(victim)
+    # fast trials can finish before the kill lands; non-terminal ones cancel
+    assert killed["state"] in ("CANCELED", "COMPLETED")
+
+    # the experiment completes with the remaining trials either way
+    wait_for(lambda: session.get_experiment(eid)["experiment"]["state"]
+             == "COMPLETED", desc="search completed despite the kill")
+    final = {t["id"]: t["state"]
+             for t in session.get_experiment(eid)["trials"]}
+    assert final[victim] == killed["state"]  # the kill's outcome held
+    assert sum(1 for s in final.values() if s == "COMPLETED") >= 2
+    # a second kill is an idempotent no-op
+    assert session.kill_trial(victim)["state"] == killed["state"]
