@@ -141,6 +141,21 @@ class MasterSession:
         return b.get_trial(self, b.V1GetTrialRequest(id=trial_id)
                            ).trial.to_json()
 
+    def trial_log_allocations(self, trial_id: int) -> list:
+        """All of a trial's allocation leg ids, oldest first — the server
+        names legs (trial-<id>.<leg> managed, unmanaged-<id>.<leg>
+        unmanaged), so clients never reconstruct the scheme."""
+        b = _b()
+        resp = b.get_trial(self, b.V1GetTrialRequest(id=trial_id))
+        latest = resp.latest_allocation
+        trial = resp.trial.to_json()
+        legs = int(trial.get("legs") or
+                   int(trial.get("restarts", 0)) + 1)
+        if not latest:
+            return [f"trial-{trial_id}.{i}" for i in range(legs)]
+        prefix = latest.rsplit(".", 1)[0]
+        return [f"{prefix}.{i}" for i in range(max(legs, 1))]
+
     def kill_trial(self, trial_id: int) -> Dict[str, Any]:
         return self.post(f"/api/v1/trials/{trial_id}/kill")["trial"]
 

@@ -137,7 +137,10 @@ def cmd_experiment_create(args) -> int:
 
 def cmd_experiment_list(args) -> int:
     exps = make_session(args).list_experiments()
-    print_table(exps, ["id", "name", "state", "owner", "workspace", "project"])
+    if not args.show_archived:
+        exps = [e for e in exps if not e.get("archived")]
+    print_table(exps, ["id", "name", "state", "archived", "owner",
+                       "workspace", "project"])
     return 0
 
 
@@ -195,9 +198,8 @@ def cmd_trial_metrics(args) -> int:
 
 def cmd_trial_logs(args) -> int:
     session = make_session(args)
-    trial = session.get_trial(args.trial_id)
-    for attempt in range(int(trial.get("restarts", 0)) + 1):
-        for rec in session.task_logs(f"trial-{args.trial_id}.{attempt}"):
+    for alloc_id in session.trial_log_allocations(args.trial_id):
+        for rec in session.task_logs(alloc_id):
             print(rec.get("log", ""))
     return 0
 
@@ -612,6 +614,8 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--timeout", type=float, default=3600)
     c.set_defaults(func=cmd_experiment_create)
     c = se.add_parser("list")
+    c.add_argument("--show-archived", action="store_true",
+                   help="include archived experiments")
     c.set_defaults(func=cmd_experiment_list)
     c = se.add_parser("describe")
     c.add_argument("experiment_id", type=int)

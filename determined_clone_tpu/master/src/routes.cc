@@ -921,9 +921,22 @@ HttpResponse Master::route(const HttpRequest& req) {
         }
         trial.state = RunState::Canceled;
         trial.ended_at = now_sec();
-        if (exp.state == RunState::Running) {
-          apply_search_ops(
-              exp, method_for(exp)->on_trial_exited_early(trial.request_id));
+        // the searcher must hear about the exit even mid-pause, or a
+        // random/ASHA search can never reach its trial count and the
+        // experiment stalls RUNNING forever after activate
+        if (exp.state == RunState::Running ||
+            exp.state == RunState::Paused) {
+          auto ops = method_for(exp)->on_trial_exited_early(trial.request_id);
+          for (auto& op : ops) {
+            if (op.kind == SearchOp::Kind::Shutdown && op.failure) {
+              // the searcher is giving up because its trial died — but the
+              // cause was a USER cancel, not a failure: the experiment
+              // ends CANCELED (like experiment kill), not ERRORED
+              op.failure = false;
+              op.cancel = true;
+            }
+          }
+          apply_search_ops(exp, std::move(ops));
         }
         dirty_ = true;
       }

@@ -56,15 +56,16 @@ class TrialRef:
     def describe(self) -> Dict[str, Any]:
         return self._session.get_trial(self.id)
 
+    def kill(self) -> Dict[str, Any]:
+        return self._session.kill_trial(self.id)
+
     def metrics(self, limit: int = 1000) -> List[Dict[str, Any]]:
         return self._session.trial_metrics(self.id, limit)
 
     def logs(self, limit: int = 1000) -> List[Dict[str, Any]]:
-        trial = self.describe()
         out: List[Dict[str, Any]] = []
-        for attempt in range(int(trial.get("restarts", 0)) + 1):
-            out.extend(self._session.task_logs(
-                f"trial-{self.id}.{attempt}", limit))
+        for alloc_id in self._session.trial_log_allocations(self.id):
+            out.extend(self._session.task_logs(alloc_id, limit))
         return out
 
     def checkpoints(self) -> List["CheckpointRef"]:

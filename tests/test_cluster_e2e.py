@@ -314,3 +314,23 @@ def test_kill_single_trial_search_continues(cluster):
     assert sum(1 for s in final.values() if s == "COMPLETED") >= 2
     # a second kill is an idempotent no-op
     assert session.kill_trial(victim)["state"] == killed["state"]
+
+
+def test_kill_only_trial_cancels_experiment(cluster):
+    """Killing a single-searcher experiment's only trial is a user cancel:
+    the experiment ends CANCELED (like experiment kill), never ERRORED."""
+    session = cluster["session"]
+    exp = session.create_experiment(exp_config(cluster, {
+        "name": "single", "metric": "loss",
+        "max_length": {"batches": 10_000},
+    }, name="kill-only-trial"))
+    trials = wait_for(lambda: session.get_experiment(exp["id"])["trials"]
+                      or None, desc="trial created")
+    session.kill_trial(trials[0]["id"])
+    detail = wait_for(
+        lambda: (lambda d: d if d["experiment"]["state"] in
+                 ("CANCELED", "ERRORED", "COMPLETED") else None)(
+            session.get_experiment(exp["id"])),
+        desc="experiment settled", timeout=60)
+    assert detail["experiment"]["state"] == "CANCELED"
+    assert detail["trials"][0]["state"] == "CANCELED"
