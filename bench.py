@@ -2,18 +2,35 @@
 
 North-star metric from BASELINE.md: trial throughput in samples/sec/chip with
 loss parity for the GPT + mnist baseline configs. The reference publishes no
-absolute numbers (BASELINE.json ``published: {}``), so ``vs_baseline`` is
-reported against 1.0 until a reference measurement exists; ``detail.mfu``
-gives the absolute utilization story (6·N·tokens/sec over v5e bf16 peak).
+absolute numbers (BASELINE.json ``published: {}``), so on TPU ``vs_baseline``
+is reported against the single-chip parity bar of 0.35 MFU (the
+matching-or-beating threshold for a v5e flash path); on the CPU fallback it
+stays 1.0 because no baseline exists for that platform.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 
-Never hangs and never exits non-zero: the measurement runs in a child process
-under a wall-clock budget — the axon TPU tunnel's backend init failed outright
-in round 1 (BENCH_r01: UNAVAILABLE) and blocked past the driver timeout in
-round 2 (BENCH_r02: rc 124) — and on child timeout/failure the parent reruns
-on a steered CPU backend. As a last resort it emits the JSON line with the
-errors recorded.
+Designed around a flaky TPU tunnel (axon): backend init failed outright in
+round 1, blocked past the driver timeout in round 2, and timed out a single
+cold 300 s attempt in round 3. The engineering answer, in order:
+
+1. **Persistent compilation cache** — ``JAX_COMPILATION_CACHE_DIR`` points at
+   a repo-local ``.jax_cache/`` so a warm round (or a retried rung) reuses
+   compiles instead of paying 20-40 s again.
+2. **Probe-then-commit** — the child prints a probe line as soon as
+   ``jax.devices()`` + one tiny jit succeed. If that line does not appear
+   within ``DCT_BENCH_PROBE_BUDGET_S`` (default 75 s) the parent kills the
+   child and falls back to CPU rather than burning the whole budget on a dead
+   tunnel.
+3. **Ascending config ladder** — the child runs 2-layer -> 4-layer ->
+   GPT-2-small, emitting a complete result JSON line after EACH rung. The
+   parent enforces the global deadline and keeps the LAST completed rung, so a
+   slow tunnel still lands *some* real-TPU number instead of nothing.
+4. **CPU fallback** is the last resort, with the TPU error recorded.
+
+Never hangs and never exits non-zero: the child runs in its own session and
+the whole process group is killed on timeout (the axon sitecustomize spawns
+tunnel helpers that inherit the stdio pipes and would otherwise block the
+parent's drain forever).
 """
 from __future__ import annotations
 
@@ -21,7 +38,10 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 
 # Per-chip bf16 peak FLOP/s by TPU generation (axon exposes the grant's
 # generation via PALLAS_AXON_TPU_GEN; default v5e).
@@ -32,6 +52,9 @@ TPU_PEAK_BF16_FLOPS = {
     "v6e": 918e12,
 }
 
+# The single-chip "matching-or-beating" bar: 0.35 MFU on the v5e flash path.
+MFU_BASELINE_BAR = 0.35
+
 
 def _budget(name: str, default: float) -> float:
     try:
@@ -41,15 +64,26 @@ def _budget(name: str, default: float) -> float:
 
 
 TPU_BUDGET_S = _budget("DCT_BENCH_TPU_BUDGET_S", 300.0)
+PROBE_BUDGET_S = _budget("DCT_BENCH_PROBE_BUDGET_S", 75.0)
 CPU_BUDGET_S = _budget("DCT_BENCH_CPU_BUDGET_S", 180.0)
 
 
 # --------------------------------------------------------------------------
-# Child: the actual measurement (runs under the parent's wall-clock budget).
+# Child: probe, then the ascending measurement ladder.
 # --------------------------------------------------------------------------
 
+def _emit(obj: dict) -> None:
+    print(json.dumps(obj), flush=True)
+
+
 def _run_child() -> None:
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, REPO_ROOT)
+    t_start = time.perf_counter()
+    deadline = float(os.environ.get("DCT_BENCH_CHILD_DEADLINE", "0")) or None
+
+    def remaining() -> float:
+        return (deadline - time.monotonic()) if deadline else 1e9
+
     import jax
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -68,23 +102,24 @@ def _run_child() -> None:
 
     device = jax.devices()[0]
     on_tpu = device.platform != "cpu"
+    t_init = time.perf_counter() - t_start
+    _emit({"probe": device.platform, "init_s": round(t_init, 1)})
 
-    def time_gpt(attention_impl: str, timed_steps: int) -> dict:
-        if on_tpu:
-            # GPT-2-small-ish: saturates a v5e chip's MXU at bf16.
-            cfg = gpt.GPTConfig(
-                vocab_size=50304, n_layers=12, d_model=768, n_heads=12,
-                d_ff=3072, max_seq_len=1024, remat=True,
-                attention_impl=attention_impl,
-            )
-            batch, seq = 8, 1024
-        else:
-            cfg = gpt.GPTConfig(
-                vocab_size=512, n_layers=2, d_model=128, n_heads=4,
-                d_ff=512, max_seq_len=128, remat=False,
-                attention_impl=attention_impl,
-            )
-            batch, seq = 4, 128
+    # One tiny jit through the real backend proves the tunnel executes, not
+    # just enumerates. Value fetch is the only reliable barrier under axon.
+    # f32 keeps the expected value exact: (x @ x).sum() with x = 2s is
+    # 8*8 * (2*2*8) = 2048.
+    x = jnp.full((8, 8), 2.0, jnp.float32)
+    jit_ok = float(jax.jit(lambda a: (a @ a).sum())(x)) == 2048.0
+    _emit({"probe_jit_ok": jit_ok,
+           "probe_s": round(time.perf_counter() - t_start, 1)})
+    if not jit_ok:
+        # A backend that returns wrong values must not publish numbers;
+        # exiting non-zero hands the parent to the CPU fallback.
+        sys.exit(3)
+
+    def time_gpt(cfg: gpt.GPTConfig, batch: int, seq: int,
+                 timed_steps: int) -> dict:
         params = gpt.init(jax.random.PRNGKey(0), cfg)
         tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
         state = create_train_state(params, tx, jax.random.PRNGKey(1))
@@ -141,55 +176,129 @@ def _run_child() -> None:
         return {"samples_per_sec": round(batch * timed_steps / dt, 1),
                 "batch": batch}
 
-    gpt_steps = 10 if on_tpu else 2
-    flash = time_gpt("flash", gpt_steps)   # flagship path: Pallas kernel
-    mha = time_gpt("mha", gpt_steps)       # plain-XLA attention for the delta
-    mnist = time_mnist(20 if on_tpu else 3)
+    def gpt_cfg(n_layers: int, d_model: int, n_heads: int, seq: int,
+                attention_impl: str, vocab: int = 50304,
+                remat: bool = True) -> gpt.GPTConfig:
+        return gpt.GPTConfig(
+            vocab_size=vocab, n_layers=n_layers, d_model=d_model,
+            n_heads=n_heads, d_ff=4 * d_model, max_seq_len=seq,
+            remat=remat, attention_impl=attention_impl)
 
-    n_params = flash["model_params"]
+    if on_tpu:
+        # Ascending ladder: bank a small number fast, then climb. Each rung
+        # emits a full result line; the parent keeps the last one. min_s is
+        # the floor of remaining budget needed to even start the rung
+        # (compile dominates; the persistent cache shrinks warm rounds).
+        ladder = [
+            {"name": "gpt-2L", "layers": 2, "d": 256, "heads": 4,
+             "seq": 512, "batch": 8, "steps": 10, "min_s": 25.0},
+            {"name": "gpt-4L", "layers": 4, "d": 512, "heads": 8,
+             "seq": 1024, "batch": 8, "steps": 10, "min_s": 40.0},
+            {"name": "gpt2-small", "layers": 12, "d": 768, "heads": 12,
+             "seq": 1024, "batch": 8, "steps": 10, "min_s": 60.0},
+        ]
+    else:
+        ladder = [
+            {"name": "gpt-tiny-cpu", "layers": 2, "d": 128, "heads": 4,
+             "seq": 128, "batch": 4, "steps": 2, "min_s": 0.0,
+             "vocab": 512},
+        ]
+
     tpu_gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
     peak = TPU_PEAK_BF16_FLOPS.get(tpu_gen, TPU_PEAK_BF16_FLOPS["v5e"])
-    mfu = (6.0 * n_params * flash["tokens_per_sec"] / peak
-           if on_tpu else None)
 
-    print(json.dumps({
-        "metric": "gpt_train_throughput",
-        "value": round(flash["samples_per_sec"], 3),
-        "unit": "samples/sec/chip",
-        "vs_baseline": 1.0,
-        "detail": {
-            "platform": device.platform,
-            "attention_impl": "flash",
-            "model_params": n_params,
-            "batch": flash["batch"],
-            "seq_len": flash["seq_len"],
-            "tokens_per_sec": round(flash["tokens_per_sec"], 1),
-            "mfu": round(mfu, 4) if mfu is not None else None,
-            "mfu_peak_assumed": f"{tpu_gen}:{peak:.0f}" if on_tpu else None,
-            "final_loss": flash["final_loss"],
-            "mha_samples_per_sec": round(mha["samples_per_sec"], 3),
-            "flash_over_mha": round(
-                flash["samples_per_sec"] / mha["samples_per_sec"], 3),
-            "mnist_cnn": mnist,
-        },
-    }))
+    mnist = None
+    flash_over_mha = None
+    mha_sps = None
+    mha_rung = None
+    for i, rung in enumerate(ladder):
+        if remaining() < rung["min_s"]:
+            _emit({"skipped_rung": rung["name"],
+                   "remaining_s": round(remaining(), 1)})
+            break
+        vocab = rung.get("vocab", 50304)
+        cfg_flash = gpt_cfg(rung["layers"], rung["d"], rung["heads"],
+                            rung["seq"], "flash", vocab=vocab,
+                            remat=on_tpu)
+        flash = time_gpt(cfg_flash, rung["batch"], rung["seq"], rung["steps"])
+
+        n_params = flash["model_params"]
+        mfu = (6.0 * n_params * flash["tokens_per_sec"] / peak
+               if on_tpu else None)
+        # Loss sanity band: finite and no worse than uniform over the vocab
+        # (+5% headroom) after the warmup+timed steps from random init.
+        import math
+        loss_ok = (math.isfinite(flash["final_loss"])
+                   and flash["final_loss"] < 1.05 * math.log(vocab))
+
+        def result_line() -> dict:
+            return {
+                "metric": "gpt_train_throughput",
+                "value": round(flash["samples_per_sec"], 3),
+                "unit": "samples/sec/chip",
+                "vs_baseline": (round(mfu / MFU_BASELINE_BAR, 3)
+                                if mfu is not None else 1.0),
+                "detail": {
+                    "platform": device.platform,
+                    "config": rung["name"],
+                    "attention_impl": "flash",
+                    "model_params": n_params,
+                    "batch": flash["batch"],
+                    "seq_len": flash["seq_len"],
+                    "tokens_per_sec": round(flash["tokens_per_sec"], 1),
+                    "mfu": round(mfu, 4) if mfu is not None else None,
+                    "mfu_peak_assumed": (f"{tpu_gen}:{peak:.0f}"
+                                         if on_tpu else None),
+                    "final_loss": flash["final_loss"],
+                    "loss_ok": loss_ok,
+                    "mha_samples_per_sec": mha_sps,
+                    "flash_over_mha": flash_over_mha,
+                    "mha_config": mha_rung,  # rung the delta was measured on
+                    "mnist_cnn": mnist,
+                    "init_s": round(t_init, 1),
+                },
+            }
+
+        # Bank the flash number IMMEDIATELY: if the budget expires during
+        # the mha/mnist extras below, the parent still has this rung.
+        _emit(result_line())
+
+        # The mha delta and mnist numbers are cheap on the first rung; on
+        # later rungs only re-measure mha if budget clearly allows.
+        if i == 0 or remaining() > 2 * rung["min_s"]:
+            import dataclasses
+            cfg_mha = dataclasses.replace(cfg_flash, attention_impl="mha")
+            mha = time_gpt(cfg_mha, rung["batch"], rung["seq"],
+                           rung["steps"])
+            mha_sps = round(mha["samples_per_sec"], 3)
+            flash_over_mha = round(
+                flash["samples_per_sec"] / mha["samples_per_sec"], 3)
+            mha_rung = rung["name"]
+        if mnist is None and (i == 0 or remaining() > 30):
+            mnist = time_mnist(20 if on_tpu else 3)
+
+        # Re-emit enriched with the extras; the parent keeps the last line.
+        _emit(result_line())
 
 
 # --------------------------------------------------------------------------
 # Parent: bounded attempts, guaranteed single JSON line, exit 0.
 # --------------------------------------------------------------------------
 
-def _attempt(env: dict, budget: float) -> tuple:
-    """Run the child under ``budget`` seconds; return (json_obj, error).
+def _attempt(env: dict, budget: float, probe_budget: float | None) -> tuple:
+    """Run the child under ``budget`` seconds; return (result, error).
 
-    Runs the child in its own session and kills the whole process group on
-    timeout: the axon sitecustomize can spawn tunnel helper processes that
-    inherit the stdout/stderr pipes, and ``subprocess.run``'s post-kill
-    ``communicate()`` has no timeout — it would block on those orphaned pipe
-    holders forever, defeating the never-hangs contract.
+    The child streams JSON lines; the last dict with a "metric" key wins.
+    If ``probe_budget`` is set and no probe line appears within it, the child
+    is killed early (dead-tunnel detection). Runs the child in its own
+    session and kills the whole process group on timeout: the axon
+    sitecustomize can spawn tunnel helper processes that inherit the pipes
+    and would otherwise hold them open forever.
     """
     import signal
 
+    env = dict(env)
+    env["DCT_BENCH_CHILD_DEADLINE"] = str(time.monotonic() + budget)
     try:
         proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--child"],
@@ -198,44 +307,117 @@ def _attempt(env: dict, budget: float) -> tuple:
         )
     except Exception as exc:  # noqa: BLE001 - must never crash the parent
         return None, f"spawn failed: {exc!r}"
-    try:
-        stdout, stderr = proc.communicate(timeout=budget)
-    except subprocess.TimeoutExpired:
+
+    lines: list[dict] = []
+    stderr_tail: list[str] = []
+    probe_seen = threading.Event()
+
+    def _reader() -> None:
+        try:
+            for line in proc.stdout:
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(obj, dict):
+                    lines.append(obj)
+                    # Device enumeration alone is not proof of life — the
+                    # known tunnel hang is at *execution* — so only the
+                    # post-jit line (or a full result) clears the probe.
+                    if "probe_jit_ok" in obj or "metric" in obj:
+                        probe_seen.set()
+        except Exception:  # noqa: BLE001 - pipe may die with the child
+            pass
+
+    def _stderr_reader() -> None:
+        # Drain continuously: a chatty child (JAX warnings, tracebacks)
+        # would otherwise block on a full 64 KB pipe mid-ladder.
+        try:
+            for line in proc.stderr:
+                stderr_tail.append(line)
+                del stderr_tail[:-50]
+        except Exception:  # noqa: BLE001
+            pass
+
+    reader = threading.Thread(target=_reader, daemon=True)
+    reader.start()
+    err_reader = threading.Thread(target=_stderr_reader, daemon=True)
+    err_reader.start()
+    t0 = time.monotonic()
+    timed_out = None
+    while True:
+        if proc.poll() is not None:
+            break
+        elapsed = time.monotonic() - t0
+        if probe_budget and not probe_seen.is_set() and elapsed > probe_budget:
+            # Distinguish the two tunnel failure modes: enumeration never
+            # returned vs devices listed but the probe jit never executed.
+            enum = next((o for o in lines if "probe" in o), None)
+            if enum is not None:
+                timed_out = (f"probe timeout: devices enumerated in "
+                             f"{enum.get('init_s')}s but probe jit never "
+                             f"completed within {probe_budget:.0f}s")
+            else:
+                timed_out = (f"probe timeout: no devices after "
+                             f"{probe_budget:.0f}s")
+            break
+        if elapsed > budget:
+            timed_out = f"timeout after {budget:.0f}s"
+            break
+        time.sleep(0.5)
+    if timed_out:
         try:
             os.killpg(proc.pid, signal.SIGKILL)
         except Exception:  # noqa: BLE001
             proc.kill()
-        try:  # bounded drain; abandon pipes still held by orphans
-            proc.communicate(timeout=10)
-        except Exception:  # noqa: BLE001
-            pass
-        return None, f"timeout after {budget:.0f}s"
+    reader.join(timeout=10)
+    err_reader.join(timeout=10)  # bounded: orphaned pipe holders are
+    stderr = "".join(stderr_tail)  # abandoned, the threads are daemons
+    try:
+        proc.wait(timeout=10)
+    except Exception:  # noqa: BLE001
+        pass
+
+    results = [o for o in lines if "metric" in o]
+    if results:
+        best = results[-1]  # last completed rung = largest model measured
+        if timed_out:
+            best.setdefault("detail", {})["budget_note"] = timed_out
+        best.setdefault("detail", {})["rungs_completed"] = len(
+            {o.get("detail", {}).get("config") for o in results})
+        return best, None
+    if timed_out:
+        return None, timed_out
     if proc.returncode != 0:
         return None, f"rc={proc.returncode}: {stderr.strip()[-400:]}"
-    for line in reversed(stdout.strip().splitlines()):
-        try:
-            obj = json.loads(line)
-        except ValueError:
-            continue
-        if isinstance(obj, dict) and "metric" in obj:
-            return obj, None
     return None, "child produced no JSON line"
 
 
 def main() -> None:
+    # Persistent compilation cache: a warm round (or a same-config retry)
+    # skips the 20-40 s XLA compile that ate round 3's budget.
+    cache_dir = os.path.join(REPO_ROOT, ".jax_cache")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError:
+        cache_dir = None
+
     errors = {}
     env = dict(os.environ)
+    if cache_dir:
+        env.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+        env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
     if env.get("JAX_PLATFORMS", "") != "cpu":
-        obj, err = _attempt(env, TPU_BUDGET_S)
+        obj, err = _attempt(env, TPU_BUDGET_S, PROBE_BUDGET_S)
         if obj is not None:
             print(json.dumps(obj))
             return
         errors["tpu"] = err
 
-    cpu_env = dict(os.environ)
+    cpu_env = dict(env)
     cpu_env.pop("PALLAS_AXON_POOL_IPS", None)
     cpu_env["JAX_PLATFORMS"] = "cpu"
-    obj, err = _attempt(cpu_env, CPU_BUDGET_S)
+    obj, err = _attempt(cpu_env, CPU_BUDGET_S, None)
     if obj is not None:
         if errors:
             obj.setdefault("detail", {})["tpu_error"] = errors.get("tpu")
