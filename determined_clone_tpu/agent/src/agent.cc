@@ -13,6 +13,7 @@
 //   2. /dev/accel* device files (TPU VM runtime)
 //   3. fallback: 0 chips (cpu-only agent, zero-slot aux tasks)
 #include <dirent.h>
+#include <fcntl.h>
 #include <signal.h>
 #include <sys/prctl.h>
 #include <sys/stat.h>
@@ -94,6 +95,9 @@ struct RunningTask {
                           // code comes from the supervisor's exit file
   int dead_polls = 0;     // adopted: polls since the task vanished (grace
                           // for the supervisor's exit-file write)
+  std::string alloc_token;  // data-plane credential: log shipping must
+                            // authenticate under --auth-required (kept
+                            // last: positional inits predate the field)
 };
 
 bool pid_alive(pid_t pid) {
@@ -462,7 +466,8 @@ class Agent {
     ::close(pipefd[0]);
     if (sup > 0) {
       tasks_[alloc_id] = RunningTask{sup, task_pid, alloc_id, log_path,
-                                     false, false};
+                                     false, false, 0, ""};
+      tasks_[alloc_id].alloc_token = cmd["alloc_token"].as_string();
       persist_state();
       send_event(alloc_id, "running", 0, "");
       std::cerr << "[agent] started " << alloc_id << " supervisor=" << sup
@@ -492,7 +497,8 @@ class Agent {
       exec_task_child(cmd, alloc_id, log_path, run_dir);
     }
     if (pid > 0) {
-      tasks_[alloc_id] = RunningTask{pid, 0, alloc_id, log_path, false, false};
+      tasks_[alloc_id] = RunningTask{pid, 0, alloc_id, log_path, false, false, 0, ""};
+      tasks_[alloc_id].alloc_token = cmd["alloc_token"].as_string();
       send_event(alloc_id, "running", 0, "");
       std::cerr << "[agent] started " << alloc_id << " pid=" << pid << std::endl;
     }
@@ -558,7 +564,16 @@ class Agent {
       if (alive) {
         tasks_[alloc_id] = RunningTask{sup, task, alloc_id,
                                        t["log_path"].as_string(), false,
-                                       true};
+                                       true, 0, ""};
+        tasks_[alloc_id].alloc_token = t["alloc_token"].as_string();
+        if (tasks_[alloc_id].alloc_token.empty()) {
+          // pre-upgrade state file: under --auth-required the master will
+          // 401 this task's log batches — say so rather than losing them
+          std::cerr << "[agent] WARNING: reattached " << alloc_id
+                    << " without an alloc token (pre-upgrade state file); "
+                    << "log shipping will fail if the master requires auth"
+                    << std::endl;
+        }
         std::cerr << "[agent] reattached " << alloc_id << " task=" << task
                   << std::endl;
         continue;
@@ -572,8 +587,10 @@ class Agent {
         ef >> exit_code;
         error = exit_code ? "task failed" : "";
       }
-      ship_logs(RunningTask{0, 0, alloc_id, t["log_path"].as_string(),
-                            false, false});
+      RunningTask lost{0, 0, alloc_id, t["log_path"].as_string(),
+                       false, false, 0, ""};
+      lost.alloc_token = t["alloc_token"].as_string();
+      ship_logs(lost);
       Json rec = Json::object();
       rec.set("allocation_id", alloc_id).set("exit_code", exit_code)
           .set("error", error);
@@ -593,15 +610,28 @@ class Agent {
       j.set("allocation_id", aid)
           .set("supervisor_pid", static_cast<int64_t>(t.pid))
           .set("task_pid", static_cast<int64_t>(t.task_pid))
-          .set("log_path", t.log_path);
+          .set("log_path", t.log_path)
+          // needed so a reattached task's logs can still authenticate;
+          // the file is 0600 below — it now holds live credentials
+          .set("alloc_token", t.alloc_token);
       tasks.push_back(j);
     }
     Json state = Json::object();
     state.set("tasks", tasks);
-    std::ofstream out(state_file() + ".tmp");
-    out << state.dump();
-    out.close();
-    ::rename((state_file() + ".tmp").c_str(), state_file().c_str());
+    // owner-only from the first byte: the state file carries alloc tokens,
+    // which on a multi-user host must not be readable by other accounts
+    std::string tmp = state_file() + ".tmp";
+    int fd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0600);
+    if (fd < 0) return;
+    std::string data = state.dump();
+    ssize_t off = 0;
+    while (off < static_cast<ssize_t>(data.size())) {
+      ssize_t n = ::write(fd, data.data() + off, data.size() - off);
+      if (n <= 0) break;
+      off += n;
+    }
+    ::close(fd);
+    ::rename(tmp.c_str(), state_file().c_str());
   }
 
   void finish_task(const std::string& alloc_id, const RunningTask& task,
@@ -674,9 +704,13 @@ class Agent {
     }
     Json body = Json::object();
     body.set("logs", logs);
+    std::map<std::string, std::string> headers;
+    if (!task.alloc_token.empty()) {
+      headers["Authorization"] = "Bearer " + task.alloc_token;
+    }
     http_request(config_.master_host, config_.master_port, "POST",
                  "/api/v1/allocations/" + task.allocation_id + "/logs",
-                 body.dump(), 10);
+                 body.dump(), 10, headers);
   }
 
   void send_event(const std::string& alloc_id, const std::string& event,

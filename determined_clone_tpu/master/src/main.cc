@@ -73,6 +73,7 @@ void apply_config_file(const std::string& path, dct::MasterConfig* config) {
       }
     } else if (key == "sso.client_id") config->sso_client_id = value;
     else if (key == "sso.client_secret") config->sso_client_secret = value;
+    else if (key == "sso.external_host") config->sso_external_host = value;
     else if (key == "session_ttl") {
       config->session_ttl_sec = std::atof(value.c_str());
     } else if (key == "webui_dir") config->webui_dir = value;
@@ -166,6 +167,9 @@ int main(int argc, char** argv) {
       config.sso_client_id = argv[++i];
     } else if (!std::strcmp(argv[i], "--sso-client-secret") && i + 1 < argc) {
       config.sso_client_secret = argv[++i];
+    } else if (!std::strcmp(argv[i], "--sso-external-host") && i + 1 < argc) {
+      // externally visible host:port for the IdP callback redirect
+      config.sso_external_host = argv[++i];
     } else if (!std::strcmp(argv[i], "--webui-dir") && i + 1 < argc) {
       config.webui_dir = argv[++i];
     } else if (!std::strcmp(argv[i], "--db") && i + 1 < argc) {

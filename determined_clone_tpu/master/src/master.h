@@ -60,6 +60,11 @@ struct MasterConfig {
   int sso_issuer_port = 0;
   std::string sso_client_id = "dct";
   std::string sso_client_secret;
+  // externally visible host:port the IdP should send the browser back to;
+  // when empty the callback host falls back to loopback rather than the
+  // request's Host header (a forged Host must not steer the authorization
+  // code to an attacker-controlled callback)
+  std::string sso_external_host;
   // static WebUI assets directory ("" disables); served at / and /ui/*
   std::string webui_dir = "webui";
   // TPU-VM autoscaling (provisioner.h); disabled unless enabled=true

@@ -874,6 +874,33 @@ HttpResponse Master::route(const HttpRequest& req) {
     if (it == trials_.end()) return not_found("no trial " + parts[3]);
     Trial& trial = it->second;
     Experiment& exp = experiments_[trial.experiment_id];
+    // 'trials' is not in kAuthRoots because its data plane is driven by
+    // alloc-token holders, so the gate lives here: under --auth-required a
+    // mutation (metrics/checkpoints/searcher ops can steer or stop a
+    // search) needs a session or THIS trial's allocation token; reads open
+    // to any live alloc token (TensorBoard fetches sibling-trial metrics)
+    // or a session. Control mutations (kill) additionally demand a
+    // session below.
+    if (config_.auth_required) {
+      bool session_ok = current_user(req) != nullptr;
+      bool allowed = session_ok;
+      if (!allowed && req.method == "GET") {
+        allowed = alloc_authed(req);
+      } else if (!allowed) {
+        const std::string tok = bearer_token(req);
+        for (const auto& [aid, a] : allocations_) {
+          if (a.trial_id == id && !a.token.empty() &&
+              crypto::constant_time_eq(tok, a.token)) {
+            allowed = true;
+            break;
+          }
+        }
+      }
+      if (!allowed) {
+        return HttpResponse::json(
+            401, error_json("session or allocation token required").dump());
+      }
+    }
 
     if (parts.size() == 4 && req.method == "GET") {
       Json j = Json::object();
@@ -896,6 +923,14 @@ HttpResponse Master::route(const HttpRequest& req) {
     // searcher is told the trial exited early so HP search can continue
     if (parts.size() == 5 && parts[4] == "kill" && req.method == "POST") {
       User* caller = current_user(req);
+      // 'trials' is not in kAuthRoots (its data-plane POSTs are driven by
+      // alloc-token holders), so this control-plane mutation must demand a
+      // session itself: with RBAC off, rbac_allows() passes unconditionally
+      // and an anonymous kill would fall through.
+      if (config_.auth_required && !caller) {
+        return HttpResponse::json(
+            401, error_json("authentication required").dump());
+      }
       bool own = caller && caller->username == exp.owner;
       if (!own && !rbac_allows(req, role_rank("Editor"),
                                workspace_id_by_name(exp.workspace))) {
@@ -1401,6 +1436,20 @@ HttpResponse Master::route(const HttpRequest& req) {
     auto it = allocations_.find(alloc_id);
     if (it == allocations_.end()) return not_found("no allocation " + alloc_id);
     Allocation& alloc = it->second;
+    // every allocation route is data-plane: rendezvous/allgather posts
+    // steer the gang's addresses, proxy registration re-points user
+    // traffic, and log posts feed log-pattern policies (a kill/requeue
+    // primitive). Under --auth-required the caller must prove membership
+    // with the allocation's token (as the trial heartbeat does) or hold a
+    // user session. Empty tokens never match: a restored pre-token
+    // allocation must not turn an empty header into a grant.
+    bool alloc_member =
+        !alloc.token.empty() &&
+        crypto::constant_time_eq(bearer_token(req), alloc.token);
+    if (config_.auth_required && !alloc_member && !current_user(req)) {
+      return HttpResponse::json(
+          401, error_json("allocation token or session required").dump());
+    }
 
     // rendezvous (≈ task/rendezvous.go:94: all members register, then all
     // receive the full member list; rank 0's host is the jax coordinator)
@@ -1408,6 +1457,12 @@ HttpResponse Master::route(const HttpRequest& req) {
       if (req.method == "POST") {
         Json body = Json::parse(req.body);
         int rank = static_cast<int>(body["rank"].as_int());
+        int world = std::max(1, alloc.world_size);
+        if (rank < 0 || rank >= world) {
+          return bad_request("rank " + std::to_string(rank) +
+                             " out of range for world size " +
+                             std::to_string(world));
+        }
         alloc.rendezvous[rank] = body["address"].as_string();
         dirty_ = true;
       }

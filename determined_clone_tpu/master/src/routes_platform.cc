@@ -6,6 +6,7 @@
 // the Master's single state map the way routes.cc does for experiments.
 #include <cctype>
 #include <fstream>
+#include <iostream>
 #include <random>
 #include <thread>
 
@@ -293,8 +294,8 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
       if (parts[4] == "login" && req.method == "GET") {
         // mint a state nonce and bounce the browser to the IdP. The
         // redirect_uri must be ABSOLUTE (a browser resolves a relative
-        // Location against the IdP's origin, not ours): rebuild it from
-        // the Host header the browser used to reach us.
+        // Location against the IdP's origin, not ours) and must come from
+        // configuration — never the request's Host header (see below).
         std::string state = crypto::random_token();
         // bound outstanding states: anonymous login spam must not grow
         // master memory — evict the nearest-expiry entries beyond the cap
@@ -307,11 +308,32 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
           sso_states_.erase(oldest);
         }
         sso_states_[state] = now + 600;
-        auto host_it = req.headers.find("host");
-        std::string self_host = host_it != req.headers.end()
-                                    ? host_it->second
-                                    : "127.0.0.1:" +
-                                          std::to_string(config_.port);
+        // The callback host must NOT come from the request's Host header:
+        // a forged Host would point the issuer redirect (and thus the
+        // authorization code) at an attacker-controlled callback. Use the
+        // configured external host; without one, trust Host only when it
+        // names this master's loopback, and otherwise fail LOUDLY — a
+        // silent loopback fallback would send a remote user's browser to
+        // its own machine with nothing in the logs naming the fix.
+        std::string loopback =
+            "127.0.0.1:" + std::to_string(config_.port);
+        std::string self_host = config_.sso_external_host;
+        if (self_host.empty()) {
+          auto host_it = req.headers.find("host");
+          std::string h =
+              host_it != req.headers.end() ? host_it->second : "";
+          if (h == loopback ||
+              h == "localhost:" + std::to_string(config_.port)) {
+            self_host = h;
+          } else {
+            std::cerr << "[master] sso login via untrusted host '" << h
+                      << "': set --sso-external-host (or sso.external_host)"
+                      << std::endl;
+            return pbad(
+                "sso requires --sso-external-host when the master is not "
+                "reached via loopback (got Host: " + h + ")");
+          }
+        }
         std::string redirect =
             "http://" + config_.sso_issuer_host + ":" +
             std::to_string(config_.sso_issuer_port) +

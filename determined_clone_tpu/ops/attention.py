@@ -144,9 +144,15 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *, axis_name: str,
     l0 = jnp.zeros((B, H, T), jnp.float32)
     # the zero-init carry is a replicated constant but every loop output
     # varies over the sp axis — mark it varying or shard_map's vma check
-    # rejects the fori_loop carry
-    acc0, m0, l0 = jax.tree.map(
-        lambda x: jax.lax.pvary(x, axis_name), (acc0, m0, l0))
+    # rejects the fori_loop carry. pvary is deprecated in favour of pcast
+    # on current JAX; keep the fallback for older versions.
+    if hasattr(jax.lax, "pcast"):
+        acc0, m0, l0 = jax.tree.map(
+            lambda x: jax.lax.pcast(x, axis_name, to="varying"),
+            (acc0, m0, l0))
+    else:
+        acc0, m0, l0 = jax.tree.map(
+            lambda x: jax.lax.pvary(x, axis_name), (acc0, m0, l0))
     acc, m, l, _, _ = jax.lax.fori_loop(
         0, axis_size, body, (acc0, m0, l0, k, v)
     )
@@ -170,6 +176,13 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
     q,k,v: [B, T_local, H, D]; H must be divisible by the axis size.
     """
+
+    sp = jax.lax.axis_size(axis_name)
+    if q.shape[2] % sp != 0:
+        raise ValueError(
+            f"ulysses_attention requires the head count ({q.shape[2]}) to "
+            f"be divisible by the '{axis_name}' axis size ({sp}); use "
+            f"ring_attention for indivisible head counts")
 
     def a2a(x, scatter_dim, concat_dim):
         return jax.lax.all_to_all(x, axis_name, split_axis=scatter_dim,

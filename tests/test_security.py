@@ -273,3 +273,131 @@ def test_exec_is_shell_mode_only(cluster):
         session.proxy(tid, "/exec", "POST", {"cmd": ["id"]})
     assert err.value.status == 403
     session.kill_task(tid)
+
+
+def test_trial_kill_requires_session(cluster):
+    """Round-3 ADVICE (high): with --auth-required but RBAC off, anonymous
+    POST /trials/:id/kill previously fell through rbac_allows() (which
+    passes unconditionally when RBAC is disabled). It must 401 without a
+    session and succeed with one."""
+    session = cluster["session"]
+    port = cluster["port"]
+    exp = session.create_experiment({
+        "name": "killsec", "entrypoint": "x:Y",
+        "searcher": {"name": "single", "metric": "loss",
+                     "max_length": {"batches": 1}},
+        "hyperparameters": {},
+    })
+    trial_id = wait_for(
+        lambda: next((t["id"] for t in
+                      session.get_experiment(exp["id"]).get("trials", [])),
+                     None),
+        desc="trial created")
+    status, _ = raw_request(port, "POST", f"/api/v1/trials/{trial_id}/kill")
+    assert status == 401
+    status, _ = raw_request(
+        port, "POST", f"/api/v1/trials/{trial_id}/kill",
+        headers={"Authorization": f"Bearer {session.token}"})
+    assert status == 200
+    session.kill_experiment(exp["id"])
+
+
+def test_allgather_requires_alloc_token(cluster):
+    """Round-3 ADVICE (medium): the allgather barrier must demand the
+    allocation's data-plane token — an anonymous peer could otherwise
+    inject its own address into a live gang's rendezvous payload."""
+    session = cluster["session"]
+    port = cluster["port"]
+    task = session.create_task("shell", name="ag-sec")
+    tid = task["id"]
+    data_dir = cluster["tmp"] / "master-data"
+    alloc_token = wait_for(
+        lambda: next((a.get("token") for a in
+                      (read_master_snapshot(data_dir) or {}).get(
+                          "allocations", [])
+                      if a["id"] == tid and a.get("token")), None),
+        desc="allocation token persisted")
+    wait_for(lambda: session.get_task(tid)["state"] in
+             ("RUNNING", "PULLING"), desc="allocation live")
+    body = {"rank": 0, "round": 0, "data": {"addr": "evil:1"}}
+    status, _ = raw_request(
+        port, "POST", f"/api/v1/allocations/{tid}/allgather", body)
+    assert status == 401
+    status, resp = raw_request(
+        port, "POST", f"/api/v1/allocations/{tid}/allgather", body,
+        headers={"Authorization": f"Bearer {alloc_token}"})
+    assert status == 200
+    session.kill_task(tid)
+
+
+def test_allocation_data_plane_requires_token(cluster):
+    """All /allocations/:id/* routes are data-plane: rendezvous and proxy
+    posts steer gang/user traffic, log posts feed log-pattern policies (a
+    kill primitive). Anonymous access must 401; the allocation's token (or
+    a session) opens them."""
+    session = cluster["session"]
+    port = cluster["port"]
+    task = session.create_task("shell", name="dp-sec")
+    tid = task["id"]
+    data_dir = cluster["tmp"] / "master-data"
+    alloc_token = wait_for(
+        lambda: next((a.get("token") for a in
+                      (read_master_snapshot(data_dir) or {}).get(
+                          "allocations", [])
+                      if a["id"] == tid and a.get("token")), None),
+        desc="allocation token persisted")
+    headers = {"Authorization": f"Bearer {alloc_token}"}
+
+    for method, path, body in [
+        ("POST", f"/api/v1/allocations/{tid}/rendezvous",
+         {"rank": 0, "address": "evil:1"}),
+        ("POST", f"/api/v1/allocations/{tid}/proxy",
+         {"address": "evil:80"}),
+        ("POST", f"/api/v1/allocations/{tid}/logs",
+         {"logs": ["injected"]}),
+        ("GET", f"/api/v1/allocations/{tid}/logs", None),
+        ("GET", f"/api/v1/allocations/{tid}/preempt", None),
+    ]:
+        status, _ = raw_request(port, method, path, body)
+        assert status == 401, f"anonymous {method} {path} -> {status}"
+        status, _ = raw_request(port, method, path, body, headers=headers)
+        assert status == 200, f"token {method} {path} -> {status}"
+
+    # out-of-range rendezvous ranks are rejected even with the token
+    status, _ = raw_request(
+        port, "POST", f"/api/v1/allocations/{tid}/rendezvous",
+        {"rank": 5, "address": "x:1"}, headers=headers)
+    assert status == 400
+    session.kill_task(tid)
+
+
+def test_trial_mutations_require_session_or_own_token(cluster):
+    """Trial data-plane mutations (metrics/searcher ops) can steer or stop
+    an HP search, so anonymous posts must 401; the trial's own allocation
+    token or a session opens them."""
+    session = cluster["session"]
+    port = cluster["port"]
+    exp = session.create_experiment({
+        "name": "trialgate", "entrypoint": "x:Y",
+        "searcher": {"name": "single", "metric": "loss",
+                     "max_length": {"batches": 1}},
+        "hyperparameters": {},
+    })
+    trial_id = wait_for(
+        lambda: next((t["id"] for t in
+                      session.get_experiment(exp["id"]).get("trials", [])),
+                     None),
+        desc="trial created")
+    body = {"group": "training", "steps_completed": 999999,
+            "metrics": {"loss": 0.0}}
+    status, _ = raw_request(
+        port, "POST", f"/api/v1/trials/{trial_id}/metrics", body)
+    assert status == 401
+    status, _ = raw_request(
+        port, "GET", f"/api/v1/trials/{trial_id}")
+    assert status == 401
+    status, _ = raw_request(
+        port, "POST", f"/api/v1/trials/{trial_id}/metrics", body,
+        headers={"Authorization": f"Bearer {session.token}"})
+    assert status == 200
+    session.kill_experiment(exp["id"])

@@ -170,3 +170,23 @@ def test_sso_unconfigured_master_declines(tmp_path):
     finally:
         proc.kill()
         proc.wait(timeout=10)
+
+
+def test_sso_redirect_rejects_forged_host(master):
+    """Round-3 ADVICE (low): the authorize redirect_uri must not be built
+    from the request's Host header — a forged Host would point the
+    authorization code at an attacker-controlled callback. Without a
+    configured --sso-external-host, a non-loopback Host fails loudly (no
+    code is issued at all) instead of silently redirecting to loopback."""
+    import http.client
+
+    port = master["port"]
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.putrequest("GET", "/api/v1/auth/sso/login", skip_host=True)
+    conn.putheader("Host", "evil.example.com:8080")
+    conn.endheaders()
+    resp = conn.getresponse()
+    body = resp.read().decode()
+    conn.close()
+    assert resp.status == 400
+    assert "sso-external-host" in body
