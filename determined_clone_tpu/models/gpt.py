@@ -56,10 +56,13 @@ class GPTConfig:
     compute_dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True
-    # attention implementation: "mha" (plain XLA), "blockwise" (streaming
-    # scan for long seqs), "flash" (fused Pallas TPU kernel). The legacy
-    # blockwise_attention flag still selects "blockwise".
-    attention_impl: str = "mha"
+    # attention implementation: "auto" (flash on TPU, mha elsewhere),
+    # "mha" (plain XLA), "blockwise" (streaming scan for long seqs),
+    # "flash" (fused Pallas TPU kernel). TPU-first means the fused kernel
+    # is the default on TPU hardware with an explicit opt-out; off-TPU the
+    # kernel would run in slow interpret mode, so auto picks plain XLA.
+    # The legacy blockwise_attention flag still selects "blockwise".
+    attention_impl: str = "auto"
     blockwise_attention: bool = False
     attention_block_size: int = 512
     tie_embeddings: bool = True
@@ -81,6 +84,25 @@ class GPTConfig:
     def tiny() -> "GPTConfig":
         return GPTConfig(vocab_size=256, n_layers=2, d_model=64, n_heads=4,
                          d_ff=128, max_seq_len=128, remat=False)
+
+
+def resolved_attention_impl(cfg: GPTConfig) -> str:
+    """The concrete attention kernel ``cfg`` selects on this backend.
+
+    "auto" resolves per backend at trace time (``jax.default_backend()``
+    is static under jit): the fused Pallas kernel on TPU, plain XLA
+    attention elsewhere. Exposed so tests and benchmarks can assert which
+    path a config actually takes — a silent fall-off the fast path is a
+    perf regression, not an implementation detail.
+    """
+    impl = "blockwise" if cfg.blockwise_attention else cfg.attention_impl
+    if impl == "auto":
+        return "flash" if jax.default_backend() == "tpu" else "mha"
+    if impl not in ("mha", "blockwise", "flash"):
+        raise ValueError(
+            f"unknown attention_impl {impl!r}; "
+            f"expected auto|mha|blockwise|flash")
+    return impl
 
 
 # Megatron-style TP rules + explicit fsdp specs. Column-parallel up-projections
@@ -171,19 +193,25 @@ def _block(cfg: GPTConfig, block_params: Params, x: jax.Array,
     q = rotary_embedding(q.reshape(B, T, H, hd), positions)
     k = rotary_embedding(k.reshape(B, T, H, hd), positions)
     v = v.reshape(B, T, H, hd)
-    impl = "blockwise" if cfg.blockwise_attention else cfg.attention_impl
-    if impl not in ("mha", "blockwise", "flash"):
-        raise ValueError(
-            f"unknown attention_impl {impl!r}; expected mha|blockwise|flash")
+    impl = resolved_attention_impl(cfg)
     if impl == "blockwise":
         attn = causal_blockwise_attention(q, k, v, block_size=cfg.attention_block_size)
     elif impl == "flash":
         from determined_clone_tpu.ops.flash_attention import flash_attention
 
-        attn = flash_attention(
-            q, k, v, causal=True,
-            block_q=min(cfg.attention_block_size, 128),
-            block_k=min(cfg.attention_block_size, 128))
+        blk = min(cfg.attention_block_size, 128)
+        # the kernel tiles T into blk-sized blocks; pad indivisible T (the
+        # everyday case: loss_fn slices tokens[:, :-1]) and slice back.
+        # Safe because attention is causal: real queries only ever see
+        # real keys (i < T), and padded rows are discarded.
+        pad = -T % blk
+        if pad:
+            q, k, v = (jnp.pad(t, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                       for t in (q, k, v))
+        attn = flash_attention(q, k, v, causal=True, block_q=blk,
+                               block_k=blk)
+        if pad:
+            attn = attn[:, :T]
     else:
         attn = mha(q, k, v, causal=True)
     attn = dense(block_params["attn_out"], attn.reshape(B, T, D),

@@ -87,8 +87,11 @@ def test_gpt_with_flash_attention_trains():
 
     # flash output agrees with mha inside the full model (BEFORE training:
     # the train step donates the state, freeing these param buffers)
+    # explicit mha: on TPU the config default ("auto") resolves to flash,
+    # which would make this parity check compare the kernel to itself
     cfg_ref = gpt.GPTConfig(vocab_size=128, n_layers=2, d_model=64, n_heads=4,
-                            d_ff=128, max_seq_len=64, remat=False)
+                            d_ff=128, max_seq_len=64, remat=False,
+                            attention_impl="mha")
     logits_ref = gpt.apply(params, cfg_ref, tokens[:, :-1])
     logits_flash = gpt.apply(params, cfg, tokens[:, :-1])
     assert jnp.max(jnp.abs(logits_ref - logits_flash)) < 0.05
@@ -104,3 +107,89 @@ def test_gpt_with_flash_attention_trains():
     state, m2 = step(state, tokens)
     assert jnp.isfinite(m2["loss"])
     assert float(m2["loss"]) < float(m1["loss"])
+
+
+def test_auto_attention_resolves_per_backend():
+    """TPU-first default: "auto" must pick the fused kernel on TPU and
+    plain XLA attention elsewhere, and unknown impls fail loudly."""
+    import dataclasses
+
+    from determined_clone_tpu.models import gpt
+
+    cfg = gpt.GPTConfig.tiny()
+    assert cfg.attention_impl == "auto"  # the out-of-the-box default
+    # literal per-backend expectations (NOT the implementation's own
+    # predicate, which would make this assertion tautological)
+    if jax.default_backend() == "tpu":
+        assert gpt.resolved_attention_impl(cfg) == "flash"
+    else:
+        assert gpt.resolved_attention_impl(cfg) == "mha"
+    assert gpt.resolved_attention_impl(
+        dataclasses.replace(cfg, attention_impl="flash")) == "flash"
+    with pytest.raises(ValueError, match="bogus"):
+        gpt.resolved_attention_impl(
+            dataclasses.replace(cfg, attention_impl="bogus"))
+
+
+def test_flash_mha_loss_parity_over_training():
+    """Kernel regression gate (VERDICT r3 #2): same-seed training with the
+    Pallas kernel must track the XLA-attention loss curve step for step.
+    A numerics bug that still 'trains' would slip a smoke test; a
+    per-step curve comparison catches it."""
+    import dataclasses
+
+    import optax
+
+    from determined_clone_tpu.models import gpt
+    from determined_clone_tpu.training.train_step import (
+        create_train_state,
+        make_train_step,
+    )
+
+    cfg_flash = gpt.GPTConfig(
+        vocab_size=128, n_layers=2, d_model=64, n_heads=4, d_ff=128,
+        max_seq_len=64, remat=False, attention_impl="flash",
+        attention_block_size=32)
+    cfg_mha = dataclasses.replace(cfg_flash, attention_impl="mha")
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 65), 0, 128)
+
+    curves = {}
+    for name, cfg in [("flash", cfg_flash), ("mha", cfg_mha)]:
+        params = gpt.init(jax.random.PRNGKey(0), cfg)
+        tx = optax.adam(3e-3)
+        state = create_train_state(params, tx, jax.random.PRNGKey(1))
+
+        def loss_fn(p, b, rng, cfg=cfg):
+            return gpt.loss_fn(p, cfg, b[:, :-1], b[:, 1:]), {}
+
+        step = make_train_step(loss_fn, tx)
+        losses = []
+        for _ in range(6):
+            state, m = step(state, tokens)
+            losses.append(float(m["loss"]))
+        curves[name] = losses
+
+    for lf, lm in zip(curves["flash"], curves["mha"]):
+        assert abs(lf - lm) / max(abs(lm), 1e-6) < 0.02, (curves)
+    # and both actually trained
+    assert curves["flash"][-1] < curves["flash"][0]
+
+
+def test_flash_pads_indivisible_seq_in_gpt():
+    """The everyday loss pattern slices tokens[:, :-1], giving T values
+    (e.g. 2047) not divisible by the kernel block. The model must pad and
+    slice transparently and still match mha numerics."""
+    import dataclasses
+
+    from determined_clone_tpu.models import gpt
+
+    cfg = gpt.GPTConfig(vocab_size=128, n_layers=2, d_model=64, n_heads=4,
+                        d_ff=128, max_seq_len=64, remat=False,
+                        attention_impl="flash", attention_block_size=32)
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (2, 50), 0, 128)
+    params = gpt.init(jax.random.PRNGKey(0), cfg)
+    logits_flash = gpt.apply(params, cfg, tokens)  # T=50, blk=32 -> pad 14
+    logits_mha = gpt.apply(
+        params, dataclasses.replace(cfg, attention_impl="mha"), tokens)
+    assert logits_flash.shape == logits_mha.shape
+    assert jnp.max(jnp.abs(logits_flash - logits_mha)) < 0.05
