@@ -49,11 +49,14 @@ class MasterSession:
 
     def request(self, method: str, path: str,
                 body: Optional[Dict[str, Any]] = None, *,
-                retryable: Optional[bool] = None) -> Dict[str, Any]:
+                retryable: Optional[bool] = None,
+                timeout: Optional[float] = None) -> Dict[str, Any]:
         """``retryable`` controls transport-error retries. Default: GETs are
         retried, POSTs are not — a POST the master already processed must not
         be silently duplicated (create_experiment, completed_op). Idempotent
-        POSTs (heartbeat, rendezvous, register) opt in."""
+        POSTs (heartbeat, rendezvous, register) opt in. ``timeout``
+        overrides the session timeout (long-poll follow requests outlive
+        it by design)."""
         if retryable is None:
             retryable = method == "GET"
         attempts = self.retries if retryable else 1
@@ -68,7 +71,8 @@ class MasterSession:
                 headers=headers,
             )
             try:
-                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                with urllib.request.urlopen(
+                        req, timeout=timeout or self.timeout) as resp:
                     payload = resp.read().decode()
                     return json.loads(payload) if payload else {}
             except urllib.error.HTTPError as e:
@@ -222,6 +226,27 @@ class MasterSession:
                 id=allocation_id, limit=page_size)):
             for rec in page.logs:
                 yield rec.to_json()
+
+    def follow_task_logs(self, allocation_id: str, offset: int = 0,
+                         follow_seconds: int = 30, page_size: int = 1000):
+        """Live tail: yield records as they land, long-polling the master
+        (follow mode of GetTaskLogs) until the allocation is terminal and
+        drained. Each empty poll blocks master-side up to
+        ``follow_seconds`` — no reconnect-per-line, no tail re-fetch."""
+        while True:
+            out = self.request(
+                "GET",
+                f"/api/v1/allocations/{_q(allocation_id)}/logs"
+                f"?limit={page_size}&offset={offset}"
+                f"&follow={follow_seconds}",
+                timeout=follow_seconds + 15)
+            for rec in out.get("logs", []):
+                yield rec
+            offset = int(out.get("next_offset", offset))
+            if out.get("end_of_stream"):
+                return
+            if not out.get("logs") and follow_seconds <= 0:
+                return  # drain-only call on a live allocation: don't spin
 
     # -- NTSC tasks (notebooks/shells/commands/tensorboards) ---------------
 

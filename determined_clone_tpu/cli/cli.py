@@ -198,10 +198,50 @@ def cmd_trial_metrics(args) -> int:
 
 def cmd_trial_logs(args) -> int:
     session = make_session(args)
-    for alloc_id in session.trial_log_allocations(args.trial_id):
-        for rec in session.task_logs(alloc_id):
-            print(rec.get("log", ""))
-    return 0
+    legs = session.trial_log_allocations(args.trial_id)
+    if not getattr(args, "follow", False):
+        for alloc_id in legs:
+            for rec in session.task_logs(alloc_id):
+                print(rec.get("log", ""))
+        return 0
+    # follow: drain earlier legs from their cursors, then live-tail the
+    # newest; a restart creates a new leg, so on end-of-stream re-list and
+    # keep going until the trial is terminal with no new leg. Per-leg
+    # cursors stop a re-entered leg (e.g. followed live, then superseded
+    # by a restart) from reprinting what was already shown.
+    import time as _time
+
+    cursors: Dict[str, int] = {}
+
+    def emit(alloc_id: str, follow_seconds: int) -> None:
+        n = cursors.get(alloc_id, 0)
+        try:
+            for rec in session.follow_task_logs(
+                    alloc_id, offset=n, follow_seconds=follow_seconds):
+                print(rec.get("log", ""), flush=True)
+                n += 1
+        except MasterError as err:
+            # a QUEUED trial's leg (or a restart's fresh leg) may be
+            # listed before its allocation registers: wait, don't crash
+            if err.status != 404:
+                raise
+            _time.sleep(1.0)
+        cursors[alloc_id] = n
+
+    while True:
+        for alloc_id in legs[:-1]:
+            emit(alloc_id, 0)   # dead leg: just drain past the cursor
+        if legs:
+            emit(legs[-1], 30)  # live leg: block for new lines
+        state = session.get_trial(args.trial_id).get("state", "")
+        new_legs = session.trial_log_allocations(args.trial_id)
+        if new_legs == legs and state in ("COMPLETED", "ERRORED",
+                                          "CANCELED"):
+            return 0
+        if new_legs == legs:
+            # e.g. PAUSED with a drained terminal leg: don't spin
+            _time.sleep(1.0)
+        legs = new_legs
 
 
 def cmd_checkpoint_list(args) -> int:
@@ -238,7 +278,12 @@ def cmd_task_kill(args) -> int:
 
 
 def cmd_task_logs(args) -> int:
-    for rec in make_session(args).task_logs(args.task_id):
+    session = make_session(args)
+    if getattr(args, "follow", False):
+        for rec in session.follow_task_logs(args.task_id):
+            print(rec.get("log", ""), flush=True)
+        return 0
+    for rec in session.task_logs(args.task_id):
         print(rec.get("log", ""))
     return 0
 
@@ -649,6 +694,9 @@ def build_parser() -> argparse.ArgumentParser:
     c.set_defaults(func=cmd_trial_metrics)
     c = st.add_parser("logs")
     c.add_argument("trial_id", type=int)
+    c.add_argument("-f", "--follow", action="store_true",
+                   help="live-tail: long-poll for new lines until the "
+                        "trial is terminal")
     c.set_defaults(func=cmd_trial_logs)
 
     # checkpoint
@@ -676,6 +724,8 @@ def build_parser() -> argparse.ArgumentParser:
     c.set_defaults(func=cmd_task_kill)
     c = stk.add_parser("logs")
     c.add_argument("task_id")
+    c.add_argument("-f", "--follow", action="store_true",
+                   help="live-tail until the task is terminal")
     c.set_defaults(func=cmd_task_logs)
 
     p_nb = sub.add_parser("notebook", help="notebook tasks")

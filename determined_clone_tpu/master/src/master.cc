@@ -302,11 +302,15 @@ void Master::load_snapshot() {
 // delegate to the pluggable Store (files or sqlite — store.h).
 void Master::append_jsonl(const std::string& file, const Json& record) {
   store_->append(file, record);
+  ++stream_versions_[file];  // callers hold mu_
+  logs_cv_.notify_all();     // wake followers; they check their version
 }
 
 void Master::append_jsonl_many(const std::string& file,
                                const std::vector<const Json*>& records) {
   store_->append_many(file, records);
+  ++stream_versions_[file];
+  logs_cv_.notify_all();
 }
 
 std::vector<Json> Master::read_jsonl_tail(const std::string& file,
@@ -746,6 +750,9 @@ void Master::on_task_done(const std::string& alloc_id, int exit_code,
   // payloads — a restarted incarnation must never rendezvous against a
   // dead incarnation's addresses
   allgather_.erase(alloc_id);
+  // wake log followers so they report end_of_stream promptly instead of
+  // sleeping out their follow window against a finished allocation
+  logs_cv_.notify_all();
   if (alloc.state == RunState::Completed || alloc.state == RunState::Errored) {
     return;  // idempotent: exits may arrive twice (task_event + heartbeat)
   }

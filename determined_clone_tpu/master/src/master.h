@@ -11,6 +11,7 @@
 #include <deque>
 #include <map>
 #include <memory>
+#include <condition_variable>
 #include <mutex>
 #include <regex>
 #include <string>
@@ -141,6 +142,11 @@ class Master {
   // state lock: the IdP token exchange is a blocking outbound request and
   // must never run under mu_ (locks only around state reads/writes)
   HttpResponse sso_callback_route(const HttpRequest& req);
+  // GET /api/v1/allocations/:id/logs?follow=N — long-poll follow mode
+  // (≈ the reference's streaming TrialLogs with follow, api.proto:781).
+  // Dispatched from handle() BEFORE the state lock: it sleeps on
+  // logs_cv_ between reads and must not pin route()'s lock_guard.
+  HttpResponse logs_follow_route(const HttpRequest& req);
 
   // -- platform helpers (routes_platform.cc) --
   User* current_user(const HttpRequest& req);   // nullptr if no valid token
@@ -191,6 +197,13 @@ class Master {
                                   // even when start() is never called)
 
   std::mutex mu_;
+  // pinged on every store append (and terminal task transitions) so log
+  // followers wake instantly instead of sleeping out their poll window.
+  // stream_versions_ lets a woken follower skip the store read unless ITS
+  // stream changed — metrics/profiler appends would otherwise fan out
+  // into O(appends x followers) reads under mu_.
+  std::condition_variable logs_cv_;
+  std::map<std::string, uint64_t> stream_versions_;
   int64_t next_experiment_id_ = 1;
   int64_t next_trial_id_ = 1;
   int64_t next_task_id_ = 1;

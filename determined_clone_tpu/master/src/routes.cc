@@ -99,6 +99,13 @@ HttpResponse Master::handle(const HttpRequest& req) {
       // the IdP token exchange blocks on an outbound request — it manages
       // its own locking instead of running under route()'s state lock
       resp = sso_callback_route(req);
+    } else if (req.method == "GET" && req.path_parts.size() == 5 &&
+               req.path_parts[0] == "api" && req.path_parts[1] == "v1" &&
+               req.path_parts[2] == "allocations" &&
+               req.path_parts[4] == "logs" && req.query.count("follow")) {
+      // follow mode long-polls on logs_cv_; it manages its own locking
+      // (the connection has a dedicated thread, so waiting here is safe)
+      resp = logs_follow_route(req);
     } else {
       resp = route(req);
     }
@@ -293,6 +300,75 @@ HttpResponse Master::static_route(const HttpRequest& req) {
   else if (ext == ".png") resp.content_type = "image/png";
   else resp.content_type = "application/octet-stream";
   return resp;
+}
+
+// GET /api/v1/allocations/:id/logs?follow=N&offset=M — hold the request
+// open until new records land past the cursor, the follow window expires,
+// or the allocation reaches a terminal state (end_of_stream tells the
+// client to stop re-polling). The reference streams TrialLogs over gRPC
+// with a follow flag (api.proto:781); this is the long-poll equivalent,
+// indexed by the store's record cursor rather than a tail rescan.
+HttpResponse Master::logs_follow_route(const HttpRequest& req) {
+  const std::string& alloc_id = req.path_parts[3];
+  size_t limit = 1000, offset = 0, follow_s = 30;
+  if (!parse_size(req.query, "limit", &limit) ||
+      !parse_size(req.query, "offset", &offset) ||
+      !parse_size(req.query, "follow", &follow_s)) {
+    return bad_request("limit/offset/follow must be non-negative integers");
+  }
+  follow_s = std::min<size_t>(follow_s, 60);  // bound the held connection
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::seconds(follow_s);
+  const std::string stream = "task-" + alloc_id + "-logs.jsonl";
+
+  std::unique_lock<std::mutex> lock(mu_);
+  {
+    auto it = allocations_.find(alloc_id);
+    if (it == allocations_.end()) {
+      return not_found("no allocation " + alloc_id);
+    }
+    // same gate as the allocations block in route(): the stream carries
+    // user log output, so a token or session is required under auth
+    bool alloc_member =
+        !it->second.token.empty() &&
+        crypto::constant_time_eq(bearer_token(req), it->second.token);
+    if (config_.auth_required && !alloc_member && !current_user(req)) {
+      return HttpResponse::json(
+          401, error_json("allocation token or session required").dump());
+    }
+  }
+  uint64_t seen_version = 0;
+  bool first = true;
+  while (true) {
+    // only touch the store when THIS stream changed (metrics/profiler
+    // appends to other streams wake us too — skip the read then)
+    std::vector<Json> recs;
+    auto vit = stream_versions_.find(stream);
+    uint64_t version = vit == stream_versions_.end() ? 0 : vit->second;
+    if (first || version != seen_version) {
+      recs = store_->read(stream, limit, offset);
+      seen_version = version;
+      first = false;
+    }
+    auto it = allocations_.find(alloc_id);  // may be reaped mid-follow
+    bool terminal = it == allocations_.end() ||
+                    it->second.state == RunState::Completed ||
+                    it->second.state == RunState::Errored ||
+                    it->second.state == RunState::Canceled;
+    if (!recs.empty() || terminal ||
+        std::chrono::steady_clock::now() >= deadline) {
+      Json arr = Json::array();
+      for (auto& rec : recs) arr.push_back(rec);
+      Json j = Json::object();
+      j.set("logs", arr)
+          .set("next_offset", static_cast<int64_t>(offset + recs.size()))
+          // terminal with records still pending is NOT the end: the
+          // client drains first and hears end_of_stream on its next call
+          .set("end_of_stream", terminal && recs.empty());
+      return ok_json(j);
+    }
+    logs_cv_.wait_until(lock, deadline);
+  }
 }
 
 HttpResponse Master::proxy_route(const HttpRequest& req) {

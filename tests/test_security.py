@@ -401,3 +401,27 @@ def test_trial_mutations_require_session_or_own_token(cluster):
         headers={"Authorization": f"Bearer {session.token}"})
     assert status == 200
     session.kill_experiment(exp["id"])
+
+
+def test_log_follow_route_requires_auth(cluster):
+    """The follow long-poll is dispatched outside route()'s gate and
+    carries its own copy — anonymous followers must 401, token 200."""
+    session = cluster["session"]
+    port = cluster["port"]
+    task = session.create_task("shell", name="follow-sec")
+    tid = task["id"]
+    data_dir = cluster["tmp"] / "master-data"
+    alloc_token = wait_for(
+        lambda: next((a.get("token") for a in
+                      (read_master_snapshot(data_dir) or {}).get(
+                          "allocations", [])
+                      if a["id"] == tid and a.get("token")), None),
+        desc="allocation token persisted")
+    status, _ = raw_request(
+        port, "GET", f"/api/v1/allocations/{tid}/logs?follow=0")
+    assert status == 401
+    status, out = raw_request(
+        port, "GET", f"/api/v1/allocations/{tid}/logs?follow=0",
+        headers={"Authorization": f"Bearer {alloc_token}"})
+    assert status == 200 and "next_offset" in out
+    session.kill_task(tid)

@@ -403,9 +403,9 @@ async function viewTasks() {
 
 async function viewTaskLogs(id) {
   const gen = renderGen;
-  const [task, lines] = await Promise.all([
+  const [task, recs] = await Promise.all([
     api("GET", `/api/v1/tasks/${id}`),
-    fetchLogLines(id),
+    fetchLogRecs(id),
   ]);
   if (gen !== renderGen) return;
   $view.innerHTML = `
@@ -413,16 +413,52 @@ async function viewTaskLogs(id) {
     <h1>${esc(task.task.name)} <span class="muted">${esc(id)}</span>
       ${stateBadge(task.task.state)}</h1>
     <h2>Logs</h2>
-    <pre class="logs">${esc(lines.join("\n")) || "no logs yet"}</pre>`;
-  scheduleRefresh(() => viewTaskLogs(id),
-                  ["RUNNING", "PULLING", "QUEUED"].includes(task.task.state));
+    <pre class="logs">${esc(recs.map(fmtLogRec).join("\n")) ||
+                       "no logs yet"}</pre>`;
+  if (["RUNNING", "PULLING", "QUEUED"].includes(task.task.state)) {
+    tailLogs(id, $view.querySelector("pre.logs"), gen, recs.length)
+        .then(() => {
+          // one re-render for the final state badge once the tail ends
+          if (gen === renderGen) scheduleRefresh(() => viewTaskLogs(id), true);
+        });
+  }
 }
 
-async function fetchLogLines(allocId) {
+function fmtLogRec(r) {
+  return typeof r.log === "string" ? r.log : JSON.stringify(r.log);
+}
+
+async function fetchLogRecs(allocId) {
   const logs = await api(
       "GET", `/api/v1/allocations/${allocId}/logs?limit=2000`);
-  return logs.logs.map((r) =>
-      typeof r.log === "string" ? r.log : JSON.stringify(r.log));
+  return logs.logs;
+}
+
+// Live tail: long-poll the follow endpoint and APPEND new lines to the
+// already-rendered <pre> (no page re-render, no tail re-fetch). Runs until
+// the allocation is terminal and drained, the view navigates away
+// (renderGen moves), or a fetch fails. Resolves when tailing is over so
+// the caller can re-render once for the final state badge.
+async function tailLogs(allocId, preEl, gen, startOffset) {
+  let offset = startOffset;
+  while (gen === renderGen) {
+    let out;
+    try {
+      out = await api(
+          "GET", `/api/v1/allocations/${allocId}/logs` +
+                 `?limit=1000&offset=${offset}&follow=30`);
+    } catch (err) {
+      return;
+    }
+    if (gen !== renderGen) return;
+    if (out.logs && out.logs.length) {
+      const text = out.logs.map(fmtLogRec).join("\n");
+      preEl.textContent += (preEl.textContent ? "\n" : "") + text;
+      preEl.scrollTop = preEl.scrollHeight;
+    }
+    offset = out.next_offset != null ? out.next_offset : offset;
+    if (out.end_of_stream) return;
+  }
 }
 
 async function viewTrialLogs(id) {
@@ -433,12 +469,13 @@ async function viewTrialLogs(id) {
   // the server names the live leg (managed and unmanaged legs differ)
   const allocId = detail.latest_allocation ||
       `trial-${trial.id}.${Math.max(0, (trial.legs || 1) - 1)}`;
-  let lines = [];
+  let recs = [];
+  let fetchErr = null;
   try {
-    lines = await fetchLogLines(allocId);
+    recs = await fetchLogRecs(allocId);
   } catch (err) {
     if (String(err.message) === "authentication required") throw err;
-    lines = [`(no logs for ${allocId}: ${err.message})`];
+    fetchErr = `(no logs for ${allocId}: ${err.message})`;
   }
   if (gen !== renderGen) return;
   $view.innerHTML = `
@@ -447,9 +484,21 @@ async function viewTrialLogs(id) {
        ${trial.experiment_id}</a>
     <h1>Trial ${trial.id} logs <span class="muted">${esc(allocId)}</span>
       ${stateBadge(trial.state)}</h1>
-    <pre class="logs">${esc(lines.join("\n")) || "no logs yet"}</pre>`;
-  scheduleRefresh(() => viewTrialLogs(id),
-                  ["RUNNING", "PULLING", "QUEUED"].includes(trial.state));
+    <pre class="logs">${esc(fetchErr || recs.map(fmtLogRec).join("\n")) ||
+                       "no logs yet"}</pre>`;
+  if (!fetchErr &&
+      ["RUNNING", "PULLING", "QUEUED"].includes(trial.state)) {
+    tailLogs(allocId, $view.querySelector("pre.logs"), gen, recs.length)
+        .then(() => {
+          if (gen === renderGen) {
+            scheduleRefresh(() => viewTrialLogs(id), true);
+          }
+        });
+  } else if (fetchErr) {
+    // the leg may simply not have logged yet — retry on the interval
+    scheduleRefresh(() => viewTrialLogs(id),
+                    ["RUNNING", "PULLING", "QUEUED"].includes(trial.state));
+  }
 }
 
 async function viewCluster() {
