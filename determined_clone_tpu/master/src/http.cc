@@ -229,6 +229,17 @@ void HttpServer::serve_connection(int fd) {
           500, std::string("{\"error\":\"") + e.what() + "\"}");
     }
 
+    if (resp.hijack) {
+      // connection takeover (WebSocket/TCP proxying): hand over the raw
+      // socket plus any bytes a pipelining client already sent. Lift the
+      // keep-alive recv timeout — an idle notebook kernel socket is not
+      // a dead connection (stop() still unblocks via shutdown()).
+      timeval no_tv{0, 0};
+      ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &no_tv, sizeof(no_tv));
+      resp.hijack(fd, std::move(buffer));
+      break;
+    }
+
     std::ostringstream out;
     out << "HTTP/1.1 " << resp.status << ' ' << status_text(resp.status)
         << "\r\nContent-Type: " << resp.content_type
@@ -260,12 +271,11 @@ bool split_host_port(const std::string& s, std::string* host, int* port) {
   return true;
 }
 
-std::optional<HttpClientResponse> http_request(
-    const std::string& host, int port, const std::string& method,
-    const std::string& path, const std::string& body, int timeout_sec,
-    const std::map<std::string, std::string>& extra_headers) {
+bool send_all_fd(int fd, const std::string& data) { return send_all(fd, data); }
+
+int tcp_connect(const std::string& host, int port, int timeout_sec) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
-  if (fd < 0) return std::nullopt;
+  if (fd < 0) return -1;
   timeval tv{timeout_sec, 0};
   ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
   ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
@@ -281,15 +291,47 @@ std::optional<HttpClientResponse> http_request(
     addrinfo* res = nullptr;
     if (::getaddrinfo(host.c_str(), nullptr, &hints, &res) != 0 || !res) {
       ::close(fd);
-      return std::nullopt;
+      return -1;
     }
     addr.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
     ::freeaddrinfo(res);
   }
   if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
     ::close(fd);
-    return std::nullopt;
+    return -1;
   }
+  return fd;
+}
+
+void relay_bidirectional(int client_fd, int upstream_fd) {
+  auto pump = [](int from, int to) {
+    char buf[16384];
+    while (true) {
+      ssize_t n = ::recv(from, buf, sizeof(buf), 0);
+      if (n <= 0) break;
+      ssize_t off = 0;
+      while (off < n) {
+        ssize_t w = ::send(to, buf + off, static_cast<size_t>(n - off),
+                           MSG_NOSIGNAL);
+        if (w <= 0) return;
+        off += w;
+      }
+    }
+    // half-close so the peer's pump sees EOF and drains cleanly
+    ::shutdown(to, SHUT_WR);
+    ::shutdown(from, SHUT_RD);
+  };
+  std::thread down([&] { pump(upstream_fd, client_fd); });
+  pump(client_fd, upstream_fd);
+  down.join();
+}
+
+std::optional<HttpClientResponse> http_request(
+    const std::string& host, int port, const std::string& method,
+    const std::string& path, const std::string& body, int timeout_sec,
+    const std::map<std::string, std::string>& extra_headers) {
+  int fd = tcp_connect(host, port, timeout_sec);
+  if (fd < 0) return std::nullopt;
   std::ostringstream out;
   out << method << ' ' << path << " HTTP/1.1\r\nHost: " << host
       << "\r\nContent-Type: application/json\r\nContent-Length: "

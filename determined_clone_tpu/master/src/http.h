@@ -35,6 +35,12 @@ struct HttpResponse {
   std::string body;
   // extra response headers (e.g. Location on a 302 redirect)
   std::map<std::string, std::string> headers;
+  // Connection takeover (WebSocket/raw-TCP proxying): when set, the
+  // server writes NOTHING — the hook receives the raw client fd plus any
+  // bytes already buffered past the request head (early frames from a
+  // pipelining client) and owns the socket until it returns, after which
+  // the connection is closed. Runs on the connection's dedicated thread.
+  std::function<void(int fd, std::string buffered)> hijack;
 
   static HttpResponse json(int status, const std::string& body) {
     HttpResponse r;
@@ -90,5 +96,20 @@ std::optional<HttpClientResponse> http_request(
     const std::string& path, const std::string& body = "",
     int timeout_sec = 70,
     const std::map<std::string, std::string>& extra_headers = {});
+
+// Blocking full-buffer send; false on error (EPIPE etc.).
+bool send_all_fd(int fd, const std::string& data);
+
+// Connected TCP socket to host:port (IPv4 literal or resolved hostname)
+// with send/recv timeouts set, or -1. The building block http_request and
+// the proxy's upgrade path share.
+int tcp_connect(const std::string& host, int port, int timeout_sec);
+
+// Pump bytes both ways between two connected sockets until either side
+// closes (WebSocket/TCP proxying). Spawns one helper thread for the
+// upstream->client direction and pumps client->upstream on the calling
+// thread; returns once both directions are drained. Closes NEITHER fd —
+// callers own their sockets.
+void relay_bidirectional(int client_fd, int upstream_fd);
 
 }  // namespace dct

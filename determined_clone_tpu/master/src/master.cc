@@ -2,6 +2,7 @@
 
 #include "crypto.h"
 
+#include <sys/socket.h>
 #include <sys/stat.h>
 #include <unistd.h>
 
@@ -125,6 +126,15 @@ void Master::stop() {
   if (!running_.exchange(false)) {
     if (server_) server_->stop();
     return;
+  }
+  // unblock held connections BEFORE joining the server's worker threads:
+  // log followers park in logs_cv_.wait_until (they check running_ on
+  // wake), and WebSocket relays block in recv() on upstream sockets that
+  // conn_fds_ does not cover
+  logs_cv_.notify_all();
+  {
+    std::lock_guard<std::mutex> lock(relay_mu_);
+    for (int fd : relay_fds_) ::shutdown(fd, SHUT_RDWR);
   }
   server_->stop();
   if (tick_thread_.joinable()) tick_thread_.join();
@@ -846,6 +856,11 @@ void Master::on_task_done(const std::string& alloc_id, int exit_code,
 
 void Master::tick_locked() {
   double now = now_sec();
+
+  // catch-all for log followers: terminal transitions that bypass
+  // on_task_done (direct kills of queued allocations, requeues) reach
+  // waiting followers within a tick instead of their full follow window
+  logs_cv_.notify_all();
 
   // expired-session sweep: dead tokens must not accumulate in memory or in
   // every snapshot write

@@ -14,6 +14,7 @@
 #include <condition_variable>
 #include <mutex>
 #include <regex>
+#include <set>
 #include <string>
 #include <thread>
 #include <vector>
@@ -204,6 +205,10 @@ class Master {
   // into O(appends x followers) reads under mu_.
   std::condition_variable logs_cv_;
   std::map<std::string, uint64_t> stream_versions_;
+  // upstream sockets of live WebSocket/TCP relays: stop() must shut them
+  // down or relay pump threads blocked in recv() would hang shutdown
+  std::mutex relay_mu_;
+  std::set<int> relay_fds_;
   int64_t next_experiment_id_ = 1;
   int64_t next_trial_id_ = 1;
   int64_t next_task_id_ = 1;

@@ -4,7 +4,9 @@
 // (proto/src/determined/api/v1/api.proto:79): experiments, trials, metrics,
 // searcher ops, checkpoints, agents, allocations (rendezvous/preemption),
 // task logs, job queue, master info.
+#include <sys/socket.h>
 #include <sys/stat.h>
+#include <unistd.h>
 
 #include <algorithm>
 #include <cctype>
@@ -355,7 +357,7 @@ HttpResponse Master::logs_follow_route(const HttpRequest& req) {
                     it->second.state == RunState::Completed ||
                     it->second.state == RunState::Errored ||
                     it->second.state == RunState::Canceled;
-    if (!recs.empty() || terminal ||
+    if (!recs.empty() || terminal || !running_ ||
         std::chrono::steady_clock::now() >= deadline) {
       Json arr = Json::array();
       for (auto& rec : recs) arr.push_back(rec);
@@ -426,6 +428,73 @@ HttpResponse Master::proxy_route(const HttpRequest& req) {
     }
     path += qs;
   }
+  // WebSocket (or any Connection: Upgrade) request: splice the two
+  // sockets instead of request/response buffering. Real jupyter under
+  // DCT_NOTEBOOK_REAL=1 needs this for kernel channels; interactive
+  // shells get a live stream instead of request/response /exec.
+  // (≈ master/internal/proxy/ws.go, tcp.go — same hijack-and-pump idea.)
+  auto conn_hdr = req.headers.find("connection");
+  auto upgrade_hdr = req.headers.find("upgrade");
+  bool wants_upgrade = false;
+  if (conn_hdr != req.headers.end() && upgrade_hdr != req.headers.end()) {
+    std::string c = conn_hdr->second;
+    for (auto& ch : c) ch = static_cast<char>(::tolower(ch));
+    wants_upgrade = c.find("upgrade") != std::string::npos;
+  }
+  if (wants_upgrade) {
+    int up_fd = tcp_connect(host, port, 10);
+    if (up_fd < 0) {
+      return HttpResponse::json(
+          502, error_json("task at " + address + " unreachable").dump());
+    }
+    // replay the request head upstream: original headers minus hop/auth
+    // ones (Host is rewritten; the session cookie/bearer must not reach
+    // untrusted task code), plus the x-alloc-token the task server
+    // expects from master-fronted traffic
+    std::ostringstream head;
+    head << req.method << ' ' << path << " HTTP/1.1\r\nHost: " << host
+         << ':' << port;
+    for (const auto& [k, v] : req.headers) {
+      if (k == "host" || k == "authorization" || k == "cookie" ||
+          k == "content-length") {
+        continue;
+      }
+      head << "\r\n" << k << ": " << v;
+    }
+    head << "\r\nx-alloc-token: " << alloc_token << "\r\n\r\n" << req.body;
+    if (!send_all_fd(up_fd, head.str())) {
+      ::close(up_fd);
+      return HttpResponse::json(
+          502, error_json("task at " + address + " dropped the upgrade")
+                   .dump());
+    }
+    HttpResponse out;
+    out.hijack = [this, up_fd](int client_fd, std::string buffered) {
+      // frames the client sent before the takeover must reach upstream —
+      // fully: a partial send would desync the spliced WS framing
+      if (!buffered.empty() && !send_all_fd(up_fd, buffered)) {
+        ::close(up_fd);
+        return;
+      }
+      // kernel sockets idle (recv) and stall (send backpressure) for
+      // long stretches; neither is a dead connection
+      timeval no_tv{0, 0};
+      ::setsockopt(up_fd, SOL_SOCKET, SO_RCVTIMEO, &no_tv, sizeof(no_tv));
+      ::setsockopt(up_fd, SOL_SOCKET, SO_SNDTIMEO, &no_tv, sizeof(no_tv));
+      {
+        std::lock_guard<std::mutex> rlock(relay_mu_);
+        relay_fds_.insert(up_fd);  // stop() shuts these down
+      }
+      relay_bidirectional(client_fd, up_fd);
+      {
+        std::lock_guard<std::mutex> rlock(relay_mu_);
+        relay_fds_.erase(up_fd);
+      }
+      ::close(up_fd);
+    };
+    return out;
+  }
+
   // inject the allocation token so the task server can reject traffic that
   // did not come through the master's authenticated proxy
   auto resp = http_request(host, port, req.method, path, req.body, 30,

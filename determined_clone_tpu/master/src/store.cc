@@ -56,18 +56,24 @@ class FileStore : public Store {
     std::vector<Json> out;
     std::string line;
     size_t index = 0;
+    // The offset cursor counts record SLOTS: non-empty {...}-shaped lines
+    // (everything this store itself writes). Skipped lines are NOT
+    // Json::parsed — log followers re-read from their cursor on every
+    // wake, and parsing 100k skipped lines under the master's state lock
+    // per appended line froze the whole API. A torn line (crash
+    // mid-append) fails the shape check and is invisible; a torn line
+    // that merged with the next append still takes its slot but parses
+    // to nothing, costing at most one duplicated record at the client.
     while (std::getline(in, line)) {
-      if (line.empty()) continue;
-      // the offset cursor counts PARSED records — clients page with
-      // offset += records_received, so a torn line must not shift it
-      Json rec;
-      try {
-        rec = Json::parse(line);
-      } catch (const std::exception&) {
+      if (line.empty() || line.front() != '{' || line.back() != '}') {
         continue;
       }
       if (index++ < offset) continue;
-      out.push_back(std::move(rec));
+      try {
+        out.push_back(Json::parse(line));
+      } catch (const std::exception&) {
+        continue;  // counted the slot; nothing to return for it
+      }
       if (out.size() >= limit) break;
     }
     return out;
