@@ -455,8 +455,10 @@ HttpResponse Master::proxy_route(const HttpRequest& req) {
     head << req.method << ' ' << path << " HTTP/1.1\r\nHost: " << host
          << ':' << port;
     for (const auto& [k, v] : req.headers) {
+      // x-alloc-token: the master injects the genuine one below — a
+      // client-supplied copy would land first and win header parsing
       if (k == "host" || k == "authorization" || k == "cookie" ||
-          k == "content-length") {
+          k == "content-length" || k == "x-alloc-token") {
         continue;
       }
       head << "\r\n" << k << ": " << v;
@@ -484,6 +486,11 @@ HttpResponse Master::proxy_route(const HttpRequest& req) {
       {
         std::lock_guard<std::mutex> rlock(relay_mu_);
         relay_fds_.insert(up_fd);  // stop() shuts these down
+      }
+      if (!running_) {
+        // raced stop()'s sweep of relay_fds_: shut down ourselves or the
+        // pump below blocks a worker join forever
+        ::shutdown(up_fd, SHUT_RDWR);
       }
       relay_bidirectional(client_fd, up_fd);
       {
@@ -1289,6 +1296,15 @@ HttpResponse Master::route(const HttpRequest& req) {
       }
     }
     // checkpoint report (≈ core/_checkpoint.py:687 chief report)
+    if (parts.size() == 5 && parts[4] == "checkpoints" && req.method == "GET") {
+      Json arr = Json::array();
+      for (const auto& c : checkpoints_) {
+        if (c.trial_id == id && !c.deleted) arr.push_back(c.to_json());
+      }
+      Json j = Json::object();
+      j.set("checkpoints", arr);
+      return ok_json(j);
+    }
     if (parts.size() == 5 && parts[4] == "checkpoints" && req.method == "POST") {
       Json body = Json::parse(req.body);
       CheckpointRecord rec;
@@ -1440,12 +1456,98 @@ HttpResponse Master::route(const HttpRequest& req) {
   }
 
   // ---- agents ------------------------------------------------------------
+  // ---- resource pools (≈ GetResourcePools, api_resourcepools.go):
+  //      configured policies + live slot/agent occupancy per pool --------
+  if (root == "resource-pools" && parts.size() == 3 &&
+      req.method == "GET") {
+    auto pool_json = [&](const std::string& name, const PoolPolicy& p) {
+      int agents = 0, slots = 0, used = 0;
+      for (const auto& [aid, a] : agents_) {
+        if (a.resource_pool != name || !a.enabled) continue;
+        ++agents;
+        slots += a.slots;
+      }
+      for (const auto& [aid, alloc] : allocations_) {
+        if (alloc.state != RunState::Running &&
+            alloc.state != RunState::Pulling) {
+          continue;
+        }
+        // attribute used slots to the agent actually holding them, and
+        // only when that agent counts toward totals — otherwise a drained
+        // agent's allocations would report >100% pool occupancy
+        for (const auto& [raid, n] : alloc.reservations) {
+          auto agent_it = agents_.find(raid);
+          if (agent_it != agents_.end() && agent_it->second.enabled &&
+              agent_it->second.resource_pool == name) {
+            used += n;
+          }
+        }
+      }
+      Json j = Json::object();
+      j.set("name", name)
+          .set("scheduler", p.type)
+          .set("preemption", p.preemption_enabled)
+          .set("agents", static_cast<int64_t>(agents))
+          .set("slots_total", static_cast<int64_t>(slots))
+          .set("slots_used", static_cast<int64_t>(used))
+          .set("is_default", name == "default");
+      return j;
+    };
+    Json arr = Json::array();
+    std::set<std::string> seen;
+    for (const auto& [name, p] : config_.pools) {
+      arr.push_back(pool_json(name, p));
+      seen.insert(name);
+    }
+    // pools that exist only because an agent registered into them run
+    // under the default policy — list them too, or occupancy is invisible
+    for (const auto& [aid, a] : agents_) {
+      if (seen.insert(a.resource_pool).second) {
+        arr.push_back(pool_json(a.resource_pool, config_.default_pool));
+      }
+    }
+    if (seen.insert("default").second) {
+      arr.push_back(pool_json("default", config_.default_pool));
+    }
+    Json j = Json::object();
+    j.set("resource_pools", arr);
+    return ok_json(j);
+  }
+
   if (root == "agents") {
     if (parts.size() == 3 && req.method == "GET") {
       Json arr = Json::array();
       for (const auto& [id, a] : agents_) arr.push_back(a.to_json());
       Json j = Json::object();
       j.set("agents", arr);
+      return ok_json(j);
+    }
+    if (parts.size() == 4 && req.method == "GET") {
+      auto ait = agents_.find(parts[3]);
+      if (ait == agents_.end()) return not_found("no agent " + parts[3]);
+      Json j = Json::object();
+      j.set("agent", ait->second.to_json());
+      return ok_json(j);
+    }
+    // operator drain controls (≈ the reference's agent enable/disable,
+    // api_agent.go): disable stops NEW fits (scheduler skips !enabled);
+    // running allocations drain naturally. draining must be set too — the
+    // heartbeat handler re-enables any non-draining live agent, which
+    // would silently undo the admin's disable seconds later.
+    if (parts.size() == 5 && req.method == "POST" &&
+        (parts[4] == "enable" || parts[4] == "disable")) {
+      if (!cluster_admin_ok(req)) {
+        return HttpResponse::json(
+            403, error_json("cluster admin required").dump());
+      }
+      auto ait = agents_.find(parts[3]);
+      if (ait == agents_.end()) return not_found("no agent " + parts[3]);
+      bool enable = parts[4] == "enable";
+      ait->second.enabled = enable;
+      ait->second.draining = !enable;
+      dirty_ = true;
+      Json j = Json::object();
+      j.set("agent", ait->second.to_json());
       return ok_json(j);
     }
     if (parts.size() == 4 && parts[3] == "register" && req.method == "POST") {

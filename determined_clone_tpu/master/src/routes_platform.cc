@@ -684,7 +684,11 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
           ModelVersion v;
           v.version = m->next_version++;
           v.checkpoint_uuid = uuid;
-          v.name = body["name"].as_string();
+          // "version_name" is the proto field (the model's own name fills
+          // the path slot); bare "name" stays accepted for raw callers
+          v.name = !body["version_name"].as_string().empty()
+                       ? body["version_name"].as_string()
+                       : body["name"].as_string();
           v.comment = body["comment"].as_string();
           v.created_at = now_sec();
           m->versions.push_back(v);

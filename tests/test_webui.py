@@ -129,8 +129,9 @@ def test_admin_nav_and_view_shipped(master):
     assert 'data-nav="admin"' in body.decode()
     _, _, body = fetch(master, "/ui/app.js")
     js = body.decode()
-    assert "viewAdmin" in js and "rbac/assignments" in js
-    assert "job-queue/" in js  # queue operator actions wired
+    # admin actions ride the generated client (webui/bindings.js)
+    assert "viewAdmin" in js and "assignRole" in js and "unassignRole" in js
+    assert "moveJob" in js and "setJobPriority" in js  # queue actions wired
 
 
 def test_trial_logs_view_shipped(master):
@@ -138,4 +139,4 @@ def test_trial_logs_view_shipped(master):
     js = body.decode()
     assert "viewTrialLogs" in js
     # the view derives the live leg's allocation id from trial.legs
-    assert "trial.legs" in js and "allocations/" in js
+    assert "trial.legs" in js and "getTaskLogs" in js

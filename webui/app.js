@@ -34,6 +34,9 @@ async function api(method, path, body) {
   return out;
 }
 
+// generated typed client (webui/bindings.js, from api.proto) over api()
+const dct = dctBindings(api);
+
 function showLogin() {
   document.getElementById("login").classList.remove("hidden");
 }
@@ -67,7 +70,7 @@ document.getElementById("login-form").addEventListener("submit", async (e) => {
   e.preventDefault();
   const form = new FormData(e.target);
   try {
-    const out = await api("POST", "/api/v1/auth/login", {
+    const out = await dct.login({
       username: form.get("username"), password: form.get("password"),
     });
     localStorage.setItem("dct-token", out.token);
@@ -251,9 +254,9 @@ function esc(s) {
 async function viewDashboard() {
   const gen = renderGen;
   const [info, exps, agents] = await Promise.all([
-    api("GET", "/api/v1/master"),
-    api("GET", "/api/v1/experiments"),
-    api("GET", "/api/v1/agents"),
+    dct.getMaster(),
+    dct.listExperiments(),
+    dct.listAgents(),
   ]);
   if (gen !== renderGen) return;
   const active = exps.experiments.filter((e) => e.state === "RUNNING").length;
@@ -288,7 +291,7 @@ function experimentTable(exps) {
 
 async function viewExperiments() {
   const gen = renderGen;
-  const out = await api("GET", "/api/v1/experiments");
+  const out = await dct.listExperiments();
   if (gen !== renderGen) return;
   $view.innerHTML = `<h1>Experiments</h1>
     ${experimentTable(out.experiments.slice().reverse())}`;
@@ -297,7 +300,7 @@ async function viewExperiments() {
 
 async function viewExperimentDetail(id) {
   const gen = renderGen;
-  const detail = await api("GET", `/api/v1/experiments/${id}`);
+  const detail = await dct.getExperiment({ id });
   if (gen !== renderGen) return;
   const exp = detail.experiment;
   const trials = detail.trials || [];
@@ -344,14 +347,15 @@ async function viewExperimentDetail(id) {
     const el = document.getElementById(btn);
     if (el) {
       el.addEventListener("click", action(async () => {
-        await api("POST", `/api/v1/experiments/${id}/${verb}`);
+        // verb is pause|activate|archive|unarchive -> pauseExperiment...
+        await dct[verb + "Experiment"]({ id });
       }, () => viewExperimentDetail(id)));
     }
   }
   const delBtn = document.getElementById("exp-delete");
   if (delBtn) {
     delBtn.addEventListener("click", action(async () => {
-      await api("DELETE", `/api/v1/experiments/${id}`);
+      await dct.deleteExperiment({ id });
       location.hash = "#/experiments";
     }, () => {}));
   }
@@ -360,7 +364,7 @@ async function viewExperimentDetail(id) {
   // fetched concurrently and reused for the training-loss fallback
   const shown = trials.slice(0, 8);
   const fetched = await Promise.all(shown.map((t) =>
-      api("GET", `/api/v1/trials/${t.id}/metrics?limit=5000`)));
+      dct.getTrialMetrics({ id: t.id, limit: 5000 })));
   if (gen !== renderGen) return;
   let chartMetric = `${metric} (validation)`;
   let series = shown.map((t, i) => ({
@@ -388,7 +392,7 @@ async function viewExperimentDetail(id) {
 
 async function viewTasks() {
   const gen = renderGen;
-  const out = await api("GET", "/api/v1/tasks");
+  const out = await dct.listTasks();
   if (gen !== renderGen) return;
   const tasks = out.tasks.slice().reverse();
   $view.innerHTML = `<h1>Tasks</h1>
@@ -404,7 +408,7 @@ async function viewTasks() {
 async function viewTaskLogs(id) {
   const gen = renderGen;
   const [task, recs] = await Promise.all([
-    api("GET", `/api/v1/tasks/${id}`),
+    dct.getTask({ id }),
     fetchLogRecs(id),
   ]);
   if (gen !== renderGen) return;
@@ -429,9 +433,8 @@ function fmtLogRec(r) {
 }
 
 async function fetchLogRecs(allocId) {
-  const logs = await api(
-      "GET", `/api/v1/allocations/${allocId}/logs?limit=2000`);
-  return logs.logs;
+  const logs = await dct.getTaskLogs({ id: allocId, limit: 2000 });
+  return logs.logs || [];
 }
 
 // Live tail: long-poll the follow endpoint and APPEND new lines to the
@@ -444,9 +447,8 @@ async function tailLogs(allocId, preEl, gen, startOffset) {
   while (gen === renderGen) {
     let out;
     try {
-      out = await api(
-          "GET", `/api/v1/allocations/${allocId}/logs` +
-                 `?limit=1000&offset=${offset}&follow=30`);
+      out = await dct.getTaskLogs(
+          { id: allocId, limit: 1000, offset, follow: 30 });
     } catch (err) {
       return;
     }
@@ -463,7 +465,7 @@ async function tailLogs(allocId, preEl, gen, startOffset) {
 
 async function viewTrialLogs(id) {
   const gen = renderGen;
-  const detail = await api("GET", `/api/v1/trials/${id}`);
+  const detail = await dct.getTrial({ id });
   if (gen !== renderGen) return;
   const trial = detail.trial;
   // the server names the live leg (managed and unmanaged legs differ)
@@ -504,8 +506,8 @@ async function viewTrialLogs(id) {
 async function viewCluster() {
   const gen = renderGen;
   const [agents, queue] = await Promise.all([
-    api("GET", "/api/v1/agents"),
-    api("GET", "/api/v1/job-queue"),
+    dct.listAgents(),
+    dct.getJobQueue(),
   ]);
   if (gen !== renderGen) return;
   $view.innerHTML = `<h1>Cluster</h1>
@@ -538,15 +540,14 @@ async function viewCluster() {
       const first = queued
           .slice().sort((a, b) => a.queued_at - b.queued_at)[0];
       if (first && first.id !== btn.dataset.id) {
-        await api("POST", `/api/v1/job-queue/${btn.dataset.id}/move`,
-                  { ahead_of: first.id });
+        await dct.moveJob({ id: btn.dataset.id, ahead_of: first.id });
       }
     }, viewCluster));
   });
   $view.querySelectorAll("input.prio").forEach((inp) => {
     inp.addEventListener("change", action(async () => {
-      await api("POST", `/api/v1/job-queue/${inp.dataset.id}/priority`,
-                { priority: Number(inp.value) });
+      await dct.setJobPriority({ id: inp.dataset.id,
+                                 priority: Number(inp.value) });
     }, viewCluster));
   });
   scheduleRefresh(viewCluster, true);
@@ -555,10 +556,10 @@ async function viewCluster() {
 async function viewAdmin() {
   const gen = renderGen;
   const [users, groups, roles, assignments] = await Promise.all([
-    api("GET", "/api/v1/users"),
-    api("GET", "/api/v1/groups"),
-    api("GET", "/api/v1/rbac/roles"),
-    api("GET", "/api/v1/rbac/assignments"),
+    dct.listUsers(),
+    dct.listGroups(),
+    dct.listRoles(),
+    dct.listRoleAssignments(),
   ]);
   if (gen !== renderGen) return;
   const userName = (id) =>
@@ -612,14 +613,13 @@ async function viewAdmin() {
   document.getElementById("group-form").addEventListener("submit",
       action(async (e) => {
         e.preventDefault();
-        await api("POST", "/api/v1/groups",
-                  { name: e.target.name.value });
+        await dct.createGroup({ name: e.target.name.value });
       }, viewAdmin));
   document.getElementById("assign-form").addEventListener("submit",
       action(async (e) => {
         e.preventDefault();
         const p = e.target.principal.value;
-        await api("POST", "/api/v1/rbac/assignments", {
+        await dct.assignRole({
           role: e.target.role.value,
           user_id: p[0] === "u" ? Number(p.slice(1)) : 0,
           group_id: p[0] === "g" ? Number(p.slice(1)) : 0,
@@ -628,7 +628,7 @@ async function viewAdmin() {
       }, viewAdmin));
   $view.querySelectorAll("button.revoke").forEach((btn) => {
     btn.addEventListener("click", action(async () => {
-      await api("DELETE", `/api/v1/rbac/assignments/${btn.dataset.id}`);
+      await dct.unassignRole({ id: btn.dataset.id });
     }, viewAdmin));
   });
 }
@@ -702,7 +702,7 @@ if (location.hash.startsWith("#sso_token=")) {
 }
 
 window.addEventListener("hashchange", route);
-api("GET", "/api/v1/auth/me")
+dct.getMe()
     .then((out) => {
       document.getElementById("whoami").textContent = out.user.username;
     })
