@@ -170,6 +170,9 @@ struct Agent {
   // terminated by the provisioner: the VM is being deleted, so heartbeats
   // must NOT re-enable it (a fresh registration clears it)
   bool draining = false;
+  // operator drain (POST /agents/:id/disable): unlike draining, this
+  // survives agent re-registration — only an explicit enable clears it
+  bool admin_disabled = false;
   std::set<std::string> blocked_by;  // experiment ids that blocklisted this node
 
   Json to_json() const {
@@ -179,7 +182,8 @@ struct Agent {
     j.set("id", id).set("resource_pool", resource_pool).set("slots", slots)
         .set("topology", topology).set("address", address)
         .set("last_heartbeat", last_heartbeat).set("enabled", enabled)
-        .set("draining", draining).set("blocked_by", blocked);
+        .set("draining", draining).set("admin_disabled", admin_disabled)
+        .set("blocked_by", blocked);
     return j;
   }
   static Agent from_json(const Json& j) {
@@ -192,6 +196,7 @@ struct Agent {
     a.last_heartbeat = j["last_heartbeat"].as_number();
     a.enabled = j["enabled"].as_bool(true);
     a.draining = j["draining"].as_bool(false);
+    a.admin_disabled = j["admin_disabled"].as_bool(false);
     for (const auto& b : j["blocked_by"].elements()) {
       a.blocked_by.insert(b.as_string());
     }

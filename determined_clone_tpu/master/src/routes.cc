@@ -1544,7 +1544,7 @@ HttpResponse Master::route(const HttpRequest& req) {
       if (ait == agents_.end()) return not_found("no agent " + parts[3]);
       bool enable = parts[4] == "enable";
       ait->second.enabled = enable;
-      ait->second.draining = !enable;
+      ait->second.admin_disabled = !enable;  // survives re-registration
       dirty_ = true;
       Json j = Json::object();
       j.set("agent", ait->second.to_json());
@@ -1563,8 +1563,10 @@ HttpResponse Master::route(const HttpRequest& req) {
       if (!body["resource_pool"].as_string().empty()) {
         agent.resource_pool = body["resource_pool"].as_string();
       }
-      agent.enabled = true;
-      agent.draining = false;  // a fresh registration is a live node again
+      // a fresh registration is a live node again — unless an operator
+      // disabled it: that drain must survive agent restarts
+      agent.enabled = !agent.admin_disabled;
+      agent.draining = false;
       agent.last_heartbeat = now_sec();
       dirty_ = true;
       Json j = Json::object();
@@ -1578,8 +1580,11 @@ HttpResponse Master::route(const HttpRequest& req) {
       if (it == agents_.end()) return not_found("unregistered agent " + aid);
       it->second.last_heartbeat = now_sec();
       // a draining agent (provisioner-terminated, VM deletion in flight)
-      // must not flip back to schedulable on its dying heartbeats
-      if (!it->second.draining) it->second.enabled = true;
+      // or an admin-disabled one must not flip back to schedulable on
+      // its heartbeats
+      if (!it->second.draining && !it->second.admin_disabled) {
+        it->second.enabled = true;
+      }
       Json body = req.body.empty() ? Json::object() : Json::parse(req.body);
       // exit reports ride the heartbeat at-least-once (agent retries until
       // a heartbeat succeeds); on_task_done is terminal-state idempotent.

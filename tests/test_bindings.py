@@ -353,12 +353,20 @@ def test_round4_surface_bindings(master):
     assert one.agent.id == aid
     off = b.disable_agent(master, b.V1DisableAgentRequest(id=aid))
     assert not off.agent.enabled
-    # a live agent's heartbeat must NOT undo the admin drain
+    # neither a heartbeat NOR a re-registration (agent restart / missed
+    # heartbeat backoff) may undo the admin drain
     master.post(f"/api/v1/agents/{aid}/heartbeat", {})
+    assert not b.get_agent(
+        master, b.V1GetAgentRequest(id=aid)).agent.enabled
+    master.post("/api/v1/agents/register",
+                {"id": aid, "slots": 4, "topology": "v5e-4"})
     assert not b.get_agent(
         master, b.V1GetAgentRequest(id=aid)).agent.enabled
     on = b.enable_agent(master, b.V1EnableAgentRequest(id=aid))
     assert on.agent.enabled
+    master.post("/api/v1/agents/register",
+                {"id": aid, "slots": 4, "topology": "v5e-4"})
+    assert b.get_agent(master, b.V1GetAgentRequest(id=aid)).agent.enabled
 
     # experiment context + allocation data plane
     ctx = b.get_experiment_context(

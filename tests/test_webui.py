@@ -140,3 +140,78 @@ def test_trial_logs_view_shipped(master):
     assert "viewTrialLogs" in js
     # the view derives the live leg's allocation id from trial.legs
     assert "trial.legs" in js and "getTaskLogs" in js
+
+
+def post(port, path, body=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body or {}).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def test_parity_pages_shipped_and_drive_real_api(master):
+    """Round-4 parity pages (VERDICT #6): queue, model registry,
+    workspaces/projects, trial detail with metrics + profiler charts —
+    each present in the bundle and backed by a live API flow."""
+    _, _, body = fetch(master, "/ui/app.js")
+    js = body.decode()
+    for marker in ["viewQueue", "viewModels", "viewModelDetail",
+                   "viewWorkspaces", "viewWorkspaceDetail",
+                   "viewTrialDetail", "listResourcePools",
+                   "getTrialProfiler", "registerModelVersion"]:
+        assert marker in js, f"app.js missing {marker}"
+    _, _, body = fetch(master, "/ui/index.html")
+    index = body.decode()
+    for nav in ["queue", "models", "workspaces"]:
+        assert f'data-nav="{nav}"' in index
+
+    # the queue page's fetches
+    _, _, body = fetch(master, "/api/v1/resource-pools")
+    pools = json.loads(body)["resource_pools"]
+    assert any(p["is_default"] for p in pools)
+
+    # model registry flow exactly as the page drives it
+    post(master, "/api/v1/models", {"name": "ui-model",
+                                    "description": "from the ui test"})
+    _, _, body = fetch(master, "/api/v1/models/ui-model")
+    assert json.loads(body)["model"]["description"] == "from the ui test"
+
+    # workspace detail flow
+    ws = post(master, "/api/v1/workspaces", {"name": "ui-ws"})["workspace"]
+    post(master, f"/api/v1/workspaces/{ws['id']}/projects",
+         {"name": "ui-proj"})
+    _, _, body = fetch(master, f"/api/v1/workspaces/{ws['id']}")
+    detail = json.loads(body)
+    assert [p["name"] for p in detail["projects"]][-1] == "ui-proj"
+    assert "experiments" in detail
+
+    # trial detail flow: experiment -> trial -> metrics/profiler/checkpoints
+    exp = post(master, "/api/v1/experiments", {"config": {
+        "name": "ui-exp", "entrypoint": "x:Y",
+        "searcher": {"name": "single", "metric": "loss",
+                     "max_length": {"batches": 1}},
+        "hyperparameters": {},
+    }})["experiment"]
+    deadline = time.time() + 30
+    trial_id = None
+    while time.time() < deadline and trial_id is None:
+        _, _, body = fetch(master, f"/api/v1/experiments/{exp['id']}")
+        trials = json.loads(body).get("trials") or []
+        trial_id = trials[0]["id"] if trials else None
+        time.sleep(0.2)
+    post(master, f"/api/v1/trials/{trial_id}/metrics",
+         {"group": "training", "steps_completed": 1,
+          "metrics": {"loss": 1.5}})
+    post(master, f"/api/v1/trials/{trial_id}/profiler",
+         {"samples": [{"cpu_pct": 12.5, "mem_mb": 100}]})
+    _, _, body = fetch(master, f"/api/v1/trials/{trial_id}")
+    assert json.loads(body)["trial"]["id"] == trial_id
+    _, _, body = fetch(master, f"/api/v1/trials/{trial_id}/metrics?limit=10")
+    assert json.loads(body)["metrics"][-1]["metrics"]["loss"] == 1.5
+    _, _, body = fetch(master, f"/api/v1/trials/{trial_id}/profiler?limit=10")
+    assert json.loads(body)["samples"][-1]["cpu_pct"] == 12.5
+    _, _, body = fetch(master, f"/api/v1/trials/{trial_id}/checkpoints")
+    assert "checkpoints" in json.loads(body)
+    post(master, f"/api/v1/experiments/{exp['id']}/kill")

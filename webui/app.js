@@ -329,7 +329,7 @@ async function viewExperimentDetail(id) {
     <table><tr><th>ID</th><th>State</th><th>Units</th>
       <th>Best ${esc(metric)}</th><th>Restarts</th><th>Hparams</th>
       <th></th></tr>
-      ${trials.map((t) => `<tr>
+      ${trials.map((t) => `<tr class="rowlink" data-href="#/trials/${t.id}">
         <td>${t.id}</td><td>${stateBadge(t.state)}</td>
         <td>${t.units_done}/${t.target_units}</td>
         <td>${t.has_metric ? Number(t.best_metric).toPrecision(5) : "—"}</td>
@@ -337,6 +337,7 @@ async function viewExperimentDetail(id) {
         <td class="muted">${esc(JSON.stringify(t.hparams))}</td>
         <td><a href="#/trials/${t.id}/logs">logs</a></td></tr>`).join("")}
     </table>`;
+  bindRowLinks();  // trial rows open the trial-detail page
 
   // lifecycle actions (≈ the reference experiment-detail header buttons)
   for (const [btn, verb] of [["exp-pause", "pause"],
@@ -503,6 +504,27 @@ async function viewTrialLogs(id) {
   }
 }
 
+// queue operator actions shared by the Cluster section and the Queue page
+// (≈ the reference job-queue page's move/priority)
+function bindQueueControls(queue, rerender) {
+  const queued = queue.filter((j) => j.state === "QUEUED");
+  $view.querySelectorAll("button.movefront").forEach((btn) => {
+    btn.addEventListener("click", action(async () => {
+      const first = queued
+          .slice().sort((a, b) => a.queued_at - b.queued_at)[0];
+      if (first && first.id !== btn.dataset.id) {
+        await dct.moveJob({ id: btn.dataset.id, ahead_of: first.id });
+      }
+    }, rerender));
+  });
+  $view.querySelectorAll("input.prio").forEach((inp) => {
+    inp.addEventListener("change", action(async () => {
+      await dct.setJobPriority({ id: inp.dataset.id,
+                                 priority: Number(inp.value) });
+    }, rerender));
+  });
+}
+
 async function viewCluster() {
   const gen = renderGen;
   const [agents, queue] = await Promise.all([
@@ -533,24 +555,271 @@ async function viewCluster() {
               ? `<button class="movefront" data-id="${esc(j.id)}">
                  to front</button>` : ""}</td></tr>`).join("")}
       </table>` : `<p class="muted">queue is empty</p>`}`;
-  // operator actions (≈ the reference job-queue page's move/priority)
-  const queued = queue.queue.filter((j) => j.state === "QUEUED");
-  $view.querySelectorAll("button.movefront").forEach((btn) => {
-    btn.addEventListener("click", action(async () => {
-      const first = queued
-          .slice().sort((a, b) => a.queued_at - b.queued_at)[0];
-      if (first && first.id !== btn.dataset.id) {
-        await dct.moveJob({ id: btn.dataset.id, ahead_of: first.id });
-      }
-    }, viewCluster));
-  });
-  $view.querySelectorAll("input.prio").forEach((inp) => {
-    inp.addEventListener("change", action(async () => {
-      await dct.setJobPriority({ id: inp.dataset.id,
-                                 priority: Number(inp.value) });
-    }, viewCluster));
-  });
+  bindQueueControls(queue.queue, viewCluster);
   scheduleRefresh(viewCluster, true);
+}
+
+// dedicated job-queue operator page (≈ webui/react pages/JobQueue): pool
+// occupancy up top, reorder + priority controls on the queue itself
+async function viewQueue() {
+  const gen = renderGen;
+  const [pools, queue] = await Promise.all([
+    dct.listResourcePools(),
+    dct.getJobQueue(),
+  ]);
+  if (gen !== renderGen) return;
+  $view.innerHTML = `<h1>Job queue</h1>
+    <div class="cards">
+      ${pools.resource_pools.map((p) => card(
+          `${p.slots_used}/${p.slots_total}`,
+          `${esc(p.name)} (${esc(p.scheduler)})`)).join("")}
+    </div>
+    ${queue.queue.length ? `<table><tr><th>ID</th><th>Type</th><th>State</th>
+      <th>Slots</th><th>Priority</th><th>Pool</th><th>Queued</th>
+      <th>Actions</th></tr>
+      ${queue.queue.map((j) => `<tr><td>${esc(j.id)}</td>
+        <td>${esc(j.task_type)}</td><td>${stateBadge(j.state)}</td>
+        <td>${j.slots}</td>
+        <td><input class="prio" data-id="${esc(j.id)}" type="number"
+             value="${j.priority}" style="width:4em"></td>
+        <td>${esc(j.resource_pool)}</td>
+        <td class="muted">${new Date(j.queued_at * 1000)
+            .toLocaleTimeString()}</td>
+        <td>${j.state === "QUEUED"
+              ? `<button class="movefront" data-id="${esc(j.id)}">
+                 to front</button>` : ""}</td></tr>`).join("")}
+      </table>` : `<p class="muted">queue is empty</p>`}`;
+  bindQueueControls(queue.queue, viewQueue);
+  scheduleRefresh(viewQueue, true);
+}
+
+// model registry (≈ webui/react ModelRegistryPage)
+async function viewModels() {
+  const gen = renderGen;
+  const out = await dct.listModels();
+  if (gen !== renderGen) return;
+  const models = out.models || [];
+  $view.innerHTML = `<h1>Model registry</h1>
+    ${models.length ? `<table><tr><th>Name</th><th>Description</th>
+      <th>Labels</th><th>Versions</th><th>Workspace</th><th>Owner</th></tr>
+      ${models.map((m) => `<tr class="rowlink"
+          data-href="#/models/${encodeURIComponent(m.name)}">
+        <td>${esc(m.name)}${m.archived
+            ? ` <span class="muted">(archived)</span>` : ""}</td>
+        <td>${esc(m.description || "")}</td>
+        <td class="muted">${esc((m.labels || []).join(", "))}</td>
+        <td>${(m.versions || []).length}</td>
+        <td>${esc(m.workspace || "")}</td>
+        <td>${esc(m.owner || "")}</td></tr>`).join("")}
+      </table>` : `<p class="muted">no registered models</p>`}`;
+  bindRowLinks();
+}
+
+async function viewModelDetail(name) {
+  const gen = renderGen;
+  const out = await dct.getModel({ name });
+  if (gen !== renderGen) return;
+  const m = out.model;
+  $view.innerHTML = `
+    <a class="backlink" href="#/models">← models</a>
+    <h1>${esc(m.name)}
+      ${m.archived ? `<span class="muted">(archived)</span>` : ""}
+      <span class="actions">
+        <button id="model-archive">${m.archived ? "unarchive" : "archive"}
+        </button>
+        <button id="model-delete">delete</button>
+      </span></h1>
+    <p class="muted">${esc(m.description || "no description")}</p>
+    <h2>Versions</h2>
+    ${(m.versions || []).length ? `<table><tr><th>Version</th><th>Name</th>
+      <th>Checkpoint</th><th>Registered</th><th></th></tr>
+      ${m.versions.map((v) => `<tr><td>${v.version}</td>
+        <td>${esc(v.name || "")}</td>
+        <td class="muted">${esc(v.checkpoint_uuid)}</td>
+        <td class="muted">${new Date(v.created_at * 1000)
+            .toLocaleString()}</td>
+        <td><button class="delver" data-v="${v.version}">delete</button>
+        </td></tr>`).join("")}
+      </table>` : `<p class="muted">no versions registered</p>`}
+    <h2>Register version</h2>
+    <form id="regver-form">
+      <input name="checkpoint_uuid" placeholder="checkpoint uuid" required>
+      <input name="version_name" placeholder="version name (optional)">
+      <button>register</button>
+    </form>`;
+  const rerender = () => viewModelDetail(name);
+  document.getElementById("model-archive").addEventListener("click",
+      action(async () => {
+        await (m.archived ? dct.unarchiveModel({ name })
+                          : dct.archiveModel({ name }));
+      }, rerender));
+  document.getElementById("model-delete").addEventListener("click",
+      action(async () => {
+        await dct.deleteModel({ name });
+        location.hash = "#/models";
+      }, () => {}));
+  $view.querySelectorAll("button.delver").forEach((btn) => {
+    btn.addEventListener("click", action(async () => {
+      await dct.deleteModelVersion({ name, version: btn.dataset.v });
+    }, rerender));
+  });
+  document.getElementById("regver-form").addEventListener("submit",
+      action(async (e) => {
+        e.preventDefault();
+        await dct.registerModelVersion({
+          name,
+          checkpoint_uuid: e.target.checkpoint_uuid.value,
+          version_name: e.target.version_name.value,
+        });
+      }, rerender));
+}
+
+// workspaces + projects (≈ webui/react WorkspaceList/ProjectDetails)
+async function viewWorkspaces() {
+  const gen = renderGen;
+  const out = await dct.listWorkspaces();
+  if (gen !== renderGen) return;
+  const ws = out.workspaces || [];
+  $view.innerHTML = `<h1>Workspaces</h1>
+    ${ws.length ? `<table><tr><th>ID</th><th>Name</th><th>Owner</th>
+      <th>Status</th></tr>
+      ${ws.map((w) => `<tr class="rowlink" data-href="#/workspaces/${w.id}">
+        <td>${w.id}</td><td>${esc(w.name)}</td><td>${esc(w.owner)}</td>
+        <td class="muted">${w.archived ? "archived" : ""}</td>
+        </tr>`).join("")}
+      </table>` : `<p class="muted">no workspaces</p>`}
+    <h2>New workspace</h2>
+    <form id="ws-form">
+      <input name="name" placeholder="workspace name" required>
+      <button>create</button>
+    </form>`;
+  bindRowLinks();
+  document.getElementById("ws-form").addEventListener("submit",
+      action(async (e) => {
+        e.preventDefault();
+        await dct.createWorkspace({ name: e.target.name.value });
+      }, viewWorkspaces));
+}
+
+async function viewWorkspaceDetail(id) {
+  const gen = renderGen;
+  const detail = await dct.getWorkspace({ id });
+  if (gen !== renderGen) return;
+  const w = detail.workspace;
+  const projects = detail.projects || [];
+  const exps = detail.experiments || [];
+  $view.innerHTML = `
+    <a class="backlink" href="#/workspaces">← workspaces</a>
+    <h1>${esc(w.name)} <span class="muted">#${w.id}</span>
+      ${w.archived ? `<span class="muted">(archived)</span>` : ""}
+      <span class="actions">
+        ${w.immutable ? "" : `<button id="ws-archive">
+          ${w.archived ? "unarchive" : "archive"}</button>`}
+      </span></h1>
+    <h2>Projects</h2>
+    ${projects.length ? `<table><tr><th>ID</th><th>Name</th>
+      <th>Description</th></tr>
+      ${projects.map((p) => `<tr><td>${p.id}</td><td>${esc(p.name)}</td>
+        <td class="muted">${esc(p.description || "")}</td>
+        </tr>`).join("")}
+      </table>` : `<p class="muted">no projects</p>`}
+    <form id="proj-form">
+      <input name="name" placeholder="new project name" required>
+      <input name="description" placeholder="description">
+      <button>create project</button>
+    </form>
+    <h2>Experiments</h2>
+    ${experimentTable(exps.slice().reverse())}`;
+  bindRowLinks();
+  const rerender = () => viewWorkspaceDetail(id);
+  const arch = document.getElementById("ws-archive");
+  if (arch) {
+    arch.addEventListener("click", action(async () => {
+      await (w.archived ? dct.unarchiveWorkspace({ id })
+                        : dct.archiveWorkspace({ id }));
+    }, rerender));
+  }
+  document.getElementById("proj-form").addEventListener("submit",
+      action(async (e) => {
+        e.preventDefault();
+        await dct.createProject({ id, name: e.target.name.value,
+                                  description: e.target.description.value });
+      }, rerender));
+}
+
+// trial detail (≈ webui/react TrialDetails): metrics + profiler charts,
+// checkpoints, hparams, live link to the log tail
+async function viewTrialDetail(id) {
+  const gen = renderGen;
+  const [detail, metrics, profiler, ckpts] = await Promise.all([
+    dct.getTrial({ id }),
+    dct.getTrialMetrics({ id, limit: 5000 }),
+    dct.getTrialProfiler({ id, limit: 2000 }),
+    dct.getTrialCheckpoints({ id }),
+  ]);
+  if (gen !== renderGen) return;
+  const t = detail.trial;
+  $view.innerHTML = `
+    <a class="backlink" href="#/experiments/${t.experiment_id}">← experiment
+      ${t.experiment_id}</a>
+    <h1>Trial ${t.id} ${stateBadge(t.state)}
+      <span class="actions"><a href="#/trials/${t.id}/logs">live logs</a>
+      </span></h1>
+    <div class="cards">
+      ${card(`${t.units_done}/${t.target_units}`, "units")}
+      ${card(t.restarts, "restarts")}
+      ${card(t.has_metric ? Number(t.best_metric).toPrecision(5) : "—",
+             "best metric")}
+    </div>
+    <p class="muted">hparams: ${esc(JSON.stringify(t.hparams))}</p>
+    <div id="trial-chart"></div>
+    <div id="profiler-chart"></div>
+    <h2>Checkpoints</h2>
+    ${(ckpts.checkpoints || []).length ? `<table><tr><th>UUID</th>
+      <th>Reported</th><th>Metadata</th></tr>
+      ${ckpts.checkpoints.map((c) => `<tr>
+        <td class="muted">${esc(c.uuid)}</td>
+        <td class="muted">${new Date(c.reported_at * 1000)
+            .toLocaleString()}</td>
+        <td class="muted">${esc(JSON.stringify(c.metadata))}</td>
+        </tr>`).join("")}
+      </table>` : `<p class="muted">no checkpoints reported</p>`}`;
+
+  // training + validation series on one chart
+  const groups = [["training", "loss"], ["validation", null]];
+  const series = [];
+  for (const [group, onlyKey] of groups) {
+    const recs = (metrics.metrics || []).filter((r) => r.group === group);
+    const keys = new Set();
+    recs.forEach((r) => Object.keys(r.metrics || {}).forEach(
+        (k) => { if (typeof r.metrics[k] === "number") keys.add(k); }));
+    for (const k of keys) {
+      if (onlyKey && k !== onlyKey) continue;
+      series.push({
+        name: `${k} (${group})`,
+        points: recs.filter((r) => typeof (r.metrics || {})[k] === "number")
+            .map((r, j) => [r.steps_completed ?? j, r.metrics[k]]),
+      });
+    }
+  }
+  lineChart(document.getElementById("trial-chart"), "metrics by step",
+            series);
+
+  // profiler: numeric system-metric samples over their sample index
+  const samples = profiler.samples || [];
+  const pkeys = new Set();
+  samples.forEach((s) => Object.keys(s).forEach((k) => {
+    if (typeof s[k] === "number") pkeys.add(k);
+  }));
+  const pseries = [...pkeys].slice(0, 8).map((k) => ({
+    name: k,
+    points: samples.map((s, j) => [j, s[k]])
+        .filter((p) => typeof p[1] === "number"),
+  }));
+  lineChart(document.getElementById("profiler-chart"),
+            "profiler samples", pseries);
+  scheduleRefresh(() => viewTrialDetail(id),
+                  ["RUNNING", "PULLING", "QUEUED"].includes(t.state));
 }
 
 async function viewAdmin() {
@@ -639,7 +908,12 @@ async function viewAdmin() {
 
 function bindRowLinks() {
   $view.querySelectorAll("tr.rowlink").forEach((tr) => {
-    tr.addEventListener("click", () => { location.hash = tr.dataset.href.slice(1); });
+    tr.addEventListener("click", (e) => {
+      // an explicit link inside the row (e.g. the trial "logs" anchor)
+      // wins over the row's own navigation
+      if (e.target.closest("a")) return;
+      location.hash = tr.dataset.href.slice(1);
+    });
   });
 }
 
@@ -674,6 +948,18 @@ async function route() {
       await viewExperimentDetail(parts[1]);
     } else if (parts[0] === "experiments") {
       await viewExperiments();
+    } else if (parts[0] === "queue") {
+      await viewQueue();
+    } else if (parts[0] === "models" && parts[1]) {
+      await viewModelDetail(decodeURIComponent(parts[1]));
+    } else if (parts[0] === "models") {
+      await viewModels();
+    } else if (parts[0] === "workspaces" && parts[1]) {
+      await viewWorkspaceDetail(parts[1]);
+    } else if (parts[0] === "workspaces") {
+      await viewWorkspaces();
+    } else if (parts[0] === "trials" && parts[1] && !parts[2]) {
+      await viewTrialDetail(parts[1]);
     } else if (parts[0] === "trials" && parts[1] && parts[2] === "logs") {
       await viewTrialLogs(parts[1]);
     } else if (parts[0] === "tasks" && parts[1]) {
