@@ -1,4 +1,4 @@
 """Built-in model families (≈ the reference's examples/ + model_hub coverage)."""
-from determined_clone_tpu.models import gpt, mlp, mnist_cnn, vit
+from determined_clone_tpu.models import bert, gpt, mlp, mnist_cnn, resnet, vit
 
-__all__ = ["gpt", "mlp", "mnist_cnn", "vit"]
+__all__ = ["bert", "gpt", "mlp", "mnist_cnn", "resnet", "vit"]

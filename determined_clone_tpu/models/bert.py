@@ -1,0 +1,200 @@
+"""BERT — bidirectional encoder for masked-LM pretraining and fine-tuning.
+
+Fills BASELINE.json config #4 ("examples/hf_trainer_api BERT fine-tune via
+Core API"; the reference fine-tunes HF BERT through its Core API — see
+/root/reference/examples/hf_trainer_api). The HF-checkpoint path lives in
+model_hub/huggingface.py; this module is the native TPU family for
+training from scratch or fine-tuning without torch weights.
+
+Same TPU-first construction as models/gpt.py:
+- stacked-block params ([L, ...] leading dim) walked by ``lax.scan`` —
+  one compiled block body regardless of depth;
+- bfloat16 matmuls (params float32), bidirectional ``mha`` (no causal
+  mask — the encoder half the GPT stack never uses);
+- learned position + segment embeddings, MLM head tied to the token
+  embedding, and a [CLS] pooler + classification head for fine-tunes.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from determined_clone_tpu.ops.attention import mha
+from determined_clone_tpu.ops.layers import (
+    dense,
+    dense_init,
+    embedding_init,
+    gelu,
+    layernorm,
+    layernorm_init,
+    softmax_cross_entropy,
+    trunc_normal,
+)
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522      # bert-base wordpiece vocab
+    n_layers: int = 12
+    d_model: int = 768
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 512
+    n_segments: int = 2
+    n_classes: int = 2           # fine-tune head (e.g. GLUE pair tasks)
+    compute_dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def tiny() -> "BertConfig":
+        return BertConfig(vocab_size=256, n_layers=2, d_model=64, n_heads=4,
+                          d_ff=128, max_seq_len=64, n_classes=2,
+                          compute_dtype=jnp.float32, remat=False)
+
+
+def init(key: jax.Array, cfg: BertConfig) -> Params:
+    keys = jax.random.split(key, 8)
+    L, D, F = cfg.n_layers, cfg.d_model, cfg.d_ff
+    dt = cfg.param_dtype
+
+    def stacked(k, shape, stddev=0.02):
+        return trunc_normal(k, (L, *shape), stddev=stddev, dtype=dt)
+
+    blocks: Params = {
+        "ln1": {"scale": jnp.ones((L, D), dt), "bias": jnp.zeros((L, D), dt)},
+        "attn_qkv": {"kernel": stacked(keys[1], (D, 3 * D)),
+                     "bias": jnp.zeros((L, 3 * D), dt)},
+        "attn_out": {"kernel": stacked(keys[2], (D, D),
+                                       stddev=0.02 / (2 * L) ** 0.5),
+                     "bias": jnp.zeros((L, D), dt)},
+        "ln2": {"scale": jnp.ones((L, D), dt), "bias": jnp.zeros((L, D), dt)},
+        "mlp_up": {"kernel": stacked(keys[3], (D, F)),
+                   "bias": jnp.zeros((L, F), dt)},
+        "mlp_down": {"kernel": stacked(keys[4], (F, D),
+                                       stddev=0.02 / (2 * L) ** 0.5),
+                     "bias": jnp.zeros((L, D), dt)},
+    }
+    return {
+        "embed": embedding_init(keys[0], cfg.vocab_size, D, dtype=dt),
+        "pos_embed": trunc_normal(keys[5], (cfg.max_seq_len, D), dtype=dt),
+        "seg_embed": trunc_normal(keys[6], (cfg.n_segments, D), dtype=dt),
+        "embed_norm": layernorm_init(D, dtype=dt),
+        "blocks": blocks,
+        "pooler": dense_init(keys[7], D, D, dtype=dt),
+        "cls_head": dense_init(jax.random.fold_in(keys[7], 1), D,
+                               cfg.n_classes, dtype=dt),
+        # MLM output bias (the projection is tied to the embedding table)
+        "mlm_bias": jnp.zeros((cfg.vocab_size,), dt),
+    }
+
+
+def _block(cfg: BertConfig, p: Params, x: jax.Array,
+           pad_mask: jax.Array) -> jax.Array:
+    B, T, D = x.shape
+    H, hd = cfg.n_heads, cfg.head_dim
+    h = layernorm(p["ln1"], x)
+    qkv = dense(p["attn_qkv"], h, compute_dtype=cfg.compute_dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    # bidirectional attention; padded KEYS are pushed to -inf so real
+    # tokens never mix in padding (zeroed pad activations still carry a
+    # layernorm bias, so value-zeroing alone would not be enough)
+    attn = mha(q.reshape(B, T, H, hd), k.reshape(B, T, H, hd),
+               v.reshape(B, T, H, hd), causal=False,
+               mask=pad_mask[:, None, None, :] > 0)
+    attn = dense(p["attn_out"], attn.reshape(B, T, D),
+                 compute_dtype=cfg.compute_dtype)
+    x = x + attn
+    h = layernorm(p["ln2"], x)
+    h = dense(p["mlp_up"], h, compute_dtype=cfg.compute_dtype)
+    h = gelu(h)
+    h = dense(p["mlp_down"], h, compute_dtype=cfg.compute_dtype)
+    x = x + h
+    return x * pad_mask[..., None]  # keep padded positions inert
+
+
+def encode(params: Params, cfg: BertConfig, tokens: jax.Array,
+           segments: Optional[jax.Array] = None,
+           pad_mask: Optional[jax.Array] = None) -> jax.Array:
+    """tokens: int32 [B, T] → sequence output [B, T, D] (compute dtype).
+
+    ``pad_mask``: float [B, T] with 1 for real tokens, 0 for padding
+    (defaults to all-ones). Padded positions are zeroed between blocks and
+    must be excluded from any loss.
+    """
+    B, T = tokens.shape
+    if segments is None:
+        segments = jnp.zeros_like(tokens)
+    if pad_mask is None:
+        pad_mask = jnp.ones((B, T), jnp.float32)
+    x = (jnp.take(params["embed"]["table"], tokens, axis=0)
+         + params["pos_embed"][None, :T]
+         + jnp.take(params["seg_embed"], segments, axis=0))
+    x = layernorm(params["embed_norm"], x).astype(cfg.compute_dtype)
+
+    def block_fn(layer_params, x):
+        return _block(cfg, layer_params, x, pad_mask)
+
+    body = jax.checkpoint(block_fn) if cfg.remat else block_fn
+    x, _ = jax.lax.scan(lambda carry, lp: (body(lp, carry), None),
+                        x, params["blocks"])
+    return x
+
+
+def pooled(params: Params, cfg: BertConfig, seq_out: jax.Array) -> jax.Array:
+    """[CLS] pooler: tanh(dense(first token)) → [B, D]."""
+    return jnp.tanh(dense(params["pooler"], seq_out[:, 0],
+                          compute_dtype=cfg.compute_dtype))
+
+
+def classify(params: Params, cfg: BertConfig, tokens: jax.Array,
+             segments: Optional[jax.Array] = None,
+             pad_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Fine-tune head → logits [B, n_classes] (float32)."""
+    seq = encode(params, cfg, tokens, segments, pad_mask)
+    return dense(params["cls_head"], pooled(params, cfg, seq),
+                 compute_dtype=cfg.compute_dtype).astype(jnp.float32)
+
+
+def mlm_logits(params: Params, cfg: BertConfig, tokens: jax.Array,
+               segments: Optional[jax.Array] = None,
+               pad_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Masked-LM logits [B, T, V] — projection tied to the embedding."""
+    seq = encode(params, cfg, tokens, segments, pad_mask)
+    table = params["embed"]["table"].astype(cfg.compute_dtype)
+    logits = jnp.einsum("btd,vd->btv", seq, table) + params["mlm_bias"]
+    return logits.astype(jnp.float32)
+
+
+def classify_loss(params: Params, cfg: BertConfig, tokens: jax.Array,
+                  labels: jax.Array,
+                  segments: Optional[jax.Array] = None,
+                  pad_mask: Optional[jax.Array] = None) -> jax.Array:
+    logits = classify(params, cfg, tokens, segments, pad_mask)
+    return jnp.mean(softmax_cross_entropy(logits, labels))
+
+
+def mlm_loss(params: Params, cfg: BertConfig, tokens: jax.Array,
+             targets: jax.Array, mask: jax.Array,
+             segments: Optional[jax.Array] = None) -> jax.Array:
+    """MLM objective: ``mask`` [B, T] selects the positions whose
+    ``targets`` count (the 15% that were masked/corrupted)."""
+    logits = mlm_logits(params, cfg, tokens, segments)
+    per_tok = softmax_cross_entropy(
+        logits.reshape(-1, cfg.vocab_size), targets.reshape(-1))
+    m = mask.reshape(-1).astype(jnp.float32)
+    return jnp.sum(per_tok * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+
+def param_count(params: Params) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))

@@ -1,0 +1,151 @@
+"""ResNet — the conv-net workhorse family (ResNet-50 class).
+
+Fills BASELINE.json config #3 ("examples/computer_vision ResNet-50
+ImageNet PyTorchTrial (distributed)"; the reference trains it through
+torchvision models under harness/determined/pytorch). TPU-first choices:
+
+- NHWC end to end (channels ride the 128-lane minor dim; conv2d in
+  ops/layers.py already speaks NHWC/HWIO).
+- GroupNorm instead of BatchNorm: batch-size independent, so per-device
+  batch never changes the math under data parallelism, and there are no
+  running stats to thread through the functional step (the standard
+  "ResNet-50-GN" recipe). BatchNorm remains available in ops/layers.py
+  for parity experiments.
+- bfloat16 compute by default; params stay float32.
+- Blocks are a static Python loop (16 bodies for ResNet-50): conv stages
+  are shallow and heterogeneous (stride/projection on stage entry), so a
+  lax.scan buys little here — unlike the uniform GPT/ViT stacks.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from determined_clone_tpu.ops.layers import (
+    conv2d,
+    conv_init,
+    dense,
+    dense_init,
+    groupnorm,
+    groupnorm_init,
+    softmax_cross_entropy,
+)
+
+Params = Dict[str, Any]
+
+# stage depths per variant (bottleneck blocks; expansion 4)
+DEPTHS = {
+    26: (1, 2, 4, 1),
+    50: (3, 4, 6, 3),
+    101: (3, 4, 23, 3),
+    152: (3, 8, 36, 3),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    depth: int = 50
+    n_classes: int = 1000
+    width: int = 64          # stem/base width; stages are width*(1,2,4,8)
+    channels: int = 3
+    gn_groups: int = 32
+    compute_dtype: Any = jnp.bfloat16
+
+    @property
+    def stage_blocks(self) -> Tuple[int, int, int, int]:
+        if self.depth not in DEPTHS:
+            raise ValueError(
+                f"unsupported resnet depth {self.depth}; "
+                f"expected one of {sorted(DEPTHS)}")
+        return DEPTHS[self.depth]
+
+    @staticmethod
+    def tiny() -> "ResNetConfig":
+        return ResNetConfig(depth=26, n_classes=10, width=16,
+                            compute_dtype=jnp.float32)
+
+
+def _block_init(key: jax.Array, c_in: int, c_mid: int,
+                stride: int) -> Params:
+    c_out = 4 * c_mid
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    p = {
+        "conv1": conv_init(k1, c_in, c_mid, 1),
+        "gn1": groupnorm_init(c_mid),
+        "conv2": conv_init(k2, c_mid, c_mid, 3),
+        "gn2": groupnorm_init(c_mid),
+        "conv3": conv_init(k3, c_mid, c_out, 1),
+        "gn3": groupnorm_init(c_out),
+    }
+    if stride != 1 or c_in != c_out:
+        p["proj"] = conv_init(k4, c_in, c_out, 1)
+        p["gn_proj"] = groupnorm_init(c_out)
+    return p
+
+
+def init(key: jax.Array, cfg: ResNetConfig) -> Params:
+    keys = jax.random.split(key, 2 + sum(cfg.stage_blocks))
+    params: Params = {
+        "stem": conv_init(keys[0], cfg.channels, cfg.width, 7),
+        "gn_stem": groupnorm_init(cfg.width),
+    }
+    c_in = cfg.width
+    ki = 1
+    for s, n_blocks in enumerate(cfg.stage_blocks):
+        c_mid = cfg.width * (2 ** s)
+        for b in range(n_blocks):
+            stride = 2 if (b == 0 and s > 0) else 1
+            params[f"s{s}b{b}"] = _block_init(keys[ki], c_in, c_mid, stride)
+            c_in = 4 * c_mid
+            ki += 1
+    params["head"] = dense_init(keys[ki], c_in, cfg.n_classes)
+    return params
+
+
+def _bottleneck(p: Params, cfg: ResNetConfig, x: jax.Array,
+                stride: int) -> jax.Array:
+    g = cfg.gn_groups
+    h = conv2d(p["conv1"], x, compute_dtype=cfg.compute_dtype)
+    h = jax.nn.relu(groupnorm(p["gn1"], h, groups=g))
+    h = conv2d(p["conv2"], h, stride=stride,
+               compute_dtype=cfg.compute_dtype)
+    h = jax.nn.relu(groupnorm(p["gn2"], h, groups=g))
+    h = conv2d(p["conv3"], h, compute_dtype=cfg.compute_dtype)
+    h = groupnorm(p["gn3"], h, groups=g)
+    if "proj" in p:
+        x = groupnorm(p["gn_proj"],
+                      conv2d(p["proj"], x, stride=stride,
+                             compute_dtype=cfg.compute_dtype),
+                      groups=g)
+    return jax.nn.relu(x + h)
+
+
+def _maxpool3_s2(x: jax.Array) -> jax.Array:
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
+
+
+def apply(params: Params, cfg: ResNetConfig, x: jax.Array) -> jax.Array:
+    """x: [B, H, W, C] NHWC → logits [B, n_classes] (float32)."""
+    x = conv2d(params["stem"], x, stride=2, compute_dtype=cfg.compute_dtype)
+    x = jax.nn.relu(groupnorm(params["gn_stem"], x, groups=cfg.gn_groups))
+    x = _maxpool3_s2(x)
+    for s, n_blocks in enumerate(cfg.stage_blocks):
+        for b in range(n_blocks):
+            stride = 2 if (b == 0 and s > 0) else 1
+            x = _bottleneck(params[f"s{s}b{b}"], cfg, x, stride)
+    x = jnp.mean(x, axis=(1, 2))  # global average pool
+    return dense(params["head"], x,
+                 compute_dtype=cfg.compute_dtype).astype(jnp.float32)
+
+
+def loss_fn(params: Params, cfg: ResNetConfig, x: jax.Array,
+            y: jax.Array) -> jax.Array:
+    return jnp.mean(softmax_cross_entropy(apply(params, cfg, x), y))
+
+
+def param_count(params: Params) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))

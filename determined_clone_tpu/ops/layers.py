@@ -147,6 +147,28 @@ def batchnorm(params: Params, x: jax.Array, *, training: bool, momentum: float =
     return y.astype(x.dtype), new_stats
 
 
+def groupnorm_init(ch: int, dtype=jnp.float32) -> Params:
+    return {"scale": jnp.ones((ch,), dtype), "bias": jnp.zeros((ch,), dtype)}
+
+
+def groupnorm(params: Params, x: jax.Array, *, groups: int = 32,
+              eps: float = 1e-5) -> jax.Array:
+    """GroupNorm over NHWC. Batch-size independent — the TPU-friendly norm
+    for conv nets: no running stats to thread functionally and no
+    cross-replica sync dependence, so per-device batch size never changes
+    the math (the reason ResNet-50-GN recipes exist)."""
+    B, H, W, C = x.shape
+    g = min(groups, C)
+    while C % g != 0:  # channel counts not divisible by 32 (stems, tests)
+        g -= 1
+    xf = x.astype(jnp.float32).reshape(B, H, W, g, C // g)
+    mean = jnp.mean(xf, axis=(1, 2, 4), keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=(1, 2, 4), keepdims=True)
+    y = ((xf - mean) * jax.lax.rsqrt(var + eps)).reshape(B, H, W, C)
+    y = y * params["scale"] + params["bias"]
+    return y.astype(x.dtype)
+
+
 # ---------------------------------------------------------------------------
 # Activations / misc
 # ---------------------------------------------------------------------------
