@@ -63,11 +63,13 @@ class ClusterInfo:
 
 
 def resolve_entrypoint(entrypoint: str):
-    """'pkg.module:ClassName' → class. The model-def directory (cwd) is on
+    """'pkg.module:Attr' → a JaxTrial subclass or a Core API function
+    ``fn(core_context, cluster_info)``. The model-def directory (cwd) is on
     sys.path, like the reference's context-dir download + import."""
     if ":" not in entrypoint:
         raise RuntimeError(
-            f"entrypoint {entrypoint!r} must look like 'module:TrialClass'"
+            f"entrypoint {entrypoint!r} must look like 'module:TrialClass' "
+            f"or 'module:core_api_function'"
         )
     module_name, class_name = entrypoint.split(":", 1)
     if "" == module_name:
@@ -121,7 +123,7 @@ def main(argv=None) -> int:
         MasterPreemptionSource,
         MasterSearcherSource,
     )
-    from determined_clone_tpu.training import Trainer, TrialContext
+    from determined_clone_tpu.training import JaxTrial, Trainer, TrialContext
 
     info = ClusterInfo.from_env()
     session = MasterSession(info.master_host, info.master_port)
@@ -219,11 +221,32 @@ def main(argv=None) -> int:
         # trial construction INSIDE the try: a raising user __init__ must
         # still stop the profiler/tb threads and report the failure cleanly
         try:
-            tctx = TrialContext(config=config, hparams=info.hparams,
-                                core=cctx)
-            trial = trial_cls(tctx)
-            trainer = Trainer(trial)
-            result = trainer.fit(latest_checkpoint=info.latest_checkpoint)
+            if isinstance(trial_cls, type):
+                # a class that does NOT subclass JaxTrial is a config error,
+                # not a Core API script — constructing it would "complete"
+                # without training a step
+                if not issubclass(trial_cls, JaxTrial):
+                    raise RuntimeError(
+                        f"entrypoint class {trial_cls.__name__!r} must "
+                        f"subclass JaxTrial (or be a plain function for "
+                        f"the Core API)")
+                tctx = TrialContext(config=config, hparams=info.hparams,
+                                    core=cctx)
+                trial = trial_cls(tctx)
+                trainer = Trainer(trial)
+                result = trainer.fit(latest_checkpoint=info.latest_checkpoint)
+            elif not callable(trial_cls):
+                raise RuntimeError(
+                    f"entrypoint {trial_cls!r} is neither a JaxTrial "
+                    f"subclass nor a callable")
+            else:
+                # Core API script entrypoint: a plain function driving the
+                # Context itself (searcher ops, metrics, checkpoints) — the
+                # reference's `entrypoint: python3 train.py` + core.init()
+                # pattern (examples/hf_trainer_api; docs Core API tutorial).
+                # Called with the live Context and ClusterInfo so the script
+                # needs no env-var spelunking.
+                result = trial_cls(cctx, info)
             print(f"[trial] leg finished: {result}", flush=True)
         except Exception as e:  # noqa: BLE001 - report, then fail the task
             print(f"[trial] FAILED: {type(e).__name__}: {e}", flush=True)

@@ -198,3 +198,25 @@ def mlm_loss(params: Params, cfg: BertConfig, tokens: jax.Array,
 
 def param_count(params: Params) -> int:
     return sum(p.size for p in jax.tree.leaves(params))
+
+
+# Megatron-style TP + fsdp layout, mirroring models/gpt.py's rules: the
+# encoder block has the same [L, ...] stacked structure, so column-parallel
+# up-projections shard the output dim on tp and row-parallel down-projections
+# the input dim. The embedding is vocab-parallel; the tied MLM projection
+# reuses it, so mlm_logits' einsum contracts over the same sharded table.
+from determined_clone_tpu.parallel.sharding import (  # noqa: E402
+    ShardingRules,
+)
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+BERT_SHARDING_RULES = ShardingRules(rules=[
+    (r"embed/table$",              P("tp", "fsdp")),        # [V, D]
+    (r"blocks/attn_qkv/kernel$",   P(None, "fsdp", "tp")),  # [L, D, 3D] col
+    (r"blocks/attn_out/kernel$",   P(None, "tp", "fsdp")),  # [L, D, D]  row
+    (r"blocks/mlp_up/kernel$",     P(None, "fsdp", "tp")),  # [L, D, F]  col
+    (r"blocks/mlp_down/kernel$",   P(None, "tp", "fsdp")),  # [L, F, D]  row
+    (r"blocks/.*(bias|scale)$",    P(None)),
+    (r"(pos_embed|seg_embed|embed_norm/|mlm_bias)", P()),
+    (r"(pooler|cls_head)/",        P()),                    # small heads
+])

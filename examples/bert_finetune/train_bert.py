@@ -1,0 +1,136 @@
+"""BERT fine-tune driven directly through the Core API — BASELINE config #4.
+
+≈ the reference's examples/hf_trainer_api flow: an `entrypoint` script (not
+a Trial class) that owns its own loop and talks to the platform through
+core.Context — searcher operations, metric reporting, checkpointing, and
+preemption polling (harness/determined/core/_context.py's five contexts).
+The framework calls ``main(core_context, cluster_info)``.
+
+The task is sequence classification with the native BERT encoder
+(models/bert.py, [CLS] pooler + head). Data is a deterministic synthetic
+"sentiment" task — the label is whether positive-class marker tokens
+outnumber negative ones in the sequence, which forces the encoder to
+aggregate over positions (a real, learnable seq-cls objective; no egress
+in CI). Swap `_synthetic_reviews` for a real tokenized dataset in a
+connected deployment.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from determined_clone_tpu.models import bert
+from determined_clone_tpu.training.train_step import (
+    create_train_state,
+    make_train_step,
+)
+
+
+def _synthetic_reviews(n, vocab_size, seq_len, seed=0):
+    """Label = whether tokens from the 'positive' band [10, 20) outnumber
+    the 'negative' band [20, 30) in the sequence."""
+    rng = np.random.RandomState(seed)
+    tokens = rng.randint(30, vocab_size, size=(n, seq_len)).astype(np.int32)
+    n_markers = rng.randint(1, max(2, seq_len // 4), size=n)
+    for i in range(n):
+        pos = rng.choice(seq_len, size=n_markers[i], replace=False)
+        polarity = rng.randint(0, 2)
+        band = 10 if polarity else 20
+        tokens[i, pos] = band + rng.randint(0, 10, size=n_markers[i])
+    labels = ((tokens >= 10) & (tokens < 20)).sum(1) > (
+        (tokens >= 20) & (tokens < 30)).sum(1)
+    return tokens, labels.astype(np.int32)
+
+
+def main(core_context, info):
+    hp = info.hparams
+    cfg = bert.BertConfig(
+        vocab_size=int(hp.get("vocab_size", 1000)),
+        n_layers=int(hp.get("n_layers", 4)),
+        d_model=int(hp.get("d_model", 128)),
+        n_heads=int(hp.get("n_heads", 4)),
+        d_ff=int(hp.get("d_ff", 256)),
+        max_seq_len=int(hp.get("seq_len", 64)),
+        n_classes=2,
+        compute_dtype=jnp.bfloat16
+        if jax.default_backend() == "tpu" else jnp.float32,
+        remat=bool(hp.get("remat", False)),
+    )
+    seq_len = int(hp.get("seq_len", 64))
+    batch_size = int(hp.get("global_batch_size", 32))
+    lr = float(hp.get("lr", 1e-4))
+
+    params = bert.init(jax.random.PRNGKey(0), cfg)
+    tx = optax.adamw(lr, weight_decay=0.01)
+    state = create_train_state(params, tx, jax.random.PRNGKey(1))
+
+    # resume a preempted/restarted leg from the platform's latest checkpoint
+    batches_done = 0
+    if info.latest_checkpoint:
+        import json
+        import pickle
+
+        with core_context.checkpoint.restore_path(info.latest_checkpoint) as d:
+            with open(os.path.join(d, "state.pkl"), "rb") as f:
+                restored = pickle.load(f)
+            state = create_train_state(restored, tx, jax.random.PRNGKey(1))
+            mpath = os.path.join(d, "metadata.json")
+            if os.path.exists(mpath):
+                with open(mpath) as f:
+                    batches_done = int(
+                        json.load(f).get("steps_completed", 0))
+
+    def loss_fn(p, batch, rng):
+        tokens, labels = batch
+        return bert.classify_loss(p, cfg, tokens, labels), {}
+
+    step = make_train_step(loss_fn, tx)
+
+    train_x, train_y = _synthetic_reviews(4096, cfg.vocab_size, seq_len)
+    val_x, val_y = _synthetic_reviews(512, cfg.vocab_size, seq_len, seed=1)
+
+    @jax.jit
+    def eval_acc(p):
+        logits = bert.classify(p, cfg, val_x, None, None)
+        return jnp.mean((jnp.argmax(logits, -1) == val_y).astype(jnp.float32))
+
+    last_loss = None
+    # the searcher hands out work in max_length units; completing each op
+    # with the searcher metric is what drives HP-search schedulers
+    for op in core_context.searcher.operations():
+        # managed runs hand out config.Length targets; local sources ints
+        target = int(getattr(op.length, "value", op.length))
+        while batches_done < target:
+            i = (batches_done * batch_size) % (len(train_x) - batch_size + 1)
+            batch = (train_x[i:i + batch_size], train_y[i:i + batch_size])
+            state, metrics = step(state, batch)
+            last_loss = float(metrics["loss"])
+            batches_done += 1
+            if batches_done % 10 == 0:
+                core_context.train.report_training_metrics(
+                    batches_done, {"loss": last_loss})
+                op.report_progress(batches_done)
+            if core_context.preempt.should_preempt():
+                _save(core_context, state, batches_done)
+                return {"state": "preempted", "batches": batches_done}
+        acc = float(eval_acc(state.params))
+        val_metrics = {"accuracy": acc}
+        if last_loss is not None:  # an op can already be satisfied on resume
+            val_metrics["loss"] = last_loss
+        core_context.train.report_validation_metrics(batches_done, val_metrics)
+        op.complete(acc)
+    _save(core_context, state, batches_done)
+    return {"state": "completed", "batches": batches_done}
+
+
+def _save(core_context, state, batches_done):
+    import pickle
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        with open(os.path.join(d, "state.pkl"), "wb") as f:
+            pickle.dump(jax.device_get(state.params), f)
+        core_context.checkpoint.upload(
+            d, metadata={"steps_completed": batches_done})

@@ -1,0 +1,71 @@
+"""MNIST tutorial trial — BASELINE configs #1 (single-slot) and #2 (8-chip DP).
+
+≈ the reference's examples/tutorials/mnist_pytorch/model_def.py (two conv
+blocks + two dense layers through its PyTorchTrial); here the same net is a
+JaxTrial whose train step the framework jits and shards. `distributed.yaml`
+scales it to 8 chips data-parallel the way the reference's distributed.yaml
+sets slots_per_trial: 8 — no launcher change, just a mesh hparam.
+
+Data: sklearn's bundled handwritten-digits scans by default (no egress in
+CI), real MNIST IDX files when `dataset: mnist` + `data_dir` point at them.
+"""
+import jax.numpy as jnp
+import optax
+
+from determined_clone_tpu.models import mnist_cnn
+from determined_clone_tpu.training import JaxTrial
+from determined_clone_tpu.utils.data import (
+    batch_iterator,
+    digits_dataset,
+    mnist_dataset,
+)
+
+
+class MnistTrial(JaxTrial):
+    def __init__(self, context):
+        super().__init__(context)
+        get = context.get_hparam
+        self.cfg = mnist_cnn.MnistCNNConfig(
+            n_filters_1=int(get("n_filters_1", 32)),
+            n_filters_2=int(get("n_filters_2", 64)),
+            dropout_1=float(get("dropout_1", 0.25)),
+            dropout_2=float(get("dropout_2", 0.5)),
+        )
+        if get("dataset", "digits") == "digits":
+            self.train_set = digits_dataset("train", image=True)
+            self.val_set = digits_dataset("test", image=True)
+        else:
+            data_dir = get("data_dir")
+            self.train_set = mnist_dataset(data_dir, "train", image=True)
+            self.val_set = mnist_dataset(data_dir, "test", image=True)
+
+    def initial_params(self, rng):
+        return mnist_cnn.init(rng, self.cfg)
+
+    def optimizer(self):
+        return optax.adamw(float(self.context.get_hparam("lr", 1e-3)))
+
+    def loss(self, params, batch, rng):
+        x, y = batch
+        return mnist_cnn.loss_fn(params, self.cfg, x, y,
+                                 training=True, dropout_key=rng), {}
+
+    def eval_metrics(self, params, batch):
+        x, y = batch
+        logits = mnist_cnn.apply(params, self.cfg, x)
+        loss = jnp.mean(mnist_cnn.softmax_cross_entropy(logits, y))
+        acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        return {"loss": loss, "accuracy": acc}
+
+    def training_data(self):
+        epoch = 0
+        while True:  # searcher max_length bounds consumption
+            yield from batch_iterator(*self.train_set, self.global_batch_size,
+                                      seed=7, epoch=epoch)
+            epoch += 1
+
+    def validation_data(self):
+        # drop_remainder: a ragged final batch would both retrace the jitted
+        # eval step and break dp-divisibility of the batch axis
+        return batch_iterator(*self.val_set, self.global_batch_size,
+                              shuffle=False, drop_remainder=True)

@@ -1,0 +1,68 @@
+"""ResNet-50 distributed image classification — BASELINE config #3.
+
+≈ the reference's examples/computer_vision ResNet-50 PyTorchTrial
+(torchvision model + DistributedDataParallel). Here the native NHWC
+ResNet-50-GN from determined_clone_tpu.models.resnet trains data-parallel
+(+ optional fsdp for optimizer-state sharding) over the mesh hparam.
+
+Data: deterministic synthetic imagenet-shaped batches (class prototypes +
+noise — learnable, so loss decrease is a real signal; no egress in CI).
+Swap `_synthetic_images` for an ImageNet loader in a connected deployment.
+"""
+import numpy as np
+import optax
+
+from determined_clone_tpu.models import resnet
+from determined_clone_tpu.training import JaxTrial
+
+
+def _synthetic_images(n, image_size, n_classes, channels=3, seed=0):
+    """Class-prototype images + gaussian noise, fixed across epochs."""
+    rng = np.random.RandomState(1234)  # prototypes shared train/val
+    protos = rng.randn(n_classes, image_size, image_size, channels).astype(
+        np.float32)
+    sample_rng = np.random.RandomState(seed)
+    labels = sample_rng.randint(0, n_classes, size=n).astype(np.int32)
+    x = protos[labels] + 0.8 * sample_rng.randn(
+        n, image_size, image_size, channels).astype(np.float32)
+    return x, labels
+
+
+class ResNetTrial(JaxTrial):
+    def __init__(self, context):
+        super().__init__(context)
+        get = context.get_hparam
+        self.cfg = resnet.ResNetConfig(
+            depth=int(get("depth", 50)),
+            n_classes=int(get("n_classes", 1000)),
+            width=int(get("width", 64)),
+        )
+        self.image_size = int(get("image_size", 224))
+        self.n_train = int(get("n_train", 4096))
+
+    def initial_params(self, rng):
+        return resnet.init(rng, self.cfg)
+
+    def optimizer(self):
+        lr = float(self.context.get_hparam("lr", 1e-3))
+        return optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(lr))
+
+    def loss(self, params, batch, rng):
+        x, y = batch
+        return resnet.loss_fn(params, self.cfg, x, y), {}
+
+    def training_data(self):
+        bs = self.global_batch_size
+        x, y = _synthetic_images(self.n_train, self.image_size,
+                                 self.cfg.n_classes)
+        i = 0
+        while True:
+            sel = np.arange(i, i + bs) % len(x)
+            yield x[sel], y[sel]
+            i += bs
+
+    def validation_data(self):
+        bs = self.global_batch_size
+        x, y = _synthetic_images(max(bs, 256) // bs * bs, self.image_size,
+                                 self.cfg.n_classes, seed=1)
+        return [(x[i:i + bs], y[i:i + bs]) for i in range(0, len(x), bs)]
