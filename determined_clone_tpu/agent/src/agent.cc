@@ -329,6 +329,7 @@ class Agent {
     env["DCT_SLOTS"] = std::to_string(cmd["slots"].as_int());
     env["DCT_RANK"] = std::to_string(cmd["rank"].as_int());
     env["DCT_WORLD_SIZE"] = std::to_string(cmd["world_size"].as_int());
+    env["DCT_N_SLICES"] = std::to_string(cmd["n_slices"].as_int(1));
     env["DCT_TASK_TYPE"] = cmd["task_type"].as_string();
     if (cmd.has("trial")) {
       env["DCT_TRIAL_ID"] = std::to_string(cmd["trial"]["id"].as_int());

@@ -121,19 +121,36 @@ class SearcherConfig:
 class ResourcesConfig:
     slots_per_trial: int = 1            # TPU chips per trial (gang size)
     topology: Optional[str] = None      # e.g. "v5e-8", "2x4"; None = any fit
+    slices: int = 1                     # multislice: gang N whole slices
+                                        # (DCN between them); topology is
+                                        # then the per-slice shape
     resource_pool: str = "default"
     priority: Optional[int] = None      # priority-scheduler weight
     max_slots: Optional[int] = None     # cap across concurrent trials
 
     @staticmethod
     def from_dict(raw: Dict[str, Any]) -> "ResourcesConfig":
+        topo = raw.get("topology")
+        slices = 1
+        if isinstance(topo, dict):
+            slices = int(topo.get("slices", 1))
+            topo = topo.get("slice_shape")
         cfg = ResourcesConfig(
             slots_per_trial=int(raw.get("slots_per_trial", 1)),
-            topology=raw.get("topology"),
+            topology=topo,
+            slices=slices,
             resource_pool=raw.get("resource_pool", "default"),
             priority=int(raw["priority"]) if raw.get("priority") is not None else None,
             max_slots=raw.get("max_slots"),
         )
+        if cfg.slices < 1:
+            raise ConfigError(
+                f"resources.topology.slices must be >= 1, got {cfg.slices}")
+        if cfg.slices > 1 and (cfg.slots_per_trial < cfg.slices
+                               or cfg.slots_per_trial % cfg.slices != 0):
+            raise ConfigError(
+                f"slots_per_trial ({cfg.slots_per_trial}) must divide evenly "
+                f"into {cfg.slices} slices (at least one chip per slice)")
         if cfg.slots_per_trial < 0:
             raise ConfigError(f"resources.slots_per_trial must be >= 0, got {cfg.slots_per_trial}")
         if cfg.priority is not None and not (1 <= int(cfg.priority) <= 99):
@@ -141,7 +158,15 @@ class ResourcesConfig:
         return cfg
 
     def to_dict(self) -> Dict[str, Any]:
-        return {k: v for k, v in dataclasses.asdict(self).items() if v is not None}
+        d = {k: v for k, v in dataclasses.asdict(self).items()
+             if v is not None}
+        if d.pop("slices", 1) > 1:
+            # round-trip the multislice object form the master parses
+            d["topology"] = {"slices": self.slices,
+                            "slice_shape": self.topology}
+            if self.topology is None:
+                d["topology"].pop("slice_shape")
+        return d
 
 
 # ---------------------------------------------------------------------------

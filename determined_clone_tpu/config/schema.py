@@ -152,7 +152,14 @@ EXPERIMENT_SCHEMA = {
                 "slots_per_trial": {"type": "integer"},
                 "resource_pool": {"type": "string"},
                 "priority": {"type": "integer"},
-                "topology": {"type": "string"},
+                # "v5e-8" or the multislice object {slices, slice_shape}
+                "topology": {"anyOf": [
+                    {"type": "string"},
+                    {"type": "object", "open": False, "properties": {
+                        "slices": {"type": "integer"},
+                        "slice_shape": {"type": "string"},
+                    }},
+                ]},
                 "max_slots": {"type": "integer"},
             },
         },

@@ -32,6 +32,7 @@ class ClusterInfo:
     rank: int
     world_size: int
     slots: int
+    n_slices: int
     hparams: Dict[str, Any]
     target_units: int
     latest_checkpoint: Optional[str]
@@ -54,6 +55,7 @@ class ClusterInfo:
             rank=int(os.environ.get("DCT_RANK", "0")),
             world_size=int(os.environ.get("DCT_WORLD_SIZE", "1")),
             slots=int(os.environ.get("DCT_SLOTS", "1")),
+            n_slices=int(os.environ.get("DCT_N_SLICES", "1")),
             hparams=json.loads(os.environ.get("DCT_HPARAMS", "{}")),
             target_units=int(os.environ.get("DCT_TARGET_UNITS", "0")),
             latest_checkpoint=os.environ.get("DCT_LATEST_CHECKPOINT") or None,
@@ -85,10 +87,12 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def do_rendezvous(session, info: ClusterInfo, addr: str) -> list:
+def do_rendezvous(session, info: ClusterInfo, addr: str) -> dict:
     """Register our address; poll until the whole gang is present
-    (≈ task/rendezvous.go:94-187). Returns member addresses rank-ordered;
-    member[0] carries the jax coordinator + control-plane ports."""
+    (≈ task/rendezvous.go:94-187). Returns the full rendezvous payload:
+    rank-ordered ``members`` (member[0] carries the jax coordinator +
+    control-plane ports) plus, for multislice gangs, ``n_slices`` and the
+    per-rank ``slice_ids`` the scheduler assigned."""
     deadline = time.time() + 300
     while True:
         resp = session.post(
@@ -97,13 +101,61 @@ def do_rendezvous(session, info: ClusterInfo, addr: str) -> list:
             retryable=True,  # idempotent re-registration
         )
         if resp.get("ready"):
-            return list(resp.get("members", []))
+            return resp
         if time.time() > deadline:
             raise RuntimeError(
                 f"rendezvous timed out: {len(resp.get('members', []))}/"
                 f"{resp.get('world_size')} members present"
             )
         time.sleep(0.5)
+
+
+def build_multislice_mesh(info: ClusterInfo, rdv: dict):
+    """The hybrid ICI×DCN mesh for a master-scheduled slice-group gang.
+
+    The rendezvous payload is the source of truth for the slice layout
+    (scheduler.cc's n_slices branch put one whole slice on each agent;
+    routes.cc's rendezvous response carries the per-rank slice_ids). The
+    mesh hparam splits into {"ici": {per-slice axes}, "dcn": {cross-slice
+    axes}}; dcn defaults to pure data parallelism over the slices.
+    """
+    import math
+
+    from determined_clone_tpu.parallel.mesh import (
+        MeshSpec,
+        make_multislice_mesh,
+    )
+
+    n_slices = int(rdv.get("n_slices", info.n_slices))
+    slice_ids = list(rdv.get("slice_ids") or [])
+    if slice_ids:
+        # make_multislice_mesh assumes slice-major device enumeration and
+        # process order == rank order: each slice's ranks must be one
+        # contiguous ascending run of equal size
+        if slice_ids != sorted(slice_ids):
+            raise RuntimeError(
+                f"rendezvous slice_ids are not slice-major: {slice_ids}")
+        counts = [slice_ids.count(s) for s in range(n_slices)]
+        if len(set(counts)) > 1:
+            raise RuntimeError(f"uneven slice groups: {counts}")
+
+    mesh_hp = info.hparams.get("mesh") or {}
+    unknown = set(mesh_hp) - {"ici", "dcn"}
+    if unknown:
+        # a flat single-slice spec ({"dp": 8, "tp": 2}) here would be
+        # silently dropped — reject loudly instead
+        raise RuntimeError(
+            f"multislice experiments take mesh: {{ici: ..., dcn: ...}}; "
+            f"got flat axes {sorted(unknown)}")
+    ici = MeshSpec.from_dict(mesh_hp.get("ici") or {})
+    dcn = MeshSpec.from_dict(mesh_hp.get("dcn") or {"dp": n_slices})
+    dcn_total = math.prod(dcn.axis_sizes())
+    if dcn_total != n_slices:
+        raise RuntimeError(
+            f"mesh.dcn axes {dcn.to_dict()} multiply to {dcn_total} but the "
+            f"allocation has {n_slices} slices — ICI axes would span the "
+            f"DCN boundary")
+    return make_multislice_mesh(ici, dcn)
 
 
 def main(argv=None) -> int:
@@ -142,7 +194,8 @@ def main(argv=None) -> int:
     else:
         addr = f"{socket.gethostname()}:0:0"
 
-    members = do_rendezvous(session, info, addr)
+    rdv = do_rendezvous(session, info, addr)
+    members = list(rdv.get("members", []))
     if info.world_size > 1:
         # multi-host gang: rank 0's host is the XLA coordinator
         # (SURVEY.md §2.8 plane 1: jax.distributed over ICI/DCN)
@@ -230,8 +283,14 @@ def main(argv=None) -> int:
                         f"entrypoint class {trial_cls.__name__!r} must "
                         f"subclass JaxTrial (or be a plain function for "
                         f"the Core API)")
+                # multislice gang: build the hybrid ICI×DCN mesh from the
+                # rendezvous slice assignments (Core API entrypoints drive
+                # their own device layout, so only the Trainer path pays
+                # for this)
+                multislice_mesh = (build_multislice_mesh(info, rdv)
+                                   if info.n_slices > 1 else None)
                 tctx = TrialContext(config=config, hparams=info.hparams,
-                                    core=cctx)
+                                    core=cctx, mesh=multislice_mesh)
                 trial = trial_cls(tctx)
                 trainer = Trainer(trial)
                 result = trainer.fit(latest_checkpoint=info.latest_checkpoint)

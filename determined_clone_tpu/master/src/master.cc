@@ -483,7 +483,24 @@ void Master::queue_trial_leg(Trial& trial) {
   alloc.resource_pool = resources["resource_pool"].as_string().empty()
                             ? "default"
                             : resources["resource_pool"].as_string();
-  alloc.topology = resources["topology"].as_string();
+  // topology: "v5e-8" (one slice of that shape) or the multislice object
+  // {slices: N, slice_shape: "v5e-8"} — N whole slices gang-scheduled as a
+  // unit, DCN between them (≈ GCP multislice; the reference has no
+  // equivalent, SURVEY §7.7)
+  if (resources["topology"].is_object()) {
+    alloc.n_slices = std::max(
+        1, static_cast<int>(resources["topology"]["slices"].as_int(1)));
+    alloc.topology = resources["topology"]["slice_shape"].as_string();
+    if (alloc.slots < alloc.n_slices) {
+      // a zero/under-sized multislice request would sail through the
+      // zero-slot scheduling branch and hand the harness an impossible
+      // DCT_N_SLICES; expconf rejects this at submit, but the master must
+      // not trust clients (direct API posts bypass expconf)
+      alloc.n_slices = 1;
+    }
+  } else {
+    alloc.topology = resources["topology"].as_string();
+  }
   alloc.queued_at = now_sec();
   alloc.token = crypto::random_token();
   alloc.spec.set("entrypoint", exp.config["entrypoint"]);
@@ -1063,6 +1080,7 @@ Json Master::allocation_start_command(const Allocation& alloc,
   cmd.set("slots", alloc.reservations.count(agent_id)
                        ? alloc.reservations.at(agent_id) : 0);
   cmd.set("world_size", alloc.world_size);
+  cmd.set("n_slices", alloc.n_slices);
   cmd.set("alloc_token", alloc.token);
   cmd.set("spec", alloc.spec);
   if (alloc.trial_id) {

@@ -215,6 +215,9 @@ struct Allocation {
   int priority = 42;
   std::string resource_pool = "default";
   std::string topology;      // requested slice shape ("" = any)
+  // multislice: gang n_slices whole slices (one agent each) joined over
+  // DCN; topology then names the PER-SLICE shape. 1 = single-slice.
+  int n_slices = 1;
   double queued_at = 0;
   // agent_id -> slots reserved
   std::map<std::string, int> reservations;
@@ -250,7 +253,8 @@ struct Allocation {
     j.set("id", id).set("trial_id", trial_id).set("task_type", task_type)
         .set("state", to_string(state)).set("slots", slots)
         .set("priority", priority).set("resource_pool", resource_pool)
-        .set("topology", topology).set("queued_at", queued_at)
+        .set("topology", topology).set("n_slices", n_slices)
+        .set("queued_at", queued_at)
         .set("reservations", res).set("rendezvous", rdv)
         .set("world_size", world_size)
         .set("preempt_requested", preempt_requested).set("spec", spec)
@@ -271,6 +275,7 @@ struct Allocation {
     a.priority = static_cast<int>(j["priority"].as_int());
     a.resource_pool = j["resource_pool"].as_string();
     a.topology = j["topology"].as_string();
+    a.n_slices = static_cast<int>(j["n_slices"].as_int(1));
     a.queued_at = j["queued_at"].as_number();
     for (const auto& [aid, n] : j["reservations"].items()) {
       a.reservations[aid] = static_cast<int>(n.as_int());

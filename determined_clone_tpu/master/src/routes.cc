@@ -1725,6 +1725,20 @@ HttpResponse Master::route(const HttpRequest& req) {
       Json j = Json::object();
       j.set("ready", ready).set("members", members)
           .set("world_size", alloc.world_size);
+      if (alloc.n_slices > 1) {
+        // multislice gang: tell the harness which DCN slice each rank's
+        // host belongs to (ranks are assigned in sorted-agent order, the
+        // same order the scheduler reserved slices in — rank r == slice
+        // r * n_slices / world). exec/trial.py uses this to build the
+        // ICI×DCN mesh with jax.devices() enumerating slice-major.
+        int world = std::max(1, alloc.world_size);
+        Json slice_ids = Json::array();
+        for (int r = 0; r < world; ++r) {
+          slice_ids.push_back(
+              static_cast<int64_t>(r) * alloc.n_slices / world);
+        }
+        j.set("n_slices", alloc.n_slices).set("slice_ids", slice_ids);
+      }
       return ok_json(j);
     }
     if (parts[4] == "preempt" && req.method == "GET") {

@@ -86,6 +86,37 @@ std::optional<std::map<std::string, int>> find_fit(
     return std::map<std::string, int>{{best->id, 0}};
   }
 
+  // 0.5) multislice slice-group (SURVEY §7.7 — beyond the reference):
+  // reserve n_slices WHOLE idle agents, one slice each, as one gang.
+  // `topology` names the PER-SLICE shape; an agent qualifies when its own
+  // advertised topology matches (or, with no shape given, when it holds
+  // exactly slots/n_slices chips). Rank order == sorted agent id ==
+  // slice_id, which the rendezvous payload hands to the harness so
+  // exec/trial.py can build the ICI×DCN mesh.
+  if (alloc.n_slices > 1) {
+    int per_slice = alloc.slots / alloc.n_slices;
+    if (per_slice * alloc.n_slices != alloc.slots || per_slice <= 0) {
+      return std::nullopt;  // mis-sized request can never fit
+    }
+    std::vector<const Agent*> idle;
+    for (const auto& a : agents) {
+      // agent_usable's topology_ok gives the same SEMANTIC shape matching
+      // as the single-slice path ("2x4" satisfies a v5e-8 agent); the
+      // exact-size check below pins one whole slice per agent
+      if (!agent_usable(alloc, a, experiment_key)) continue;
+      auto it = free_slots.find(a.id);
+      bool whole_free = it != free_slots.end() && it->second == a.slots;
+      if (!whole_free || a.slots != per_slice) continue;
+      idle.push_back(&a);
+    }
+    if (static_cast<int>(idle.size()) < alloc.n_slices) return std::nullopt;
+    std::sort(idle.begin(), idle.end(),
+              [](const Agent* x, const Agent* y) { return x->id < y->id; });
+    std::map<std::string, int> gang;
+    for (int i = 0; i < alloc.n_slices; ++i) gang[idle[i]->id] = idle[i]->slots;
+    return gang;
+  }
+
   // 1) best single-agent fit: smallest free-slot surplus (bin packing),
   //    exact-capacity agents preferred, AND — with grids — a contiguous
   //    free rectangle must exist: n free chips scattered across the torus

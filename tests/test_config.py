@@ -154,6 +154,24 @@ class TestExperimentConfig:
         assert again.resources.slots_per_trial == 8
         assert again.searcher.max_trials == 8
 
+    def test_multislice_topology_object(self):
+        cfg = ExperimentConfig.from_dict({
+            "resources": {"slots_per_trial": 16,
+                          "topology": {"slices": 2, "slice_shape": "v5e-8"}},
+        })
+        assert cfg.resources.slices == 2
+        assert cfg.resources.topology == "v5e-8"
+        # round-trip preserves the object form the master parses
+        again = ExperimentConfig.from_dict(cfg.to_dict())
+        assert again.resources.slices == 2
+        assert again.resources.topology == "v5e-8"
+        # slices must divide slots_per_trial
+        with pytest.raises(ConfigError):
+            ExperimentConfig.from_dict({
+                "resources": {"slots_per_trial": 9,
+                              "topology": {"slices": 2}},
+            })
+
     def test_invalid_fields(self):
         with pytest.raises(ConfigError):
             ExperimentConfig.from_dict({"checkpoint_policy": "sometimes"})
