@@ -169,6 +169,12 @@ class MasterSession:
         return self.get(f"/api/v1/trials/{trial_id}/metrics?limit={limit}")[
             "metrics"]
 
+    def trial_metric_summary(self, trial_id: int) -> list:
+        """Materialized per-(group, name) aggregates — flat-cost regardless
+        of history depth (store.cc metric_summary)."""
+        return self.get(f"/api/v1/trials/{trial_id}/metrics/summary")[
+            "summary"]
+
     def trial_profiler_samples(self, trial_id: int, limit: int = 1000) -> list:
         return self.get(
             f"/api/v1/trials/{trial_id}/profiler?limit={limit}")["samples"]

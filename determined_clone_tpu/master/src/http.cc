@@ -7,7 +7,9 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cstring>
+#include <memory>
 #include <sstream>
 
 namespace dct {
@@ -125,7 +127,7 @@ void HttpServer::stop() {
   }
   if (accept_thread_.joinable()) accept_thread_.join();
   for (auto& w : workers_) {
-    if (w.joinable()) w.join();
+    if (w.thread.joinable()) w.thread.join();
   }
   workers_.clear();
 }
@@ -146,13 +148,32 @@ void HttpServer::accept_loop() {
       std::lock_guard<std::mutex> lock(conn_mu_);
       conn_fds_.insert(fd);
     }
-    workers_.emplace_back([this, fd] {
+    // reap finished connection threads before spawning the next: a soak's
+    // connection churn must not accumulate ten thousand dead std::threads
+    // (only the accept thread touches workers_, so no lock needed).
+    // Two passes — join first, then erase with a side-effect-free
+    // predicate ([alg.req] forbids remove_if predicates that mutate).
+    for (auto& w : workers_) {
+      if (w.done->load() && w.thread.joinable()) w.thread.join();
+    }
+    workers_.erase(
+        std::remove_if(workers_.begin(), workers_.end(),
+                       [](const Worker& w) {
+                         return w.done->load() && !w.thread.joinable();
+                       }),
+        workers_.end());
+    auto done = std::make_shared<std::atomic<bool>>(false);
+    Worker w;
+    w.done = done;
+    w.thread = std::thread([this, fd, done] {
       serve_connection(fd);
-      std::lock_guard<std::mutex> lock(conn_mu_);
-      conn_fds_.erase(fd);
+      {
+        std::lock_guard<std::mutex> lock(conn_mu_);
+        conn_fds_.erase(fd);
+      }
+      done->store(true);
     });
-    // opportunistic reaping of finished threads is skipped: connections are
-    // few (CLI, agents, harness) and joined at stop()
+    workers_.push_back(std::move(w));
   }
 }
 

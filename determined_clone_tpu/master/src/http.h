@@ -11,6 +11,7 @@
 #include <atomic>
 #include <functional>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <optional>
 #include <set>
@@ -72,7 +73,15 @@ class HttpServer {
   int listen_fd_ = -1;
   int port_ = 0;
   std::thread accept_thread_;
-  std::vector<std::thread> workers_;
+  // one thread per live connection; finished entries (done flag set by the
+  // thread itself) are reaped on the next accept, so a long-lived master
+  // under connection churn holds O(live connections) threads, not
+  // O(total connections ever)
+  struct Worker {
+    std::thread thread;
+    std::shared_ptr<std::atomic<bool>> done;
+  };
+  std::vector<Worker> workers_;
   std::mutex conn_mu_;
   std::set<int> conn_fds_;
 };

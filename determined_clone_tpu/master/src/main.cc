@@ -64,6 +64,14 @@ void apply_config_file(const std::string& path, dct::MasterConfig* config) {
       config->agent_timeout_sec = std::atof(value.c_str());
     } else if (key == "unmanaged_timeout") {
       config->unmanaged_timeout_sec = std::atof(value.c_str());
+    } else if (key == "log_retention_records") {
+      config->log_retention_records = std::atoll(value.c_str());
+    } else if (key == "log_retention_interval") {
+      config->log_retention_interval_sec = std::atof(value.c_str());
+    } else if (key == "log_retention_grace") {
+      config->log_retention_grace_sec = std::atof(value.c_str());
+    } else if (key == "max_log_followers") {
+      config->max_log_followers = std::atoi(value.c_str());
     } else if (key == "auth_required") config->auth_required = parse_bool(value);
     else if (key == "rbac") config->rbac_enabled = parse_bool(value);
     else if (key == "sso.issuer") {

@@ -922,6 +922,36 @@ void Master::tick_locked() {
     }
   }
 
+  // log retention: trim FINISHED tasks' log streams to the configured
+  // tail. Running tasks keep everything (a live debug session must not
+  // lose its head); a terminal task gets a grace window longer than the
+  // 60 s follow cap before its first trim, so a client still draining by
+  // positional offset finishes before records shift under it. Each task
+  // is swept once per master lifetime — late post-terminal log shipments
+  // are negligible and a restart re-sweeps.
+  if (config_.log_retention_records > 0 &&
+      now - last_retention_sweep_ > config_.log_retention_interval_sec) {
+    last_retention_sweep_ = now;
+    const double grace = config_.log_retention_grace_sec;
+    for (const auto& [id, alloc] : allocations_) {
+      bool terminal = alloc.state == RunState::Completed ||
+                      alloc.state == RunState::Errored ||
+                      alloc.state == RunState::Canceled;
+      if (!terminal || retention_done_.count(id)) continue;
+      auto seen = retention_terminal_seen_.find(id);
+      if (seen == retention_terminal_seen_.end()) {
+        retention_terminal_seen_[id] = now;
+        continue;
+      }
+      if (now - seen->second < grace) continue;
+      store_->retain_stream(
+          "task-" + id + "-logs.jsonl",
+          static_cast<size_t>(config_.log_retention_records));
+      retention_done_.insert(id);
+      retention_terminal_seen_.erase(seen);
+    }
+  }
+
   // agent liveness: reconnect-with-amnesia (≈ agent.go:330): a timed-out
   // agent's reservations are released and its allocations requeued
   for (auto& [aid, agent] : agents_) {

@@ -74,6 +74,18 @@ struct MasterConfig {
   // persistence backend: "auto" (sqlite when libsqlite3 loads, else files),
   // "sqlite", or "files" (store.h)
   std::string db = "auto";
+  // log retention: keep only the newest N records of each FINISHED task's
+  // log stream (0 = keep everything). Applied periodically by the tick
+  // thread (≈ the reference's retention policies, master/internal/logs).
+  int64_t log_retention_records = 0;
+  double log_retention_interval_sec = 60;
+  // must exceed the 60 s follow cap so draining clients finish first
+  double log_retention_grace_sec = 120;
+  // thread budget for log-follow long-polls: each held follower pins one
+  // connection thread (bounded 60 s); beyond this many concurrent
+  // followers the route degrades to an immediate (non-held) response and
+  // the client simply re-polls — tailing stays correct, just chattier
+  int max_log_followers = 64;
   // resource manager: "agent" (gang scheduler over dct-agents) or
   // "kubernetes" (allocations become TPU pods; ≈ rm/setup.go:17-28)
   std::string rm = "agent";
@@ -205,6 +217,12 @@ class Master {
   // into O(appends x followers) reads under mu_.
   std::condition_variable logs_cv_;
   std::map<std::string, uint64_t> stream_versions_;
+  double last_retention_sweep_ = 0;
+  // retention bookkeeping: when each terminal allocation was first seen
+  // (grace timer) and which have already been trimmed (once per lifetime)
+  std::map<std::string, double> retention_terminal_seen_;
+  std::set<std::string> retention_done_;
+  std::atomic<int> active_followers_{0};
   // upstream sockets of live WebSocket/TCP relays: stop() must shut them
   // down or relay pump threads blocked in recv() would hang shutdown
   std::mutex relay_mu_;

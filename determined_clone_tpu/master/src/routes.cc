@@ -319,6 +319,22 @@ HttpResponse Master::logs_follow_route(const HttpRequest& req) {
     return bad_request("limit/offset/follow must be non-negative integers");
   }
   follow_s = std::min<size_t>(follow_s, 60);  // bound the held connection
+  // thread budget (config max_log_followers): past the cap, degrade to an
+  // immediate response instead of holding the connection thread — the
+  // client's next poll retries, so tailing stays correct under a stampede
+  // of WebUI tabs while the master keeps threads for everyone else
+  struct FollowerSlot {
+    std::atomic<int>& count;
+    bool held;
+    explicit FollowerSlot(std::atomic<int>& c, int cap) : count(c) {
+      held = count.fetch_add(1) < cap;
+      if (!held) count.fetch_sub(1);
+    }
+    ~FollowerSlot() {
+      if (held) count.fetch_sub(1);
+    }
+  } slot(active_followers_, config_.max_log_followers);
+  if (!slot.held) follow_s = 0;
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::seconds(follow_s);
   const std::string stream = "task-" + alloc_id + "-logs.jsonl";
@@ -557,9 +573,13 @@ HttpResponse Master::route(const HttpRequest& req) {
   // ---- master info -------------------------------------------------------
   if (root == "master" && parts.size() == 3 && req.method == "GET") {
     Json j = Json::object();
+    Json store = Json::object();
+    store.set("kind", store_->kind())
+        .set("schema_version", static_cast<int64_t>(store_->schema_version()));
     j.set("version", "0.1.0").set("cluster_name", "dct")
         .set("agents", static_cast<int64_t>(agents_.size()))
-        .set("experiments", static_cast<int64_t>(experiments_.size()));
+        .set("experiments", static_cast<int64_t>(experiments_.size()))
+        .set("store", store);
     return ok_json(j);
   }
   // active config, secrets omitted (≈ GetMasterConfig api_master.go);
@@ -1189,12 +1209,15 @@ HttpResponse Master::route(const HttpRequest& req) {
       }
       return ok_json(j);
     }
-    // report metrics (≈ ReportTrialMetrics api_trials.go:1330)
+    // report metrics (≈ ReportTrialMetrics api_trials.go:1330) — typed
+    // store path: relational rows + incrementally materialized summary
+    // (store.h append_metric; ≈ postgres_trial.go + the reference's
+    // calculate-full-trial-summary-metrics.sql)
     if (parts.size() == 5 && parts[4] == "metrics") {
       if (req.method == "POST") {
         Json body = Json::parse(req.body);
         body.set("time", now_sec());
-        append_jsonl("trial-" + std::to_string(id) + "-metrics.jsonl", body);
+        store_->append_metric(id, body);
         if (body["group"].as_string() == "training" &&
             body.has("steps_completed")) {
           // monotonic: a restarted leg resuming from an older checkpoint
@@ -1206,19 +1229,25 @@ HttpResponse Master::route(const HttpRequest& req) {
         return ok_json(Json::object());
       }
       if (req.method == "GET") {
-        size_t limit = 1000;
-        if (!parse_size(req.query, "limit", &limit)) {
-          return bad_request("limit must be a non-negative integer");
+        size_t limit = 1000, offset = 0;
+        if (!parse_size(req.query, "limit", &limit) ||
+            !parse_size(req.query, "offset", &offset)) {
+          return bad_request("limit/offset must be non-negative integers");
         }
         Json arr = Json::array();
-        for (auto& rec : read_jsonl(
-                 "trial-" + std::to_string(id) + "-metrics.jsonl", limit)) {
+        for (auto& rec : store_->read_metrics(id, limit, offset)) {
           arr.push_back(rec);
         }
         Json j = Json::object();
         j.set("metrics", arr);
         return ok_json(j);
       }
+    }
+    // materialized per-trial metric summary: flat-cost aggregates for the
+    // experiment/trial pages (no history scan per refresh)
+    if (parts.size() == 6 && parts[4] == "metrics" &&
+        parts[5] == "summary" && req.method == "GET") {
+      return ok_json(store_->metric_summary(id));
     }
     // profiler samples (≈ master profiler API, common/api/profiler.py)
     if (parts.size() == 5 && parts[4] == "profiler") {

@@ -3,14 +3,68 @@
 #include <dirent.h>
 #include <dlfcn.h>
 
+#include <algorithm>
 #include <cstdio>
 #include <deque>
 #include <fstream>
 #include <iostream>
+#include <map>
 #include <sstream>
 
 namespace dct {
 namespace {
+
+// ---------------------------------------------------------------------------
+// shared metric aggregation (files backend scan + sqlite backfill)
+// ---------------------------------------------------------------------------
+
+struct MetricAgg {
+  int64_t count = 0;
+  double sum = 0, min = 0, max = 0, last = 0;
+  int64_t last_step = 0;
+};
+
+// One reported metrics record → per-(group, name) aggregates. Only numeric
+// values aggregate (the train context serializes NaN as the string "nan").
+void aggregate_metric_record(
+    const Json& rec,
+    std::map<std::pair<std::string, std::string>, MetricAgg>& aggs) {
+  std::string grp = rec["group"].as_string();
+  if (grp.empty()) grp = "training";
+  int64_t step = rec["steps_completed"].as_int(0);
+  if (!rec["metrics"].is_object()) return;
+  for (const auto& [name, val] : rec["metrics"].items()) {
+    if (!val.is_number()) continue;
+    double v = val.as_number();
+    MetricAgg& a = aggs[{grp, name}];
+    if (a.count == 0) {
+      a.min = a.max = v;
+    } else {
+      a.min = std::min(a.min, v);
+      a.max = std::max(a.max, v);
+    }
+    ++a.count;
+    a.sum += v;
+    a.last = v;
+    a.last_step = step;
+  }
+}
+
+Json summary_json(
+    const std::map<std::pair<std::string, std::string>, MetricAgg>& aggs) {
+  Json arr = Json::array();
+  for (const auto& [key, a] : aggs) {
+    Json row = Json::object();
+    row.set("group", key.first).set("name", key.second)
+        .set("count", a.count).set("min", a.min).set("max", a.max)
+        .set("mean", a.count ? a.sum / a.count : 0.0)
+        .set("last", a.last).set("last_step", a.last_step);
+    arr.push_back(row);
+  }
+  Json j = Json::object();
+  j.set("summary", arr);
+  return j;
+}
 
 // ---------------------------------------------------------------------------
 // files backend (the original persistence mode)
@@ -101,7 +155,64 @@ class FileStore : public Store {
 
   const char* kind() const override { return "files"; }
 
+  void append_metric(int64_t trial_id, const Json& rec) override {
+    append(metric_stream(trial_id), rec);
+  }
+
+  std::vector<Json> read_metrics(int64_t trial_id, size_t limit,
+                                 size_t offset) override {
+    return read(metric_stream(trial_id), limit, offset);
+  }
+
+  Json metric_summary(int64_t trial_id) override {
+    // no materialization on the files backend: scan-aggregate (the sqlite
+    // backend is the history-scale path; this keeps the API uniform)
+    std::ifstream in(data_dir_ + "/" + metric_stream(trial_id));
+    std::map<std::pair<std::string, std::string>, MetricAgg> aggs;
+    std::string line;
+    while (std::getline(in, line)) {
+      if (line.empty() || line.front() != '{') continue;
+      try {
+        Json rec = Json::parse(line);
+        aggregate_metric_record(rec, aggs);
+      } catch (const std::exception&) {
+      }
+    }
+    return summary_json(aggs);
+  }
+
+  void retain_stream(const std::string& stream, size_t keep_last) override {
+    const std::string path = data_dir_ + "/" + stream;
+    std::deque<std::string> tail;
+    bool trimmed = false;
+    {
+      std::ifstream in(path);
+      if (!in.good()) return;
+      std::string line;
+      while (std::getline(in, line)) {
+        tail.push_back(std::move(line));
+        if (tail.size() > keep_last) {
+          tail.pop_front();
+          trimmed = true;
+        }
+      }
+    }
+    if (!trimmed) return;  // already within budget: skip the rewrite
+    const std::string tmp = path + ".tmp";
+    {
+      std::ofstream out(tmp);
+      for (const auto& l : tail) out << l << "\n";
+    }
+    ::rename(tmp.c_str(), path.c_str());
+  }
+
+  int schema_version() override { return 0; }
+
  private:
+  static std::string metric_stream(int64_t trial_id) {
+    return "trial-" + std::to_string(trial_id) + "-metrics.jsonl";
+  }
+
   std::string data_dir_;
 };
 
@@ -129,7 +240,10 @@ struct SqliteApi {
   int (*finalize)(sqlite3_stmt*);
   int (*bind_text)(sqlite3_stmt*, int, const char*, int, void (*)(void*));
   int (*bind_int64)(sqlite3_stmt*, int, long long);
+  int (*bind_double)(sqlite3_stmt*, int, double);
   const unsigned char* (*column_text)(sqlite3_stmt*, int);
+  double (*column_double)(sqlite3_stmt*, int);
+  long long (*column_int64)(sqlite3_stmt*, int);
   const char* (*errmsg)(sqlite3*);
 
   bool load() {
@@ -148,11 +262,18 @@ struct SqliteApi {
         reinterpret_cast<decltype(bind_text)>(sym("sqlite3_bind_text"));
     bind_int64 =
         reinterpret_cast<decltype(bind_int64)>(sym("sqlite3_bind_int64"));
+    bind_double =
+        reinterpret_cast<decltype(bind_double)>(sym("sqlite3_bind_double"));
     column_text =
         reinterpret_cast<decltype(column_text)>(sym("sqlite3_column_text"));
+    column_double =
+        reinterpret_cast<decltype(column_double)>(sym("sqlite3_column_double"));
+    column_int64 =
+        reinterpret_cast<decltype(column_int64)>(sym("sqlite3_column_int64"));
     errmsg = reinterpret_cast<decltype(errmsg)>(sym("sqlite3_errmsg"));
     return open && close && exec && prepare && step && reset && finalize &&
-           bind_text && bind_int64 && column_text && errmsg;
+           bind_text && bind_int64 && bind_double && column_text &&
+           column_double && column_int64 && errmsg;
   }
 };
 
@@ -222,14 +343,201 @@ class SqliteStore : public Store {
 
   const char* kind() const override { return "sqlite"; }
 
+  void append_metric(int64_t trial_id, const Json& rec) override {
+    exec_sql("BEGIN");
+    append_metric_rows(trial_id, rec);
+    exec_sql("COMMIT");
+  }
+
+  // row + summary-upsert writes, no transaction (callers own it — the hot
+  // path wraps one record, the v2 backfill wraps the whole migration)
+  void append_metric_rows(int64_t trial_id, const Json& rec) {
+    const std::string body = rec.dump();
+    std::string grp = rec["group"].as_string();
+    if (grp.empty()) grp = "training";
+    {
+      sqlite3_stmt* stmt = nullptr;
+      if (api_.prepare(db_,
+                       "INSERT INTO metrics (trial_id, seq, grp, step, time, "
+                       "body) VALUES (?1, (SELECT COALESCE(MAX(seq), 0) + 1 "
+                       "FROM metrics WHERE trial_id = ?1), ?2, ?3, ?4, ?5)",
+                       -1, &stmt, nullptr) == kSqliteOk) {
+        api_.bind_int64(stmt, 1, trial_id);
+        api_.bind_text(stmt, 2, grp.c_str(), static_cast<int>(grp.size()),
+                       kTransient);
+        api_.bind_int64(stmt, 3, rec["steps_completed"].as_int(0));
+        api_.bind_double(stmt, 4, rec["time"].as_number(0));
+        api_.bind_text(stmt, 5, body.c_str(), static_cast<int>(body.size()),
+                       kTransient);
+        if (api_.step(stmt) != kSqliteDone) {
+          std::cerr << "[store] metric insert failed: " << api_.errmsg(db_)
+                    << std::endl;
+        }
+        api_.finalize(stmt);
+      }
+    }
+    // materialized summary: one upsert per numeric metric (the
+    // experiment/trial pages read aggregates without scanning history —
+    // ≈ the reference's calculate-full-trial-summary-metrics.sql, kept
+    // incrementally instead of recomputed)
+    std::map<std::pair<std::string, std::string>, MetricAgg> aggs;
+    aggregate_metric_record(rec, aggs);
+    for (const auto& [key, a] : aggs) {
+      sqlite3_stmt* stmt = nullptr;
+      if (api_.prepare(db_,
+                       "INSERT INTO metric_summary (trial_id, grp, name, "
+                       "count, sum, min, max, last, last_step) VALUES "
+                       "(?1, ?2, ?3, 1, ?4, ?4, ?4, ?4, ?5) "
+                       "ON CONFLICT(trial_id, grp, name) DO UPDATE SET "
+                       "count = count + 1, sum = sum + excluded.sum, "
+                       "min = MIN(min, excluded.min), "
+                       "max = MAX(max, excluded.max), "
+                       "last = excluded.last, "
+                       "last_step = excluded.last_step",
+                       -1, &stmt, nullptr) == kSqliteOk) {
+        api_.bind_int64(stmt, 1, trial_id);
+        api_.bind_text(stmt, 2, key.first.c_str(),
+                       static_cast<int>(key.first.size()), kTransient);
+        api_.bind_text(stmt, 3, key.second.c_str(),
+                       static_cast<int>(key.second.size()), kTransient);
+        api_.bind_double(stmt, 4, a.last);
+        api_.bind_int64(stmt, 5, a.last_step);
+        if (api_.step(stmt) != kSqliteDone) {
+          std::cerr << "[store] summary upsert failed: " << api_.errmsg(db_)
+                    << std::endl;
+        }
+        api_.finalize(stmt);
+      }
+    }
+  }
+
+  std::vector<Json> read_metrics(int64_t trial_id, size_t limit,
+                                 size_t offset) override {
+    std::vector<Json> out;
+    sqlite3_stmt* stmt = nullptr;
+    if (api_.prepare(db_,
+                     "SELECT body FROM metrics WHERE trial_id = ?1 "
+                     "ORDER BY seq LIMIT ?2 OFFSET ?3",
+                     -1, &stmt, nullptr) != kSqliteOk) {
+      return out;
+    }
+    api_.bind_int64(stmt, 1, trial_id);
+    api_.bind_int64(stmt, 2, static_cast<long long>(limit));
+    api_.bind_int64(stmt, 3, static_cast<long long>(offset));
+    while (api_.step(stmt) == kSqliteRow) {
+      const unsigned char* text = api_.column_text(stmt, 0);
+      if (!text) continue;
+      try {
+        out.push_back(Json::parse(reinterpret_cast<const char*>(text)));
+      } catch (const std::exception&) {
+      }
+    }
+    api_.finalize(stmt);
+    return out;
+  }
+
+  Json metric_summary(int64_t trial_id) override {
+    Json arr = Json::array();
+    sqlite3_stmt* stmt = nullptr;
+    if (api_.prepare(db_,
+                     "SELECT grp, name, count, sum, min, max, last, "
+                     "last_step FROM metric_summary WHERE trial_id = ?1 "
+                     "ORDER BY grp, name",
+                     -1, &stmt, nullptr) == kSqliteOk) {
+      api_.bind_int64(stmt, 1, trial_id);
+      while (api_.step(stmt) == kSqliteRow) {
+        auto text = [&](int c) {
+          const unsigned char* t = api_.column_text(stmt, c);
+          return t ? std::string(reinterpret_cast<const char*>(t)) : "";
+        };
+        int64_t count = api_.column_int64(stmt, 2);
+        Json row = Json::object();
+        row.set("group", text(0)).set("name", text(1)).set("count", count)
+            .set("min", api_.column_double(stmt, 4))
+            .set("max", api_.column_double(stmt, 5))
+            .set("mean", count ? api_.column_double(stmt, 3) / count : 0.0)
+            .set("last", api_.column_double(stmt, 6))
+            .set("last_step", static_cast<int64_t>(api_.column_int64(stmt, 7)));
+        arr.push_back(row);
+      }
+      api_.finalize(stmt);
+    }
+    Json j = Json::object();
+    j.set("summary", arr);
+    return j;
+  }
+
+  void retain_stream(const std::string& stream, size_t keep_last) override {
+    sqlite3_stmt* stmt = nullptr;
+    if (api_.prepare(db_,
+                     "DELETE FROM records WHERE stream = ?1 AND seq <= "
+                     "(SELECT COALESCE(MAX(seq), 0) FROM records WHERE "
+                     "stream = ?1) - ?2",
+                     -1, &stmt, nullptr) != kSqliteOk) {
+      return;
+    }
+    api_.bind_text(stmt, 1, stream.c_str(), static_cast<int>(stream.size()),
+                   kTransient);
+    api_.bind_int64(stmt, 2, static_cast<long long>(keep_last));
+    if (api_.step(stmt) != kSqliteDone) {
+      std::cerr << "[store] retention delete failed: " << api_.errmsg(db_)
+                << std::endl;
+    }
+    api_.finalize(stmt);
+  }
+
+  int schema_version() override { return schema_version_; }
+
+  // Versioned forward migrations (≈ the reference's
+  // master/static/migrations — 144 up/down pairs under go-migrate; here a
+  // linear ladder stamped into PRAGMA user_version). Each entry runs in a
+  // transaction; a fresh database replays the whole ladder.
   bool init_schema() {
-    return exec_sql("PRAGMA journal_mode=WAL") &&
-           exec_sql("PRAGMA synchronous=NORMAL") &&
-           exec_sql("CREATE TABLE IF NOT EXISTS kv ("
-                    "key TEXT PRIMARY KEY, value TEXT NOT NULL)") &&
-           exec_sql("CREATE TABLE IF NOT EXISTS records ("
-                    "stream TEXT NOT NULL, seq INTEGER NOT NULL, "
-                    "body TEXT NOT NULL, PRIMARY KEY (stream, seq))");
+    if (!exec_sql("PRAGMA journal_mode=WAL") ||
+        !exec_sql("PRAGMA synchronous=NORMAL")) {
+      return false;
+    }
+    struct Migration {
+      int version;
+      const char* description;
+      bool (SqliteStore::*apply)();
+    };
+    static const Migration kMigrations[] = {
+        {1, "base kv + record streams", &SqliteStore::migrate_v1_base},
+        {2, "relational metrics + materialized summary",
+         &SqliteStore::migrate_v2_metrics},
+    };
+    int version = read_user_version();
+    for (const auto& m : kMigrations) {
+      if (m.version <= version) continue;
+      if (m.version == 2) {
+        // ORDER MATTERS: the v2 backfill reads `records`, so a files→sqlite
+        // switch must import the legacy .jsonl streams first or every
+        // pre-switch metric row would be invisible to the typed tables
+        migrate_legacy_streams();
+      }
+      exec_sql("BEGIN");
+      if (!(this->*m.apply)()) {
+        exec_sql("ROLLBACK");
+        std::cerr << "[store] migration v" << m.version << " ("
+                  << m.description << ") failed" << std::endl;
+        return false;
+      }
+      std::string stamp =
+          "PRAGMA user_version = " + std::to_string(m.version);
+      if (!exec_sql(stamp.c_str())) {
+        exec_sql("ROLLBACK");
+        return false;
+      }
+      exec_sql("COMMIT");
+      if (version > 0) {
+        std::cerr << "[store] applied migration v" << m.version << ": "
+                  << m.description << std::endl;
+      }
+      version = m.version;
+    }
+    schema_version_ = version;
+    return true;
   }
 
   // files→sqlite migration: on an empty records table, import legacy
@@ -273,6 +581,75 @@ class SqliteStore : public Store {
   }
 
  private:
+  int read_user_version() {
+    int version = 0;
+    sqlite3_stmt* stmt = nullptr;
+    if (api_.prepare(db_, "PRAGMA user_version", -1, &stmt, nullptr) ==
+        kSqliteOk) {
+      if (api_.step(stmt) == kSqliteRow) {
+        version = static_cast<int>(api_.column_int64(stmt, 0));
+      }
+      api_.finalize(stmt);
+    }
+    return version;
+  }
+
+  bool migrate_v1_base() {
+    return exec_sql("CREATE TABLE IF NOT EXISTS kv ("
+                    "key TEXT PRIMARY KEY, value TEXT NOT NULL)") &&
+           exec_sql("CREATE TABLE IF NOT EXISTS records ("
+                    "stream TEXT NOT NULL, seq INTEGER NOT NULL, "
+                    "body TEXT NOT NULL, PRIMARY KEY (stream, seq))");
+  }
+
+  bool migrate_v2_metrics() {
+    if (!exec_sql("CREATE TABLE IF NOT EXISTS metrics ("
+                  "trial_id INTEGER NOT NULL, seq INTEGER NOT NULL, "
+                  "grp TEXT NOT NULL, step INTEGER, time REAL, "
+                  "body TEXT NOT NULL, PRIMARY KEY (trial_id, seq))") ||
+        !exec_sql("CREATE INDEX IF NOT EXISTS idx_metrics_trial_grp_step "
+                  "ON metrics (trial_id, grp, step)") ||
+        !exec_sql("CREATE TABLE IF NOT EXISTS metric_summary ("
+                  "trial_id INTEGER NOT NULL, grp TEXT NOT NULL, "
+                  "name TEXT NOT NULL, count INTEGER NOT NULL, "
+                  "sum REAL, min REAL, max REAL, last REAL, "
+                  "last_step INTEGER, PRIMARY KEY (trial_id, grp, name))")) {
+      return false;
+    }
+    // backfill: metric history reported before this schema existed lives
+    // in the generic record streams — move it into the typed tables so
+    // summaries cover the whole trial, not just post-upgrade reports
+    sqlite3_stmt* stmt = nullptr;
+    if (api_.prepare(db_,
+                     "SELECT stream, body FROM records WHERE stream LIKE "
+                     "'trial-%-metrics.jsonl' ORDER BY stream, seq",
+                     -1, &stmt, nullptr) != kSqliteOk) {
+      return false;
+    }
+    size_t imported = 0;
+    while (api_.step(stmt) == kSqliteRow) {
+      const unsigned char* stream_c = api_.column_text(stmt, 0);
+      const unsigned char* body_c = api_.column_text(stmt, 1);
+      if (!stream_c || !body_c) continue;
+      const std::string stream = reinterpret_cast<const char*>(stream_c);
+      // "trial-<id>-metrics.jsonl"
+      int64_t trial_id = std::atoll(stream.c_str() + 6);
+      if (trial_id <= 0) continue;
+      try {
+        append_metric_rows(trial_id,
+                           Json::parse(reinterpret_cast<const char*>(body_c)));
+        ++imported;
+      } catch (const std::exception&) {
+      }
+    }
+    api_.finalize(stmt);
+    if (imported) {
+      std::cerr << "[store] migration v2 backfilled " << imported
+                << " metric records" << std::endl;
+    }
+    return true;
+  }
+
   void append_raw(const std::string& stream, const std::string& body) {
     // one prepared statement for the hot write path (log batches of 100+)
     if (!insert_stmt_) {
@@ -351,6 +728,7 @@ class SqliteStore : public Store {
   sqlite3* db_;
   std::string data_dir_;
   sqlite3_stmt* insert_stmt_ = nullptr;
+  int schema_version_ = 0;
 };
 
 }  // namespace

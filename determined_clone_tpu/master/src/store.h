@@ -38,6 +38,24 @@ class Store {
   virtual std::vector<Json> read_tail(const std::string& stream,
                                       size_t limit) = 0;
 
+  // -- typed trial metrics ---------------------------------------------
+  // Relational on sqlite (metrics rows + a materialized per-(group, name)
+  // summary, ≈ the reference's postgres_trial.go metric tables +
+  // calculate-full-trial-summary-metrics.sql); stream-backed with scan
+  // aggregation on the files backend.
+  virtual void append_metric(int64_t trial_id, const Json& rec) = 0;
+  virtual std::vector<Json> read_metrics(int64_t trial_id, size_t limit,
+                                         size_t offset) = 0;
+  // {"summary": [{group, name, count, min, max, mean, last, last_step}]}
+  // — the flat-cost read the experiment/trial pages aggregate from
+  virtual Json metric_summary(int64_t trial_id) = 0;
+
+  // log retention: drop all but the newest keep_last records of a stream
+  virtual void retain_stream(const std::string& stream, size_t keep_last) = 0;
+
+  // backend schema version (files backend: 0; sqlite: migration stamp)
+  virtual int schema_version() = 0;
+
   virtual const char* kind() const = 0;
 };
 
