@@ -126,7 +126,7 @@ def py_type(field) -> tuple:
             conv_in = f"{base}.from_json(v)"
             conv_out = "x.to_json()"
         if field.label == LABEL_REPEATED:
-            return (f"List[{base}]", "None",
+            return (f"Optional[List[{base}]]", "None",
                     f"[{conv_in} for v in (v or [])]",
                     f"[{conv_out} for x in x]")
         return (f"Optional[{base}]", "None",
@@ -134,8 +134,8 @@ def py_type(field) -> tuple:
                 f"({conv_out} if x is not None else None)")
     ann, _ = SCALAR_TYPES[field.type]
     if field.label == LABEL_REPEATED:
-        return (f"List[{ann}]", "None", f"[{ann}(v) for v in (v or [])]",
-                "list(x)")
+        return (f"Optional[List[{ann}]]", "None",
+                f"[{ann}(v) for v in (v or [])]", "list(x)")
     return (f"Optional[{ann}]", "None",
             f"{ann}(v)" if ann != "bool" else "bool(v)", "x")
 
@@ -150,19 +150,17 @@ def gen_message(msg) -> str:
     tos = []
     for field in msg.field:
         ann, default, from_expr, to_expr = py_type(field)
-        if ann.startswith("List["):
-            inits.append(
-                f"    {field.name}: {ann} = dataclasses.field("
-                f"default_factory=list)")
-        else:
-            inits.append(f"    {field.name}: {ann} = {default}")
+        repeated = ann.startswith("Optional[List[")
+        # Repeated fields: None = unset (omitted on the wire, so requests
+        # can distinguish "don't touch" from an explicit [] that clears);
+        # responses deserialize missing to [] for iteration ergonomics.
+        inits.append(f"    {field.name}: {ann} = {default}")
         froms.append(
             f"            {field.name}=(lambda v: {from_expr})"
             f"(obj.get({field.name!r}))"
             f" if obj.get({field.name!r}) is not None else "
-            + ("[]" if ann.startswith("List[") else "None") + ",")
-        guard = (f"self.{field.name}" if ann.startswith("List[")
-                 else f"self.{field.name} is not None")
+            + ("[]" if repeated else "None") + ",")
+        guard = f"self.{field.name} is not None"
         tos.append(
             f"        if {guard}:\n"
             f"            out[{field.name!r}] = "

@@ -289,14 +289,22 @@ def cmd_task_logs(args) -> int:
 
 
 def _start_ntsc(args, task_type: str, **extra: Any) -> int:
+    # typed roots (LaunchNotebook/LaunchShell/... RPCs) rather than the
+    # generic CreateTask — the type is pinned server-side
     session = make_session(args)
     kwargs: Dict[str, Any] = dict(extra)
     if getattr(args, "name", None):
         kwargs["name"] = args.name
     if getattr(args, "idle_timeout", None):
         kwargs["idle_timeout"] = args.idle_timeout
-    task = session.create_task(task_type, **kwargs)
+    task = session.post(f"/api/v1/{task_type}s", kwargs)[task_type]
     print(f"Started {task_type} {task['id']}")
+    return 0
+
+
+def _list_ntsc(args, task_type: str) -> int:
+    tasks = make_session(args).get(f"/api/v1/{task_type}s")[task_type + "s"]
+    print_table(tasks, ["id", "name", "state", "owner", "proxy_address"])
     return 0
 
 
@@ -325,6 +333,80 @@ def cmd_command_run(args) -> int:
 def cmd_tensorboard_start(args) -> int:
     ids = [int(x) for x in args.experiment_ids.split(",") if x]
     return _start_ntsc(args, "tensorboard", experiment_ids=ids)
+
+
+def cmd_master_logs(args) -> int:
+    out = make_session(args).get(
+        f"/api/v1/master/logs?limit={args.limit}&offset={args.offset}")
+    for rec in out["logs"]:
+        print(f"[{rec['level']}] {rec['log']}")
+    return 0
+
+
+def cmd_trial_summary(args) -> int:
+    rows = make_session(args).trial_metric_summary(args.trial_id)
+    print_table(rows, ["group", "name", "count", "min", "max", "mean",
+                       "last", "last_step"])
+    return 0
+
+
+def cmd_experiment_move(args) -> int:
+    out = make_session(args).post(
+        f"/api/v1/experiments/{args.experiment_id}/move",
+        {"project_id": args.project_id})
+    e = out["experiment"]
+    print(f"Moved experiment {e['id']} to {e['workspace']}/{e['project']}")
+    return 0
+
+
+def cmd_experiment_label(args) -> int:
+    labels = [x for x in args.labels.split(",") if x]
+    out = make_session(args).request(
+        "PATCH", f"/api/v1/experiments/{args.experiment_id}",
+        {"labels": labels})
+    print(f"Labels: {out['experiment']['labels']}")
+    return 0
+
+
+def cmd_experiment_progress(args) -> int:
+    out = make_session(args).get(
+        f"/api/v1/experiments/{args.experiment_id}/progress")
+    print(f"{out['progress'] * 100:.1f}% "
+          f"({out['units_done']:.0f}/{out['units_target']:.0f} units, "
+          f"{out['state']})")
+    return 0
+
+
+def cmd_project_move(args) -> int:
+    out = make_session(args).post(
+        f"/api/v1/projects/{args.project_id}/move",
+        {"workspace_id": args.workspace_id})
+    print(f"Moved project {out['project']['id']} to workspace "
+          f"{out['project']['workspace_id']}")
+    return 0
+
+
+def cmd_user_settings(args) -> int:
+    session = make_session(args)
+    if args.key is not None and args.value is not None:
+        try:
+            value = json.loads(args.value)
+        except json.JSONDecodeError:
+            value = args.value
+        out = session.post("/api/v1/users/settings",
+                           {"key": args.key, "value": value})
+        print_json(out["settings"])
+        return 0
+    settings = session.get("/api/v1/users/settings")["settings"]
+    if args.key is not None:
+        # read one key; missing is a visible error, not a silent full dump
+        if args.key not in settings:
+            print(f"no setting {args.key!r}", file=sys.stderr)
+            return 1
+        print_json(settings[args.key])
+        return 0
+    print_json(settings)
+    return 0
 
 
 def cmd_agent_list(args) -> int:
@@ -644,6 +726,10 @@ def build_parser() -> argparse.ArgumentParser:
     sm = p_master.add_subparsers(dest="subcommand", required=True)
     sm.add_parser("info").set_defaults(func=cmd_master_info)
     sm.add_parser("config").set_defaults(func=cmd_master_config)
+    c = sm.add_parser("logs")
+    c.add_argument("--limit", type=int, default=200)
+    c.add_argument("--offset", type=int, default=0)
+    c.set_defaults(func=cmd_master_logs)
 
     # experiment
     p_exp = sub.add_parser("experiment", aliases=["e"], help="experiments")
@@ -674,6 +760,17 @@ def build_parser() -> argparse.ArgumentParser:
         c = se.add_parser(action)
         c.add_argument("experiment_id", type=int)
         c.set_defaults(func=fn)
+    c = se.add_parser("move")
+    c.add_argument("experiment_id", type=int)
+    c.add_argument("project_id", type=int)
+    c.set_defaults(func=cmd_experiment_move)
+    c = se.add_parser("label")
+    c.add_argument("experiment_id", type=int)
+    c.add_argument("labels", help="comma-separated; empty string clears")
+    c.set_defaults(func=cmd_experiment_label)
+    c = se.add_parser("progress")
+    c.add_argument("experiment_id", type=int)
+    c.set_defaults(func=cmd_experiment_progress)
     c = se.add_parser("archive")
     c.add_argument("experiment_id", type=int)
     c.add_argument("--unarchive", action="store_true")
@@ -688,6 +785,9 @@ def build_parser() -> argparse.ArgumentParser:
     c = st.add_parser("kill")
     c.add_argument("trial_id", type=int)
     c.set_defaults(func=cmd_trial_kill)
+    c = st.add_parser("summary")
+    c.add_argument("trial_id", type=int)
+    c.set_defaults(func=cmd_trial_summary)
     c = st.add_parser("metrics")
     c.add_argument("trial_id", type=int)
     c.add_argument("--limit", type=int, default=1000)
@@ -730,6 +830,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     p_nb = sub.add_parser("notebook", help="notebook tasks")
     sn = p_nb.add_subparsers(dest="subcommand", required=True)
+    sn.add_parser("list").set_defaults(
+        func=lambda a: _list_ntsc(a, "notebook"))
     c = sn.add_parser("start")
     c.add_argument("--name", default=None)
     c.add_argument("--idle-timeout", type=float, default=None)
@@ -737,6 +839,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     p_sh = sub.add_parser("shell", help="shell tasks")
     ss = p_sh.add_subparsers(dest="subcommand", required=True)
+    ss.add_parser("list").set_defaults(
+        func=lambda a: _list_ntsc(a, "shell"))
     c = ss.add_parser("start")
     c.add_argument("--name", default=None)
     c.add_argument("--idle-timeout", type=float, default=None)
@@ -748,6 +852,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     p_cmd = sub.add_parser("cmd", help="command tasks")
     scm = p_cmd.add_subparsers(dest="subcommand", required=True)
+    scm.add_parser("list").set_defaults(
+        func=lambda a: _list_ntsc(a, "command"))
     c = scm.add_parser("run")
     c.add_argument("--name", default=None)
     c.add_argument("cmd", nargs="+")
@@ -755,6 +861,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     p_tb = sub.add_parser("tensorboard", help="tensorboard tasks")
     stb = p_tb.add_subparsers(dest="subcommand", required=True)
+    stb.add_parser("list").set_defaults(
+        func=lambda a: _list_ntsc(a, "tensorboard"))
     c = stb.add_parser("start")
     c.add_argument("experiment_ids", help="comma-separated experiment ids")
     c.add_argument("--name", default=None)
@@ -794,6 +902,11 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--admin", action="store_true")
     c.set_defaults(func=cmd_user_create)
     su.add_parser("list").set_defaults(func=cmd_user_list)
+    c = su.add_parser("settings")
+    c.add_argument("key", nargs="?", default=None)
+    c.add_argument("value", nargs="?", default=None,
+                   help="JSON value (bare strings accepted)")
+    c.set_defaults(func=cmd_user_settings)
 
     # workspace / project
     p_ws = sub.add_parser("workspace", aliases=["w"], help="workspaces")
@@ -808,6 +921,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     p_proj = sub.add_parser("project", aliases=["p"], help="projects")
     sp = p_proj.add_subparsers(dest="subcommand", required=True)
+    c = sp.add_parser("move")
+    c.add_argument("project_id", type=int)
+    c.add_argument("workspace_id", type=int)
+    c.set_defaults(func=cmd_project_move)
     c = sp.add_parser("create")
     c.add_argument("workspace_id", type=int)
     c.add_argument("name")

@@ -203,6 +203,13 @@ void Master::save_snapshot_locked() {
       .set("allocations", allocs).set("agents", agents)
       .set("checkpoints", ckpts).set("request_to_trial", req_map)
       .set("users", users).set("sessions", sessions)
+      .set("user_settings", [this] {
+        Json j = Json::object();
+        for (const auto& [uid, bag] : user_settings_) {
+          j.set(std::to_string(uid), bag);
+        }
+        return j;
+      }())
       .set("workspaces", workspaces).set("projects", projects)
       .set("models", models).set("templates", templates)
       .set("webhooks", webhooks).set("groups", groups)
@@ -269,6 +276,14 @@ void Master::load_snapshot() {
     SessionToken tok = SessionToken::from_json(s);
     sessions_[tok.token] = std::move(tok);
   }
+  if (snap["user_settings"].is_object()) {
+    for (const auto& [uid, bag] : snap["user_settings"].items()) {
+      try {
+        user_settings_[std::stoll(uid)] = bag;
+      } catch (const std::exception&) {
+      }
+    }
+  }
   for (const auto& w : snap["workspaces"].elements()) {
     Workspace ws = Workspace::from_json(w);
     workspaces_[ws.id] = std::move(ws);
@@ -310,6 +325,17 @@ void Master::load_snapshot() {
 
 // The jsonl-era names survive as the call sites' vocabulary; the bodies
 // delegate to the pluggable Store (files or sqlite — store.h).
+void Master::log_event(const std::string& level, const std::string& msg) {
+  // callers hold mu_
+  Json rec = Json::object();
+  rec.set("time", now_sec()).set("level", level).set("log", msg);
+  event_log_.push_back(rec);
+  if (event_log_.size() > 5000) {
+    event_log_.pop_front();
+    ++event_log_head_seq_;
+  }
+}
+
 void Master::append_jsonl(const std::string& file, const Json& record) {
   store_->append(file, record);
   ++stream_versions_[file];  // callers hold mu_
@@ -750,6 +776,10 @@ void Master::finish_experiment(Experiment& exp, RunState state,
   exp.state = state;
   exp.ended_at = now_sec();
   exp.error = error;
+  log_event(state == RunState::Errored ? "error" : "info",
+            "experiment " + std::to_string(exp.id) + " finished: " +
+                std::string(to_string(state)) +
+                (error.empty() ? "" : " (" + error + ")"));
   fire_webhooks(exp);  // async, detached (≈ webhooks/shipper.go)
   gc_checkpoints_locked(exp);  // storage-policy GC (≈ checkpoint_gc.go:27)
   // a finished experiment's node blocklist is dead state — drop it so
@@ -773,6 +803,9 @@ void Master::on_task_done(const std::string& alloc_id, int exit_code,
   auto ait = allocations_.find(alloc_id);
   if (ait == allocations_.end()) return;
   Allocation& alloc = ait->second;
+  log_event(exit_code == 0 ? "info" : "error",
+            "task " + alloc_id + " exited rc=" + std::to_string(exit_code) +
+                (error.empty() ? "" : ": " + error));
   // any exit (clean, failed, canceled) invalidates the gang's barrier
   // payloads — a restarted incarnation must never rendezvous against a
   // dead incarnation's addresses
@@ -959,6 +992,8 @@ void Master::tick_locked() {
     if (agent.last_heartbeat > 0 &&
         now - agent.last_heartbeat > config_.agent_timeout_sec) {
       agent.enabled = false;
+      log_event("warn", "agent " + aid + " timed out (heartbeat lost); "
+                "requeueing its allocations");
       for (auto& [id, alloc] : allocations_) {
         if (alloc.reservations.count(aid) &&
             (alloc.state == RunState::Running ||

@@ -160,6 +160,11 @@ class Master {
   // Dispatched from handle() BEFORE the state lock: it sleeps on
   // logs_cv_ between reads and must not pin route()'s lock_guard.
   HttpResponse logs_follow_route(const HttpRequest& req);
+  // generic + typed NTSC task surface (tasks/notebooks/shells/commands/
+  // tensorboards roots share it; forced_type pins the type, "" = generic)
+  HttpResponse tasks_route(const HttpRequest& req,
+                           const std::string& forced_type,
+                           const char* singular, const char* plural);
 
   // -- platform helpers (routes_platform.cc) --
   User* current_user(const HttpRequest& req);   // nullptr if no valid token
@@ -217,6 +222,12 @@ class Master {
   // into O(appends x followers) reads under mu_.
   std::condition_variable logs_cv_;
   std::map<std::string, uint64_t> stream_versions_;
+  // master's own event log (≈ the reference's master logs API,
+  // api_master.go GetMasterLogs): bounded in-memory ring; seq numbers stay
+  // absolute across drops so client cursors survive trimming
+  std::deque<Json> event_log_;
+  uint64_t event_log_head_seq_ = 0;  // seq of event_log_.front()
+  void log_event(const std::string& level, const std::string& msg);
   double last_retention_sweep_ = 0;
   // retention bookkeeping: when each terminal allocation was first seen
   // (grace timer) and which have already been trimmed (once per lifetime)
@@ -248,6 +259,7 @@ class Master {
   int64_t next_group_id_ = 1;
   int64_t next_assignment_id_ = 1;
   std::map<int64_t, User> users_;
+  std::map<int64_t, Json> user_settings_;  // per-user UI/CLI settings bag
   std::map<std::string, SessionToken> sessions_;
   std::map<int64_t, Workspace> workspaces_;
   std::map<int64_t, Project> projects_;

@@ -61,9 +61,13 @@ struct Experiment {
   double ended_at = 0;
   std::string error;
   bool archived = false;
+  std::string description;
+  std::vector<std::string> labels;
 
   Json to_json() const {
     Json j = Json::object();
+    Json lbls = Json::array();
+    for (const auto& l : labels) lbls.push_back(l);
     j.set("id", id).set("name", name).set("config", config)
         .set("state", to_string(state))
         .set("next_request_id", next_request_id)
@@ -71,7 +75,8 @@ struct Experiment {
         .set("owner", owner).set("workspace", workspace)
         .set("project", project).set("created_at", created_at)
         .set("ended_at", ended_at).set("error", error)
-        .set("archived", archived);
+        .set("archived", archived).set("description", description)
+        .set("labels", lbls);
     return j;
   }
   static Experiment from_json(const Json& j) {
@@ -89,6 +94,10 @@ struct Experiment {
     e.ended_at = j["ended_at"].as_number();
     e.error = j["error"].as_string();
     e.archived = j["archived"].as_bool(false);
+    e.description = j["description"].as_string();
+    for (const auto& l : j["labels"].elements()) {
+      if (l.is_string()) e.labels.push_back(l.as_string());
+    }
     return e;
   }
 };

@@ -535,6 +535,140 @@ HttpResponse Master::proxy_route(const HttpRequest& req) {
   return out;
 }
 
+// Generic NTSC task surface, shared by /api/v1/tasks and the typed roots
+// (/api/v1/{notebooks,shells,commands,tensorboards} — ≈ the reference's
+// typed LaunchNotebook/LaunchShell/LaunchTensorboard families,
+// api_notebook.go etc.). `forced_type` pins the task type ("" = generic:
+// type from the body / query); `singular`/`plural` name the response keys.
+HttpResponse Master::tasks_route(const HttpRequest& req,
+                                 const std::string& forced_type,
+                                 const char* singular, const char* plural) {
+  const auto& parts = req.path_parts;
+    if (parts.size() == 3 && req.method == "POST") {
+      // rbac: NTSC tasks consume cluster slots like experiments do
+      if (!rbac_allows(req, role_rank("Editor"))) {
+        return HttpResponse::json(
+            403, error_json("Editor role required to create tasks").dump());
+      }
+      Json body = Json::parse(req.body);
+      std::string type = body["type"].as_string();
+      if (!forced_type.empty()) type = forced_type;
+      if (type.empty()) type = "command";
+      if (type != "command" && type != "notebook" && type != "shell" &&
+          type != "tensorboard") {
+        return bad_request("unknown task type " + type);
+      }
+      Allocation alloc;
+      alloc.id = "task-" + type + "-" + std::to_string(next_task_id_++);
+      alloc.task_type = type;
+      alloc.trial_id = 0;
+      alloc.name = body["name"].as_string().empty() ? alloc.id
+                                                    : body["name"].as_string();
+      // owner is the authenticated caller — a client-supplied owner would
+      // make the owner-may-kill gate below spoofable. The body field is
+      // honored only when there is no session (auth off / internal use).
+      if (User* caller = current_user(req)) {
+        alloc.owner = caller->username;
+      } else if (!body["owner"].as_string().empty()) {
+        alloc.owner = body["owner"].as_string();
+      }
+      alloc.state = RunState::Queued;
+      alloc.slots = static_cast<int>(body["slots"].as_int(0));
+      alloc.priority = static_cast<int>(body["priority"].as_int(42));
+      alloc.resource_pool = body["resource_pool"].as_string().empty()
+                                ? "default"
+                                : body["resource_pool"].as_string();
+      alloc.idle_timeout_sec = body["idle_timeout"].as_number(0);
+      alloc.queued_at = now_sec();
+      alloc.last_activity = alloc.queued_at;
+      alloc.token = crypto::random_token();
+      // the agent execs spec.argv directly; built-in task types run the
+      // generic harness task server (determined_clone_tpu/exec/task.py)
+      Json argv = Json::array();
+      if (type == "command") {
+        if (!body["cmd"].is_array() || body["cmd"].size() == 0) {
+          return bad_request("command task requires cmd argv array");
+        }
+        for (const auto& e : body["cmd"].elements()) {
+          if (!e.is_string() || e.as_string().empty()) {
+            return bad_request("cmd argv elements must be non-empty strings");
+          }
+        }
+        argv = body["cmd"];
+      } else {
+        argv.push_back("python");
+        argv.push_back("-m");
+        argv.push_back("determined_clone_tpu.exec.task");
+        argv.push_back(type);
+        if (type == "tensorboard" && body["experiment_ids"].is_array()) {
+          std::string ids;
+          for (const auto& e : body["experiment_ids"].elements()) {
+            if (!ids.empty()) ids += ",";
+            ids += std::to_string(e.as_int());
+          }
+          argv.push_back("--experiment-ids");
+          argv.push_back(ids);
+        }
+      }
+      alloc.spec.set("argv", argv);
+      if (body["env"].is_object()) alloc.spec.set("env", body["env"]);
+      std::string id = alloc.id;
+      allocations_[id] = std::move(alloc);
+      dirty_ = true;
+      Json j = Json::object();
+      j.set(singular, allocations_[id].to_json());
+      return HttpResponse::json(201, j.dump());
+    }
+    if (parts.size() == 3 && req.method == "GET") {
+      auto type_filter = req.query.find("type");
+      Json arr = Json::array();
+      for (const auto& [id, a] : allocations_) {
+        if (a.trial_id != 0 || a.task_type == "trial") continue;
+        if (!forced_type.empty() && a.task_type != forced_type) continue;
+        if (type_filter != req.query.end() &&
+            a.task_type != type_filter->second) {
+          continue;
+        }
+        arr.push_back(a.to_json());
+      }
+      Json j = Json::object();
+      j.set(plural, arr);
+      return ok_json(j);
+    }
+    if (parts.size() >= 4) {
+      auto it = allocations_.find(parts[3]);
+      if (it == allocations_.end() || it->second.task_type == "trial" ||
+          (!forced_type.empty() && it->second.task_type != forced_type)) {
+        return not_found("no task " + parts[3]);
+      }
+      Allocation& alloc = it->second;
+      if (parts.size() == 4 && req.method == "GET") {
+        Json j = Json::object();
+        j.set(singular, alloc.to_json());
+        return ok_json(j);
+      }
+      if (parts.size() == 5 && parts[4] == "kill" && req.method == "POST") {
+        // rbac: global Editor, or the task's owner killing their own task
+        User* caller = current_user(req);
+        bool own = caller && caller->username == alloc.owner;
+        if (!own && !rbac_allows(req, role_rank("Editor"))) {
+          return HttpResponse::json(
+              403, error_json("Editor role (or task ownership) required")
+                       .dump());
+        }
+        if (alloc.state == RunState::Queued || alloc.state == RunState::Pulling ||
+            alloc.state == RunState::Running) {
+          alloc.state = RunState::Canceled;  // heartbeat derives the kill
+          dirty_ = true;
+        }
+        Json j = Json::object();
+        j.set(singular, alloc.to_json());
+        return ok_json(j);
+      }
+    }
+  return not_found("no such route");
+}
+
 HttpResponse Master::route(const HttpRequest& req) {
   const auto& parts = req.path_parts;  // e.g. {"api","v1","experiments","3"}
   if (parts.size() < 2 || parts[0] != "api" || parts[1] != "v1") {
@@ -554,7 +688,8 @@ HttpResponse Master::route(const HttpRequest& req) {
   static const std::set<std::string> kAuthRoots = {
       "experiments", "tasks",  "users",    "workspaces", "models",
       "templates",   "webhooks", "job-queue", "provisioner", "groups",
-      "rbac"};
+      "rbac", "notebooks", "shells", "commands", "tensorboards",
+      "projects", "checkpoints"};
   if (config_.auth_required && kAuthRoots.count(root)) {
     bool alloc_readonly = req.method == "GET" &&
                           (root == "experiments" || root == "users") &&
@@ -580,6 +715,37 @@ HttpResponse Master::route(const HttpRequest& req) {
         .set("agents", static_cast<int64_t>(agents_.size()))
         .set("experiments", static_cast<int64_t>(experiments_.size()))
         .set("store", store);
+    return ok_json(j);
+  }
+  // master's own event log (≈ GetMasterLogs, api_master.go): bounded ring
+  // of lifecycle events; absolute seq cursor survives ring trimming.
+  // Session-gated under auth — unlike /master (sanitized info), the event
+  // log carries agent/experiment/task detail.
+  if (root == "master" && parts.size() == 4 && parts[3] == "logs" &&
+      req.method == "GET") {
+    if (config_.auth_required && !current_user(req)) {
+      return HttpResponse::json(
+          401, error_json("authentication required").dump());
+    }
+    size_t limit = 1000, offset = 0;
+    if (!parse_size(req.query, "limit", &limit) ||
+        !parse_size(req.query, "offset", &offset)) {
+      return bad_request("limit/offset must be non-negative integers");
+    }
+    Json arr = Json::array();
+    uint64_t seq = event_log_head_seq_;
+    size_t start = offset > seq ? offset - seq : 0;
+    for (size_t i = start; i < event_log_.size() && arr.size() < limit;
+         ++i) {
+      Json rec = event_log_[i];
+      rec.set("seq", static_cast<int64_t>(seq + i));
+      arr.push_back(rec);
+    }
+    uint64_t consumed = seq + start + arr.size();
+    Json j = Json::object();
+    j.set("logs", arr)
+        .set("next_offset", static_cast<int64_t>(
+                                std::max<uint64_t>(offset, consumed)));
     return ok_json(j);
   }
   // active config, secrets omitted (≈ GetMasterConfig api_master.go);
@@ -893,6 +1059,80 @@ HttpResponse Master::route(const HttpRequest& req) {
         }
         Json j = Json::object();
         j.set("experiment", exp.to_json());
+        return ok_json(j);
+      }
+      // patch (≈ PatchExperiment): display metadata only — name,
+      // description, labels; lifecycle stays with the action routes
+      if (parts.size() == 4 && req.method == "PATCH") {
+        if (!rbac_allows(req, role_rank("Editor"),
+                         workspace_id_by_name(exp.workspace))) {
+          return HttpResponse::json(
+              403, error_json("Editor role required").dump());
+        }
+        Json body = Json::parse(req.body);
+        if (body["name"].is_string() && !body["name"].as_string().empty()) {
+          exp.name = body["name"].as_string();
+        }
+        if (body["description"].is_string()) {
+          exp.description = body["description"].as_string();
+        }
+        if (body["labels"].is_array()) {
+          exp.labels.clear();
+          for (const auto& l : body["labels"].elements()) {
+            if (l.is_string()) exp.labels.push_back(l.as_string());
+          }
+        }
+        dirty_ = true;
+        Json j = Json::object();
+        j.set("experiment", exp.to_json());
+        return ok_json(j);
+      }
+      // move to another project (≈ MoveExperiment, api_experiment.go)
+      if (parts.size() == 5 && parts[4] == "move" && req.method == "POST") {
+        Json body = Json::parse(req.body);
+        int64_t pid = body["project_id"].as_int(-1);
+        auto pit = projects_.find(pid);
+        if (pit == projects_.end()) {
+          return bad_request("destination project_id required");
+        }
+        auto wit = workspaces_.find(pit->second.workspace_id);
+        if (wit == workspaces_.end()) {
+          return bad_request("destination project has no workspace");
+        }
+        // rights on both the source and destination workspace scopes
+        if (!rbac_allows(req, role_rank("Editor"),
+                         workspace_id_by_name(exp.workspace)) ||
+            !rbac_allows(req, role_rank("Editor"),
+                         pit->second.workspace_id)) {
+          return HttpResponse::json(
+              403,
+              error_json("Editor role required in both workspaces").dump());
+        }
+        exp.project = pit->second.name;
+        exp.workspace = wit->second.name;
+        dirty_ = true;
+        Json j = Json::object();
+        j.set("experiment", exp.to_json());
+        return ok_json(j);
+      }
+      // searcher progress (≈ GetExperimentProgress / the searcher-progress
+      // reads in api_experiment.go): fraction of target units done across
+      // live trials
+      if (parts.size() == 5 && parts[4] == "progress" &&
+          req.method == "GET") {
+        double done = 0, target = 0;
+        for (const auto& [tid, t] : trials_) {
+          if (t.experiment_id != id) continue;
+          target += static_cast<double>(std::max<int64_t>(t.target_units, 0));
+          done += static_cast<double>(
+              std::min<int64_t>(t.units_done, t.target_units));
+        }
+        Json j = Json::object();
+        bool terminal = exp.state == RunState::Completed;
+        j.set("progress", terminal ? 1.0
+                                   : (target > 0 ? done / target : 0.0))
+            .set("units_done", done).set("units_target", target)
+            .set("state", std::string(to_string(exp.state)));
         return ok_json(j);
       }
       // delete (≈ DeleteExperiment): terminal only; every checkpoint is
@@ -1249,6 +1489,52 @@ HttpResponse Master::route(const HttpRequest& req) {
         parts[5] == "summary" && req.method == "GET") {
       return ok_json(store_->metric_summary(id));
     }
+    // workload history (≈ GetTrialWorkloads, api_trials.go): the
+    // training/validation record sequence as workload entries
+    if (parts.size() == 5 && parts[4] == "workloads" &&
+        req.method == "GET") {
+      size_t limit = 1000, offset = 0;
+      if (!parse_size(req.query, "limit", &limit) ||
+          !parse_size(req.query, "offset", &offset)) {
+        return bad_request("limit/offset must be non-negative integers");
+      }
+      Json arr = Json::array();
+      for (auto& rec : store_->read_metrics(id, limit, offset)) {
+        Json w = Json::object();
+        w.set("kind", rec["group"].as_string())
+            .set("steps_completed", rec["steps_completed"].as_int(0))
+            .set("time", rec["time"].as_number(0))
+            .set("metrics", rec["metrics"]);
+        arr.push_back(w);
+      }
+      Json j = Json::object();
+      j.set("workloads", arr);
+      return ok_json(j);
+    }
+    // profiler series discovery (≈ GetTrialProfilerAvailableSeries): the
+    // distinct metric names the profiler stream carries, so a chart UI
+    // can enumerate before fetching samples
+    if (parts.size() == 6 && parts[4] == "profiler" &&
+        parts[5] == "series" && req.method == "GET") {
+      // samples are flat {"time", "group", <metric>: number, ...} dicts
+      // (profiler.py sample_once); a series is "<group>/<metric>"
+      std::set<std::string> names;
+      for (auto& rec : read_jsonl_tail(
+               "trial-" + std::to_string(id) + "-profiler.jsonl", 2000)) {
+        if (!rec.is_object()) continue;
+        std::string group = rec["group"].as_string();
+        if (group.empty()) group = "system";
+        for (const auto& [k, v] : rec.items()) {
+          if (k == "time" || !v.is_number()) continue;
+          names.insert(group + "/" + k);
+        }
+      }
+      Json arr = Json::array();
+      for (const auto& n : names) arr.push_back(n);
+      Json j = Json::object();
+      j.set("series", arr);
+      return ok_json(j);
+    }
     // profiler samples (≈ master profiler API, common/api/profiler.py)
     if (parts.size() == 5 && parts[4] == "profiler") {
       if (req.method == "POST") {
@@ -1358,130 +1644,85 @@ HttpResponse Master::route(const HttpRequest& req) {
     }
     return not_found("no checkpoint " + parts[3]);
   }
+  // checkpoint mutation (≈ PatchCheckpoints / DeleteCheckpoints,
+  // api_checkpoint.go): metadata merge, and bulk delete that enqueues the
+  // zero-slot storage-GC task per owning experiment
+  if (root == "checkpoints" && parts.size() == 4 && req.method == "PATCH") {
+    if (!rbac_allows(req, role_rank("Editor"))) {
+      return HttpResponse::json(
+          403, error_json("Editor role required").dump());
+    }
+    Json body = Json::parse(req.body);
+    for (auto& c : checkpoints_) {
+      if (c.uuid != parts[3] || c.deleted) continue;
+      if (body["metadata"].is_object()) {
+        for (const auto& [k, v] : body["metadata"].items()) {
+          c.metadata.set(k, v);
+        }
+      }
+      dirty_ = true;
+      return ok_json(c.to_json());
+    }
+    return not_found("no checkpoint " + parts[3]);
+  }
+  if (root == "checkpoints" && parts.size() == 4 && parts[3] == "delete" &&
+      req.method == "POST") {
+    if (!rbac_allows(req, role_rank("Editor"))) {
+      return HttpResponse::json(
+          403, error_json("Editor role required").dump());
+    }
+    Json body = Json::parse(req.body);
+    if (!body["uuids"].is_array()) {
+      return bad_request("uuids array required");
+    }
+    std::set<std::string> wanted;
+    for (const auto& u : body["uuids"].elements()) {
+      wanted.insert(u.as_string());
+    }
+    // group doomed checkpoints by experiment so each GC task runs with
+    // that experiment's checkpoint_storage config
+    std::map<int64_t, std::vector<std::string>> doomed_by_exp;
+    int64_t deleted = 0;
+    for (auto& c : checkpoints_) {
+      if (!wanted.count(c.uuid) || c.deleted) continue;
+      c.deleted = true;
+      ++deleted;
+      doomed_by_exp[c.experiment_id].push_back(c.uuid);
+      // a trial whose latest checkpoint was deleted must not resume from it
+      for (auto& [tid, t] : trials_) {
+        if (t.latest_checkpoint == c.uuid) t.latest_checkpoint.clear();
+      }
+    }
+    for (const auto& [eid, doomed] : doomed_by_exp) {
+      auto eit = experiments_.find(eid);
+      if (eit != experiments_.end()) {
+        spawn_gc_task_locked(eit->second, doomed);
+      }
+    }
+    if (deleted) dirty_ = true;
+    Json j = Json::object();
+    j.set("deleted", deleted);
+    return ok_json(j);
+  }
 
   // ---- NTSC tasks: notebooks/shells/commands/tensorboards ----------------
   // (≈ master/internal/command/command_service.go + api_{notebook,shell,
   //  tensorboard,command}.go, collapsed onto the shared allocation path)
   if (root == "tasks") {
-    if (parts.size() == 3 && req.method == "POST") {
-      // rbac: NTSC tasks consume cluster slots like experiments do
-      if (!rbac_allows(req, role_rank("Editor"))) {
-        return HttpResponse::json(
-            403, error_json("Editor role required to create tasks").dump());
-      }
-      Json body = Json::parse(req.body);
-      std::string type = body["type"].as_string();
-      if (type.empty()) type = "command";
-      if (type != "command" && type != "notebook" && type != "shell" &&
-          type != "tensorboard") {
-        return bad_request("unknown task type " + type);
-      }
-      Allocation alloc;
-      alloc.id = "task-" + type + "-" + std::to_string(next_task_id_++);
-      alloc.task_type = type;
-      alloc.trial_id = 0;
-      alloc.name = body["name"].as_string().empty() ? alloc.id
-                                                    : body["name"].as_string();
-      // owner is the authenticated caller — a client-supplied owner would
-      // make the owner-may-kill gate below spoofable. The body field is
-      // honored only when there is no session (auth off / internal use).
-      if (User* caller = current_user(req)) {
-        alloc.owner = caller->username;
-      } else if (!body["owner"].as_string().empty()) {
-        alloc.owner = body["owner"].as_string();
-      }
-      alloc.state = RunState::Queued;
-      alloc.slots = static_cast<int>(body["slots"].as_int(0));
-      alloc.priority = static_cast<int>(body["priority"].as_int(42));
-      alloc.resource_pool = body["resource_pool"].as_string().empty()
-                                ? "default"
-                                : body["resource_pool"].as_string();
-      alloc.idle_timeout_sec = body["idle_timeout"].as_number(0);
-      alloc.queued_at = now_sec();
-      alloc.last_activity = alloc.queued_at;
-      alloc.token = crypto::random_token();
-      // the agent execs spec.argv directly; built-in task types run the
-      // generic harness task server (determined_clone_tpu/exec/task.py)
-      Json argv = Json::array();
-      if (type == "command") {
-        if (!body["cmd"].is_array() || body["cmd"].size() == 0) {
-          return bad_request("command task requires cmd argv array");
-        }
-        for (const auto& e : body["cmd"].elements()) {
-          if (!e.is_string() || e.as_string().empty()) {
-            return bad_request("cmd argv elements must be non-empty strings");
-          }
-        }
-        argv = body["cmd"];
-      } else {
-        argv.push_back("python");
-        argv.push_back("-m");
-        argv.push_back("determined_clone_tpu.exec.task");
-        argv.push_back(type);
-        if (type == "tensorboard" && body["experiment_ids"].is_array()) {
-          std::string ids;
-          for (const auto& e : body["experiment_ids"].elements()) {
-            if (!ids.empty()) ids += ",";
-            ids += std::to_string(e.as_int());
-          }
-          argv.push_back("--experiment-ids");
-          argv.push_back(ids);
-        }
-      }
-      alloc.spec.set("argv", argv);
-      if (body["env"].is_object()) alloc.spec.set("env", body["env"]);
-      std::string id = alloc.id;
-      allocations_[id] = std::move(alloc);
-      dirty_ = true;
-      Json j = Json::object();
-      j.set("task", allocations_[id].to_json());
-      return HttpResponse::json(201, j.dump());
-    }
-    if (parts.size() == 3 && req.method == "GET") {
-      auto type_filter = req.query.find("type");
-      Json arr = Json::array();
-      for (const auto& [id, a] : allocations_) {
-        if (a.trial_id != 0 || a.task_type == "trial") continue;
-        if (type_filter != req.query.end() &&
-            a.task_type != type_filter->second) {
-          continue;
-        }
-        arr.push_back(a.to_json());
-      }
-      Json j = Json::object();
-      j.set("tasks", arr);
-      return ok_json(j);
-    }
-    if (parts.size() >= 4) {
-      auto it = allocations_.find(parts[3]);
-      if (it == allocations_.end() || it->second.task_type == "trial") {
-        return not_found("no task " + parts[3]);
-      }
-      Allocation& alloc = it->second;
-      if (parts.size() == 4 && req.method == "GET") {
-        Json j = Json::object();
-        j.set("task", alloc.to_json());
-        return ok_json(j);
-      }
-      if (parts.size() == 5 && parts[4] == "kill" && req.method == "POST") {
-        // rbac: global Editor, or the task's owner killing their own task
-        User* caller = current_user(req);
-        bool own = caller && caller->username == alloc.owner;
-        if (!own && !rbac_allows(req, role_rank("Editor"))) {
-          return HttpResponse::json(
-              403, error_json("Editor role (or task ownership) required")
-                       .dump());
-        }
-        if (alloc.state == RunState::Queued || alloc.state == RunState::Pulling ||
-            alloc.state == RunState::Running) {
-          alloc.state = RunState::Canceled;  // heartbeat derives the kill
-          dirty_ = true;
-        }
-        Json j = Json::object();
-        j.set("task", alloc.to_json());
-        return ok_json(j);
-      }
-    }
+    return tasks_route(req, "", "task", "tasks");
+  }
+  // typed NTSC roots: aliases over the same machinery with the type pinned
+  if (root == "notebooks") {
+    return tasks_route(req, "notebook", "notebook", "notebooks");
+  }
+  if (root == "shells") {
+    return tasks_route(req, "shell", "shell", "shells");
+  }
+  if (root == "commands") {
+    return tasks_route(req, "command", "command", "commands");
+  }
+  if (root == "tensorboards") {
+    return tasks_route(req, "tensorboard", "tensorboard", "tensorboards");
   }
 
   // ---- agents ------------------------------------------------------------
@@ -1597,6 +1838,10 @@ HttpResponse Master::route(const HttpRequest& req) {
       agent.enabled = !agent.admin_disabled;
       agent.draining = false;
       agent.last_heartbeat = now_sec();
+      log_event("info", std::string(reconnect ? "agent reconnected: "
+                                              : "agent registered: ") +
+                            aid + " (" + std::to_string(agent.slots) +
+                            " slots, " + agent.topology + ")");
       dirty_ = true;
       Json j = Json::object();
       j.set("agent", agent.to_json());

@@ -382,6 +382,37 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
       j.set("user", users_[u.id].to_json());
       return pcreated(j);
     }
+    // per-user UI/CLI settings (≈ GetUserSetting / PostUserSetting /
+    // ResetUserSetting, api_user.go): a key→value bag scoped to the
+    // calling session (user 0 when auth is off)
+    if (parts.size() == 4 && parts[3] == "settings") {
+      User* caller = current_user(req);
+      int64_t uid = caller ? caller->id : 0;
+      if (req.method == "GET") {
+        Json j = Json::object();
+        auto sit = user_settings_.find(uid);
+        j.set("settings",
+              sit != user_settings_.end() ? sit->second : Json::object());
+        return pok(j);
+      }
+      if (req.method == "POST") {
+        Json body = Json::parse(req.body);
+        const std::string& key = body["key"].as_string();
+        if (key.empty()) return pbad("setting key required");
+        Json& bag = user_settings_[uid];
+        if (!bag.is_object()) bag = Json::object();
+        bag.set(key, body["value"]);
+        dirty_ = true;
+        Json j = Json::object();
+        j.set("settings", bag);
+        return pok(j);
+      }
+      if (req.method == "DELETE") {
+        user_settings_.erase(uid);
+        dirty_ = true;
+        return pok(Json::object());
+      }
+    }
     if (parts.size() >= 4) {
       int64_t uid = 0;
       try {
@@ -393,6 +424,28 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
       if (it == users_.end()) return pnotfound("no user " + parts[3]);
       User& u = it->second;
       if (parts.size() == 4 && req.method == "GET") {
+        Json j = Json::object();
+        j.set("user", u.to_json());
+        return pok(j);
+      }
+      if (parts.size() == 4 && req.method == "PATCH") {
+        // ≈ PatchUser: display name self-service; admin flag admin-only
+        User* caller = current_user(req);
+        bool self = caller && caller->id == uid;
+        if (config_.auth_required && !self && !cluster_admin_ok(req)) {
+          return pforbidden("admin or self required");
+        }
+        Json body = Json::parse(req.body);
+        if (body["display_name"].is_string()) {
+          u.display_name = body["display_name"].as_string();
+        }
+        if (body.has("admin")) {
+          if (config_.auth_required && !cluster_admin_ok(req)) {
+            return pforbidden("admin required to change the admin flag");
+          }
+          u.admin = body["admin"].as_bool();
+        }
+        dirty_ = true;
         Json j = Json::object();
         j.set("user", u.to_json());
         return pok(j);
@@ -554,6 +607,118 @@ std::optional<HttpResponse> Master::route_platform(const HttpRequest& req) {
       }
     }
     return pnotfound("unknown workspaces route");
+  }
+
+  // ---- project depth (≈ api_project.go: Get/Patch/Delete/Archive/Move) ---
+  if (root == "projects" && parts.size() >= 4) {
+    int64_t pid = 0;
+    try {
+      pid = std::stoll(parts[3]);
+    } catch (const std::exception&) {
+      return pbad("project id must be an integer");
+    }
+    auto it = projects_.find(pid);
+    if (it == projects_.end()) {
+      return pnotfound("no project " + parts[3]);
+    }
+    Project& p = it->second;
+    // experiments reference (workspace name, project name) pairs — always
+    // match both, since project names may repeat across workspaces
+    auto wit_own = workspaces_.find(p.workspace_id);
+    const std::string own_ws =
+        wit_own != workspaces_.end() ? wit_own->second.name : "";
+    auto in_project = [&](const Experiment& e) {
+      return e.project == p.name && e.workspace == own_ws;
+    };
+    if (parts.size() == 4 && req.method == "GET") {
+      Json exps = Json::array();
+      for (const auto& [eid, e] : experiments_) {
+        if (in_project(e)) exps.push_back(e.to_json());
+      }
+      Json j = Json::object();
+      j.set("project", p.to_json()).set("experiments", exps);
+      return pok(j);
+    }
+    if (parts.size() == 4 && req.method == "PATCH") {
+      if (!rbac_allows(req, role_rank("Editor"), p.workspace_id)) {
+        return pforbidden("Editor role required in this workspace");
+      }
+      Json body = Json::parse(req.body);
+      if (body["name"].is_string() && !body["name"].as_string().empty()) {
+        const std::string& next = body["name"].as_string();
+        for (const auto& [oid, other] : projects_) {
+          if (oid != pid && other.workspace_id == p.workspace_id &&
+              other.name == next) {
+            return pbad("project name taken in workspace");
+          }
+        }
+        // experiments reference projects by name: rename them along
+        for (auto& [eid, e] : experiments_) {
+          if (in_project(e)) e.project = next;
+        }
+        p.name = next;
+      }
+      if (body["description"].is_string()) {
+        p.description = body["description"].as_string();
+      }
+      dirty_ = true;
+      Json j = Json::object();
+      j.set("project", p.to_json());
+      return pok(j);
+    }
+    if (parts.size() == 4 && req.method == "DELETE") {
+      if (!rbac_allows(req, role_rank("WorkspaceAdmin"), p.workspace_id)) {
+        return pforbidden("WorkspaceAdmin role required");
+      }
+      for (const auto& [eid, e] : experiments_) {
+        if (in_project(e)) {
+          return pbad("project still holds experiments; move them first");
+        }
+      }
+      projects_.erase(it);
+      dirty_ = true;
+      return pok(Json::object());
+    }
+    if (parts.size() == 5 && req.method == "POST" &&
+        (parts[4] == "archive" || parts[4] == "unarchive")) {
+      if (!rbac_allows(req, role_rank("Editor"), p.workspace_id)) {
+        return pforbidden("Editor role required in this workspace");
+      }
+      p.archived = parts[4] == "archive";
+      dirty_ = true;
+      Json j = Json::object();
+      j.set("project", p.to_json());
+      return pok(j);
+    }
+    if (parts.size() == 5 && parts[4] == "move" && req.method == "POST") {
+      Json body = Json::parse(req.body);
+      int64_t dest = body["workspace_id"].as_int(-1);
+      auto wit = workspaces_.find(dest);
+      if (wit == workspaces_.end()) {
+        return pbad("destination workspace_id required");
+      }
+      // moving between workspaces needs rights on BOTH scopes
+      if (!rbac_allows(req, role_rank("Editor"), p.workspace_id) ||
+          !rbac_allows(req, role_rank("Editor"), dest)) {
+        return pforbidden("Editor role required in both workspaces");
+      }
+      for (const auto& [oid, other] : projects_) {
+        if (oid != pid && other.workspace_id == dest &&
+            other.name == p.name) {
+          return pbad("project name taken in destination workspace");
+        }
+      }
+      // experiments track workspace by name: follow the project
+      for (auto& [eid, e] : experiments_) {
+        if (in_project(e)) e.workspace = wit->second.name;
+      }
+      p.workspace_id = dest;
+      dirty_ = true;
+      Json j = Json::object();
+      j.set("project", p.to_json());
+      return pok(j);
+    }
+    return pnotfound("unknown projects route");
   }
 
   // ---- model registry (≈ api_model.go) -----------------------------------

@@ -284,6 +284,8 @@ class SqliteStore : public Store {
 
   ~SqliteStore() override {
     if (insert_stmt_) api_.finalize(insert_stmt_);
+    if (metric_insert_stmt_) api_.finalize(metric_insert_stmt_);
+    if (summary_upsert_stmt_) api_.finalize(summary_upsert_stmt_);
     if (db_) api_.close(db_);
   }
 
@@ -383,30 +385,34 @@ class SqliteStore : public Store {
     std::map<std::pair<std::string, std::string>, MetricAgg> aggs;
     aggregate_metric_record(rec, aggs);
     for (const auto& [key, a] : aggs) {
-      sqlite3_stmt* stmt = nullptr;
-      if (api_.prepare(db_,
-                       "INSERT INTO metric_summary (trial_id, grp, name, "
-                       "count, sum, min, max, last, last_step) VALUES "
-                       "(?1, ?2, ?3, 1, ?4, ?4, ?4, ?4, ?5) "
-                       "ON CONFLICT(trial_id, grp, name) DO UPDATE SET "
-                       "count = count + 1, sum = sum + excluded.sum, "
-                       "min = MIN(min, excluded.min), "
-                       "max = MAX(max, excluded.max), "
-                       "last = excluded.last, "
-                       "last_step = excluded.last_step",
-                       -1, &stmt, nullptr) == kSqliteOk) {
-        api_.bind_int64(stmt, 1, trial_id);
-        api_.bind_text(stmt, 2, key.first.c_str(),
-                       static_cast<int>(key.first.size()), kTransient);
-        api_.bind_text(stmt, 3, key.second.c_str(),
-                       static_cast<int>(key.second.size()), kTransient);
-        api_.bind_double(stmt, 4, a.last);
-        api_.bind_int64(stmt, 5, a.last_step);
-        if (api_.step(stmt) != kSqliteDone) {
-          std::cerr << "[store] summary upsert failed: " << api_.errmsg(db_)
-                    << std::endl;
+      if (!summary_upsert_stmt_) {
+        if (api_.prepare(db_,
+                         "INSERT INTO metric_summary (trial_id, grp, name, "
+                         "count, sum, min, max, last, last_step) VALUES "
+                         "(?1, ?2, ?3, 1, ?4, ?4, ?4, ?4, ?5) "
+                         "ON CONFLICT(trial_id, grp, name) DO UPDATE SET "
+                         "count = count + 1, sum = sum + excluded.sum, "
+                         "min = MIN(min, excluded.min), "
+                         "max = MAX(max, excluded.max), "
+                         "last = excluded.last, "
+                         "last_step = excluded.last_step",
+                         -1, &summary_upsert_stmt_, nullptr) != kSqliteOk) {
+          std::cerr << "[store] summary upsert prepare failed: "
+                    << api_.errmsg(db_) << std::endl;
+          return;
         }
-        api_.finalize(stmt);
+      }
+      api_.reset(summary_upsert_stmt_);
+      api_.bind_int64(summary_upsert_stmt_, 1, trial_id);
+      api_.bind_text(summary_upsert_stmt_, 2, key.first.c_str(),
+                     static_cast<int>(key.first.size()), kTransient);
+      api_.bind_text(summary_upsert_stmt_, 3, key.second.c_str(),
+                     static_cast<int>(key.second.size()), kTransient);
+      api_.bind_double(summary_upsert_stmt_, 4, a.last);
+      api_.bind_int64(summary_upsert_stmt_, 5, a.last_step);
+      if (api_.step(summary_upsert_stmt_) != kSqliteDone) {
+        std::cerr << "[store] summary upsert failed: " << api_.errmsg(db_)
+                  << std::endl;
       }
     }
   }
@@ -728,6 +734,8 @@ class SqliteStore : public Store {
   sqlite3* db_;
   std::string data_dir_;
   sqlite3_stmt* insert_stmt_ = nullptr;
+  sqlite3_stmt* metric_insert_stmt_ = nullptr;
+  sqlite3_stmt* summary_upsert_stmt_ = nullptr;
   int schema_version_ = 0;
 };
 
