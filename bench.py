@@ -73,6 +73,27 @@ def _budget(name: str, default: float) -> float:
         return default
 
 
+def loss_ok_for(config_name: str, loss: float, vocab: int) -> bool:
+    """Loss gate for a bench rung. With a recorded band for this config
+    (tests/data/loss_bands.json, maintained by tests/test_convergence.py)
+    the gate catches REGRESSION — a loss outside the band either way means
+    the training path changed. Without a band: finite and no worse than
+    uniform-over-vocab (+5% headroom) — the catastrophe bound."""
+    import math
+
+    if not math.isfinite(loss):
+        return False
+    try:
+        with open(os.path.join(REPO_ROOT, "tests", "data",
+                               "loss_bands.json")) as f:
+            band = json.load(f).get(config_name)
+    except (OSError, ValueError):
+        band = None
+    if band:
+        return band["min"] <= loss <= band["max"]
+    return loss < 1.05 * math.log(vocab)
+
+
 TPU_BUDGET_S = _budget("DCT_BENCH_TPU_BUDGET_S", 300.0)
 PROBE_BUDGET_S = _budget("DCT_BENCH_PROBE_BUDGET_S", 150.0)
 CPU_BUDGET_S = _budget("DCT_BENCH_CPU_BUDGET_S", 180.0)
@@ -275,11 +296,9 @@ def _run_child() -> None:
         n_params = flash["model_params"]
         mfu = (6.0 * n_params * flash["tokens_per_sec"] / peak
                if on_tpu else None)
-        # Loss sanity band: finite and no worse than uniform over the vocab
-        # (+5% headroom) after the warmup+timed steps from random init.
-        import math
-        loss_ok = (math.isfinite(flash["final_loss"])
-                   and flash["final_loss"] < 1.05 * math.log(vocab))
+        # Loss gate: the recorded band (regression) where one exists for
+        # this config, the uniform-entropy catastrophe bound otherwise.
+        loss_ok = loss_ok_for(rung["name"], flash["final_loss"], vocab)
 
         def result_line() -> dict:
             return {
