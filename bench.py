@@ -220,6 +220,57 @@ def _run_child() -> None:
             "seq_len": seq,
         }
 
+    def time_pipeline(cfg: gpt.GPTConfig, batch: int, seq: int,
+                      timed_steps: int, k: int) -> dict:
+        """The REAL hot loop: host-side token batches through the async
+        DevicePrefetcher + fused k-step dispatch (the trainer's default
+        path). Reports the input-pipeline overlap — dataloading_fraction is
+        the consumer-visible queue wait over wall time (0 = perfect
+        overlap, 1 = host-bound)."""
+        import numpy as np
+
+        from determined_clone_tpu.utils.data import DevicePrefetcher
+
+        params = gpt.init(jax.random.PRNGKey(0), cfg)
+        tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+        state = create_train_state(params, tx, jax.random.PRNGKey(1))
+        host_rng = np.random.RandomState(7)
+
+        def host_batches():
+            while True:
+                yield host_rng.randint(
+                    0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32)
+
+        def loss(p, b, rng):
+            return gpt.loss_fn(p, cfg, b[:, :-1], b[:, 1:]), {}
+
+        step = make_train_step(loss, tx, steps_per_dispatch=k)
+        feed = DevicePrefetcher(host_batches(), jax.device_put, depth=2 * k)
+        try:
+            group = [next(feed) for _ in range(k)]
+            state, metrics = step(state, *group)  # compile
+            group = [next(feed) for _ in range(k)]
+            state, metrics = step(state, *group)  # one executed dispatch
+            float(metrics["loss"])  # value fetch = real barrier
+            feed.take_queue_wait()  # reset: warm-up stall is not steady state
+            n_dispatches = max(timed_steps // k, 1)
+            t0 = time.perf_counter()
+            for _ in range(n_dispatches):
+                group = [next(feed) for _ in range(k)]
+                state, metrics = step(state, *group)
+            float(metrics["loss"])  # fetch = barrier
+            dt = time.perf_counter() - t0
+            wait = feed.take_queue_wait()
+        finally:
+            feed.close()
+        return {
+            "pipeline_samples_per_sec": round(
+                batch * k * n_dispatches / dt, 3),
+            "dataloading_fraction": round(min(max(wait / dt, 0.0), 1.0), 4),
+            "steps_per_dispatch": k,
+            "prefetch_depth": 2 * k,
+        }
+
     def time_mnist(timed_steps: int) -> dict:
         cfg = mnist_cnn.MnistCNNConfig(
             compute_dtype=jnp.bfloat16 if on_tpu else jnp.float32)
@@ -279,6 +330,7 @@ def _run_child() -> None:
     peak = TPU_PEAK_BF16_FLOPS.get(tpu_gen, TPU_PEAK_BF16_FLOPS["v5e"])
 
     mnist = None
+    pipeline = None
     flash_over_mha = None
     mha_sps = None
     mha_rung = None
@@ -324,6 +376,14 @@ def _run_child() -> None:
                     "flash_over_mha": flash_over_mha,
                     "mha_config": mha_rung,  # rung the delta was measured on
                     "mnist_cnn": mnist,
+                    # input-pipeline overlap (prefetch + fused dispatch):
+                    # tracked across rounds so regressions in the trainer's
+                    # default hot-loop path are visible in BENCH history
+                    "dataloading_fraction": (pipeline or {}).get(
+                        "dataloading_fraction"),
+                    "steps_per_dispatch": (pipeline or {}).get(
+                        "steps_per_dispatch"),
+                    "pipeline": pipeline,
                     "init_s": round(t_init, 1),
                 },
             }
@@ -345,6 +405,15 @@ def _run_child() -> None:
             mha_rung = rung["name"]
         if mnist is None and (i == 0 or remaining() > 30):
             mnist = time_mnist(20 if on_tpu else 3)
+        if pipeline is None and (not on_tpu or remaining() > 45):
+            # the prefetch + fused-dispatch hot loop on this rung's config;
+            # never let the extra compile sink the banked rung result
+            try:
+                pipeline = time_pipeline(
+                    cfg_flash, rung["batch"], rung["seq"],
+                    timed_steps=8 if not on_tpu else rung["steps"], k=4)
+            except Exception as exc:  # noqa: BLE001
+                pipeline = {"error": repr(exc)[:200]}
 
         # Re-emit enriched with the extras; the parent keeps the last line.
         _emit(result_line())

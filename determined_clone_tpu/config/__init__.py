@@ -4,6 +4,7 @@ from determined_clone_tpu.config.experiment import (
     ConfigError,
     ExperimentConfig,
     LogPolicy,
+    OptimizationsConfig,
     ResourcesConfig,
     SearcherConfig,
     merge_configs,
@@ -25,6 +26,7 @@ __all__ = [
     "ConfigError",
     "ExperimentConfig",
     "LogPolicy",
+    "OptimizationsConfig",
     "ResourcesConfig",
     "SearcherConfig",
     "merge_configs",

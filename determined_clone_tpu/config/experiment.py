@@ -230,6 +230,42 @@ class CheckpointStorageConfig:
 
 
 # ---------------------------------------------------------------------------
+# Optimizations (reference: expconf OptimizationsConfig — there it tunes
+# horovod aggregation; here it tunes the XLA hot loop: input prefetch and
+# fused multi-step dispatch)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class OptimizationsConfig:
+    prefetch_depth: int = 2        # device batches buffered ahead (0 = sync)
+    steps_per_dispatch: int = 1    # optimizer steps fused into one program
+
+    @staticmethod
+    def from_dict(raw: Dict[str, Any]) -> "OptimizationsConfig":
+        if not isinstance(raw, dict):
+            raise ConfigError(f"optimizations must be a mapping, got {raw!r}")
+        cfg = OptimizationsConfig(
+            prefetch_depth=int(raw.get("prefetch_depth", 2)),
+            steps_per_dispatch=int(raw.get("steps_per_dispatch", 1)),
+        )
+        cfg.validate()
+        return cfg
+
+    def validate(self) -> None:
+        if self.prefetch_depth < 0:
+            raise ConfigError(
+                f"optimizations.prefetch_depth must be >= 0, "
+                f"got {self.prefetch_depth}")
+        if self.steps_per_dispatch < 1:
+            raise ConfigError(
+                f"optimizations.steps_per_dispatch must be >= 1, "
+                f"got {self.steps_per_dispatch}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
 # Log policies (reference: expconf log_policies → logpattern subsystem)
 # ---------------------------------------------------------------------------
 
@@ -264,6 +300,9 @@ class ExperimentConfig:
         default_factory=HyperparameterSpace
     )
     checkpoint_storage: Optional[CheckpointStorageConfig] = None
+    optimizations: OptimizationsConfig = dataclasses.field(
+        default_factory=OptimizationsConfig
+    )
     checkpoint_policy: str = "best"     # best | all | none
     min_validation_period: Optional[Length] = None
     min_checkpoint_period: Optional[Length] = None
@@ -311,6 +350,9 @@ class ExperimentConfig:
             checkpoint_storage=(
                 CheckpointStorageConfig.from_dict(raw["checkpoint_storage"])
                 if raw.get("checkpoint_storage") else None
+            ),
+            optimizations=OptimizationsConfig.from_dict(
+                raw.get("optimizations") or {}
             ),
             checkpoint_policy=raw.get("checkpoint_policy", "best"),
             min_validation_period=(
@@ -382,6 +424,8 @@ class ExperimentConfig:
             d["entrypoint"] = self.entrypoint
         if self.checkpoint_storage:
             d["checkpoint_storage"] = self.checkpoint_storage.to_dict()
+        if self.optimizations != OptimizationsConfig():
+            d["optimizations"] = self.optimizations.to_dict()
         if self.min_validation_period:
             d["min_validation_period"] = self.min_validation_period.to_dict()
         if self.min_checkpoint_period:

@@ -189,6 +189,15 @@ EXPERIMENT_SCHEMA = {
             "type": "object", "open": False,
             "properties": {"enabled": {"type": "boolean"}},
         },
+        # hot-loop knobs (the TPU-native successor of the reference's
+        # horovod-centric optimizations block)
+        "optimizations": {
+            "type": "object", "open": False,
+            "properties": {
+                "prefetch_depth": {"type": "integer"},
+                "steps_per_dispatch": {"type": "integer"},
+            },
+        },
         "environment": {"any": True},
         "data": {"any": True},
     },

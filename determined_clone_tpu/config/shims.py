@@ -65,12 +65,26 @@ def shim(raw: Dict[str, Any]) -> Tuple[Dict[str, Any], List[str]]:
         cfg["scheduling_unit"] = cfg.pop("batches_per_step")
         notes.append("batches_per_step is v0; shimmed to scheduling_unit")
 
-    # v0 nested `optimizations` block: aggregation_frequency maps onto
-    # nothing (XLA owns fusion); keep submissions working, note the drop
+    # v0 nested `optimizations` block: horovod-era keys (aggregation_
+    # frequency etc.) map onto nothing (XLA owns fusion); keep submissions
+    # working by dropping those with a note, while the TPU-native keys
+    # (prefetch_depth, steps_per_dispatch) pass through to the v1 block
     if "optimizations" in cfg:
-        cfg.pop("optimizations")
-        notes.append("optimizations is v0 and has no TPU equivalent "
-                     "(XLA owns fusion/aggregation); ignored")
+        opt = cfg.pop("optimizations")
+        kept = {}
+        if isinstance(opt, dict):
+            kept = {key: opt[key]
+                    for key in ("prefetch_depth", "steps_per_dispatch")
+                    if key in opt}
+            dropped = sorted(set(opt) - set(kept))
+        else:
+            dropped = ["<non-mapping optimizations>"]
+        if dropped:
+            notes.append(f"optimizations keys {dropped} are v0 and have no "
+                         "TPU equivalent (XLA owns fusion/aggregation); "
+                         "ignored")
+        if kept:
+            cfg["optimizations"] = kept
 
     # v0 flat `slots` became resources.slots_per_trial
     if "slots" in cfg:

@@ -190,16 +190,33 @@ class ProfilerAgent:
             self._flush_now.set()
 
     def record_batch_timing(self, batches_trained: int, *,
-                            dataloading_s: float, compute_s: float) -> None:
+                            dataloading_s: float, compute_s: float,
+                            queue_wait_s: Optional[float] = None,
+                            steps_per_dispatch: Optional[int] = None,
+                            prefetch_depth: Optional[int] = None) -> None:
         """Per-batch (or per-chunk) timings from the trainer's hot loop —
-        the dataloader_next/compute split (profiler.py timings)."""
-        self.record({
+        the dataloader_next/compute split (profiler.py timings).
+
+        With async prefetch the split sharpens: ``dataloading_s`` is the
+        producer thread's true input cost (pull + device_put, possibly
+        hidden under compute) while ``queue_wait_s`` is the consumer-visible
+        stall — the overlap residue. dataloading >> queue_wait means the
+        prefetcher is doing its job; queue_wait ≈ dataloading means the
+        host is the bottleneck and deeper prefetch won't help."""
+        sample = {
             "time": time.time(),
             "group": "timing",
             "batches_trained": batches_trained,
             "dataloading_s": round(dataloading_s, 6),
             "compute_s": round(compute_s, 6),
-        })
+        }
+        if queue_wait_s is not None:
+            sample["queue_wait_s"] = round(queue_wait_s, 6)
+        if steps_per_dispatch is not None:
+            sample["steps_per_dispatch"] = int(steps_per_dispatch)
+        if prefetch_depth is not None:
+            sample["prefetch_depth"] = int(prefetch_depth)
+        self.record(sample)
 
     # -- flushing ----------------------------------------------------------
 

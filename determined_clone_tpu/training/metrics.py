@@ -21,20 +21,27 @@ class MetricAccumulator:
         self._sums: Dict[str, jax.Array] = {}
         self._counts: Dict[str, int] = {}
 
-    def add(self, metrics: Dict[str, jax.Array]) -> None:
+    def add(self, metrics: Dict[str, jax.Array], count: int = 1) -> None:
+        """Accumulate per-batch scalars. ``count`` is how many batches the
+        values already sum over — a fused k-step dispatch hands in
+        device-side summed metrics with ``count=k`` so the reported mean
+        stays a true per-batch mean."""
         for k, v in metrics.items():
             if k in self._sums:
                 self._sums[k] = self._sums[k] + v
-                self._counts[k] += 1
+                self._counts[k] += count
             else:
                 self._sums[k] = v
-                self._counts[k] = 1
+                self._counts[k] = count
 
     def result(self) -> Dict[str, float]:
-        """Host sync point: returns means and resets."""
+        """Host sync point: returns means and resets. All sums cross the
+        device boundary in ONE ``jax.device_get`` of the whole dict — a
+        reporting boundary costs one host sync, not one per metric key."""
+        host_sums = jax.device_get(self._sums)
         out = {
-            k: float(np.asarray(jax.device_get(s))) / self._counts[k]
-            for k, s in self._sums.items()
+            k: float(np.asarray(s)) / self._counts[k]
+            for k, s in host_sums.items()
         }
         self._sums.clear()
         self._counts.clear()

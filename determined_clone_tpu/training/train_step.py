@@ -91,13 +91,24 @@ def make_train_step(
     state_sharding: Optional[TrainState] = None,
     batch_sharding: Optional[Any] = None,
     donate: bool = True,
-) -> Callable[[TrainState, Any], Tuple[TrainState, Dict[str, jax.Array]]]:
+    steps_per_dispatch: int = 1,
+) -> Callable[..., Tuple[TrainState, Dict[str, jax.Array]]]:
     """Build the jitted train step.
 
     ``loss_fn(params, batch, rng)`` returns a scalar loss or
     ``(loss, metrics_dict)``. Gradient reduction across dp/fsdp is implicit:
     the batch is sharded over those axes, so XLA emits the reduce-scatter /
     all-reduce the specs imply.
+
+    With ``steps_per_dispatch=k > 1`` the returned callable takes
+    ``(state, batch_0, ..., batch_{k-1})`` and runs all k optimizer steps
+    inside ONE jitted program: the batches are stacked device-side and
+    ``lax.scan``ned through the step body with the train state as donated
+    carry, and per-step metrics are summed on device. One Python dispatch
+    (and one donation round-trip) then covers k batches — semantically
+    identical to k sequential calls of the k=1 step, including the per-step
+    rng split chain, so seeded runs are bit-compatible modulo the metric
+    re-association. Pair with ``MetricAccumulator.add(metrics, count=k)``.
     """
 
     def step_fn(state: TrainState, batch: Any):
@@ -124,14 +135,38 @@ def make_train_step(
                        "grad_norm": gnorm.astype(jnp.float32), **metrics}
         return new_state, out_metrics
 
+    k = int(steps_per_dispatch)
+    if k < 1:
+        raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")
+
+    if k == 1:
+        fn: Callable[..., Any] = step_fn
+        n_batch_args = 1
+    else:
+        def fused_fn(state: TrainState, *batches: Any):
+            # stack the k batches device-side: the scan's leading axis
+            stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *batches)
+
+            def body(carry: TrainState, batch: Any):
+                return step_fn(carry, batch)
+
+            new_state, per_step = jax.lax.scan(body, state, stacked)
+            # sum (not mean) so the accumulator's count-weighted mean stays
+            # exact when a chunk mixes fused and single-step dispatches
+            summed = jax.tree.map(lambda m: jnp.sum(m, axis=0), per_step)
+            return new_state, summed
+
+        fn = fused_fn
+        n_batch_args = k
+
     kwargs: Dict[str, Any] = {}
     if state_sharding is not None:
-        in_shardings = (state_sharding, batch_sharding)
+        in_shardings = (state_sharding,) + (batch_sharding,) * n_batch_args
         out_shardings = (state_sharding, None)
         kwargs = dict(in_shardings=in_shardings, out_shardings=out_shardings)
     if donate:
         kwargs["donate_argnums"] = (0,)
-    return jax.jit(step_fn, **kwargs)
+    return jax.jit(fn, **kwargs)
 
 
 def make_eval_step(

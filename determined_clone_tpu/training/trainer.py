@@ -6,6 +6,19 @@ Loop shape mirrors the reference's searcher-driven boundaries
 training metrics per chunk, validate/checkpoint on period boundaries,
 cooperate with preemption — but each batch is one jitted XLA program and
 metrics stay on device until a boundary (no per-batch host syncs).
+
+Hot-loop performance (config ``optimizations:`` block, docs/
+training_loop_performance.md):
+
+- **Async device prefetch** (``prefetch_depth``, default 2): a background
+  thread pulls host batches and applies the sharded ``device_put`` into a
+  bounded queue, so input transfer overlaps device compute instead of
+  blocking every dispatch. Depth 0 restores the synchronous path.
+- **Fused multi-step dispatch** (``steps_per_dispatch=k``): k batches are
+  ``lax.scan``ned through the step body inside one jitted program — one
+  Python dispatch per k optimizer steps, metrics summed device-side.
+  Chunk/target remainders smaller than k fall back to the k=1 program, so
+  batch order and the rng chain match the unfused loop exactly.
 """
 from __future__ import annotations
 
@@ -28,8 +41,29 @@ from determined_clone_tpu.training.train_step import (
     state_shardings,
 )
 from determined_clone_tpu.training.trial import JaxTrial
+from determined_clone_tpu.utils.data import make_device_feeder
 
 CKPT_STATE_DIR = "state"
+
+
+def _skip_batches(it: Iterator[Any], n: int) -> int:
+    """Fast-forward ``n`` batches of ``it``; returns how many were skipped
+    (< n once exhausted). Iterators exposing ``skip_batches`` (e.g.
+    ``utils.data.BatchIterator``) skip by index arithmetic; anything else
+    falls back to materialize-and-discard."""
+    if n <= 0:
+        return 0
+    fast = getattr(it, "skip_batches", None)
+    if fast is not None:
+        return int(fast(n))
+    skipped = 0
+    while skipped < n:
+        try:
+            next(it)
+        except StopIteration:
+            break
+        skipped += 1
+    return skipped
 
 
 class Trainer:
@@ -139,10 +173,23 @@ class Trainer:
         else:
             state = jax.device_put(state, shardings)
 
+        opt = config.optimizations
+        k = max(1, int(opt.steps_per_dispatch))
+        prefetch_depth = max(0, int(opt.prefetch_depth))
+
         train_step = make_train_step(
             trial.loss, tx, mesh=mesh, state_sharding=shardings,
             batch_sharding=batch_sharding,
         )
+        # k batches through one jitted lax.scan program; remainders smaller
+        # than k use the single-step program above, so batch order and the
+        # rng chain are identical to the unfused loop
+        fused_step = None
+        if k > 1:
+            fused_step = make_train_step(
+                trial.loss, tx, mesh=mesh, state_sharding=shardings,
+                batch_sharding=batch_sharding, steps_per_dispatch=k,
+            )
         eval_step = make_eval_step(
             trial.eval_metrics, state_sharding=shardings,
             batch_sharding=batch_sharding,
@@ -155,16 +202,49 @@ class Trainer:
         smaller = config.searcher.smaller_is_better
         searcher_metric = config.searcher.metric
 
+        # skip already-trained batches on restore so data order lines up;
+        # index-capable iterators (BatchIterator.skip_batches) fast-forward
+        # by arithmetic instead of materializing every replayed batch
+        restored = batches_trained > 0
+        if restored:
+            to_skip = batches_trained - 1  # first_batch is discarded below
+            while to_skip > 0:
+                skipped = _skip_batches(data_iter, to_skip)
+                to_skip -= skipped
+                if to_skip > 0:
+                    # epoch exhausted mid-replay: roll into the next one
+                    data_iter = iter(trial.training_data())
+                    if skipped == 0:
+                        # the previous epoch was already drained, so a
+                        # zero-progress round means the fresh epoch must
+                        # move — probe one batch to rule out an empty
+                        # dataset (would otherwise loop forever)
+                        if _skip_batches(data_iter, 1) == 0:
+                            raise RuntimeError(
+                                "training_data() yielded no batches while "
+                                "replaying restored progress")
+                        to_skip -= 1
+
         def batches() -> Iterator[Any]:
-            yield first_batch
+            if not restored:
+                yield first_batch
             yield from data_iter
             while True:  # repeat dataset
                 yield from iter(trial.training_data())
 
         batch_gen = batches()
-        # skip already-trained batches on restore so data order lines up
-        for _ in range(batches_trained):
-            next(batch_gen)
+
+        def to_device(batch: Any) -> Any:
+            return jax.device_put(batch, batch_sharding)
+
+        # async device prefetch: a producer thread overlaps host input +
+        # device_put with XLA compute (depth 0 = the old synchronous path);
+        # fused dispatch consumes k batches at once, so scale the buffer
+        feed = make_device_feeder(
+            batch_gen, to_device,
+            depth=prefetch_depth * k if prefetch_depth else 0,
+            name="train-prefetch",
+        )
 
         acc = MetricAccumulator()
         last_val: Dict[str, float] = {}
@@ -183,10 +263,31 @@ class Trainer:
             vdata = trial.validation_data()
             if vdata is None:
                 return {}
+
+            def full_batches() -> Iterator[Any]:
+                # drop the shape-mismatched remainder batch (the
+                # drop_remainder contract): a second batch shape would mean
+                # a second eval_step compile every validation — eval stays
+                # a single compiled program
+                first_shapes = None
+                for vb in vdata:
+                    shapes = tuple(
+                        np.shape(leaf) for leaf in jax.tree.leaves(vb))
+                    if first_shapes is None:
+                        first_shapes = shapes
+                    elif shapes != first_shapes:
+                        continue
+                    yield vb
+
             vacc = MetricAccumulator()
-            for vbatch in vdata:
-                vbatch = jax.device_put(vbatch, batch_sharding)
-                vacc.add(eval_step(state, vbatch))
+            vfeed = make_device_feeder(
+                full_batches(), to_device,
+                depth=prefetch_depth, name="eval-prefetch")
+            try:
+                for vbatch in vfeed:
+                    vacc.add(eval_step(state, vbatch))
+            finally:
+                vfeed.close()
             metrics = vacc.result() if len(vacc) else {}
             if metrics:
                 self.core.train.report_validation_metrics(batches_trained, metrics)
@@ -194,97 +295,114 @@ class Trainer:
                     tb.add_scalars("validation", metrics, batches_trained)
             return metrics
 
-        for op in self.core.searcher.operations():
-            if op.length is None:
-                raise RuntimeError(
-                    "searcher.max_length is not set: the searcher operation "
-                    "has no training target. Set searcher.max_length in the "
-                    "experiment config (e.g. {'batches': 1000}) or provide a "
-                    "searcher_source."
-                )
-            target = self._to_batches(op.length, 0)
-            while batches_trained < target and not preempted:
-                chunk_end = min(
-                    target,
-                    (batches_trained // sched_unit + 1) * sched_unit,
-                )
-                t0 = time.perf_counter()
-                n0 = batches_trained
-                t_data = 0.0  # host-side input time vs XLA dispatch+compute
-                while batches_trained < chunk_end:
-                    td0 = time.perf_counter()
-                    batch = jax.device_put(next(batch_gen), batch_sharding)
-                    t_data += time.perf_counter() - td0
-                    state, metrics = train_step(state, batch)
-                    acc.add(metrics)
-                    batches_trained += 1
-                # ---- reporting boundary (one host sync per chunk) ----
-                train_metrics = acc.result()
-                dt = time.perf_counter() - t0
-                train_metrics["batches_per_second"] = (batches_trained - n0) / dt
-                train_metrics["samples_per_second"] = (
-                    (batches_trained - n0) * trial.global_batch_size / dt
-                )
-                self.core.train.report_training_metrics(batches_trained,
-                                                        train_metrics)
-                if profiler is not None:
-                    # chunk-level split of the hot loop: dataloading vs the
-                    # rest (dispatch + device compute up to the acc sync)
-                    profiler.record_batch_timing(
-                        batches_trained, dataloading_s=t_data,
-                        compute_s=max(dt - t_data, 0.0))
-                if tb is not None:
-                    tb.add_scalars("training", train_metrics, batches_trained)
-                op.report_progress(batches_trained)
+        # the prefetcher must join on EVERY exit — normal completion,
+        # preemption, or a mid-chunk exception (no leaked producer
+        # threads, no deadlock on a dead consumer)
+        try:
+            for op in self.core.searcher.operations():
+                if op.length is None:
+                    raise RuntimeError(
+                        "searcher.max_length is not set: the searcher operation "
+                        "has no training target. Set searcher.max_length in the "
+                        "experiment config (e.g. {'batches': 1000}) or provide a "
+                        "searcher_source."
+                    )
+                target = self._to_batches(op.length, 0)
+                while batches_trained < target and not preempted:
+                    chunk_end = min(
+                        target,
+                        (batches_trained // sched_unit + 1) * sched_unit,
+                    )
+                    t0 = time.perf_counter()
+                    n0 = batches_trained
+                    while batches_trained < chunk_end:
+                        if (fused_step is not None
+                                and chunk_end - batches_trained >= k):
+                            # k prefetched device batches → ONE dispatch
+                            group = [next(feed) for _ in range(k)]
+                            state, metrics = fused_step(state, *group)
+                            acc.add(metrics, count=k)
+                            batches_trained += k
+                        else:
+                            state, metrics = train_step(state, next(feed))
+                            acc.add(metrics)
+                            batches_trained += 1
+                    # ---- reporting boundary (one host sync per chunk) ----
+                    train_metrics = acc.result()
+                    dt = time.perf_counter() - t0
+                    # queue-wait is the consumer-visible input stall (the
+                    # overlap residue); host-time is the producer's true input
+                    # cost even when hidden under compute
+                    t_wait = feed.take_queue_wait()
+                    t_host = feed.take_host_time()
+                    train_metrics["batches_per_second"] = (batches_trained - n0) / dt
+                    train_metrics["samples_per_second"] = (
+                        (batches_trained - n0) * trial.global_batch_size / dt
+                    )
+                    self.core.train.report_training_metrics(batches_trained,
+                                                            train_metrics)
+                    if profiler is not None:
+                        # chunk-level split of the hot loop: input stall vs the
+                        # rest (dispatch + device compute up to the acc sync)
+                        profiler.record_batch_timing(
+                            batches_trained, dataloading_s=t_host,
+                            compute_s=max(dt - t_wait, 0.0),
+                            queue_wait_s=t_wait, steps_per_dispatch=k,
+                            prefetch_depth=prefetch_depth)
+                    if tb is not None:
+                        tb.add_scalars("training", train_metrics, batches_trained)
+                    op.report_progress(batches_trained)
 
-                if val_period and batches_trained - last_val_at >= val_period:
-                    last_val = validate()
+                    if val_period and batches_trained - last_val_at >= val_period:
+                        last_val = validate()
+                        last_val_at = batches_trained
+                        if searcher_metric in last_val:
+                            v = last_val[searcher_metric]
+                            is_best = best_val is None or (
+                                v < best_val if smaller else v > best_val
+                            )
+                            if is_best:
+                                best_val = v
+                                if policy == "best":
+                                    self._save(state, batches_trained, "best",
+                                               metric=v)
+                                    last_ckpt_at = batches_trained
+
+                    # a metric only describes the saved weights when validation
+                    # ran at THIS batch count — a stale value would misattribute
+                    # quality to drifted weights (and mislead best-checkpoint GC)
+                    def fresh_metric():
+                        if last_val_at == batches_trained:
+                            return last_val.get(searcher_metric)
+                        return None
+
+                    if ckpt_period and batches_trained - last_ckpt_at >= ckpt_period:
+                        if policy != "none":
+                            self._save(state, batches_trained, "periodic",
+                                       metric=fresh_metric())
+                        last_ckpt_at = batches_trained
+
+                    if self.core.preempt.should_preempt():
+                        preempted = True
+
+                if preempted:
+                    self._save(state, batches_trained, "preemption",
+                               metric=fresh_metric())
+                    self.core.train.report_early_exit("preempted")
+                    break
+
+                # op complete: ensure a fresh validation at the boundary
+                final_val = validate()
+                if final_val:
+                    last_val = final_val
                     last_val_at = batches_trained
-                    if searcher_metric in last_val:
-                        v = last_val[searcher_metric]
-                        is_best = best_val is None or (
-                            v < best_val if smaller else v > best_val
-                        )
-                        if is_best:
+                    if searcher_metric in final_val:
+                        v = final_val[searcher_metric]
+                        if best_val is None or (v < best_val if smaller else v > best_val):
                             best_val = v
-                            if policy == "best":
-                                self._save(state, batches_trained, "best",
-                                           metric=v)
-                                last_ckpt_at = batches_trained
-
-                # a metric only describes the saved weights when validation
-                # ran at THIS batch count — a stale value would misattribute
-                # quality to drifted weights (and mislead best-checkpoint GC)
-                def fresh_metric():
-                    if last_val_at == batches_trained:
-                        return last_val.get(searcher_metric)
-                    return None
-
-                if ckpt_period and batches_trained - last_ckpt_at >= ckpt_period:
-                    if policy != "none":
-                        self._save(state, batches_trained, "periodic",
-                                   metric=fresh_metric())
-                    last_ckpt_at = batches_trained
-
-                if self.core.preempt.should_preempt():
-                    preempted = True
-
-            if preempted:
-                self._save(state, batches_trained, "preemption",
-                           metric=fresh_metric())
-                self.core.train.report_early_exit("preempted")
-                break
-
-            # op complete: ensure a fresh validation at the boundary
-            final_val = validate()
-            if final_val:
-                last_val = final_val
-                last_val_at = batches_trained
-                if searcher_metric in final_val:
-                    v = final_val[searcher_metric]
-                    if best_val is None or (v < best_val if smaller else v > best_val):
-                        best_val = v
-            op.complete(last_val.get(searcher_metric, float("nan")))
+                op.complete(last_val.get(searcher_metric, float("nan")))
+        finally:
+            feed.close()
 
         if not preempted and policy != "none" and batches_trained > last_ckpt_at:
             metric = (last_val.get(searcher_metric)

@@ -14,8 +14,11 @@ from __future__ import annotations
 
 import gzip
 import os
+import queue
 import struct
-from typing import Iterator, Optional, Tuple
+import threading
+import time
+from typing import Any, Callable, Iterable, Iterator, Optional, Tuple
 
 import numpy as np
 
@@ -106,16 +109,247 @@ def mnist_dataset(data_dir: Optional[str] = None, split: str = "train",
     )
 
 
+class BatchIterator:
+    """Deterministic shuffled batches of (x, y) with an index-skip fast path.
+
+    The shuffle order is fixed up front from (seed, epoch), so skipping n
+    already-consumed batches (checkpoint-restore replay) is pure arithmetic
+    on the cursor — no gather, no copy — via :meth:`skip_batches`. The
+    Trainer probes for that method when fast-forwarding a restored run.
+    """
+
+    def __init__(self, x: np.ndarray, y: np.ndarray, batch_size: int, *,
+                 seed: int = 0, epoch: int = 0, shuffle: bool = True,
+                 drop_remainder: bool = True) -> None:
+        self._x, self._y = x, y
+        self._batch_size = batch_size
+        n = len(x)
+        idx = np.arange(n)
+        if shuffle:
+            np.random.RandomState(
+                (seed * 1_000_003 + epoch) % (2**31)).shuffle(idx)
+        self._idx = idx
+        self._end = n - (n % batch_size) if drop_remainder else n
+        self._pos = 0
+
+    def __iter__(self) -> "BatchIterator":
+        return self
+
+    def __next__(self) -> Tuple[np.ndarray, np.ndarray]:
+        if self._pos >= self._end:
+            raise StopIteration
+        sel = self._idx[self._pos:self._pos + self._batch_size]
+        self._pos += self._batch_size
+        return self._x[sel], self._y[sel]
+
+    def __len__(self) -> int:
+        """Batches remaining (partial final batch counts when kept)."""
+        left = max(self._end - self._pos, 0)
+        return -(-left // self._batch_size)
+
+    def skip_batches(self, n: int) -> int:
+        """Advance past up to ``n`` batches without materializing them;
+        returns how many were actually skipped (< n once exhausted)."""
+        k = min(max(n, 0), len(self))
+        self._pos += k * self._batch_size
+        return k
+
+
 def batch_iterator(x: np.ndarray, y: np.ndarray, batch_size: int, *,
                    seed: int = 0, epoch: int = 0, shuffle: bool = True,
-                   drop_remainder: bool = True
-                   ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+                   drop_remainder: bool = True) -> BatchIterator:
     """Deterministic shuffled batches of (x, y)."""
-    n = len(x)
-    idx = np.arange(n)
-    if shuffle:
-        np.random.RandomState((seed * 1_000_003 + epoch) % (2**31)).shuffle(idx)
-    end = n - (n % batch_size) if drop_remainder else n
-    for i in range(0, end, batch_size):
-        sel = idx[i:i + batch_size]
-        yield x[sel], y[sel]
+    return BatchIterator(x, y, batch_size, seed=seed, epoch=epoch,
+                         shuffle=shuffle, drop_remainder=drop_remainder)
+
+
+# ---------------------------------------------------------------------------
+# Device feeding: async prefetch so host input overlaps device compute
+# ---------------------------------------------------------------------------
+
+_ITEM, _DONE, _ERROR = "item", "done", "error"
+
+
+class DevicePrefetcher:
+    """Background-thread device feeder with a bounded queue (double-buffering).
+
+    A producer thread pulls host batches from ``iterator``, applies ``put``
+    (typically the sharded ``jax.device_put``), and parks up to ``depth``
+    device-resident batches in a queue. The consumer's ``next()`` then only
+    blocks when the device is outrunning the host — input transfer overlaps
+    XLA compute instead of serializing before every dispatch.
+
+    Shutdown is cooperative and deadlock-free in both directions:
+
+    - the producer never blocks forever on a full queue (it offers with a
+      timeout and re-checks the stop flag), so a consumer that dies
+      mid-chunk cannot strand the thread;
+    - ``close()`` signals stop, drains the queue to unwedge the producer,
+      and joins it — preemption/exception paths leak nothing.
+
+    Exceptions raised by the host iterator or by ``put`` are forwarded to
+    the consumer and re-raised from ``next()``.
+    """
+
+    def __init__(self, iterator: Iterable[Any],
+                 put: Optional[Callable[[Any], Any]] = None, *,
+                 depth: int = 2, name: str = "device-prefetch") -> None:
+        self._it = iter(iterator)
+        self._put = put if put is not None else (lambda b: b)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+        self._finished = False           # consumer saw done/error
+        self._closed = False
+        # observability counters (reported via take_*): host_time is the
+        # producer's true input cost (pull + device_put) even when hidden by
+        # overlap; queue_wait is the consumer-visible stall.
+        self._host_time_s = 0.0
+        self._host_time_taken = 0.0
+        self._queue_wait_s = 0.0
+        self._thread = threading.Thread(target=self._producer, daemon=True,
+                                        name=name)
+        self._thread.start()
+
+    # -- producer -----------------------------------------------------------
+
+    def _offer(self, msg: Tuple[str, Any]) -> bool:
+        """Bounded put that never outlives a dead consumer."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(msg, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _producer(self) -> None:
+        while not self._stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                batch = next(self._it)
+            except StopIteration:
+                self._offer((_DONE, None))
+                return
+            except BaseException as exc:  # noqa: BLE001 - forwarded
+                self._offer((_ERROR, exc))
+                return
+            try:
+                batch = self._put(batch)
+            except BaseException as exc:  # noqa: BLE001 - forwarded
+                self._offer((_ERROR, exc))
+                return
+            self._host_time_s += time.perf_counter() - t0
+            if not self._offer((_ITEM, batch)):
+                return
+
+    # -- consumer -----------------------------------------------------------
+
+    def __iter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __next__(self) -> Any:
+        if self._finished or self._closed:
+            raise StopIteration
+        t0 = time.perf_counter()
+        tag, payload = self._queue.get()
+        self._queue_wait_s += time.perf_counter() - t0
+        if tag == _ITEM:
+            return payload
+        self._finished = True
+        if tag == _ERROR:
+            raise payload
+        raise StopIteration
+
+    # -- accounting ---------------------------------------------------------
+
+    def take_queue_wait(self) -> float:
+        """Consumer stall time since the last call (the overlap residue)."""
+        out, self._queue_wait_s = self._queue_wait_s, 0.0
+        return out
+
+    def take_host_time(self) -> float:
+        """Producer-side input time since the last call (may be hidden)."""
+        cur = self._host_time_s  # float read is atomic under the GIL
+        out = cur - self._host_time_taken
+        self._host_time_taken = cur
+        return out
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the producer and join it. Idempotent; safe mid-stream."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        # drain so a producer blocked in _offer's put() wakes immediately
+        while True:
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=timeout)
+
+    @property
+    def thread_alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+class SyncDeviceFeeder:
+    """Drop-in synchronous twin of :class:`DevicePrefetcher` (depth 0):
+    pulls and ``put``s inline on the consumer thread. Keeps the trainer's
+    hot loop shape identical whether prefetch is on or off."""
+
+    def __init__(self, iterator: Iterable[Any],
+                 put: Optional[Callable[[Any], Any]] = None) -> None:
+        self._it = iter(iterator)
+        self._put = put if put is not None else (lambda b: b)
+        self._host_time_s = 0.0
+        self._taken = {"wait": 0.0, "host": 0.0}
+
+    def __iter__(self) -> "SyncDeviceFeeder":
+        return self
+
+    def __next__(self) -> Any:
+        t0 = time.perf_counter()
+        batch = self._put(next(self._it))
+        self._host_time_s += time.perf_counter() - t0
+        return batch
+
+    def _take(self, key: str) -> float:
+        out = self._host_time_s - self._taken[key]
+        self._taken[key] = self._host_time_s
+        return out
+
+    def take_queue_wait(self) -> float:
+        """Synchronous path: the whole input time is consumer-visible."""
+        return self._take("wait")
+
+    def take_host_time(self) -> float:
+        return self._take("host")
+
+    def close(self, timeout: float = 0.0) -> None:
+        pass
+
+    def __enter__(self) -> "SyncDeviceFeeder":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        pass
+
+
+def make_device_feeder(iterator: Iterable[Any],
+                       put: Optional[Callable[[Any], Any]] = None, *,
+                       depth: int = 2, name: str = "device-prefetch"):
+    """``depth >= 1`` → async :class:`DevicePrefetcher`; ``depth == 0`` →
+    :class:`SyncDeviceFeeder` (the old blocking behaviour, for debugging
+    and strict-determinism comparisons)."""
+    if depth and depth > 0:
+        return DevicePrefetcher(iterator, put, depth=depth, name=name)
+    return SyncDeviceFeeder(iterator, put)

@@ -4,6 +4,15 @@
 # PALLAS_AXON_POOL_IPS is cleared so the axon TPU-tunnel sitecustomize skips
 # its PJRT relay handshake (it serializes every python process behind the
 # single TPU grant, ~minutes of startup latency); tests are CPU-only anyway.
+#
+# `./run_tests.sh --tier1` runs the tier-1 gate subset (everything not
+# marked slow) — the same selection ROADMAP.md's verify command uses, and
+# the set the prefetch/fused-dispatch tests (tests/test_prefetch_fused.py)
+# ride in.
+if [ "$1" = "--tier1" ]; then
+    shift
+    set -- tests/ -m "not slow" "$@"
+fi
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}" \
     python -m pytest "${@:-tests/}" -q

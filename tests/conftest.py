@@ -10,9 +10,37 @@ determined_clone_tpu.utils.host_steering, shared with __graft_entry__ and bench.
 """
 import os
 import sys
+import threading
+import time
+
+import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from determined_clone_tpu.utils.host_steering import steer_to_host_cpu  # noqa: E402
 
 steer_to_host_cpu(8)
+
+
+@pytest.fixture(autouse=True)
+def no_leaked_nondaemon_threads():
+    """Fail any test that leaks a non-daemon thread.
+
+    Library threads (prefetcher, profiler, checkpoint uploader, tb-sync) are
+    all daemon AND joined on their owners' shutdown paths; a surviving
+    non-daemon thread would hang interpreter exit in production. A short
+    grace window lets threads a test just signalled finish dying.
+    """
+    before = set(threading.enumerate())
+    yield
+
+    def leaked():
+        return [t for t in threading.enumerate()
+                if t not in before and not t.daemon and t.is_alive()]
+
+    deadline = time.monotonic() + 2.0
+    while leaked() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    remaining = leaked()
+    assert not remaining, (
+        f"test leaked non-daemon threads: {[t.name for t in remaining]}")

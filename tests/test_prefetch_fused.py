@@ -1,0 +1,473 @@
+"""Async device-prefetch + fused multi-step dispatch: the hot-loop overhaul.
+
+Pins the contract that makes the optimizations safe to leave on by default:
+the prefetched / fused paths are *semantically invisible* — bit-identical
+batch order, the same rng chain, the same final state as the synchronous
+k=1 loop — and the prefetcher's producer thread never outlives the loop,
+whatever way the loop exits.
+"""
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from determined_clone_tpu import core
+from determined_clone_tpu.config import ExperimentConfig
+from determined_clone_tpu.parallel import MeshSpec, make_mesh
+from determined_clone_tpu.training import JaxTrial, Trainer, TrialContext
+from determined_clone_tpu.training.metrics import MetricAccumulator
+from determined_clone_tpu.training.train_step import (
+    create_train_state,
+    make_train_step,
+)
+from determined_clone_tpu.utils.data import (
+    BatchIterator,
+    DevicePrefetcher,
+    SyncDeviceFeeder,
+    batch_iterator,
+    make_device_feeder,
+    synthetic_mnist,
+)
+
+
+def prefetch_threads_alive():
+    return [t for t in threading.enumerate()
+            if t.is_alive() and "prefetch" in t.name]
+
+
+# ---------------------------------------------------------------------------
+# DevicePrefetcher unit behaviour
+# ---------------------------------------------------------------------------
+
+class TestDevicePrefetcher:
+    def test_preserves_order_and_applies_put(self):
+        with DevicePrefetcher(iter(range(50)), put=lambda x: x * 2,
+                              depth=3) as pf:
+            assert list(pf) == [2 * i for i in range(50)]
+        assert not pf.thread_alive
+
+    def test_iterator_exception_forwarded(self):
+        def gen():
+            yield 1
+            raise ValueError("source died")
+
+        pf = DevicePrefetcher(gen(), depth=2)
+        assert next(pf) == 1
+        with pytest.raises(ValueError, match="source died"):
+            next(pf)
+        pf.close()
+        assert not pf.thread_alive
+
+    def test_put_exception_forwarded(self):
+        def bad_put(x):
+            raise RuntimeError("device_put failed")
+
+        pf = DevicePrefetcher(iter([1]), put=bad_put)
+        with pytest.raises(RuntimeError, match="device_put failed"):
+            next(pf)
+        pf.close()
+        assert not pf.thread_alive
+
+    def test_dead_consumer_mid_chunk_does_not_strand_producer(self):
+        # infinite source, bounded queue: the producer is parked on a full
+        # queue when the consumer stops pulling; close() must still join it
+        def forever():
+            i = 0
+            while True:
+                yield i
+                i += 1
+
+        pf = DevicePrefetcher(forever(), depth=2)
+        assert next(pf) == 0  # producer is live and mid-chunk
+        pf.close(timeout=5.0)
+        assert not pf.thread_alive
+
+    def test_close_is_idempotent_and_ends_iteration(self):
+        pf = DevicePrefetcher(iter(range(10)), depth=2)
+        assert next(pf) == 0
+        pf.close()
+        pf.close()
+        with pytest.raises(StopIteration):
+            next(pf)
+
+    def test_wait_and_host_time_counters(self):
+        pf = DevicePrefetcher(iter(range(5)), depth=2)
+        assert list(pf) == list(range(5))
+        assert pf.take_queue_wait() >= 0.0
+        assert pf.take_queue_wait() == 0.0  # reset on take
+        assert pf.take_host_time() >= 0.0
+        pf.close()
+
+    def test_sync_feeder_counts_both_ways(self):
+        sf = SyncDeviceFeeder(iter(range(3)))
+        assert list(sf) == [0, 1, 2]
+        # both views report the same underlying counter, independently
+        assert sf.take_queue_wait() >= 0.0
+        assert sf.take_host_time() >= 0.0
+
+    def test_factory_depth_zero_is_sync(self):
+        assert isinstance(make_device_feeder(iter([]), depth=0),
+                          SyncDeviceFeeder)
+        pf = make_device_feeder(iter([]), depth=2)
+        assert isinstance(pf, DevicePrefetcher)
+        pf.close()
+
+
+# ---------------------------------------------------------------------------
+# BatchIterator index-skip fast path
+# ---------------------------------------------------------------------------
+
+class TestBatchIteratorSkip:
+    def test_skip_equals_materialize(self):
+        x, y = synthetic_mnist(640, seed=3)
+        a = batch_iterator(x, y, 64, seed=5)
+        b = batch_iterator(x, y, 64, seed=5)
+        for _ in range(4):
+            next(a)
+        assert b.skip_batches(4) == 4
+        for xa_ya, xb_yb in zip(a, b):
+            np.testing.assert_array_equal(xa_ya[0], xb_yb[0])
+            np.testing.assert_array_equal(xa_ya[1], xb_yb[1])
+
+    def test_skip_past_end_reports_actual(self):
+        x, y = synthetic_mnist(320, seed=0)
+        it = batch_iterator(x, y, 64)  # 5 batches
+        assert it.skip_batches(3) == 3
+        assert len(it) == 2
+        assert it.skip_batches(10) == 2
+        with pytest.raises(StopIteration):
+            next(it)
+
+    def test_remainder_kept_when_not_dropped(self):
+        x, y = synthetic_mnist(130, seed=0)
+        it = batch_iterator(x, y, 64, drop_remainder=False, shuffle=False)
+        assert len(it) == 3
+        assert it.skip_batches(2) == 2
+        xb, _ = next(it)
+        assert len(xb) == 2  # the remainder batch survived the skip
+
+
+# ---------------------------------------------------------------------------
+# Fused dispatch: step-level equivalence
+# ---------------------------------------------------------------------------
+
+class TestFusedTrainStep:
+    def test_k4_matches_sequential_k1(self):
+        from determined_clone_tpu.models import mlp
+
+        cfg = mlp.MLPConfig(in_dim=16, hidden_dims=(8,), n_classes=4)
+        params = mlp.init(jax.random.PRNGKey(0), cfg)
+        tx = optax.adam(1e-2)
+
+        def loss(p, b, rng):
+            xb, yb = b
+            return mlp.loss_fn(p, cfg, xb, yb), {}
+
+        rng = np.random.RandomState(0)
+        batches = [
+            (rng.randn(8, 16).astype(np.float32),
+             rng.randint(0, 4, 8).astype(np.int32))
+            for _ in range(8)
+        ]
+
+        s1 = create_train_state(params, tx, jax.random.PRNGKey(1))
+        step1 = make_train_step(loss, tx, donate=False)
+        acc1 = MetricAccumulator()
+        for b in batches:
+            s1, m = step1(s1, b)
+            acc1.add(m)
+
+        s4 = create_train_state(params, tx, jax.random.PRNGKey(1))
+        step4 = make_train_step(loss, tx, donate=False, steps_per_dispatch=4)
+        acc4 = MetricAccumulator()
+        for i in range(0, len(batches), 4):
+            s4, m = step4(s4, *batches[i:i + 4])
+            acc4.add(m, count=4)
+
+        # identical params AND identical rng chain: the scan is the same
+        # sequence of steps, not an approximation of it
+        for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s4.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(s1.rng), np.asarray(s4.rng))
+        r1, r4 = acc1.result(), acc4.result()
+        assert r1["loss"] == pytest.approx(r4["loss"], rel=1e-5)
+
+    def test_rejects_bad_k(self):
+        with pytest.raises(ValueError, match="steps_per_dispatch"):
+            make_train_step(lambda p, b, r: jnp.zeros(()), optax.sgd(0.1),
+                            steps_per_dispatch=0)
+
+
+# ---------------------------------------------------------------------------
+# Trainer-level seeded equivalence + shutdown
+# ---------------------------------------------------------------------------
+
+class OrderSensitiveTrial(JaxTrial):
+    """loss = (w - mean(batch))^2 with per-batch distinct means: the final w
+    encodes the exact batch sequence, so any reordering or drop by the
+    prefetch/fused paths changes the result."""
+
+    N_BATCHES = 24
+
+    def initial_params(self, rng):
+        return {"w": jnp.zeros(())}
+
+    def optimizer(self):
+        return optax.sgd(0.05)
+
+    def loss(self, params, batch, rng):
+        del rng
+        loss = (params["w"] - jnp.mean(batch)) ** 2
+        return loss, {}
+
+    def training_data(self):
+        rng = np.random.RandomState(42)
+        for i in range(self.N_BATCHES):
+            yield (rng.randn(4, 1) * 0.1 + i).astype(np.float32)
+
+    def validation_data(self):
+        return [np.ones((4, 1), np.float32)]
+
+    @property
+    def global_batch_size(self):
+        return 4
+
+
+def run_trial(tmp_path, trial_cls, optimizations, max_batches=24,
+              sched_unit=8, subdir=""):
+    cfg = ExperimentConfig.from_dict({
+        "searcher": {"name": "single", "metric": "loss",
+                     "max_length": {"batches": max_batches}},
+        "scheduling_unit": sched_unit,
+        "optimizations": optimizations,
+        "checkpoint_storage": {"type": "shared_fs",
+                               "host_path": str(tmp_path / (subdir or "ck"))},
+    })
+    with core.init(config=cfg, trial_id=1) as cctx:
+        mesh = make_mesh(MeshSpec(dp=1), jax.devices()[:1])
+        ctx = TrialContext(config=cfg, hparams={}, core=cctx, mesh=mesh)
+        t = Trainer(trial_cls(ctx))
+        result = t.fit()
+        backend = cctx.train._backend
+        losses = [r["metrics"]["loss"] for r in backend.records
+                  if r["group"] == "training"]
+        return float(np.asarray(t._final_state.params["w"])), losses, result
+
+
+class TestTrainerEquivalence:
+    def test_prefetch_and_fusion_match_sync_loop(self, tmp_path):
+        w_sync, loss_sync, _ = run_trial(
+            tmp_path, OrderSensitiveTrial,
+            {"prefetch_depth": 0, "steps_per_dispatch": 1}, subdir="sync")
+        w_pf, loss_pf, _ = run_trial(
+            tmp_path, OrderSensitiveTrial,
+            {"prefetch_depth": 2, "steps_per_dispatch": 1}, subdir="pf")
+        w_fused, loss_fused, _ = run_trial(
+            tmp_path, OrderSensitiveTrial,
+            {"prefetch_depth": 2, "steps_per_dispatch": 4}, subdir="fused")
+
+        # prefetch changes WHERE device_put happens, not what runs: exact
+        assert w_pf == w_sync
+        assert loss_pf == pytest.approx(loss_sync, rel=1e-6)
+        # fusion reorders only the metric summation: same weights, loss
+        # equal within re-association tolerance
+        assert w_fused == pytest.approx(w_sync, rel=1e-5, abs=1e-6)
+        assert loss_fused == pytest.approx(loss_sync, rel=1e-4)
+        assert not prefetch_threads_alive()
+
+    def test_fusion_handles_non_divisible_boundaries(self, tmp_path):
+        # 22 batches, scheduling_unit 8, k=4: chunks of 8, 8, 6 — the last
+        # chunk mixes one fused dispatch with two single-step fallbacks
+        w_sync, _, res_s = run_trial(
+            tmp_path, OrderSensitiveTrial,
+            {"prefetch_depth": 0, "steps_per_dispatch": 1},
+            max_batches=22, subdir="sync22")
+        w_fused, _, res_f = run_trial(
+            tmp_path, OrderSensitiveTrial,
+            {"prefetch_depth": 2, "steps_per_dispatch": 4},
+            max_batches=22, subdir="fused22")
+        assert res_s["batches_trained"] == res_f["batches_trained"] == 22
+        assert w_fused == pytest.approx(w_sync, rel=1e-5, abs=1e-6)
+
+
+class ExplodingTrial(OrderSensitiveTrial):
+    def training_data(self):
+        for i in range(7):
+            yield np.full((4, 1), float(i), np.float32)
+        raise RuntimeError("data source died mid-chunk")
+
+
+class TestPrefetcherShutdown:
+    def test_mid_chunk_exception_joins_producer(self, tmp_path):
+        cfg = ExperimentConfig.from_dict({
+            "searcher": {"name": "single", "metric": "loss",
+                         "max_length": {"batches": 1000}},
+            "scheduling_unit": 10,
+            "optimizations": {"prefetch_depth": 2, "steps_per_dispatch": 1},
+            "checkpoint_storage": {"type": "shared_fs",
+                                   "host_path": str(tmp_path)},
+        })
+        with core.init(config=cfg, trial_id=1) as cctx:
+            mesh = make_mesh(MeshSpec(dp=1), jax.devices()[:1])
+            ctx = TrialContext(config=cfg, hparams={}, core=cctx, mesh=mesh)
+            with pytest.raises(RuntimeError, match="data source died"):
+                Trainer(ExplodingTrial(ctx)).fit()
+        assert not prefetch_threads_alive()
+
+    def test_preemption_joins_producer(self, tmp_path):
+        import time as _time
+
+        flag = tmp_path / "flag"
+        flag.write_text("")
+        cfg = ExperimentConfig.from_dict({
+            "searcher": {"name": "single", "metric": "loss",
+                         "max_length": {"batches": 100000}},
+            "scheduling_unit": 4,
+            "optimizations": {"prefetch_depth": 2, "steps_per_dispatch": 2},
+            "checkpoint_storage": {"type": "shared_fs",
+                                   "host_path": str(tmp_path)},
+        })
+
+        class EndlessTrial(OrderSensitiveTrial):
+            def training_data(self):
+                i = 0
+                while True:
+                    yield np.full((4, 1), float(i % 97), np.float32)
+                    i += 1
+
+        with core.init(
+            config=cfg, trial_id=1,
+            preemption_source=core.FilePreemptionSource(str(flag)),
+        ) as cctx:
+            mesh = make_mesh(MeshSpec(dp=1), jax.devices()[:1])
+            ctx = TrialContext(config=cfg, hparams={}, core=cctx, mesh=mesh)
+            _time.sleep(0.3)  # let the watcher observe the flag
+            result = Trainer(EndlessTrial(ctx)).fit()
+            assert result["preempted"]
+        assert not prefetch_threads_alive()
+
+
+# ---------------------------------------------------------------------------
+# Restore: index-skip replay + validation remainder handling
+# ---------------------------------------------------------------------------
+
+class CountingBatchIterator(BatchIterator):
+    materialized = 0
+
+    def __next__(self):
+        CountingBatchIterator.materialized += 1
+        return super().__next__()
+
+
+class SkippableTrial(JaxTrial):
+    def initial_params(self, rng):
+        return {"w": jnp.zeros(())}
+
+    def optimizer(self):
+        return optax.sgd(0.1)
+
+    def loss(self, params, batch, rng):
+        del batch, rng
+        loss = (params["w"] - 3.0) ** 2
+        return loss, {}
+
+    def training_data(self):
+        x, y = synthetic_mnist(2048, seed=0)
+        return CountingBatchIterator(x, y, 64, seed=0)
+
+    @property
+    def global_batch_size(self):
+        return 64
+
+
+class TestRestoreSkipFastPath:
+    def test_replay_skips_by_arithmetic(self, tmp_path):
+        cfg_dict = {
+            "searcher": {"name": "single", "metric": "loss",
+                         "max_length": {"batches": 10}},
+            "scheduling_unit": 10,
+            # sync feeder: with prefetch on, the producer runs ahead of
+            # consumption and the materialization count isn't deterministic
+            "optimizations": {"prefetch_depth": 0},
+            "checkpoint_storage": {"type": "shared_fs",
+                                   "host_path": str(tmp_path)},
+        }
+        cfg = ExperimentConfig.from_dict(cfg_dict)
+        with core.init(config=cfg, trial_id=1) as cctx:
+            mesh = make_mesh(MeshSpec(dp=1), jax.devices()[:1])
+            ctx = TrialContext(config=cfg, hparams={}, core=cctx, mesh=mesh)
+            Trainer(SkippableTrial(ctx)).fit()
+        ckpt_id = core.LocalCheckpointRegistry(
+            str(tmp_path / "checkpoints.jsonl")).list()[-1]["storage_id"]
+
+        cfg_dict["searcher"]["max_length"] = {"batches": 20}
+        cfg2 = ExperimentConfig.from_dict(cfg_dict)
+        CountingBatchIterator.materialized = 0
+        with core.init(config=cfg2, trial_id=1) as cctx:
+            mesh = make_mesh(MeshSpec(dp=1), jax.devices()[:1])
+            ctx = TrialContext(config=cfg2, hparams={}, core=cctx, mesh=mesh)
+            result = Trainer(SkippableTrial(ctx)).fit(
+                latest_checkpoint=ckpt_id)
+        assert result["batches_trained"] == 20
+        # fast path: 1 probe batch (batch_spec discovery) + 10 trained;
+        # without skip_batches the replay would also materialize the 9
+        # remaining replayed batches (20 total)
+        assert CountingBatchIterator.materialized == 11
+
+
+class RemainderValTrial(JaxTrial):
+    """Validation data with a shape-mismatched remainder batch; ``bsum``
+    detects whether the remainder reached eval_step (it must not — eval
+    stays one compiled program)."""
+
+    def initial_params(self, rng):
+        return {"w": jnp.zeros(())}
+
+    def optimizer(self):
+        return optax.sgd(0.1)
+
+    def loss(self, params, batch, rng):
+        del batch, rng
+        return (params["w"] - 3.0) ** 2, {}
+
+    def eval_metrics(self, params, batch):
+        return {"loss": (params["w"] - 3.0) ** 2,
+                "bsum": jnp.sum(batch)}
+
+    def training_data(self):
+        for _ in range(8):
+            yield np.ones((4, 1), np.float32)
+
+    def validation_data(self):
+        return [np.ones((4, 1), np.float32),
+                np.ones((4, 1), np.float32),
+                np.ones((2, 1), np.float32)]  # the remainder
+
+    @property
+    def global_batch_size(self):
+        return 4
+
+
+class TestValidationRemainder:
+    def test_remainder_batch_dropped_not_retraced(self, tmp_path):
+        cfg = ExperimentConfig.from_dict({
+            "searcher": {"name": "single", "metric": "loss",
+                         "max_length": {"batches": 8}},
+            "scheduling_unit": 8,
+            "checkpoint_storage": {"type": "shared_fs",
+                                   "host_path": str(tmp_path)},
+        })
+        with core.init(config=cfg, trial_id=1) as cctx:
+            mesh = make_mesh(MeshSpec(dp=1), jax.devices()[:1])
+            ctx = TrialContext(config=cfg, hparams={}, core=cctx, mesh=mesh)
+            Trainer(RemainderValTrial(ctx)).fit()
+            vals = [r["metrics"] for r in cctx.train._backend.records
+                    if r["group"] == "validation"]
+        assert vals
+        # full batches sum to 4.0 each; had the (2,1) remainder been
+        # included the mean would be (4+4+2)/3 ≈ 3.33
+        assert vals[-1]["bsum"] == pytest.approx(4.0)
