@@ -226,10 +226,17 @@ def _run_child() -> None:
         DevicePrefetcher + fused k-step dispatch (the trainer's default
         path). Reports the input-pipeline overlap — dataloading_fraction is
         the consumer-visible queue wait over wall time (0 = perfect
-        overlap, 1 = host-bound)."""
+        overlap, 1 = host-bound) — plus the telemetry span summary and the
+        XLA (re)trace count, so compile churn in the hot loop shows up in
+        BENCH history."""
         import numpy as np
 
+        from determined_clone_tpu.telemetry import Telemetry
         from determined_clone_tpu.utils.data import DevicePrefetcher
+
+        # no sync= on wrap_jit: spans time dispatch, the value fetches
+        # below stay the only barriers — throughput is unperturbed
+        tel = Telemetry(enabled=True)
 
         params = gpt.init(jax.random.PRNGKey(0), cfg)
         tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
@@ -244,8 +251,10 @@ def _run_child() -> None:
         def loss(p, b, rng):
             return gpt.loss_fn(p, cfg, b[:, :-1], b[:, 1:]), {}
 
-        step = make_train_step(loss, tx, steps_per_dispatch=k)
-        feed = DevicePrefetcher(host_batches(), jax.device_put, depth=2 * k)
+        step = tel.wrap_jit("train_dispatch",
+                            make_train_step(loss, tx, steps_per_dispatch=k))
+        feed = DevicePrefetcher(host_batches(), jax.device_put, depth=2 * k,
+                                tracer=tel.tracer, registry=tel.registry)
         try:
             group = [next(feed) for _ in range(k)]
             state, metrics = step(state, *group)  # compile
@@ -269,6 +278,9 @@ def _run_child() -> None:
             "dataloading_fraction": round(min(max(wait / dt, 0.0), 1.0), 4),
             "steps_per_dispatch": k,
             "prefetch_depth": 2 * k,
+            # >1 means the fused program recompiled mid-run (shape churn)
+            "xla_compiles": tel.compile_count(),
+            "span_summary": tel.span_summary(),
         }
 
     def time_mnist(timed_steps: int) -> dict:

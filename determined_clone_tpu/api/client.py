@@ -80,7 +80,7 @@ class MasterSession:
                 try:
                     detail = json.loads(detail).get("error", detail)
                 except Exception:
-                    pass
+                    pass  # error body wasn't JSON; surface it raw
                 raise MasterError(e.code, detail) from None
             except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
                 last_err = e

@@ -437,6 +437,42 @@ def cmd_job_set_priority(args) -> int:
     return 0
 
 
+def cmd_trace_export(args) -> int:
+    """Convert a trial's shipped telemetry spans (or a local span-record
+    JSONL) into a Perfetto-loadable Chrome trace-event JSON file."""
+    from determined_clone_tpu.telemetry.chrome_trace import (
+        spans_from_profiler_samples,
+        to_chrome_trace,
+        validate_chrome_trace,
+    )
+
+    if args.from_file:
+        with open(args.from_file) as f:
+            samples = [json.loads(line) for line in f if line.strip()]
+    else:
+        if args.trial_id is None:
+            print("error: give a trial id or --from-file", file=sys.stderr)
+            return 2
+        samples = make_session(args).trial_profiler_samples(
+            args.trial_id, limit=args.limit)
+    spans = spans_from_profiler_samples(samples)
+    if not spans:
+        print("no span samples found — the trial must run with "
+              "observability: {enabled: true, ship_spans: true}",
+              file=sys.stderr)
+        return 1
+    trace = to_chrome_trace(spans)
+    problems = validate_chrome_trace(trace)
+    if problems:  # can only come from malformed shipped records
+        print("warning: trace has structural problems:\n  " +
+              "\n  ".join(problems), file=sys.stderr)
+    with open(args.output, "w") as f:
+        json.dump(trace, f)
+    print(f"wrote {len(spans)} spans to {args.output} "
+          f"(load at ui.perfetto.dev or chrome://tracing)")
+    return 0
+
+
 def _deploy_runner(args):
     from determined_clone_tpu.deploy import DryRunRunner, SubprocessRunner
 
@@ -1013,6 +1049,21 @@ def build_parser() -> argparse.ArgumentParser:
     c = sr.add_parser("me")
     c.add_argument("--workspace-id", type=int, default=None)
     c.set_defaults(func=cmd_rbac_me)
+
+    # trace (telemetry timeline export — docs/observability.md)
+    p_trace = sub.add_parser("trace", help="telemetry trace export")
+    str_ = p_trace.add_subparsers(dest="subcommand", required=True)
+    c = str_.add_parser("export",
+                        help="build a Chrome trace-event JSON from a "
+                             "trial's shipped spans")
+    c.add_argument("trial_id", type=int, nargs="?", default=None)
+    c.add_argument("--from-file", default=None,
+                   help="read span records from a local JSONL instead of "
+                        "the master")
+    c.add_argument("-o", "--output", default="trace.json")
+    c.add_argument("--limit", type=int, default=100000,
+                   help="max profiler samples to pull from the master")
+    c.set_defaults(func=cmd_trace_export)
 
     # deploy
     p_dep = sub.add_parser("deploy", help="cluster deployment")

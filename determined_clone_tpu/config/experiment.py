@@ -266,6 +266,45 @@ class OptimizationsConfig:
 
 
 # ---------------------------------------------------------------------------
+# Observability (trial-side telemetry: spans, metrics registry, Chrome-trace
+# export — see docs/observability.md; disabled by default so the hot loop
+# stays unwrapped)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ObservabilityConfig:
+    enabled: bool = False
+    max_events: int = 200_000      # span-record cap (head kept, tail dropped)
+    ship_spans: bool = False       # ship span records over profiler channel
+    ship_metrics: bool = True      # ship registry snapshots over it
+    trace_path: Optional[str] = None  # trace.json destination; None = default
+
+    @staticmethod
+    def from_dict(raw: Dict[str, Any]) -> "ObservabilityConfig":
+        if not isinstance(raw, dict):
+            raise ConfigError(f"observability must be a mapping, got {raw!r}")
+        cfg = ObservabilityConfig(
+            enabled=bool(raw.get("enabled", False)),
+            max_events=int(raw.get("max_events", 200_000)),
+            ship_spans=bool(raw.get("ship_spans", False)),
+            ship_metrics=bool(raw.get("ship_metrics", True)),
+            trace_path=raw.get("trace_path"),
+        )
+        cfg.validate()
+        return cfg
+
+    def validate(self) -> None:
+        if self.max_events < 1:
+            raise ConfigError(
+                f"observability.max_events must be >= 1, "
+                f"got {self.max_events}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if v is not None}
+
+
+# ---------------------------------------------------------------------------
 # Log policies (reference: expconf log_policies → logpattern subsystem)
 # ---------------------------------------------------------------------------
 
@@ -302,6 +341,9 @@ class ExperimentConfig:
     checkpoint_storage: Optional[CheckpointStorageConfig] = None
     optimizations: OptimizationsConfig = dataclasses.field(
         default_factory=OptimizationsConfig
+    )
+    observability: ObservabilityConfig = dataclasses.field(
+        default_factory=ObservabilityConfig
     )
     checkpoint_policy: str = "best"     # best | all | none
     min_validation_period: Optional[Length] = None
@@ -353,6 +395,9 @@ class ExperimentConfig:
             ),
             optimizations=OptimizationsConfig.from_dict(
                 raw.get("optimizations") or {}
+            ),
+            observability=ObservabilityConfig.from_dict(
+                raw.get("observability") or {}
             ),
             checkpoint_policy=raw.get("checkpoint_policy", "best"),
             min_validation_period=(
@@ -426,6 +471,8 @@ class ExperimentConfig:
             d["checkpoint_storage"] = self.checkpoint_storage.to_dict()
         if self.optimizations != OptimizationsConfig():
             d["optimizations"] = self.optimizations.to_dict()
+        if self.observability != ObservabilityConfig():
+            d["observability"] = self.observability.to_dict()
         if self.min_validation_period:
             d["min_validation_period"] = self.min_validation_period.to_dict()
         if self.min_checkpoint_period:

@@ -189,6 +189,18 @@ EXPERIMENT_SCHEMA = {
             "type": "object", "open": False,
             "properties": {"enabled": {"type": "boolean"}},
         },
+        # trial-side telemetry (spans + metrics + trace.json export;
+        # docs/observability.md)
+        "observability": {
+            "type": "object", "open": False,
+            "properties": {
+                "enabled": {"type": "boolean"},
+                "max_events": {"type": "integer"},
+                "ship_spans": {"type": "boolean"},
+                "ship_metrics": {"type": "boolean"},
+                "trace_path": {"type": "string"},
+            },
+        },
         # hot-loop knobs (the TPU-native successor of the reference's
         # horovod-centric optimizations block)
         "optimizations": {

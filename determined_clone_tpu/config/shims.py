@@ -86,6 +86,17 @@ def shim(raw: Dict[str, Any]) -> Tuple[Dict[str, Any], List[str]]:
         if kept:
             cfg["optimizations"] = kept
 
+    # v0 `telemetry` block became `observability` (matching the subsystem
+    # package name); same keys, straight rename
+    if "telemetry" in cfg:
+        tel = cfg.pop("telemetry")
+        if "observability" in cfg:
+            raise ValueError(
+                "config sets both legacy telemetry and observability "
+                "blocks; remove the legacy key")
+        cfg["observability"] = tel
+        notes.append("top-level telemetry is v0; shimmed to observability")
+
     # v0 flat `slots` became resources.slots_per_trial
     if "slots" in cfg:
         slots = cfg.pop("slots")

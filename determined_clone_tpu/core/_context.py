@@ -53,9 +53,11 @@ class Context:
         self.searcher = searcher
         self.info = info
         # observability, wired by the exec layer on managed runs (None in
-        # local/unmanaged mode): ProfilerAgent / TensorboardManager
+        # local/unmanaged mode): ProfilerAgent / TensorboardManager /
+        # telemetry.Telemetry (the `observability:` config block)
         self.profiler: Optional[Any] = None
         self.tensorboard: Optional[Any] = None
+        self.telemetry: Optional[Any] = None
 
     def close(self) -> None:
         self.preempt.close()
@@ -126,9 +128,19 @@ def init(
 
     ctx = Context(distributed=dist, train=train, checkpoint=checkpoint,
                   preempt=preempt, searcher=searcher)
+
+    # local/unmanaged runs still get telemetry when the config asks for it
+    # (managed runs: exec/trial.py wires this plus profiler shipping)
+    from determined_clone_tpu.telemetry import telemetry_from_config
+
+    ctx.telemetry = telemetry_from_config(config)
     try:
         yield ctx
     finally:
-        ctx.close()
-        if cleanup_dir is not None:
-            cleanup_dir.cleanup()
+        try:
+            if ctx.telemetry is not None and ctx.telemetry.trace_path:
+                ctx.telemetry.export_chrome_trace()
+        finally:
+            ctx.close()
+            if cleanup_dir is not None:
+                cleanup_dir.cleanup()

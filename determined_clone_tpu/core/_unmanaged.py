@@ -51,7 +51,7 @@ class _HeartbeatThread(threading.Thread):
             self._session.post(
                 f"/api/v1/trials/{self._trial_id}/heartbeat", body)
         except Exception:
-            pass
+            pass  # best-effort terminal report; master may already be gone
 
 
 class _HeartbeatPreemptionSource:

@@ -113,7 +113,7 @@ def cluster_up(*, n_agents: int = 1, slots_per_agent: int = 1,
                 up = True
                 break
         except Exception:
-            pass
+            pass  # master still booting; poll again until the deadline
         time.sleep(0.3)
 
     state = {

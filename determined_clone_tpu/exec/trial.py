@@ -247,12 +247,20 @@ def main(argv=None) -> int:
         signal_mod.signal(signal_mod.SIGTERM,
                           lambda signum, frame: cctx.preempt.signal())
 
-        # observability: profiler (opt-in via `profiling` config) +
-        # tensorboard event shipping (chief only, needs a storage backend)
+        # observability: telemetry (opt-in via `observability` config,
+        # already built by core.init), profiler (opt-in via `profiling`
+        # config) + tensorboard event shipping (chief only, needs a
+        # storage backend). The telemetry registry feeds the profiler's
+        # drop counters; spans/metrics ship over the profiler channel.
         from determined_clone_tpu import profiler as profiler_mod
 
-        prof = profiler_mod.from_config(session, info.trial_id,
-                                        info.experiment_config)
+        tel = cctx.telemetry
+        if tel is not None and not tel.trace_path:
+            tel.trace_path = os.path.abspath(
+                f"trace-trial-{info.trial_id}.json")
+        prof = profiler_mod.from_config(
+            session, info.trial_id, info.experiment_config,
+            registry=tel.registry if tel is not None else None)
         cctx.profiler = prof if prof.enabled else None
         prof.start()
 
@@ -311,6 +319,17 @@ def main(argv=None) -> int:
             print(f"[trial] FAILED: {type(e).__name__}: {e}", flush=True)
             exit_code = 1
         finally:
+            if tel is not None:
+                # final metric snapshot rides the profiler buffer that
+                # prof.stop() flushes; the Chrome trace lands next to the
+                # model def (core.init also exports, this logs the path)
+                tel.publish(cctx.profiler)
+                try:
+                    path = tel.export_chrome_trace()
+                    print(f"[trial] telemetry trace written: {path}",
+                          flush=True)
+                except OSError as e:
+                    print(f"[trial] trace export failed: {e}", flush=True)
             prof.stop()
             if tbm is not None:
                 tbm.close()

@@ -12,14 +12,18 @@ Opt-in per experiment via the ``profiling: {enabled: true}`` config block
 """
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
+logger = logging.getLogger(__name__)
+
 SYSTEM_SAMPLE_PERIOD_SEC = 1.0
 FLUSH_PERIOD_SEC = 5.0
 MAX_BATCHED = 100
+DROP_WARN_PERIOD_SEC = 60.0  # at most one dropped-samples warning a minute
 
 
 def _read_proc_stat() -> Optional[List[int]]:
@@ -129,7 +133,8 @@ class ProfilerAgent:
 
     def __init__(self, session: Any, trial_id: int, *,
                  enabled: bool = True,
-                 sample_system: bool = True) -> None:
+                 sample_system: bool = True,
+                 registry: Optional[Any] = None) -> None:
         self._session = session
         self._trial_id = trial_id
         self.enabled = enabled
@@ -140,6 +145,26 @@ class ProfilerAgent:
         self._stop = threading.Event()
         self._flush_now = threading.Event()
         self._sample_system = sample_system
+        # dropped-sample accounting: lossiness is by design (shedding +
+        # non-retryable posts) but must be *visible* — a counter in the
+        # telemetry registry (when wired) plus a rate-limited warning
+        self._dropped = (registry.counter(
+            "profiler_samples_dropped",
+            "profiler samples lost to buffer shedding or failed posts")
+            if registry is not None else None)
+        self._dropped_total = 0
+        self._last_drop_warn = 0.0
+
+    def _count_dropped(self, n: int, why: str) -> None:
+        self._dropped_total += n
+        if self._dropped is not None:
+            self._dropped.inc(n)
+        now = time.monotonic()
+        if now - self._last_drop_warn >= DROP_WARN_PERIOD_SEC:
+            self._last_drop_warn = now
+            logger.warning(
+                "profiler dropped %d samples (%s); %d dropped total this "
+                "trial", n, why, self._dropped_total)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -180,12 +205,16 @@ class ProfilerAgent:
         the master is slow — profiling must never take down training)."""
         if not self.enabled:
             return
+        shed = 0
         with self._lock:
             self._buffer.append(sample)
             if len(self._buffer) >= 10 * MAX_BATCHED:
                 # master unreachable for a long stretch: shed oldest samples
                 del self._buffer[:MAX_BATCHED]
+                shed = MAX_BATCHED
             full = len(self._buffer) >= MAX_BATCHED
+        if shed:
+            self._count_dropped(shed, "buffer full, shed oldest")
         if full:
             self._flush_now.set()
 
@@ -195,14 +224,9 @@ class ProfilerAgent:
                             steps_per_dispatch: Optional[int] = None,
                             prefetch_depth: Optional[int] = None) -> None:
         """Per-batch (or per-chunk) timings from the trainer's hot loop —
-        the dataloader_next/compute split (profiler.py timings).
-
-        With async prefetch the split sharpens: ``dataloading_s`` is the
-        producer thread's true input cost (pull + device_put, possibly
-        hidden under compute) while ``queue_wait_s`` is the consumer-visible
-        stall — the overlap residue. dataloading >> queue_wait means the
-        prefetcher is doing its job; queue_wait ≈ dataloading means the
-        host is the bottleneck and deeper prefetch won't help."""
+        the dataloader_next/compute split (profiler.py timings). How to
+        read ``dataloading_s`` vs ``queue_wait_s``: docs/observability.md
+        ("Interpreting the input-pipeline numbers")."""
         sample = {
             "time": time.time(),
             "group": "timing",
@@ -243,15 +267,24 @@ class ProfilerAgent:
                 f"/api/v1/trials/{self._trial_id}/profiler",
                 {"samples": batch}, retryable=False)
         except Exception:
-            pass  # profiling must never take down training
+            # profiling must never take down training — but the loss is
+            # counted and warned about, not silent
+            self._count_dropped(len(batch), "post to master failed")
+
+    @property
+    def samples_dropped(self) -> int:
+        return self._dropped_total
 
 
 def from_config(session: Any, trial_id: int,
-                experiment_config: Dict[str, Any]) -> ProfilerAgent:
+                experiment_config: Dict[str, Any], *,
+                registry: Optional[Any] = None) -> ProfilerAgent:
     """Build from the experiment's ``profiling`` block; disabled by default
-    like the reference (expconf profiling.go)."""
+    like the reference (expconf profiling.go). ``registry`` (the telemetry
+    MetricsRegistry, when observability is on) receives drop counters."""
     profiling = experiment_config.get("profiling") or {}
     enabled = bool(profiling.get("enabled", False))
     if os.environ.get("DCT_PROFILING") == "1":
         enabled = True
-    return ProfilerAgent(session, trial_id, enabled=enabled)
+    return ProfilerAgent(session, trial_id, enabled=enabled,
+                         registry=registry)

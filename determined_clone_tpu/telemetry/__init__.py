@@ -1,0 +1,241 @@
+"""Trial-side telemetry: spans, a metrics registry, Chrome-trace export.
+
+The observability layer the async hot loop (PR 1) needs: with a prefetch
+producer thread and fused multi-step dispatch, "where did the wall-clock
+go" is no longer answerable from logs. This package provides
+
+- :class:`Tracer` / spans — nested, thread-safe, monotonic-clock timing of
+  the trainer loop end to end (``docs/observability.md`` has the taxonomy);
+- :class:`MetricsRegistry` — Counter/Gauge/Histogram (streaming p50/p95/p99)
+  fed by the trainer, prefetcher, and ProfilerAgent, exposed as Prometheus
+  text via ``dump()`` and shipped to the master over the profiler channel;
+- Chrome trace-event export — a per-trial ``trace.json`` that loads in
+  Perfetto with thread lanes for the consumer loop, prefetch producer, and
+  profiler threads (``dct trace export`` converts master-shipped spans).
+
+Opt-in via the experiment config's ``observability: {enabled: true}`` block
+(or ``DCT_OBSERVABILITY=1``); disabled (the default) it creates no threads
+and the trainer's hot loop stays byte-identical (the instrumentation wraps
+the step callables and the feeder only when enabled).
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, Optional
+
+from determined_clone_tpu.telemetry.chrome_trace import (
+    chrome_trace_events,
+    spans_from_profiler_samples,
+    to_chrome_trace,
+    validate_chrome_trace,
+    write_chrome_trace,
+)
+from determined_clone_tpu.telemetry.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from determined_clone_tpu.telemetry.spans import (
+    NULL_SPAN,
+    Span,
+    Tracer,
+    null_span,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "NULL_SPAN", "Span", "Telemetry", "Tracer",
+    "chrome_trace_events", "null_span", "spans_from_profiler_samples",
+    "telemetry_from_config", "to_chrome_trace", "validate_chrome_trace",
+    "write_chrome_trace",
+]
+
+
+class _TracedFeeder:
+    """Wraps a device feeder so each consumer pull is a ``dataload_wait``
+    span + histogram observation. Only constructed when telemetry is
+    enabled — the disabled hot loop consumes the raw feeder."""
+
+    def __init__(self, feed: Any, telemetry: "Telemetry") -> None:
+        self._feed = feed
+        self._span = telemetry.tracer.span
+        self._hist = telemetry.registry.histogram(
+            "dataload_wait_seconds",
+            "consumer-visible input stall per pull (overlap residue)")
+
+    def __iter__(self) -> "_TracedFeeder":
+        return self
+
+    def __next__(self) -> Any:
+        t0 = time.perf_counter()
+        with self._span("dataload_wait"):
+            batch = next(self._feed)
+        self._hist.observe(time.perf_counter() - t0)
+        return batch
+
+    # trainer-facing surface of DevicePrefetcher / SyncDeviceFeeder
+    def take_queue_wait(self) -> float:
+        return self._feed.take_queue_wait()
+
+    def take_host_time(self) -> float:
+        return self._feed.take_host_time()
+
+    def close(self, timeout: float = 5.0) -> None:
+        self._feed.close(timeout)
+
+
+class Telemetry:
+    """Facade bundling one Tracer + one MetricsRegistry per trial."""
+
+    def __init__(self, *, enabled: bool = True, max_events: int = 200_000,
+                 ship_spans: bool = False, ship_metrics: bool = True,
+                 trace_path: Optional[str] = None) -> None:
+        self.enabled = enabled
+        self.ship_spans = ship_spans
+        self.ship_metrics = ship_metrics
+        self.trace_path = trace_path
+        self.tracer = Tracer(enabled=enabled, max_events=max_events)
+        self.registry = MetricsRegistry()
+        self._ship_cursor = 0
+
+    # -- instrumentation hooks ---------------------------------------------
+
+    def wrap_jit(self, name: str, fn: Callable[..., Any], *,
+                 sync: Optional[Callable[[Any], Any]] = None,
+                 ) -> Callable[..., Any]:
+        """Wrap a jitted callable: every call is a ``name`` span feeding a
+        ``{name}_seconds`` histogram, and XLA compiles are detected and
+        recorded as ``xla_compile`` spans.
+
+        Detection uses the jitted function's compilation-cache size when
+        available (each growth = one trace+compile, so *re*traces — e.g. a
+        new batch shape — are caught too), falling back to first-call
+        timing otherwise.
+
+        ``sync`` (e.g. ``jax.block_until_ready``) is applied to the output
+        *inside* the span: under async dispatch the bare call returns after
+        enqueue, so without a sync the span would time Python dispatch
+        overhead, not device compute. This is the tracing observer effect
+        (docs/observability.md) — dispatch pipelining is traded for
+        attributable timings while telemetry is on.
+        """
+        if not self.enabled:
+            return fn
+        tracer = self.tracer
+        hist = self.registry.histogram(
+            f"{name}_seconds", f"duration of each {name} call")
+        compiles = self.registry.counter(
+            "xla_compiles_total",
+            "jitted-program compilations observed (first calls + retraces)")
+        cache_size = getattr(fn, "_cache_size", None)
+        state = {"calls": 0}
+
+        def wrapped(*args: Any, **kwargs: Any) -> Any:
+            before = cache_size() if cache_size is not None else None
+            first = state["calls"] == 0
+            state["calls"] += 1
+            t0 = time.perf_counter()
+            with tracer.span(name) as sp:
+                out = fn(*args, **kwargs)
+                if sync is not None:
+                    sync(out)
+            dt = time.perf_counter() - t0
+            hist.observe(dt)
+            compiled = (cache_size() > before if before is not None
+                        else first)
+            if compiled:
+                sp.set(compiled=True)
+                compiles.inc()
+                tracer.record_span("xla_compile", t0, dt, program=name)
+            return out
+
+        wrapped.__name__ = f"traced_{name}"
+        if cache_size is not None:
+            # keep the probe reachable through the wrapper so retrace
+            # counting (train_step.program_cache_size) still works
+            wrapped._cache_size = cache_size
+        return wrapped
+
+    def wrap_feeder(self, feed: Any) -> Any:
+        """Wrap a device feeder in ``dataload_wait`` accounting."""
+        if not self.enabled:
+            return feed
+        return _TracedFeeder(feed, self)
+
+    def compile_count(self) -> int:
+        return int(self.registry.counter("xla_compiles_total").value)
+
+    # -- shipping + export --------------------------------------------------
+
+    def publish(self, profiler: Any,
+                batches_trained: Optional[int] = None) -> None:
+        """Feed the profiler channel one registry snapshot (group
+        ``telemetry``) and, when ``ship_spans``, the span records finished
+        since the last publish (group ``span``). Called at the trainer's
+        chunk boundary, so shipping is batched and off the hot path."""
+        if not self.enabled or profiler is None:
+            return
+        now = time.time()
+        if self.ship_metrics:
+            sample: Dict[str, Any] = {
+                "time": now, "group": "telemetry",
+                "metrics": self.registry.snapshot(),
+            }
+            if batches_trained is not None:
+                sample["batches_trained"] = int(batches_trained)
+            profiler.record(sample)
+        if self.ship_spans:
+            new, self._ship_cursor = self.tracer.drain_since(
+                self._ship_cursor)
+            for rec in new:
+                profiler.record({"time": now, "group": "span", **rec})
+
+    def export_chrome_trace(self, path: Optional[str] = None) -> str:
+        path = path or self.trace_path or "trace.json"
+        return write_chrome_trace(
+            path, self.tracer.events(),
+            other_data={
+                "wall_epoch": self.tracer.wall_epoch,
+                "events_dropped": self.tracer.dropped,
+                "span_summary": self.tracer.span_summary(),
+            })
+
+    def span_summary(self) -> Dict[str, Dict[str, float]]:
+        return self.tracer.span_summary()
+
+
+def telemetry_from_config(config: Any) -> Optional[Telemetry]:
+    """Build from an experiment config's ``observability:`` block.
+
+    Accepts an :class:`ExperimentConfig` (reads ``.observability``) or a raw
+    config dict. Returns None when disabled — callers keep a no-telemetry
+    fast path instead of threading a disabled object through the hot loop.
+    ``DCT_OBSERVABILITY=1`` force-enables, mirroring ``DCT_PROFILING``.
+    """
+    obs = getattr(config, "observability", None)
+    if obs is None and isinstance(config, dict):
+        from determined_clone_tpu.config.experiment import ObservabilityConfig
+
+        try:
+            obs = ObservabilityConfig.from_dict(
+                config.get("observability") or {})
+        except Exception:
+            obs = ObservabilityConfig()
+    enabled = bool(obs is not None and obs.enabled)
+    if os.environ.get("DCT_OBSERVABILITY") == "1":
+        enabled = True
+    if not enabled:
+        return None
+    if obs is None:
+        from determined_clone_tpu.config.experiment import ObservabilityConfig
+
+        obs = ObservabilityConfig()
+    return Telemetry(
+        enabled=True,
+        max_events=obs.max_events,
+        ship_spans=obs.ship_spans,
+        ship_metrics=obs.ship_metrics,
+        trace_path=obs.trace_path,
+    )

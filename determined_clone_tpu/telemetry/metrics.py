@@ -1,0 +1,248 @@
+"""Metrics registry: Counter / Gauge / Histogram with Prometheus exposition.
+
+≈ the reference master's prometheus middleware (core.go:1189) on the trial
+side: the trainer, prefetcher, and ProfilerAgent all feed one registry, which
+renders the Prometheus text exposition format via :meth:`MetricsRegistry.dump`
+and ships structured snapshots to the master through the profiler channel
+(:meth:`MetricsRegistry.snapshot` → ``ProfilerAgent.record``).
+
+Histograms keep a bounded uniform reservoir (Vitter's algorithm R) plus exact
+count/sum/min/max, so streaming p50/p95/p99 are exact until ``reservoir_size``
+observations and statistically unbiased after. Quantiles interpolate linearly
+— the same estimator as ``numpy.percentile``'s default — so tests can compare
+directly against numpy.
+
+Everything here is stdlib-only and thread-safe (one lock per metric; the
+registry lock only guards the name table), and nothing spawns threads:
+telemetry rides the profiler's existing flush thread for shipping.
+"""
+from __future__ import annotations
+
+import bisect
+import random
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+
+def _valid_name(name: str) -> str:
+    out = "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+    if not out or out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+class Counter:
+    """Monotonically increasing count (Prometheus counter)."""
+
+    def __init__(self, name: str, help: str = "") -> None:
+        self.name = _valid_name(name)
+        self.help = help
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease (n={n})")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def expose(self) -> List[str]:
+        return [f"# TYPE {self.name} counter",
+                f"{self.name} {_fmt(self.value)}"]
+
+    def sample(self) -> Dict[str, Any]:
+        return {"type": "counter", "value": self.value}
+
+
+class Gauge:
+    """A value that goes up and down (Prometheus gauge)."""
+
+    def __init__(self, name: str, help: str = "") -> None:
+        self.name = _valid_name(name)
+        self.help = help
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def expose(self) -> List[str]:
+        return [f"# TYPE {self.name} gauge",
+                f"{self.name} {_fmt(self.value)}"]
+
+    def sample(self) -> Dict[str, Any]:
+        return {"type": "gauge", "value": self.value}
+
+
+class Histogram:
+    """Streaming distribution with reservoir-sampled quantiles.
+
+    Exposed as a Prometheus *summary* (quantile labels + _sum/_count): the
+    trial side wants p50/p95/p99 directly, not cumulative buckets that need
+    a server-side quantile estimator.
+    """
+
+    QUANTILES = (0.5, 0.95, 0.99)
+
+    def __init__(self, name: str, help: str = "", *,
+                 reservoir_size: int = 4096, seed: int = 0) -> None:
+        self.name = _valid_name(name)
+        self.help = help
+        self.reservoir_size = int(reservoir_size)
+        self._rng = random.Random(seed)  # deterministic for reproducibility
+        self._sample: List[float] = []
+        self._count = 0
+        self._sum = 0.0
+        self._min = float("inf")
+        self._max = float("-inf")
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            self._min = min(self._min, v)
+            self._max = max(self._max, v)
+            if len(self._sample) < self.reservoir_size:
+                bisect.insort(self._sample, v)
+            else:
+                # algorithm R: replace a uniform victim with prob k/n
+                # (the reservoir is kept sorted, but a uniform index into
+                # it is still a uniform victim)
+                j = self._rng.randrange(self._count)
+                if j < self.reservoir_size:
+                    self._sample.pop(j)
+                    bisect.insort(self._sample, v)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def percentile(self, q: float) -> float:
+        """q in [0, 100]; numpy-default linear interpolation over the
+        reservoir (exact while count <= reservoir_size)."""
+        if not 0.0 <= q <= 100.0:
+            raise ValueError(f"percentile q must be in [0, 100], got {q}")
+        with self._lock:
+            xs = list(self._sample)
+        if not xs:
+            return float("nan")
+        pos = (len(xs) - 1) * (q / 100.0)
+        lo = int(pos)
+        frac = pos - lo
+        if lo + 1 < len(xs):
+            return xs[lo] * (1.0 - frac) + xs[lo + 1] * frac
+        return xs[lo]
+
+    def expose(self) -> List[str]:
+        lines = [f"# TYPE {self.name} summary"]
+        for q in self.QUANTILES:
+            lines.append(f'{self.name}{{quantile="{q}"}} '
+                         f"{_fmt(self.percentile(100 * q))}")
+        lines.append(f"{self.name}_sum {_fmt(self.sum)}")
+        lines.append(f"{self.name}_count {self.count}")
+        return lines
+
+    def sample(self) -> Dict[str, Any]:
+        with self._lock:
+            count, total = self._count, self._sum
+            mn, mx = self._min, self._max
+        out: Dict[str, Any] = {"type": "histogram", "count": count,
+                               "sum": round(total, 6)}
+        if count:
+            out.update(
+                min=round(mn, 6), max=round(mx, 6),
+                p50=round(self.percentile(50), 6),
+                p95=round(self.percentile(95), 6),
+                p99=round(self.percentile(99), 6),
+            )
+        return out
+
+
+class MetricsRegistry:
+    """Name → metric table with get-or-create accessors.
+
+    Accessors are idempotent (same name returns the same instance) and
+    type-checked: registering ``foo`` as both a counter and a gauge is a
+    bug worth failing loudly on.
+    """
+
+    def __init__(self, prefix: str = "") -> None:
+        self.prefix = prefix
+        self._metrics: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, cls, name: str, help: str, **kw) -> Any:
+        name = self.prefix + name
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls):
+                    raise TypeError(
+                        f"metric {name!r} already registered as "
+                        f"{type(existing).__name__}, not {cls.__name__}")
+                return existing
+            metric = cls(name, help, **kw)
+            self._metrics[metric.name] = metric
+            return metric
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "", *,
+                  reservoir_size: int = 4096) -> Histogram:
+        return self._get_or_create(Histogram, name, help,
+                                   reservoir_size=reservoir_size)
+
+    def metrics(self) -> List[Any]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def dump(self) -> str:
+        """Prometheus text exposition (text/plain; version=0.0.4)."""
+        lines: List[str] = []
+        for metric in sorted(self.metrics(), key=lambda m: m.name):
+            if metric.help:
+                lines.append(f"# HELP {metric.name} {metric.help}")
+            lines.extend(metric.expose())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Structured state for shipping through the profiler channel."""
+        return {m.name: m.sample() for m in self.metrics()}
+
+
+def _fmt(v: float) -> str:
+    if v != v:  # NaN
+        return "NaN"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)

@@ -169,6 +169,21 @@ def make_train_step(
     return jax.jit(fn, **kwargs)
 
 
+def program_cache_size(fn: Any) -> Optional[int]:
+    """Best-effort size of a jitted callable's compilation cache, or None
+    when this jax version doesn't expose it. Growth between two reads means
+    a (re)trace+compile happened — ``telemetry.Telemetry.wrap_jit`` and
+    ``bench.py`` use this to count XLA compiles; traced wrappers propagate
+    the probe so the count survives instrumentation."""
+    probe = getattr(fn, "_cache_size", None)
+    if probe is None:
+        return None
+    try:
+        return int(probe())
+    except Exception:
+        return None
+
+
 def make_eval_step(
     eval_fn: Callable[[Any, Any], Dict[str, jax.Array]],
     *,

@@ -22,6 +22,7 @@ training_loop_performance.md):
 """
 from __future__ import annotations
 
+import logging
 import os
 import time
 from typing import Any, Dict, Iterator, Optional
@@ -32,6 +33,7 @@ from jax.sharding import NamedSharding
 
 from determined_clone_tpu.config.length import Length
 from determined_clone_tpu.core._serialization import load_pytree, save_pytree
+from determined_clone_tpu.telemetry.spans import null_span
 from determined_clone_tpu.training.metrics import MetricAccumulator
 from determined_clone_tpu.training.train_step import (
     TrainState,
@@ -44,6 +46,8 @@ from determined_clone_tpu.training.trial import JaxTrial
 from determined_clone_tpu.utils.data import make_device_feeder
 
 CKPT_STATE_DIR = "state"
+
+logger = logging.getLogger(__name__)
 
 
 def _skip_batches(it: Iterator[Any], n: int) -> int:
@@ -105,27 +109,41 @@ class Trainer:
         }
         if metric is not None:
             metadata["validation_metric"] = float(metric)
-        with ck.store_path(
-            metadata=metadata,
-            shard=sharded,
-        ) as (path, holder):
-            save_pytree(f"{path}/{CKPT_STATE_DIR}", state, host_id=dist.rank)
+        with self._span("checkpoint_save", reason=reason):
+            with ck.store_path(
+                metadata=metadata,
+                shard=sharded,
+            ) as (path, holder):
+                save_pytree(f"{path}/{CKPT_STATE_DIR}", state,
+                            host_id=dist.rank)
         return holder.get("storage_id", "")
 
     def _restore(self, storage_id: str, like: TrainState,
                  shardings: TrainState) -> tuple:
         ck = self.core.checkpoint
-        with ck.restore_path(storage_id) as path:
-            state = load_pytree(f"{path}/{CKPT_STATE_DIR}", like,
-                                shardings=shardings)
-            mpath = f"{path}/metadata.json"
-            meta: dict = {}
-            if os.path.exists(mpath):
-                with open(mpath) as f:
-                    import json
+        with self._span("checkpoint_restore"):
+            with ck.restore_path(storage_id) as path:
+                state = load_pytree(f"{path}/{CKPT_STATE_DIR}", like,
+                                    shardings=shardings)
+                mpath = f"{path}/metadata.json"
+                meta: dict = {}
+                if os.path.exists(mpath):
+                    with open(mpath) as f:
+                        import json
 
-                    meta = json.load(f)
+                        meta = json.load(f)
         return state, int(meta.get("steps_completed", 0))
+
+    @property
+    def _telemetry(self):
+        return getattr(self.core, "telemetry", None)
+
+    @property
+    def _span(self):
+        """The tracer's span factory, or the shared no-op when telemetry is
+        off — boundary-only call sites (save/restore/sync), never per batch."""
+        tel = self._telemetry
+        return tel.tracer.span if tel is not None else null_span
 
     # -- the loop -----------------------------------------------------------
 
@@ -195,6 +213,21 @@ class Trainer:
             batch_sharding=batch_sharding,
         )
 
+        # telemetry (observability: block; None when disabled — the hot loop
+        # below then runs the *unwrapped* callables and feeder, so the
+        # disabled path adds nothing per step). The sync makes each
+        # train_dispatch span cover device completion, not just enqueue.
+        tel = self._telemetry
+        span = tel.tracer.span if tel is not None else null_span
+        if tel is not None:
+            train_step = tel.wrap_jit("train_dispatch", train_step,
+                                      sync=jax.block_until_ready)
+            if fused_step is not None:
+                fused_step = tel.wrap_jit("train_dispatch", fused_step,
+                                          sync=jax.block_until_ready)
+            eval_step = tel.wrap_jit("eval_dispatch", eval_step,
+                                     sync=jax.block_until_ready)
+
         sched_unit = config.scheduling_unit
         val_period = self._to_batches(config.min_validation_period, 0)
         ckpt_period = self._to_batches(config.min_checkpoint_period, 0)
@@ -244,7 +277,11 @@ class Trainer:
             batch_gen, to_device,
             depth=prefetch_depth * k if prefetch_depth else 0,
             name="train-prefetch",
+            tracer=tel.tracer if tel is not None else None,
+            registry=tel.registry if tel is not None else None,
         )
+        if tel is not None:
+            feed = tel.wrap_feeder(feed)
 
         acc = MetricAccumulator()
         last_val: Dict[str, float] = {}
@@ -258,6 +295,11 @@ class Trainer:
         # local/unmanaged runs): profiler (≈ ProfilerAgent) + tensorboard
         profiler = self.core.profiler
         tb = self.core.tensorboard
+
+        # truncated validation must be visible: dropped remainder batches
+        # are counted (examples, not batches), surfaced once per fit in the
+        # log and continuously in a telemetry gauge
+        eval_dropped = {"examples": 0, "warned": False}
 
         def validate() -> Dict[str, float]:
             vdata = trial.validation_data()
@@ -276,19 +318,38 @@ class Trainer:
                     if first_shapes is None:
                         first_shapes = shapes
                     elif shapes != first_shapes:
+                        leaves = jax.tree.leaves(vb)
+                        n = int(np.shape(leaves[0])[0]) if (
+                            leaves and np.ndim(leaves[0])) else 1
+                        eval_dropped["examples"] += n
                         continue
                     yield vb
 
-            vacc = MetricAccumulator()
-            vfeed = make_device_feeder(
-                full_batches(), to_device,
-                depth=prefetch_depth, name="eval-prefetch")
-            try:
-                for vbatch in vfeed:
-                    vacc.add(eval_step(state, vbatch))
-            finally:
-                vfeed.close()
-            metrics = vacc.result() if len(vacc) else {}
+            with span("validate"):
+                vacc = MetricAccumulator()
+                vfeed = make_device_feeder(
+                    full_batches(), to_device,
+                    depth=prefetch_depth, name="eval-prefetch",
+                    tracer=tel.tracer if tel is not None else None)
+                try:
+                    for vbatch in vfeed:
+                        vacc.add(eval_step(state, vbatch))
+                finally:
+                    vfeed.close()
+                metrics = vacc.result() if len(vacc) else {}
+            if eval_dropped["examples"]:
+                if not eval_dropped["warned"]:
+                    eval_dropped["warned"] = True
+                    logger.warning(
+                        "validation dropped %d examples in shape-mismatched "
+                        "remainder batches (drop_remainder contract); pad "
+                        "or size the eval set to a batch multiple for full "
+                        "coverage", eval_dropped["examples"])
+                if tel is not None:
+                    tel.registry.gauge(
+                        "eval_examples_dropped",
+                        "eval examples lost to shape-mismatched remainder "
+                        "batches this fit").set(eval_dropped["examples"])
             if metrics:
                 self.core.train.report_validation_metrics(batches_trained, metrics)
                 if tb is not None:
@@ -328,7 +389,8 @@ class Trainer:
                             acc.add(metrics)
                             batches_trained += 1
                     # ---- reporting boundary (one host sync per chunk) ----
-                    train_metrics = acc.result()
+                    with span("host_sync"):
+                        train_metrics = acc.result()
                     dt = time.perf_counter() - t0
                     # queue-wait is the consumer-visible input stall (the
                     # overlap residue); host-time is the producer's true input
@@ -349,6 +411,10 @@ class Trainer:
                             compute_s=max(dt - t_wait, 0.0),
                             queue_wait_s=t_wait, steps_per_dispatch=k,
                             prefetch_depth=prefetch_depth)
+                    if tel is not None:
+                        # batched telemetry shipping rides the chunk
+                        # boundary (and the profiler's flush thread)
+                        tel.publish(profiler, batches_trained)
                     if tb is not None:
                         tb.add_scalars("training", train_metrics, batches_trained)
                     op.report_progress(batches_trained)

@@ -9,9 +9,18 @@
 # marked slow) — the same selection ROADMAP.md's verify command uses, and
 # the set the prefetch/fused-dispatch tests (tests/test_prefetch_fused.py)
 # ride in.
+#
+# `./run_tests.sh --observability` runs just the telemetry + profiler
+# surface (docs/observability.md): the telemetry core, profiler/tensorboard
+# shipping, the observability config round-trip, and the static checks.
 if [ "$1" = "--tier1" ]; then
     shift
     set -- tests/ -m "not slow" "$@"
+elif [ "$1" = "--observability" ]; then
+    shift
+    set -- tests/test_telemetry.py tests/test_profiler_tensorboard.py \
+        tests/test_observability_config.py tests/test_static_checks.py \
+        -m "not slow" "$@"
 fi
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}" \

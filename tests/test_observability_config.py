@@ -253,3 +253,61 @@ def test_master_config_endpoint(tmp_path):
     finally:
         proc.kill()
         proc.wait(timeout=10)
+
+
+def test_telemetry_samples_round_trip_through_master(tmp_path):
+    """Trial-shipped telemetry (registry snapshots + spans) lands under the
+    trial's profiler endpoint and converts back into a valid Chrome trace
+    — the `dct trace export` path, end to end against the real master."""
+    from determined_clone_tpu.profiler import ProfilerAgent
+    from determined_clone_tpu.telemetry import (
+        Telemetry,
+        spans_from_profiler_samples,
+        to_chrome_trace,
+        validate_chrome_trace,
+    )
+
+    if not build_binaries():
+        pytest.skip("C++ master build unavailable")
+    # kubernetes RM materializes trials without a real agent, and the
+    # profiler endpoint rejects unknown trial ids
+    proc, session, port = start_master(
+        tmp_path, "--rm", "kubernetes", "--kube-slots-per-pod", "8")
+    try:
+        exp = session.create_experiment({
+            "name": "obs-roundtrip", "entrypoint": "m:T",
+            "searcher": {"name": "single", "metric": "loss",
+                         "max_length": {"batches": 1}},
+            "resources": {"slots_per_trial": 1},
+            "observability": {"enabled": True, "ship_spans": True},
+        })
+        trial_id = session.get_experiment(exp["id"])["trials"][0]["id"]
+
+        tel = Telemetry(enabled=True, ship_spans=True)
+        prof = ProfilerAgent(session, trial_id, enabled=True,
+                             sample_system=False, registry=tel.registry)
+        prof.start()
+        tel.registry.counter("steps_total", "steps").inc(5)
+        tel.registry.histogram("train_dispatch_seconds", "x").observe(0.01)
+        with tel.tracer.span("train_dispatch", chunk=0):
+            pass
+        tel.publish(prof, batches_trained=5)
+        prof.stop()  # final flush
+        assert prof.samples_dropped == 0
+
+        samples = session.trial_profiler_samples(trial_id)
+        by_group = {}
+        for s in samples:
+            by_group.setdefault(s.get("group"), []).append(s)
+
+        (snap,) = by_group["telemetry"]
+        assert snap["batches_trained"] == 5
+        assert snap["metrics"]["steps_total"]["value"] == 5
+        assert snap["metrics"]["train_dispatch_seconds"]["count"] == 1
+
+        recs = spans_from_profiler_samples(samples)
+        assert [r["name"] for r in recs] == ["train_dispatch"]
+        assert validate_chrome_trace(to_chrome_trace(recs)) == []
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)

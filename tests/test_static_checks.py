@@ -1,0 +1,105 @@
+"""Tier-1 static checks: no silently swallowed exceptions.
+
+Runs tools/check_swallowed_exceptions.py over the library so a new bare
+``except Exception: pass`` without a justification comment fails the gate
+(the failure mode that hid profiler sample drops before
+``profiler_samples_dropped`` existed — see docs/observability.md).
+"""
+import sys
+import textwrap
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import check_swallowed_exceptions as csx  # noqa: E402
+
+
+def _violations(snippet, tmp_path):
+    f = tmp_path / "snippet.py"
+    f.write_text(textwrap.dedent(snippet))
+    return list(csx.check_file(f))
+
+
+def test_library_is_clean():
+    assert csx.main([str(REPO / "determined_clone_tpu")]) == 0
+
+
+def test_tools_and_bench_are_clean():
+    assert csx.main([str(REPO / "tools"), str(REPO / "bench.py")]) == 0
+
+
+def test_flags_uncommented_swallow(tmp_path):
+    v = _violations(
+        """
+        try:
+            work()
+        except Exception:
+            pass
+        """, tmp_path)
+    assert len(v) == 1
+    assert "except Exception" in v[0][1]
+
+
+def test_flags_bare_except_and_ellipsis(tmp_path):
+    v = _violations(
+        """
+        try:
+            work()
+        except:
+            ...
+        """, tmp_path)
+    assert len(v) == 1
+
+
+def test_comment_on_pass_line_suppresses(tmp_path):
+    assert _violations(
+        """
+        try:
+            work()
+        except Exception:
+            pass  # best-effort cleanup; never mask the original error
+        """, tmp_path) == []
+
+
+def test_comment_above_try_suppresses(tmp_path):
+    assert _violations(
+        """
+        # Transient poll failures must not kill training; the watcher
+        # retries on its next tick.
+        try:
+            work()
+        except Exception:
+            pass
+        """, tmp_path) == []
+
+
+def test_narrow_handler_is_fine(tmp_path):
+    assert _violations(
+        """
+        try:
+            work()
+        except KeyError:
+            pass
+        """, tmp_path) == []
+
+
+def test_broad_handler_with_real_body_is_fine(tmp_path):
+    assert _violations(
+        """
+        try:
+            work()
+        except Exception:
+            log.warning("work failed")
+        """, tmp_path) == []
+
+
+def test_tuple_including_broad_is_flagged(tmp_path):
+    v = _violations(
+        """
+        try:
+            work()
+        except (ValueError, Exception):
+            pass
+        """, tmp_path)
+    assert len(v) == 1

@@ -1,0 +1,647 @@
+"""Telemetry subsystem tests: spans, metrics registry, Chrome-trace export,
+and the trainer/prefetcher/profiler wiring (docs/observability.md)."""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from determined_clone_tpu.telemetry import (
+    NULL_SPAN,
+    Histogram,
+    MetricsRegistry,
+    Telemetry,
+    Tracer,
+    chrome_trace_events,
+    null_span,
+    spans_from_profiler_samples,
+    telemetry_from_config,
+    to_chrome_trace,
+    validate_chrome_trace,
+)
+
+
+# ---------------------------------------------------------------------------
+# Spans: nesting, ordering, determinism
+# ---------------------------------------------------------------------------
+
+class TestSpans:
+    def test_nesting_depth_and_order(self):
+        tr = Tracer()
+        with tr.span("outer"):
+            with tr.span("inner_a"):
+                pass
+            with tr.span("inner_b", tag=1):
+                pass
+        # spans record on exit: children before parent, siblings in order
+        names = [e["name"] for e in tr.events()]
+        assert names == ["inner_a", "inner_b", "outer"]
+        by_name = {e["name"]: e for e in tr.events()}
+        assert by_name["outer"]["depth"] == 0
+        assert by_name["inner_a"]["depth"] == 1
+        assert by_name["inner_b"]["depth"] == 1
+        assert by_name["inner_b"]["args"] == {"tag": 1}
+
+    def test_child_interval_inside_parent(self):
+        tr = Tracer()
+        with tr.span("parent"):
+            with tr.span("child"):
+                time.sleep(0.002)
+        by_name = {e["name"]: e for e in tr.events()}
+        p, c = by_name["parent"], by_name["child"]
+        assert p["ts_us"] <= c["ts_us"]
+        assert c["ts_us"] + c["dur_us"] <= p["ts_us"] + p["dur_us"] + 1
+
+    def test_set_merges_args(self):
+        tr = Tracer()
+        with tr.span("s", a=1) as sp:
+            sp.set(b=2)
+        (e,) = tr.events()
+        assert e["args"] == {"a": 1, "b": 2}
+
+    def test_instant_event(self):
+        tr = Tracer()
+        tr.instant("marker", k="v")
+        (e,) = tr.events()
+        assert e["ph"] == "i" and e["name"] == "marker"
+
+    def test_max_events_keeps_head_counts_drops(self):
+        tr = Tracer(max_events=3)
+        for i in range(5):
+            with tr.span(f"s{i}"):
+                pass
+        assert [e["name"] for e in tr.events()] == ["s0", "s1", "s2"]
+        assert tr.dropped == 2
+
+    def test_disabled_tracer_is_null(self):
+        tr = Tracer(enabled=False)
+        assert tr.span("x") is NULL_SPAN
+        with tr.span("x") as sp:
+            sp.set(ignored=True)
+        assert tr.events() == []
+
+    def test_null_span_is_reusable_noop(self):
+        with null_span("a", k=1) as sp:
+            sp.set(other=2)
+        with null_span("b") as sp2:
+            assert sp2 is sp
+
+    def test_span_summary_aggregates(self):
+        tr = Tracer()
+        for _ in range(3):
+            with tr.span("step"):
+                pass
+        tr.instant("marker")  # instants excluded from the summary
+        summary = tr.span_summary()
+        assert set(summary) == {"step"}
+        assert summary["step"]["count"] == 3
+        assert summary["step"]["total_s"] >= 0.0
+
+    def test_drain_since_cursor(self):
+        tr = Tracer()
+        with tr.span("a"):
+            pass
+        new, cur = tr.drain_since(0)
+        assert [e["name"] for e in new] == ["a"]
+        with tr.span("b"):
+            pass
+        new, cur = tr.drain_since(cur)
+        assert [e["name"] for e in new] == ["b"]
+        new, cur = tr.drain_since(cur)
+        assert new == []
+
+
+# ---------------------------------------------------------------------------
+# Thread safety: spans recorded from a producer thread interleave cleanly
+# ---------------------------------------------------------------------------
+
+class TestThreadSafety:
+    def test_spans_from_many_threads(self):
+        tr = Tracer()
+        n_threads, n_spans = 4, 200
+
+        def work(tid):
+            for i in range(n_spans):
+                with tr.span("w", i=i):
+                    if i % 50 == 0:
+                        time.sleep(0.0001)
+
+        threads = [threading.Thread(target=work, args=(t,), name=f"wk-{t}")
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        events = tr.events()
+        assert len(events) == n_threads * n_spans
+        # per-thread nesting stacks are thread-local: every span depth 0
+        assert all(e["depth"] == 0 for e in events)
+        assert len({e["tid"] for e in events}) == n_threads
+
+    def test_prefetch_producer_lane(self):
+        from determined_clone_tpu.utils.data import DevicePrefetcher
+
+        tr = Tracer()
+        reg = MetricsRegistry()
+        pf = DevicePrefetcher(iter(range(20)), put=lambda x: x * 2,
+                              depth=2, tracer=tr, registry=reg)
+        try:
+            got = list(pf)
+        finally:
+            pf.close()
+        assert got == [x * 2 for x in range(20)]
+        events = tr.events()
+        names = {e["name"] for e in events}
+        assert {"produce_batch", "dataload_next", "device_put"} <= names
+        # all producer spans live on the producer thread's lane
+        lanes = {e["tname"] for e in events}
+        assert lanes == {"device-prefetch"}
+        # nesting: device_put sits inside produce_batch
+        by = {}
+        for e in events:
+            by.setdefault(e["name"], []).append(e)
+        assert all(e["depth"] == 1 for e in by["device_put"])
+        assert all(e["depth"] == 0 for e in by["produce_batch"])
+        hist = reg.histogram("device_put_seconds")
+        assert hist.count == 20
+
+    def test_registry_concurrent_updates(self):
+        reg = MetricsRegistry()
+        c = reg.counter("hits", "test")
+        h = reg.histogram("lat", "test")
+
+        def work():
+            for i in range(500):
+                c.inc()
+                h.observe(i * 0.001)
+
+        threads = [threading.Thread(target=work) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == 2000
+        assert h.count == 2000
+
+
+# ---------------------------------------------------------------------------
+# Histogram percentiles vs numpy
+# ---------------------------------------------------------------------------
+
+class TestHistogram:
+    @pytest.mark.parametrize("q", [50, 95, 99])
+    def test_percentiles_match_numpy_when_unsampled(self, q):
+        rng = np.random.default_rng(7)
+        xs = rng.lognormal(mean=-3, sigma=1.0, size=1000)
+        h = Histogram("lat", "test", reservoir_size=4096)
+        for x in xs:
+            h.observe(float(x))
+        assert h.percentile(q) == pytest.approx(
+            np.percentile(xs, q), rel=1e-9)
+
+    def test_percentiles_close_under_reservoir_sampling(self):
+        rng = np.random.default_rng(11)
+        xs = rng.normal(loc=10.0, scale=2.0, size=20_000)
+        h = Histogram("lat", "test", reservoir_size=2048, seed=3)
+        for x in xs:
+            h.observe(float(x))
+        # reservoir is a uniform sample: quantiles agree statistically
+        assert h.percentile(50) == pytest.approx(
+            np.percentile(xs, 50), abs=0.3)
+        assert h.count == 20_000
+
+    def test_empty_histogram(self):
+        import math
+
+        h = Histogram("lat", "test")
+        assert math.isnan(h.percentile(50))
+        assert h.count == 0
+        with pytest.raises(ValueError):
+            h.percentile(101)
+
+    def test_prometheus_exposition_format(self):
+        reg = MetricsRegistry()
+        reg.counter("reqs_total", "requests").inc(3)
+        reg.gauge("depth", "queue depth").set(2.5)
+        h = reg.histogram("lat_seconds", "latency")
+        for v in (0.1, 0.2, 0.3):
+            h.observe(v)
+        text = reg.dump()
+        assert "# TYPE reqs_total counter" in text
+        assert "reqs_total 3" in text
+        assert "# TYPE depth gauge" in text
+        assert "depth 2.5" in text
+        assert '# TYPE lat_seconds summary' in text
+        assert 'lat_seconds{quantile="0.5"} 0.2' in text
+        assert "lat_seconds_count 3" in text
+
+    def test_registry_idempotent_and_type_checked(self):
+        reg = MetricsRegistry()
+        c1 = reg.counter("n", "x")
+        assert reg.counter("n", "x") is c1
+        with pytest.raises(TypeError):
+            reg.gauge("n", "x")
+
+    def test_counter_rejects_negative(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError):
+            reg.counter("n", "x").inc(-1)
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace export: schema validity
+# ---------------------------------------------------------------------------
+
+class TestChromeTrace:
+    def _trace(self):
+        tr = Tracer()
+        with tr.span("outer"):
+            with tr.span("inner"):
+                pass
+        tr.instant("mark")
+        return to_chrome_trace(tr.events())
+
+    def test_schema_valid(self):
+        trace = self._trace()
+        assert validate_chrome_trace(trace) == []
+        assert trace["displayTimeUnit"] == "ms"
+        phases = [e["ph"] for e in trace["traceEvents"]]
+        assert "M" in phases and "X" in phases and "i" in phases
+
+    def test_json_round_trip(self, tmp_path):
+        tel = Telemetry(enabled=True)
+        with tel.tracer.span("s"):
+            pass
+        path = tel.export_chrome_trace(str(tmp_path / "trace.json"))
+        with open(path) as f:
+            loaded = json.load(f)
+        assert validate_chrome_trace(loaded) == []
+        assert "wall_epoch" in loaded["otherData"]
+        assert loaded["otherData"]["span_summary"]["s"]["count"] == 1
+
+    def test_thread_lanes_have_metadata(self):
+        tr = Tracer()
+
+        def other():
+            with tr.span("bg"):
+                pass
+
+        t = threading.Thread(target=other, name="lane-two")
+        with tr.span("fg"):
+            pass
+        t.start()
+        t.join()
+        trace = to_chrome_trace(tr.events())
+        meta = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+        lane_names = {e["args"]["name"] for e in meta}
+        assert "lane-two" in lane_names
+        assert len(meta) == 2
+        # X events from the two threads use distinct remapped tids
+        tids = {e["tid"] for e in trace["traceEvents"] if e["ph"] == "X"}
+        assert len(tids) == 2
+
+    def test_validator_catches_problems(self):
+        bad = {"traceEvents": [
+            {"ph": "X", "name": "n", "pid": 1, "tid": 1},   # missing ts/dur
+            {"ph": "Z", "name": "n", "pid": 1, "tid": 1, "ts": 0},
+        ]}
+        problems = validate_chrome_trace(bad)
+        assert len(problems) >= 2
+
+    def test_spans_from_profiler_samples(self):
+        samples = [
+            {"group": "timing", "dataloading_s": 0.1},
+            {"group": "span", "name": "train_dispatch", "ts_us": 0,
+             "dur_us": 5, "tid": 1, "tname": "MainThread", "depth": 0},
+        ]
+        recs = spans_from_profiler_samples(samples)
+        assert len(recs) == 1
+        trace = to_chrome_trace(recs)
+        assert validate_chrome_trace(trace) == []
+
+
+# ---------------------------------------------------------------------------
+# wrap_jit: spans + compile detection
+# ---------------------------------------------------------------------------
+
+class TestWrapJit:
+    def test_detects_compiles_and_retraces(self):
+        import jax
+        import jax.numpy as jnp
+
+        tel = Telemetry(enabled=True)
+        fn = jax.jit(lambda x: x * 2)
+        cache = getattr(fn, "_cache_size", None)
+        wrapped = tel.wrap_jit("train_dispatch", fn,
+                               sync=jax.block_until_ready)
+        wrapped(jnp.ones((4,)))
+        wrapped(jnp.ones((4,)))          # cache hit: no new compile
+        assert tel.compile_count() == 1
+        if cache is not None:
+            wrapped(jnp.ones((8,)))      # new shape => retrace
+            assert tel.compile_count() == 2
+        names = [e["name"] for e in tel.tracer.events()]
+        assert "xla_compile" in names
+        assert names.count("train_dispatch") >= 2
+        hist = tel.registry.histogram("train_dispatch_seconds")
+        assert hist.count >= 2
+
+    def test_fallback_first_call_timing(self):
+        tel = Telemetry(enabled=True)
+        calls = []
+        wrapped = tel.wrap_jit("step", lambda x: calls.append(x) or x)
+        wrapped(1)
+        wrapped(2)
+        assert calls == [1, 2]
+        assert tel.compile_count() == 1  # first call counted as compile
+
+    def test_disabled_returns_same_objects(self):
+        tel = Telemetry(enabled=False)
+        fn = lambda x: x  # noqa: E731
+        feed = iter([1, 2])
+        assert tel.wrap_jit("step", fn) is fn
+        assert tel.wrap_feeder(feed) is feed
+
+    def test_traced_feeder_delegates_and_observes(self):
+        from determined_clone_tpu.utils.data import DevicePrefetcher
+
+        tel = Telemetry(enabled=True)
+        pf = DevicePrefetcher(iter(range(5)), depth=2)
+        feed = tel.wrap_feeder(pf)
+        try:
+            assert list(feed) == list(range(5))
+            assert feed.take_queue_wait() >= 0.0
+            assert feed.take_host_time() >= 0.0
+        finally:
+            feed.close()
+        hist = tel.registry.histogram("dataload_wait_seconds")
+        assert hist.count == 5
+        # 5 item pulls + the exhaustion pull (span exits via StopIteration)
+        assert [e["name"] for e in tel.tracer.events()].count(
+            "dataload_wait") == 6
+
+
+# ---------------------------------------------------------------------------
+# Publishing over the profiler channel
+# ---------------------------------------------------------------------------
+
+class FakeProfiler:
+    def __init__(self):
+        self.samples = []
+
+    def record(self, sample):
+        self.samples.append(sample)
+
+
+class TestPublish:
+    def test_metrics_snapshot_shipped(self):
+        tel = Telemetry(enabled=True)
+        tel.registry.counter("hits", "x").inc(7)
+        prof = FakeProfiler()
+        tel.publish(prof, batches_trained=42)
+        (s,) = prof.samples
+        assert s["group"] == "telemetry"
+        assert s["batches_trained"] == 42
+        assert s["metrics"]["hits"]["value"] == 7
+
+    def test_spans_shipped_incrementally(self):
+        tel = Telemetry(enabled=True, ship_spans=True, ship_metrics=False)
+        prof = FakeProfiler()
+        with tel.tracer.span("a"):
+            pass
+        tel.publish(prof)
+        with tel.tracer.span("b"):
+            pass
+        tel.publish(prof)
+        names = [s["name"] for s in prof.samples if s["group"] == "span"]
+        assert names == ["a", "b"]
+        # and the shipped form converts straight back to a valid trace
+        recs = spans_from_profiler_samples(prof.samples)
+        assert validate_chrome_trace(to_chrome_trace(recs)) == []
+
+    def test_profiler_drop_counter_wired(self):
+        from determined_clone_tpu.profiler import ProfilerAgent
+
+        class FailingSession:
+            def post(self, path, body, retryable=False):
+                raise ConnectionError("master unreachable")
+
+        reg = MetricsRegistry()
+        prof = ProfilerAgent(FailingSession(), 1, enabled=True,
+                             sample_system=False, registry=reg)
+        prof.start()
+        prof.record({"time": time.time(), "group": "timing"})
+        prof.stop()
+        assert reg.counter("profiler_samples_dropped").value >= 1
+        assert prof.samples_dropped >= 1
+
+
+# ---------------------------------------------------------------------------
+# Config plumbing
+# ---------------------------------------------------------------------------
+
+class TestConfig:
+    def test_disabled_by_default(self):
+        from determined_clone_tpu.config.experiment import ExperimentConfig
+
+        cfg = ExperimentConfig.from_dict({"name": "t"})
+        assert cfg.observability.enabled is False
+        assert telemetry_from_config(cfg) is None
+
+    def test_enabled_builds_telemetry(self):
+        from determined_clone_tpu.config.experiment import ExperimentConfig
+
+        cfg = ExperimentConfig.from_dict({
+            "name": "t",
+            "observability": {"enabled": True, "ship_spans": True,
+                              "max_events": 5000},
+        })
+        tel = telemetry_from_config(cfg)
+        assert tel is not None and tel.ship_spans
+        assert tel.tracer.max_events == 5000
+
+    def test_env_force_enable(self, monkeypatch):
+        from determined_clone_tpu.config.experiment import ExperimentConfig
+
+        monkeypatch.setenv("DCT_OBSERVABILITY", "1")
+        cfg = ExperimentConfig.from_dict({"name": "t"})
+        assert telemetry_from_config(cfg) is not None
+
+    def test_raw_dict_accepted(self):
+        tel = telemetry_from_config({"observability": {"enabled": True}})
+        assert tel is not None
+
+
+# ---------------------------------------------------------------------------
+# CLI: dct trace export --from-file
+# ---------------------------------------------------------------------------
+
+class TestCliExport:
+    def test_export_from_file(self, tmp_path, capsys):
+        from determined_clone_tpu.cli.cli import main
+
+        samples = [
+            {"group": "telemetry", "metrics": {}},
+            {"group": "span", "name": "train_dispatch", "ts_us": 10,
+             "dur_us": 100, "tid": 1, "tname": "MainThread", "depth": 0},
+        ]
+        src = tmp_path / "samples.jsonl"
+        src.write_text("\n".join(json.dumps(s) for s in samples) + "\n")
+        out = tmp_path / "trace.json"
+        rc = main(["trace", "export", "--from-file", str(src),
+                   "-o", str(out)])
+        assert rc in (0, None)
+        with open(out) as f:
+            trace = json.load(f)
+        assert validate_chrome_trace(trace) == []
+        assert any(e["ph"] == "X" for e in trace["traceEvents"])
+
+    def test_export_no_spans_errors(self, tmp_path):
+        from determined_clone_tpu.cli.cli import main
+
+        src = tmp_path / "samples.jsonl"
+        src.write_text(json.dumps({"group": "timing"}) + "\n")
+        rc = main(["trace", "export", "--from-file", str(src),
+                   "-o", str(tmp_path / "t.json")])
+        assert rc == 1
+
+
+# ---------------------------------------------------------------------------
+# Acceptance smoke: an instrumented training run end to end
+# ---------------------------------------------------------------------------
+
+class RecordingProfiler:
+    """Profiler-channel stand-in capturing what the trainer ships."""
+
+    def __init__(self):
+        self.samples = []
+
+    def record(self, sample):
+        self.samples.append(sample)
+
+    def record_batch_timing(self, batches, dataloading_s, compute_s,
+                            queue_wait_s=None, **kw):
+        self.samples.append({"group": "timing", "batches": batches,
+                             "dataloading_s": dataloading_s,
+                             "compute_s": compute_s,
+                             "queue_wait_s": queue_wait_s})
+
+
+class TestTrainerSmoke:
+    def _run(self, tmp_path, observability):
+        import jax
+        import optax
+        from determined_clone_tpu import core
+        from determined_clone_tpu.config import ExperimentConfig
+        from determined_clone_tpu.parallel import (
+            MeshSpec,
+            make_mesh,
+        )
+        from determined_clone_tpu.training import (
+            JaxTrial,
+            Trainer,
+            TrialContext,
+        )
+
+        class MatmulTrial(JaxTrial):
+            # big enough that device compute dominates Python overhead —
+            # the compute_s agreement check below needs that
+            def initial_params(self, rng):
+                import jax.numpy as jnp
+                return {"w": jnp.eye(512) * 0.1}
+
+            def optimizer(self):
+                return optax.sgd(0.01)
+
+            def loss(self, params, batch, rng):
+                import jax.numpy as jnp
+                h = batch @ params["w"]
+                h = jnp.tanh(h) @ params["w"]
+                return jnp.mean(h * h), {}
+
+            def training_data(self):
+                rng = np.random.default_rng(0)  # seeded
+                for _ in range(48):
+                    yield rng.standard_normal((32, 512)).astype(np.float32)
+
+            def validation_data(self):
+                rng = np.random.default_rng(1)
+                return [rng.standard_normal((32, 512)).astype(np.float32)]
+
+            @property
+            def global_batch_size(self):
+                return 32
+
+        cfg = ExperimentConfig.from_dict({
+            "searcher": {"name": "single", "metric": "loss",
+                         "max_length": {"batches": 48}},
+            "scheduling_unit": 16,
+            "checkpoint_storage": {"type": "shared_fs",
+                                   "host_path": str(tmp_path / "ckpt")},
+            "observability": observability,
+        })
+        prof = RecordingProfiler()
+        with core.init(config=cfg, trial_id=1) as cctx:
+            cctx.profiler = prof
+            mesh = make_mesh(MeshSpec(dp=1), jax.devices()[:1])
+            ctx = TrialContext(config=cfg, hparams={}, core=cctx, mesh=mesh)
+            result = Trainer(MatmulTrial(ctx)).fit()
+            tel = cctx.telemetry
+            events = tel.tracer.events() if tel is not None else []
+        return result, prof, events, cctx
+
+    def test_instrumented_run_meets_acceptance(self, tmp_path):
+        trace_path = str(tmp_path / "trace.json")
+        result, prof, events, cctx = self._run(
+            tmp_path, {"enabled": True, "trace_path": trace_path})
+        assert result["batches_trained"] == 48
+
+        # trace.json was written on core.init exit and is schema-valid
+        with open(trace_path) as f:
+            trace = json.load(f)
+        assert validate_chrome_trace(trace) == []
+
+        # spans from >= 2 threads: consumer loop + prefetch producer
+        lanes = {e["tname"] for e in events}
+        assert "MainThread" in lanes
+        assert any(n.startswith("train-prefetch") for n in lanes), lanes
+
+        # nesting: producer device_put sits inside produce_batch
+        assert any(e["name"] == "device_put" and e["depth"] == 1
+                   for e in events)
+
+        # the taxonomy's trainer-side spans all showed up
+        names = {e["name"] for e in events}
+        assert {"train_dispatch", "host_sync", "validate",
+                "checkpoint_save", "xla_compile"} <= names
+
+        # summed train_dispatch agrees with profiler compute_s within 10%
+        dispatch_s = sum(e["dur_us"] for e in events
+                         if e["name"] == "train_dispatch") / 1e6
+        compute_s = sum(s["compute_s"] for s in prof.samples
+                        if s["group"] == "timing")
+        assert compute_s > 0
+        assert abs(dispatch_s - compute_s) / compute_s < 0.10, (
+            f"train_dispatch sum {dispatch_s:.4f}s vs "
+            f"compute_s {compute_s:.4f}s")
+
+        # telemetry snapshots rode the profiler channel at chunk boundaries
+        snaps = [s for s in prof.samples if s.get("group") == "telemetry"]
+        assert len(snaps) == 3  # 48 batches / scheduling_unit 16
+        assert snaps[-1]["metrics"]["train_dispatch_seconds"]["count"] == 48
+
+    def test_disabled_adds_no_threads_or_events(self, tmp_path):
+        before = {t.name for t in threading.enumerate()
+                  if not t.name.startswith(("train-prefetch",
+                                            "eval-prefetch"))}
+        result, prof, events, cctx = self._run(tmp_path, {"enabled": False})
+        assert result["batches_trained"] == 48
+        assert cctx.telemetry is None
+        assert events == []
+        assert not any(s.get("group") == "telemetry" for s in prof.samples)
+        after = {t.name for t in threading.enumerate()
+                 if not t.name.startswith(("train-prefetch",
+                                           "eval-prefetch"))}
+        assert after <= before
+        assert not (tmp_path / "trace.json").exists()
