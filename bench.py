@@ -489,9 +489,11 @@ def _attempt(env: dict, budget: float, probe_budget: float | None) -> tuple:
         except Exception:  # noqa: BLE001
             pass
 
-    reader = threading.Thread(target=_reader, daemon=True)
+    reader = threading.Thread(target=_reader, daemon=True,
+                              name="bench-stdout-reader")
     reader.start()
-    err_reader = threading.Thread(target=_stderr_reader, daemon=True)
+    err_reader = threading.Thread(target=_stderr_reader, daemon=True,
+                                  name="bench-stderr-reader")
     err_reader.start()
     t0 = time.monotonic()
     timed_out = None

@@ -197,7 +197,7 @@ class MasterSession:
         (≈ master/internal/task/allgather)."""
         import time as _time
 
-        deadline = _time.time() + timeout
+        deadline = _time.monotonic() + timeout
         while True:
             resp = self.post(
                 f"/api/v1/allocations/{_q(allocation_id)}/allgather",
@@ -205,7 +205,7 @@ class MasterSession:
                 retryable=True)  # idempotent re-registration
             if resp.get("ready"):
                 return list(resp.get("data", []))
-            if _time.time() > deadline:
+            if _time.monotonic() > deadline:
                 raise MasterError(
                     408, f"allgather round {round} timed out with "
                          f"{resp.get('world_size')} members expected")

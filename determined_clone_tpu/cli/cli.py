@@ -473,6 +473,39 @@ def cmd_trace_export(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """Run the dctlint static-analysis suite (docs/static_analysis.md).
+    The linter lives in the repo's tools/ package (it is developer
+    tooling, not shipped library code), so resolve it relative to the
+    source checkout when it isn't already importable."""
+    try:
+        from tools.dctlint.__main__ import main as dctlint_main
+    except ImportError:
+        import determined_clone_tpu
+
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(determined_clone_tpu.__file__)))
+        if not os.path.isdir(os.path.join(repo_root, "tools", "dctlint")):
+            print("error: tools/dctlint not found — `dct lint` runs from "
+                  "a source checkout", file=sys.stderr)
+            return 2
+        sys.path.insert(0, repo_root)
+        from tools.dctlint.__main__ import main as dctlint_main
+
+    argv: List[str] = list(args.paths)
+    if args.select:
+        argv += ["--select", args.select]
+    if args.no_baseline:
+        argv.append("--no-baseline")
+    if args.write_baseline:
+        argv.append("--write-baseline")
+    if args.list_checkers:
+        argv.append("--list-checkers")
+    if args.json:
+        argv += ["--format", "json"]
+    return dctlint_main(argv)
+
+
 def _deploy_runner(args):
     from determined_clone_tpu.deploy import DryRunRunner, SubprocessRunner
 
@@ -1064,6 +1097,21 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--limit", type=int, default=100000,
                    help="max profiler samples to pull from the master")
     c.set_defaults(func=cmd_trace_export)
+
+    # lint (dctlint static analysis — docs/static_analysis.md)
+    c = sub.add_parser("lint",
+                       help="run the dctlint static-analysis suite over "
+                            "the source tree")
+    c.add_argument("paths", nargs="*", default=[],
+                   help="files/directories (default: the tier-1 set: "
+                        "determined_clone_tpu tools bench.py)")
+    c.add_argument("--select", default=None,
+                   help="comma-separated rule ids (e.g. JAX001,TIME001)")
+    c.add_argument("--no-baseline", action="store_true")
+    c.add_argument("--write-baseline", action="store_true")
+    c.add_argument("--list-checkers", action="store_true")
+    c.add_argument("--json", action="store_true")
+    c.set_defaults(func=cmd_lint)
 
     # deploy
     p_dep = sub.add_parser("deploy", help="cluster deployment")

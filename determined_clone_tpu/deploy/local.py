@@ -105,9 +105,9 @@ def cluster_up(*, n_agents: int = 1, slots_per_agent: int = 1,
     from determined_clone_tpu.api.client import MasterSession
 
     session = MasterSession("127.0.0.1", port, timeout=5, retries=2)
-    deadline = time.time() + wait_sec
+    deadline = time.monotonic() + wait_sec
     up = False
-    while time.time() < deadline:
+    while time.monotonic() < deadline:
         try:
             if len(session.list_agents()) >= n_agents:
                 up = True
@@ -172,8 +172,8 @@ def cluster_down(*, state_path: Optional[str] = None) -> Dict[str, Any]:
             except OSError:
                 pass
     # grace period, then hard-kill stragglers
-    deadline = time.time() + 10
-    while time.time() < deadline and any(_alive(p) for p in pids if p > 0):
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and any(_alive(p) for p in pids if p > 0):
         time.sleep(0.2)
     for pid in pids:
         if pid > 0 and _alive(pid):

@@ -280,7 +280,8 @@ def main(argv: List[str]) -> int:
 
     # graceful preemption: the agent SIGTERMs on preempt/kill
     def stop(signum: int, frame: Any) -> None:
-        threading.Thread(target=server.shutdown, daemon=True).start()
+        threading.Thread(target=server.shutdown, daemon=True,
+                         name="task-shutdown").start()
 
     signal.signal(signal.SIGTERM, stop)
     try:

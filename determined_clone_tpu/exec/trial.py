@@ -93,7 +93,7 @@ def do_rendezvous(session, info: ClusterInfo, addr: str) -> dict:
     rank-ordered ``members`` (member[0] carries the jax coordinator +
     control-plane ports) plus, for multislice gangs, ``n_slices`` and the
     per-rank ``slice_ids`` the scheduler assigned."""
-    deadline = time.time() + 300
+    deadline = time.monotonic() + 300
     while True:
         resp = session.post(
             f"/api/v1/allocations/{info.allocation_id}/rendezvous",
@@ -102,7 +102,7 @@ def do_rendezvous(session, info: ClusterInfo, addr: str) -> dict:
         )
         if resp.get("ready"):
             return resp
-        if time.time() > deadline:
+        if time.monotonic() > deadline:
             raise RuntimeError(
                 f"rendezvous timed out: {len(resp.get('members', []))}/"
                 f"{resp.get('world_size')} members present"

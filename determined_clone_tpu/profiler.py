@@ -88,7 +88,9 @@ class SystemMetricsThread(threading.Thread):
         self._stop_event = threading.Event()
         self._prev_cpu: Optional[List[int]] = None
         self._prev_net = _read_net_bytes()
-        self._prev_t = time.time()
+        # rate denominators use the monotonic clock (TIME001); the shipped
+        # sample's "time" field stays wall clock for the master's axes
+        self._prev_t = time.monotonic()
 
     def stop(self) -> None:
         self._stop_event.set()
@@ -98,8 +100,8 @@ class SystemMetricsThread(threading.Thread):
             self.sample_once()
 
     def sample_once(self) -> None:
-        now = time.time()
-        sample: Dict[str, Any] = {"time": now, "group": "system"}
+        now = time.monotonic()
+        sample: Dict[str, Any] = {"time": time.time(), "group": "system"}
 
         cpu = _read_proc_stat()
         if cpu and self._prev_cpu:

@@ -143,8 +143,8 @@ class ExperimentRef:
 
     def wait(self, timeout: float = 600, interval: float = 1.0) -> str:
         """Block until the experiment reaches a terminal state."""
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             state = self.state
             if state in TERMINAL_STATES:
                 return state

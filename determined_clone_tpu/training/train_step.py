@@ -185,14 +185,33 @@ def program_cache_size(fn: Any) -> Optional[int]:
 
 
 def make_eval_step(
-    eval_fn: Callable[[Any, Any], Dict[str, jax.Array]],
+    eval_fn: Callable[..., Dict[str, jax.Array]],
     *,
     state_sharding: Optional[TrainState] = None,
     batch_sharding: Optional[Any] = None,
+    rng: Optional[jax.Array] = None,
 ) -> Callable[[TrainState, Any], Dict[str, jax.Array]]:
-    """Jitted evaluation step over params only."""
+    """Jitted evaluation step over params only.
+
+    When ``rng`` is given and ``eval_fn`` declares an ``rng`` parameter,
+    each call receives ``fold_in(rng, state.step)`` — derived from the
+    experiment's seeded chain and fresh per validation boundary, never the
+    constant-key-per-eval antipattern (JAX002). Trials with the plain
+    ``(params, batch)`` signature are called unchanged.
+    """
+    import inspect
+
+    wants_rng = False
+    if rng is not None:
+        try:
+            wants_rng = "rng" in inspect.signature(eval_fn).parameters
+        except (TypeError, ValueError):
+            wants_rng = False
 
     def step_fn(state: TrainState, batch: Any):
+        if wants_rng:
+            return eval_fn(state.params, batch,
+                           rng=jax.random.fold_in(rng, state.step))
         return eval_fn(state.params, batch)
 
     kwargs: Dict[str, Any] = {}

@@ -169,6 +169,10 @@ class Trainer:
 
         rng = jax.random.PRNGKey(config.experiment_seed)
         init_rng, state_rng = jax.random.split(rng)
+        # eval keys branch off the same seeded chain via fold_in (not a
+        # 3-way split) so init/state keys — and restored runs — are
+        # unchanged from earlier versions
+        eval_rng = jax.random.fold_in(rng, 1)
         params = trial.initial_params(init_rng)
         tx = trial.optimizer()
         state = create_train_state(params, tx, state_rng)
@@ -210,7 +214,7 @@ class Trainer:
             )
         eval_step = make_eval_step(
             trial.eval_metrics, state_sharding=shardings,
-            batch_sharding=batch_sharding,
+            batch_sharding=batch_sharding, rng=eval_rng,
         )
 
         # telemetry (observability: block; None when disabled — the hot loop

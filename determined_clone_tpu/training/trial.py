@@ -9,7 +9,7 @@ JaxTrial therefore declares pure functions over pytrees:
   optimizer()                    ≈ wrap_optimizer (an optax transformation —
                                     LR schedules are optax schedules, ≈ wrap_lr_scheduler)
   loss(params, batch, rng)       ≈ train_batch (traced; returns loss, metrics)
-  eval_metrics(params, batch)    ≈ evaluate_batch (traced)
+  eval_metrics(params, batch[, rng])  ≈ evaluate_batch (traced)
   sharding_rules()               parallelism layout (≈ DeepSpeed config / MPU)
   training_data()/validation_data()  ≈ build_training_data_loader
 
@@ -84,9 +84,20 @@ class JaxTrial(abc.ABC):
 
     # -- optional -----------------------------------------------------------
 
-    def eval_metrics(self, params: Any, batch: Any) -> Dict[str, jax.Array]:
-        """Traced. Per-batch validation metrics (mean-reduced across batches)."""
-        loss, metrics = self.loss(params, batch, jax.random.PRNGKey(0))
+    def eval_metrics(self, params: Any, batch: Any,
+                     rng: Optional[jax.Array] = None
+                     ) -> Dict[str, jax.Array]:
+        """Traced. Per-batch validation metrics (mean-reduced across batches).
+
+        ``rng`` is threaded by the Trainer off the experiment's seeded key
+        chain (``make_eval_step`` folds the train step count in, so every
+        validation sees fresh randomness — never a constant reused key).
+        Direct callers that pass no key get one derived from the
+        experiment seed. Overrides with the plain ``(params, batch)``
+        signature keep working; declare ``rng`` to receive the key."""
+        if rng is None:
+            rng = jax.random.PRNGKey(self.context.config.experiment_seed)
+        loss, metrics = self.loss(params, batch, rng)
         return {"loss": loss, **metrics}
 
     def validation_data(self) -> Optional[Iterable[Any]]:

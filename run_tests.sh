@@ -13,7 +13,15 @@
 # `./run_tests.sh --observability` runs just the telemetry + profiler
 # surface (docs/observability.md): the telemetry core, profiler/tensorboard
 # shipping, the observability config round-trip, and the static checks.
-if [ "$1" = "--tier1" ]; then
+#
+# `./run_tests.sh --lint` runs the dctlint static-analysis suite over the
+# tier-1 lint set (docs/static_analysis.md) — the same run
+# tests/test_static_checks.py gates in CI.
+if [ "$1" = "--lint" ]; then
+    shift
+    exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python -m tools.dctlint determined_clone_tpu tools bench.py "$@"
+elif [ "$1" = "--tier1" ]; then
     shift
     set -- tests/ -m "not slow" "$@"
 elif [ "$1" = "--observability" ]; then

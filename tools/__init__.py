@@ -1,0 +1,4 @@
+"""Repo-local developer tooling (not shipped with the library).
+
+A package so ``python -m tools.dctlint`` resolves from the repo root.
+"""

@@ -1,92 +1,40 @@
 #!/usr/bin/env python3
 """Static check: no silently swallowed exceptions without a stated reason.
 
-Flags every ``except Exception:`` / ``except BaseException:`` / bare
-``except:`` handler whose body is only ``pass`` (or ``...``) unless a
-justification comment sits adjacent to it. "Adjacent" means any ``#``
-comment in the window from three lines above the ``except`` line through
-one line below the handler body — that covers a comment on the ``pass``
-line, on the ``except`` line, a block comment just above the ``try``, or
-a trailing note after the handler.
-
-Motivated by the telemetry work (docs/observability.md): a swallowed
-exception with no counter and no comment is exactly how sample drops went
-invisible in the profiler's ``_post``. Narrow handlers (``except
-KeyError:`` etc.) are fine — catching a specific error and ignoring it is
-a statement in itself; catching *everything* and ignoring it needs words.
+Thin compatibility shim — the check now lives in the dctlint framework as
+rule **EXC001** (tools/dctlint/checkers/exceptions.py; catalog in
+docs/static_analysis.md). Existing invocations keep working:
 
 Usage: ``python tools/check_swallowed_exceptions.py [paths...]``
 Defaults to ``determined_clone_tpu/``. Exit 0 = clean, 1 = violations.
-Runs in tier-1 via tests/test_static_checks.py.
+Prefer ``python -m tools.dctlint`` for the full checker suite.
 """
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 from typing import Iterator, List, Tuple
 
-BROAD = ("Exception", "BaseException")
-COMMENT_WINDOW_ABOVE = 3
+# importable both as `tools.check_swallowed_exceptions` and as a top-level
+# module with tools/ on sys.path (how tests/test_static_checks.py loads it)
+_REPO_ROOT = str(Path(__file__).resolve().parent.parent)
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
-
-def _is_broad(handler: ast.ExceptHandler) -> bool:
-    t = handler.type
-    if t is None:  # bare except:
-        return True
-    if isinstance(t, ast.Name):
-        return t.id in BROAD
-    if isinstance(t, ast.Tuple):
-        return any(isinstance(e, ast.Name) and e.id in BROAD for e in t.elts)
-    return False
-
-
-def _is_noop_body(body: List[ast.stmt]) -> bool:
-    if len(body) != 1:
-        return False
-    stmt = body[0]
-    if isinstance(stmt, ast.Pass):
-        return True
-    return (isinstance(stmt, ast.Expr)
-            and isinstance(stmt.value, ast.Constant)
-            and stmt.value.value is Ellipsis)
-
-
-def _has_adjacent_comment(lines: List[str], handler: ast.ExceptHandler) -> bool:
-    start = max(0, handler.lineno - 1 - COMMENT_WINDOW_ABOVE)
-    end = min(len(lines), (handler.body[-1].end_lineno or handler.lineno) + 1)
-    return any("#" in line for line in lines[start:end])
+from tools.dctlint import core  # noqa: E402  (registers checkers on import)
 
 
 def check_file(path: Path) -> Iterator[Tuple[int, str]]:
-    source = path.read_text()
-    try:
-        tree = ast.parse(source, filename=str(path))
-    except SyntaxError as e:
-        yield (e.lineno or 0, f"syntax error: {e.msg}")
-        return
-    lines = source.splitlines()
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        if _is_broad(node) and _is_noop_body(node.body) \
-                and not _has_adjacent_comment(lines, node):
-            what = ast.unparse(node.type) if node.type else "<bare>"
-            yield (node.lineno,
-                   f"swallowed `except {what}: pass` with no adjacent "
-                   f"justification comment")
+    """(lineno, message) per violation — the original script's contract."""
+    for d in core.lint_file(Path(path), select=["EXC001"]):
+        yield (d.line, d.message)
 
 
 def main(argv: List[str]) -> int:
-    roots = [Path(p) for p in (argv or ["determined_clone_tpu"])]
-    violations = []
-    for root in roots:
-        files = sorted(root.rglob("*.py")) if root.is_dir() else [root]
-        for f in files:
-            for lineno, msg in check_file(f):
-                violations.append(f"{f}:{lineno}: {msg}")
-    for v in violations:
-        print(v)
+    roots = argv or ["determined_clone_tpu"]
+    violations = core.run(roots, select=["EXC001"], baseline=None)
+    for d in violations:
+        print(f"{d.path}:{d.line}: {d.message}")
     if violations:
         print(f"\n{len(violations)} swallowed-exception violation(s). "
               f"Either narrow the handler, count the drop in a telemetry "

@@ -1,0 +1,95 @@
+"""CLI entry: ``python -m tools.dctlint [paths...]`` (also ``dct lint``).
+
+Exit codes: 0 clean, 1 violations, 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+from tools.dctlint import CHECKERS, DEFAULT_PATHS, core
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dctlint",
+        description="project-specific AST static analysis "
+                    "(docs/static_analysis.md)")
+    p.add_argument("paths", nargs="*", default=None,
+                   help=f"files/directories (default: "
+                        f"{' '.join(DEFAULT_PATHS)})")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                   help="baseline JSON of grandfathered violations")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report baselined violations too")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current violations to the baseline file "
+                        "(each entry then needs a real justification)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--no-hints", action="store_true",
+                   help="omit fix hints from text output")
+    p.add_argument("--list-checkers", action="store_true")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_checkers:
+        for rule in sorted(CHECKERS):
+            c = CHECKERS[rule]
+            print(f"{rule}  {c.title}")
+        return 0
+
+    select: Optional[List[str]] = None
+    if args.select:
+        select = [r.strip() for r in args.select.split(",") if r.strip()]
+        unknown = [r for r in select if r not in CHECKERS]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)} "
+                  f"(see --list-checkers)", file=sys.stderr)
+            return 2
+
+    paths = args.paths or [str(REPO_ROOT / p) for p in DEFAULT_PATHS]
+    missing = [p for p in paths if not Path(p).exists()]
+    if missing:
+        print(f"no such path(s): {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    baseline = None if args.no_baseline else Path(args.baseline)
+    if args.write_baseline:
+        diags = core.run(paths, select=select, baseline=None,
+                         relative_to=REPO_ROOT)
+        n = core.write_baseline(Path(args.baseline), diags)
+        print(f"wrote {n} baseline entr{'y' if n == 1 else 'ies'} to "
+              f"{args.baseline} — fill in the justifications")
+        return 0
+
+    diags = core.run(paths, select=select, baseline=baseline,
+                     relative_to=REPO_ROOT)
+
+    if args.format == "json":
+        print(json.dumps([dataclasses.asdict(d) for d in diags], indent=2))
+    else:
+        for d in diags:
+            print(d.format(show_hint=not args.no_hints))
+        if diags:
+            rules = sorted({d.rule for d in diags})
+            print(f"\n{len(diags)} violation(s) [{', '.join(rules)}]. "
+                  f"Fix, suppress inline with "
+                  f"`# dctlint: disable=RULE <reason>`, or baseline with "
+                  f"a justification (docs/static_analysis.md).")
+    return 1 if diags else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

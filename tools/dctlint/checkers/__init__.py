@@ -1,0 +1,8 @@
+"""Checker modules. Importing this package registers every checker;
+add a new module here to enroll it (docs/static_analysis.md §adding)."""
+from tools.dctlint.checkers import (  # noqa: F401  (import = registration)
+    concurrency,
+    exceptions,
+    jax_checks,
+    timeutils,
+)

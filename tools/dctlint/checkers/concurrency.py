@@ -1,0 +1,165 @@
+"""Concurrency checkers: thread attribution and lock discipline.
+
+CONC001 exists because the conftest thread-leak fixture attributes leaks
+*by thread name* — an anonymous ``Thread-3`` survivor is undiagnosable,
+and an un-``daemon`` library thread can hang interpreter exit. CONC002 is
+the classic leak: an ``acquire()`` whose ``release()`` is skipped by an
+exception between them deadlocks every later acquirer.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional
+
+from tools.dctlint.core import Checker, Diagnostic, FileContext, register
+
+THREAD_NAMES = {"threading.Thread"}
+
+
+def _thread_ctor_problems(call: ast.Call) -> Optional[List[str]]:
+    """Which of daemon=/name= are missing, or None when undecidable
+    (a ``**kwargs`` splat may carry them)."""
+    if any(kw.arg is None for kw in call.keywords):
+        return None
+    present = {kw.arg for kw in call.keywords}
+    return [k for k in ("daemon", "name") if k not in present]
+
+
+@register
+class ThreadNeedsDaemonAndName(Checker):
+    rule = "CONC001"
+    title = "threading.Thread without explicit daemon= and name="
+    hint = ("pass daemon= (an explicit lifetime decision) and name= (so "
+            "the conftest thread-leak fixture can attribute a survivor)")
+
+    def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
+        thread_classes = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef) and any(
+                    (ctx.qualified_name(b) or "") in THREAD_NAMES
+                    for b in node.bases):
+                thread_classes.add(node)
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if (ctx.qualified_name(node.func) or "") in THREAD_NAMES:
+                # direct construction — unless it's the super().__init__
+                # pattern's import site; handled below per subclass
+                missing = _thread_ctor_problems(node)
+                if missing:
+                    yield self.diag(
+                        ctx, node,
+                        f"threading.Thread(...) missing "
+                        f"{' and '.join(f'{m}=' for m in missing)}")
+
+        for cls in thread_classes:
+            yield from self._check_subclass(ctx, cls)
+
+    def _check_subclass(self, ctx: FileContext,
+                        cls: ast.ClassDef) -> Iterator[Diagnostic]:
+        init = next((n for n in cls.body
+                     if isinstance(n, ast.FunctionDef)
+                     and n.name == "__init__"), None)
+        if init is None:
+            yield self.diag(
+                ctx, cls,
+                f"Thread subclass '{cls.name}' has no __init__ forwarding "
+                f"daemon= and name= to super().__init__")
+            return
+        for node in ast.walk(init):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "__init__" \
+                    and isinstance(node.func.value, ast.Call) \
+                    and (ctx.qualified_name(node.func.value.func)
+                         == "super"):
+                missing = _thread_ctor_problems(node)
+                if missing:
+                    yield self.diag(
+                        ctx, node,
+                        f"'{cls.name}.__init__' super().__init__() missing "
+                        f"{' and '.join(f'{m}=' for m in missing)}")
+                return
+        yield self.diag(
+            ctx, init,
+            f"Thread subclass '{cls.name}.__init__' never calls "
+            f"super().__init__(daemon=..., name=...)")
+
+
+def _enclosing_statement(ctx: FileContext, node: ast.AST) -> ast.stmt:
+    """The statement to reason about siblings of: hop out of expressions,
+    and out of an If/While *test* to the If/While itself."""
+    cur = node
+    while True:
+        parent = ctx.parents.get(cur)
+        if parent is None or isinstance(cur, ast.stmt):
+            if isinstance(parent, (ast.If, ast.While)) \
+                    and getattr(parent, "test", None) is cur:
+                return parent
+            if isinstance(cur, ast.stmt):
+                return cur
+        if parent is None:
+            return cur  # pragma: no cover - module node fallback
+        if isinstance(parent, (ast.If, ast.While)) and parent.test is cur:
+            return parent
+        cur = parent
+
+
+def _try_releases(try_node: ast.Try) -> bool:
+    for stmt in try_node.finalbody:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "release":
+                return True
+    return False
+
+
+@register
+class AcquireWithoutRelease(Checker):
+    rule = "CONC002"
+    title = "Lock.acquire() outside with / try-finally"
+    hint = ("prefer `with lock:`; when acquire() must be explicit, the "
+            "very next statement must be try/finally: lock.release()")
+
+    def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"):
+                continue
+            if self._protected(ctx, node):
+                continue
+            yield self.diag(
+                ctx, node,
+                f"{ast.unparse(node.func)}() without a guaranteed release "
+                f"— an exception before release() deadlocks every later "
+                f"acquirer")
+
+    def _protected(self, ctx: FileContext, call: ast.Call) -> bool:
+        # inside a Try whose finally releases
+        cur: Optional[ast.AST] = call
+        while cur is not None:
+            parent = ctx.parents.get(cur)
+            if isinstance(parent, ast.Try) and cur in parent.body \
+                    and _try_releases(parent):
+                return True
+            cur = parent
+        # the statement right after the acquire is try/finally: release
+        stmt = _enclosing_statement(ctx, call)
+        parent = ctx.parents.get(stmt)
+        for field in ("body", "orelse", "finalbody"):
+            siblings = getattr(parent, field, None)
+            if isinstance(siblings, list) and stmt in siblings:
+                i = siblings.index(stmt)
+                nxt = siblings[i + 1] if i + 1 < len(siblings) else None
+                if isinstance(nxt, ast.Try) and _try_releases(nxt):
+                    return True
+                # `if lock.acquire(timeout=..):` guarding a try/finally body
+                if stmt is not call and isinstance(stmt, (ast.If, ast.While)):
+                    body = getattr(stmt, "body", [])
+                    if body and isinstance(body[0], ast.Try) \
+                            and _try_releases(body[0]):
+                        return True
+        return False

@@ -1,0 +1,71 @@
+"""EXC001 — no silently swallowed exceptions without a stated reason.
+
+The PR 2 gate (``tools/check_swallowed_exceptions.py``), migrated into the
+framework; that script is now a thin shim over this checker. Flags every
+``except Exception:`` / ``except BaseException:`` / bare ``except:``
+handler whose body is only ``pass`` (or ``...``) unless a justification
+comment sits adjacent — any ``#`` comment from three lines above the
+``except`` through one line below the handler body. Narrow handlers
+(``except KeyError:`` etc.) are fine: catching a specific error and
+ignoring it is a statement in itself; catching *everything* silently
+needs words (see docs/observability.md — this is how profiler sample
+drops went invisible).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from tools.dctlint.core import Checker, Diagnostic, FileContext, register
+
+BROAD = ("Exception", "BaseException")
+COMMENT_WINDOW_ABOVE = 3
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare except:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in BROAD
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in BROAD for e in t.elts)
+    return False
+
+
+def _is_noop_body(body: List[ast.stmt]) -> bool:
+    if len(body) != 1:
+        return False
+    stmt = body[0]
+    if isinstance(stmt, ast.Pass):
+        return True
+    return (isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis)
+
+
+def _has_adjacent_comment(lines: List[str],
+                          handler: ast.ExceptHandler) -> bool:
+    start = max(0, handler.lineno - 1 - COMMENT_WINDOW_ABOVE)
+    end = min(len(lines), (handler.body[-1].end_lineno or handler.lineno) + 1)
+    return any("#" in line for line in lines[start:end])
+
+
+@register
+class SwallowedException(Checker):
+    rule = "EXC001"
+    title = "broad except with silent pass and no justification"
+    hint = ("narrow the handler, count the drop in a telemetry counter, "
+            "or add a comment saying why silence is correct")
+
+    def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _is_broad(node) and _is_noop_body(node.body) \
+                    and not _has_adjacent_comment(ctx.lines, node):
+                what = ast.unparse(node.type) if node.type else "<bare>"
+                yield self.diag(
+                    ctx, node,
+                    f"swallowed `except {what}: pass` with no adjacent "
+                    f"justification comment")

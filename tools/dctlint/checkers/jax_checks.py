@@ -1,0 +1,275 @@
+"""JAX tracing checkers: host syncs in jit, RNG key hygiene, donation.
+
+Why these are linted rather than reviewed: inside ``jax.jit`` the Python
+body runs ONCE at trace time, so a ``print``/``np.*``/``.item()`` either
+silently prints tracers, forces a device→host sync that serializes the
+pipeline, or is constant-folded into the compiled program — none of which
+fail a test. Same for a constant ``PRNGKey``: the program is *correct*,
+just statistically wrong (every step sees the same dropout mask). These
+only surface as perf cliffs or bad convergence, which is exactly what
+static analysis is for (ISSUE 3; docs/static_analysis.md).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set
+
+from tools.dctlint.core import Checker, Diagnostic, FileContext, register
+
+JIT_NAMES = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit", "pjit"}
+SCAN_NAMES = {"jax.lax.scan", "lax.scan"}
+KEY_NAMES = {"jax.random.PRNGKey", "jax.random.key"}
+KEY_CONSUMERS = {"jax.random.split", "jax.random.fold_in"}
+
+# per-step / loss-shaped function names: the ones called once per batch,
+# where a constant key means every step reuses the same randomness
+PER_STEP_NAME = re.compile(r"(^|_)(loss|step|train|eval|metric)", re.I)
+TRAIN_STEP_NAME = re.compile(r"train|(^|_)step(_|$)", re.I)
+
+
+def _call_qname(ctx: FileContext, call: ast.Call) -> Optional[str]:
+    return ctx.qualified_name(call.func)
+
+
+def _decorator_traces(ctx: FileContext, dec: ast.expr) -> bool:
+    """True when a decorator jits the function: ``@jax.jit``, ``@pjit``,
+    ``@partial(jax.jit, ...)`` or ``@jax.jit(...)`` parameterized."""
+    if isinstance(dec, ast.Call):
+        name = ctx.qualified_name(dec.func) or ""
+        if name in JIT_NAMES:
+            return True
+        if name in ("functools.partial", "partial"):
+            return bool(dec.args) and (
+                ctx.qualified_name(dec.args[0]) in JIT_NAMES)
+        return False
+    return (ctx.qualified_name(dec) or "") in JIT_NAMES
+
+
+def _traced_functions(ctx: FileContext) -> Set[ast.AST]:
+    """Function/lambda nodes whose bodies run under trace: jit-decorated
+    defs, defs passed to ``jax.jit``/``pjit``/``lax.scan`` (through one
+    level of ``alias = fn`` indirection), and everything nested inside."""
+    defs_by_name: Dict[str, List[ast.FunctionDef]] = {}
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.FunctionDef):
+            defs_by_name.setdefault(node.name, []).append(node)
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Name):
+            aliases[node.targets[0].id] = node.value.id
+
+    traced: Set[ast.AST] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.FunctionDef):
+            if any(_decorator_traces(ctx, d) for d in node.decorator_list):
+                traced.add(node)
+        elif isinstance(node, ast.Call):
+            name = _call_qname(ctx, node) or ""
+            if name not in JIT_NAMES and name not in SCAN_NAMES:
+                continue
+            if not node.args:
+                continue
+            fn = node.args[0]
+            if isinstance(fn, ast.Lambda):
+                traced.add(fn)
+            elif isinstance(fn, ast.Name):
+                target = aliases.get(fn.id, fn.id)
+                for d in defs_by_name.get(target, []):
+                    traced.add(d)
+    # nested defs trace with their parent
+    closure: Set[ast.AST] = set()
+    for root in traced:
+        for sub in ast.walk(root):
+            if isinstance(sub, (ast.FunctionDef, ast.Lambda)):
+                closure.add(sub)
+    return closure
+
+
+@register
+class HostSyncInJit(Checker):
+    rule = "JAX001"
+    title = "host sync / side effect inside traced code"
+    hint = ("use jax.debug.print / jnp.* inside jit; move host conversions "
+            "(.item(), float()) outside the traced function or behind "
+            "jax.block_until_ready at a reporting boundary")
+
+    def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
+        traced = _traced_functions(ctx)
+        seen: Set[ast.AST] = set()
+        for root in traced:
+            for node in ast.walk(root):
+                if not isinstance(node, ast.Call) or node in seen:
+                    continue
+                seen.add(node)
+                name = _call_qname(ctx, node) or ""
+                if name == "print":
+                    yield self.diag(ctx, node,
+                                    "print() inside a jitted/scanned "
+                                    "function runs at trace time only (and "
+                                    "prints tracers)")
+                elif name.split(".")[0] == "numpy":
+                    yield self.diag(ctx, node,
+                                    f"{name}() inside a jitted/scanned "
+                                    f"function forces a host round-trip or "
+                                    f"constant-folds at trace time")
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "item" and not node.args:
+                    yield self.diag(ctx, node,
+                                    ".item() inside a jitted/scanned "
+                                    "function is a blocking device->host "
+                                    "sync")
+                elif name == "float" and node.args \
+                        and not isinstance(node.args[0], ast.Constant):
+                    yield self.diag(ctx, node,
+                                    "float() on a traced value is a "
+                                    "blocking device->host sync")
+
+
+def _enclosing_def_names(ctx: FileContext, node: ast.AST) -> List[str]:
+    return [f.name for f in ctx.enclosing_functions(node)
+            if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+@register
+class ConstantKeyReuse(Checker):
+    rule = "JAX002"
+    title = "constant PRNGKey in per-step code / key reused without split"
+    hint = ("thread a key from the seeded rng chain (jax.random.split / "
+            "fold_in) instead of re-deriving a constant key per call")
+
+    def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
+        # (a) constant PRNGKey inside loss/step/eval-shaped functions:
+        # the same key every invocation means the same dropout mask /
+        # noise every step — silently wrong statistics
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and (_call_qname(ctx, node) or "") in KEY_NAMES \
+                    and node.args \
+                    and all(isinstance(a, ast.Constant) for a in node.args):
+                names = _enclosing_def_names(ctx, node)
+                if names and PER_STEP_NAME.search(names[0]):
+                    yield self.diag(
+                        ctx, node,
+                        f"constant {ast.unparse(node.func)}"
+                        f"({ast.unparse(node.args[0])}) inside per-step "
+                        f"function '{names[0]}' reuses the same key every "
+                        f"call")
+        # (b) a key variable consumed by two calls with no split between:
+        # both consumers see identical randomness
+        for scope in self._top_level_functions(ctx):
+            yield from self._check_reuse(ctx, scope)
+
+    def _top_level_functions(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef) \
+                    and not ctx.enclosing_functions(node):
+                yield node
+
+    def _check_reuse(self, ctx: FileContext,
+                     scope: ast.FunctionDef) -> Iterator[Diagnostic]:
+        key_names: Set[str] = set()
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not (isinstance(node.value, ast.Call) and
+                    (_call_qname(ctx, node.value) or "")
+                    in KEY_NAMES | KEY_CONSUMERS):
+                continue
+            for t in node.targets:
+                elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                key_names.update(
+                    e.id for e in elts if isinstance(e, ast.Name))
+        if not key_names:
+            return
+        uses: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            if (_call_qname(ctx, node) or "") in KEY_CONSUMERS:
+                continue  # split/fold_in is the sanctioned consumption
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in key_names:
+                    uses.setdefault(arg.id, []).append(arg)
+        for name, sites in uses.items():
+            if len(sites) > 1:
+                yield self.diag(
+                    ctx, sites[1],
+                    f"key '{name}' is passed to {len(sites)} calls without "
+                    f"an intervening jax.random.split — both consumers see "
+                    f"identical randomness")
+
+
+@register
+class MissingDonation(Checker):
+    rule = "JAX003"
+    title = "jitted train step without donate_argnums"
+    hint = ("pass donate_argnums=(0,) (the carried state) so XLA reuses "
+            "the input buffers — without it every step holds two copies "
+            "of params + optimizer state")
+
+    def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
+        defs_by_name: Dict[str, List[ast.FunctionDef]] = {}
+        aliases: Dict[str, str] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef):
+                defs_by_name.setdefault(node.name, []).append(node)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Name):
+                aliases[node.targets[0].id] = node.value.id
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                if (_call_qname(ctx, node) or "") not in JIT_NAMES:
+                    continue
+                if any(kw.arg is None for kw in node.keywords):
+                    continue  # **kwargs: cannot prove donation is missing
+                if any(kw.arg in ("donate_argnums", "donate_argnames")
+                       for kw in node.keywords):
+                    continue
+                if not node.args or not isinstance(node.args[0], ast.Name):
+                    continue
+                target = aliases.get(node.args[0].id, node.args[0].id)
+                for d in defs_by_name.get(target, []):
+                    if self._is_train_step(ctx, d):
+                        yield self.diag(
+                            ctx, node,
+                            f"jax.jit of train-step-shaped '{d.name}' "
+                            f"without donate_argnums")
+                        break
+            elif isinstance(node, ast.FunctionDef) \
+                    and self._is_train_step(ctx, node):
+                for dec in node.decorator_list:
+                    if self._plain_jit_decorator(ctx, dec):
+                        yield self.diag(
+                            ctx, dec,
+                            f"@jax.jit on train-step-shaped '{node.name}' "
+                            f"without donate_argnums")
+
+    def _is_train_step(self, ctx: FileContext, d: ast.FunctionDef) -> bool:
+        if not TRAIN_STEP_NAME.search(d.name):
+            return False
+        if "eval" in d.name.lower():
+            return False
+        # a step_fn nested inside make_eval_step etc. is not a train step
+        return not any("eval" in n.lower()
+                       for n in _enclosing_def_names(ctx, d))
+
+    def _plain_jit_decorator(self, ctx: FileContext, dec: ast.expr) -> bool:
+        if isinstance(dec, ast.Call):
+            name = ctx.qualified_name(dec.func) or ""
+            if name in JIT_NAMES:
+                return not any(
+                    kw.arg is None
+                    or kw.arg in ("donate_argnums", "donate_argnames")
+                    for kw in dec.keywords)
+            if name in ("functools.partial", "partial") and dec.args \
+                    and ctx.qualified_name(dec.args[0]) in JIT_NAMES:
+                return not any(
+                    kw.arg is None
+                    or kw.arg in ("donate_argnums", "donate_argnames")
+                    for kw in dec.keywords)
+            return False
+        return (ctx.qualified_name(dec) or "") in JIT_NAMES

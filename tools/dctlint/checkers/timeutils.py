@@ -1,0 +1,83 @@
+"""TIME001 — durations and deadlines must come from a monotonic clock.
+
+``time.time()`` is wall clock: NTP slews and steps move it, so a delta
+(``time.time() - t0``) or a deadline (``time.time() + timeout``) built on
+it can be negative, jump hours, or never expire. ``time.monotonic()`` is
+the duration clock. Wall-clock values that are *reported* (a ``"time":``
+field in a shipped sample, a tfevents timestamp) are fine — only
+arithmetic on ``time.time()`` is flagged.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from tools.dctlint.core import Checker, Diagnostic, FileContext, register
+
+WALL_CLOCK = "time.time"
+
+
+def _is_wall_call(ctx: FileContext, node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) \
+        and ctx.qualified_name(node.func) == WALL_CLOCK
+
+
+@register
+class WallClockArithmetic(Checker):
+    rule = "TIME001"
+    title = "time.time() arithmetic (delta/deadline)"
+    hint = ("use time.monotonic() for durations and deadlines; keep "
+            "time.time() only for reported wall-clock timestamps")
+
+    def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
+        # per-scope: `now` may be wall clock in one function and monotonic
+        # in its neighbor — taint must not leak across function boundaries
+        scopes = [ctx.tree] + [n for n in ast.walk(ctx.tree)
+                               if isinstance(n, (ast.FunctionDef,
+                                                 ast.AsyncFunctionDef))]
+        for scope in scopes:
+            yield from self._check_scope(ctx, scope)
+
+    def _scope_nodes(self, scope: ast.AST) -> Iterator[ast.AST]:
+        """Walk a scope without descending into nested function scopes."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _check_scope(self, ctx: FileContext,
+                     scope: ast.AST) -> Iterator[Diagnostic]:
+        # names assigned directly from time.time() in THIS scope
+        wall_names: Set[str] = set()
+        for node in self._scope_nodes(scope):
+            if isinstance(node, ast.Assign) \
+                    and _is_wall_call(ctx, node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        wall_names.add(t.id)
+
+        def tainted(expr: ast.AST) -> bool:
+            if _is_wall_call(ctx, expr):
+                return True
+            return isinstance(expr, ast.Name) and expr.id in wall_names
+
+        for node in self._scope_nodes(scope):
+            if isinstance(node, ast.BinOp) \
+                    and isinstance(node.op, (ast.Add, ast.Sub)) \
+                    and (tainted(node.left) or tainted(node.right)):
+                yield self.diag(
+                    ctx, node,
+                    f"duration/deadline arithmetic on time.time() "
+                    f"(`{ast.unparse(node)}`): wall clock can jump under "
+                    f"NTP, so the result may be negative or never expire")
+            elif isinstance(node, ast.AugAssign) \
+                    and isinstance(node.op, (ast.Add, ast.Sub)) \
+                    and tainted(node.value):
+                yield self.diag(
+                    ctx, node,
+                    f"duration accumulation from time.time() "
+                    f"(`{ast.unparse(node)}`): use time.monotonic()")

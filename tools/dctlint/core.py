@@ -1,0 +1,289 @@
+"""dctlint core — checker registry, suppressions, baseline, runner.
+
+The framework generalizes ``tools/check_swallowed_exceptions.py`` (PR 2's
+single-check gate) into a pluggable AST linter for the project's own
+invariants: JAX tracing pitfalls, concurrency hygiene, clock discipline.
+Go gets this from ``go vet`` + the race detector; a jitted multi-threaded
+JAX pipeline needs the equivalent encoded per-project (docs/
+static_analysis.md).
+
+Concepts
+--------
+- **Checker**: a class with a ``rule`` id (e.g. ``JAX001``) and a
+  ``check(ctx)`` generator over :class:`Diagnostic`. Register with
+  ``@register``; the registry is what ``--list-checkers`` and ``--select``
+  see.
+- **FileContext**: one parsed file — source, lines, AST — plus import-alias
+  resolution so ``np.sum``/``numpy.sum`` and ``import time as _time`` look
+  identical to checkers (:meth:`FileContext.qualified_name`).
+- **Suppression**: ``# dctlint: disable=JAX002 <reason>`` on the flagged
+  line (or ``disable-next-line=`` on the line above). A reason is
+  mandatory — a bare disable is itself reported (rule ``DCT000``).
+- **Baseline**: a committed JSON of grandfathered violations keyed by
+  (rule, path, message) with a required ``justification``; matching
+  diagnostics are filtered so the gate only fails on *new* violations.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+SUPPRESS_RE = re.compile(
+    r"#\s*dctlint:\s*disable(?P<next>-next-line)?="
+    r"(?P<rules>[A-Z]+[0-9]+(?:\s*,\s*[A-Z]+[0-9]+)*|all)"
+    r"(?P<reason>.*)$"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Diagnostic:
+    """One finding: rule id, location, message, and a fix hint."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    hint: str = ""
+
+    def format(self, *, show_hint: bool = True) -> str:
+        s = f"{self.path}:{self.line}: {self.rule} {self.message}"
+        if show_hint and self.hint:
+            s += f"\n    fix: {self.hint}"
+        return s
+
+    def baseline_key(self) -> Tuple[str, str, str]:
+        # line numbers are deliberately excluded so a baseline survives
+        # unrelated edits above the grandfathered site
+        return (self.rule, Path(self.path).as_posix(), self.message)
+
+
+class FileContext:
+    """A parsed source file plus the alias tables checkers share."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module) -> None:
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        # module alias -> canonical module ("np" -> "numpy"), and
+        # imported name -> canonical dotted name ("scan" -> "jax.lax.scan")
+        self.module_aliases: Dict[str, str] = {}
+        self.name_imports: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.module_aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    self.name_imports[a.asname or a.name] = (
+                        f"{node.module}.{a.name}")
+        # parent links let checkers walk enclosing scopes
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+
+    def qualified_name(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted name of a Name/Attribute chain, with import
+        aliases resolved: ``np.linalg.norm`` -> ``numpy.linalg.norm``,
+        ``_time.time`` -> ``time.time``, ``scan`` (from ``from jax.lax
+        import scan``) -> ``jax.lax.scan``. None for non-name expressions.
+        """
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = node.id
+        if root in self.name_imports:
+            parts.append(self.name_imports[root])
+        else:
+            parts.append(self.module_aliases.get(root, root))
+        return ".".join(reversed(parts))
+
+    def enclosing_functions(self, node: ast.AST) -> List[ast.AST]:
+        """Innermost-first chain of enclosing function defs."""
+        out: List[ast.AST] = []
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                out.append(cur)
+            cur = self.parents.get(cur)
+        return out
+
+
+class Checker:
+    """Base class. Subclass, set ``rule``/``title``/``hint``, implement
+    ``check``; decorate with ``@register`` to enroll."""
+
+    rule: str = "DCT999"
+    title: str = ""
+    hint: str = ""
+
+    def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
+        raise NotImplementedError
+
+    def diag(self, ctx: FileContext, node: ast.AST, message: str,
+             hint: Optional[str] = None) -> Diagnostic:
+        return Diagnostic(rule=self.rule, path=ctx.path,
+                          line=getattr(node, "lineno", 0), message=message,
+                          hint=self.hint if hint is None else hint)
+
+
+CHECKERS: Dict[str, Checker] = {}
+
+
+def register(cls):
+    """Class decorator enrolling a Checker in the global registry."""
+    inst = cls()
+    if inst.rule in CHECKERS:
+        raise ValueError(f"duplicate checker rule {inst.rule}")
+    CHECKERS[inst.rule] = inst
+    return cls
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+def parse_suppressions(lines: Sequence[str], path: str
+                       ) -> Tuple[Dict[int, set], List[Diagnostic]]:
+    """Per-line suppression map {1-based line -> set of rule ids (or
+    {"all"})} plus DCT000 diagnostics for disables missing a reason."""
+    suppressed: Dict[int, set] = {}
+    bad: List[Diagnostic] = []
+    for i, line in enumerate(lines, start=1):
+        m = SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group("rules").split(",")}
+        target = i + 1 if m.group("next") else i
+        if not m.group("reason").strip():
+            bad.append(Diagnostic(
+                rule="DCT000", path=path, line=i,
+                message=f"suppression of {','.join(sorted(rules))} has no "
+                        f"reason",
+                hint="write `# dctlint: disable=RULE <why this is safe>` — "
+                     "an unexplained disable is as opaque as the violation"))
+            continue  # a reasonless disable does not suppress
+        suppressed.setdefault(target, set()).update(rules)
+    return suppressed, bad
+
+
+def _is_suppressed(d: Diagnostic, suppressed: Dict[int, set]) -> bool:
+    rules = suppressed.get(d.line, ())
+    return "all" in rules or d.rule in rules
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: Optional[Path]) -> List[Dict[str, str]]:
+    if path is None or not Path(path).exists():
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    entries = data.get("violations", data if isinstance(data, list) else [])
+    for e in entries:
+        for k in ("rule", "path", "message"):
+            if k not in e:
+                raise ValueError(f"baseline entry missing {k!r}: {e}")
+    return entries
+
+
+def write_baseline(path: Path, diags: Iterable[Diagnostic]) -> int:
+    entries = []
+    seen = set()
+    for d in sorted(diags, key=lambda d: (d.path, d.line, d.rule)):
+        key = d.baseline_key()
+        if key in seen:
+            continue
+        seen.add(key)
+        entries.append({
+            "rule": d.rule,
+            "path": Path(d.path).as_posix(),
+            "message": d.message,
+            "justification": "TODO: justify or fix",
+        })
+    payload = {
+        "_comment": "dctlint grandfathered violations. Each entry MUST "
+                    "carry a real justification; new code never lands "
+                    "here — fix or suppress inline with a reason.",
+        "violations": entries,
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    return len(entries)
+
+
+def apply_baseline(diags: List[Diagnostic],
+                   entries: List[Dict[str, str]]) -> List[Diagnostic]:
+    keys = {(e["rule"], Path(e["path"]).as_posix(), e["message"])
+            for e in entries}
+    return [d for d in diags if d.baseline_key() not in keys]
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+def lint_source(source: str, path: str = "<string>", *,
+                select: Optional[Sequence[str]] = None) -> List[Diagnostic]:
+    """Lint one source string: parse, run the (selected) checkers, apply
+    per-line suppressions. Baseline filtering happens in :func:`run`."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Diagnostic(rule="DCT001", path=path, line=e.lineno or 0,
+                           message=f"syntax error: {e.msg}",
+                           hint="dctlint only lints parseable files")]
+    ctx = FileContext(path, source, tree)
+    suppressed, diags = parse_suppressions(ctx.lines, path)
+    checkers = [CHECKERS[r] for r in select] if select else \
+        list(CHECKERS.values())
+    for checker in checkers:
+        diags.extend(checker.check(ctx))
+    return [d for d in diags if not _is_suppressed(d, suppressed)]
+
+
+def lint_file(path: Path, *, select: Optional[Sequence[str]] = None,
+              relative_to: Optional[Path] = None) -> List[Diagnostic]:
+    display = str(path)
+    if relative_to is not None:
+        try:
+            display = str(Path(path).resolve().relative_to(
+                Path(relative_to).resolve()))
+        except ValueError:
+            pass  # outside the root: keep the path as given
+    return lint_source(Path(path).read_text(), display, select=select)
+
+
+def iter_python_files(roots: Sequence[str]) -> Iterator[Path]:
+    for root in roots:
+        p = Path(root)
+        if p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+        else:
+            yield p
+
+
+def run(paths: Sequence[str], *, select: Optional[Sequence[str]] = None,
+        baseline: Optional[Path] = None,
+        relative_to: Optional[Path] = None) -> List[Diagnostic]:
+    """Lint ``paths`` (files or directories), minus baseline entries."""
+    diags: List[Diagnostic] = []
+    for f in iter_python_files(paths):
+        diags.extend(lint_file(f, select=select, relative_to=relative_to))
+    if baseline is not None:
+        diags = apply_baseline(diags, load_baseline(baseline))
+    return sorted(diags, key=lambda d: (d.path, d.line, d.rule))
