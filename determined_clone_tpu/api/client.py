@@ -5,11 +5,18 @@ from __future__ import annotations
 
 import json
 import os
-import time
 import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Any, Dict, Optional
+
+from determined_clone_tpu import faults
+from determined_clone_tpu.utils import retry as retry_util
+
+# transport errors only: an HTTPError is an answer from the master, never
+# retried (it subclasses URLError, so it must be converted before this
+# tuple is consulted)
+_TRANSPORT_ERRORS = (urllib.error.URLError, ConnectionError, TimeoutError)
 
 
 def _q(segment: Any) -> str:
@@ -50,19 +57,24 @@ class MasterSession:
     def request(self, method: str, path: str,
                 body: Optional[Dict[str, Any]] = None, *,
                 retryable: Optional[bool] = None,
-                timeout: Optional[float] = None) -> Dict[str, Any]:
+                timeout: Optional[float] = None,
+                idempotency_key: Optional[str] = None) -> Dict[str, Any]:
         """``retryable`` controls transport-error retries. Default: GETs are
         retried, POSTs are not — a POST the master already processed must not
         be silently duplicated (create_experiment, completed_op). Idempotent
-        POSTs (heartbeat, rendezvous, register) opt in. ``timeout``
+        POSTs (heartbeat, rendezvous, register) opt in; non-idempotent ones
+        become safe by passing a client-generated ``idempotency_key`` (sent
+        in the body, letting the master dedup replays). ``timeout``
         overrides the session timeout (long-poll follow requests outlive
         it by design)."""
         if retryable is None:
             retryable = method == "GET"
-        attempts = self.retries if retryable else 1
+        if idempotency_key and body is not None:
+            body = {**body, "idempotency_key": idempotency_key}
         data = json.dumps(body).encode() if body is not None else None
-        last_err: Optional[Exception] = None
-        for attempt in range(attempts):
+
+        def attempt() -> Dict[str, Any]:
+            faults.point("api.request")
             headers = {"Content-Type": "application/json"}
             if self.token:
                 headers["Authorization"] = f"Bearer {self.token}"
@@ -82,17 +94,26 @@ class MasterSession:
                 except Exception:
                     pass  # error body wasn't JSON; surface it raw
                 raise MasterError(e.code, detail) from None
-            except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
-                last_err = e
-                time.sleep(min(2.0 ** attempt * 0.2, 5.0))
-        raise MasterError(0, f"master unreachable at {self.base_url}: {last_err}")
+
+        policy = retry_util.RetryPolicy(
+            name="api_request",
+            max_attempts=max(1, self.retries) if retryable else 1,
+            base_delay_s=0.2, max_delay_s=5.0,
+            retryable=_TRANSPORT_ERRORS)
+        try:
+            return retry_util.retry_call(attempt, policy=policy)
+        except _TRANSPORT_ERRORS as e:
+            raise MasterError(
+                0, f"master unreachable at {self.base_url}: {e}") from None
 
     def get(self, path: str) -> Dict[str, Any]:
         return self.request("GET", path)
 
     def post(self, path: str, body: Optional[Dict[str, Any]] = None, *,
-             retryable: bool = False) -> Dict[str, Any]:
-        return self.request("POST", path, body or {}, retryable=retryable)
+             retryable: bool = False,
+             idempotency_key: Optional[str] = None) -> Dict[str, Any]:
+        return self.request("POST", path, body or {}, retryable=retryable,
+                            idempotency_key=idempotency_key)
 
     # -- convenience wrappers ----------------------------------------------
     # These run on the GENERATED bindings (api/bindings.py, from

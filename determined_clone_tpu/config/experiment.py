@@ -305,6 +305,61 @@ class ObservabilityConfig:
 
 
 # ---------------------------------------------------------------------------
+# Fault injection (the `faults:` block) — a seeded, deterministic FaultPlan
+# for chaos testing; see docs/fault_tolerance.md. No reference equivalent:
+# the reference exercises failure paths with live clusters, we do it by seed.
+# ---------------------------------------------------------------------------
+
+_FAULT_ACTIONS = ("error", "delay", "truncate", "exit")
+_FAULT_EXCS = ("fault", "io", "conn")
+
+
+@dataclasses.dataclass
+class FaultsConfig:
+    enabled: bool = True
+    seed: int = 0
+    rules: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+
+    @staticmethod
+    def from_dict(raw: Dict[str, Any]) -> "FaultsConfig":
+        if not isinstance(raw, dict):
+            raise ConfigError(f"faults must be a mapping, got {raw!r}")
+        cfg = FaultsConfig(
+            enabled=bool(raw.get("enabled", True)),
+            seed=int(raw.get("seed", 0)),
+            rules=[dict(r) for r in raw.get("rules") or []],
+        )
+        cfg.validate()
+        return cfg
+
+    def validate(self) -> None:
+        for i, rule in enumerate(self.rules):
+            if not isinstance(rule, dict) or not rule.get("point"):
+                raise ConfigError(f"faults.rules[{i}] requires a `point`")
+            action = rule.get("action", "error")
+            if action not in _FAULT_ACTIONS:
+                raise ConfigError(
+                    f"faults.rules[{i}].action must be one of "
+                    f"{_FAULT_ACTIONS}, got {action!r}")
+            exc = rule.get("exc", "fault")
+            if exc not in _FAULT_EXCS:
+                raise ConfigError(
+                    f"faults.rules[{i}].exc must be one of "
+                    f"{_FAULT_EXCS}, got {exc!r}")
+            prob = float(rule.get("probability", 1.0))
+            if not 0.0 <= prob <= 1.0:
+                raise ConfigError(
+                    f"faults.rules[{i}].probability must be in [0, 1], "
+                    f"got {prob}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"enabled": self.enabled, "seed": self.seed}
+        if self.rules:
+            d["rules"] = self.rules
+        return d
+
+
+# ---------------------------------------------------------------------------
 # Log policies (reference: expconf log_policies → logpattern subsystem)
 # ---------------------------------------------------------------------------
 
@@ -345,6 +400,7 @@ class ExperimentConfig:
     observability: ObservabilityConfig = dataclasses.field(
         default_factory=ObservabilityConfig
     )
+    faults: Optional[FaultsConfig] = None
     checkpoint_policy: str = "best"     # best | all | none
     min_validation_period: Optional[Length] = None
     min_checkpoint_period: Optional[Length] = None
@@ -399,6 +455,8 @@ class ExperimentConfig:
             observability=ObservabilityConfig.from_dict(
                 raw.get("observability") or {}
             ),
+            faults=(FaultsConfig.from_dict(raw["faults"])
+                    if raw.get("faults") else None),
             checkpoint_policy=raw.get("checkpoint_policy", "best"),
             min_validation_period=(
                 Length.from_dict(raw["min_validation_period"])
@@ -473,6 +531,8 @@ class ExperimentConfig:
             d["optimizations"] = self.optimizations.to_dict()
         if self.observability != ObservabilityConfig():
             d["observability"] = self.observability.to_dict()
+        if self.faults is not None:
+            d["faults"] = self.faults.to_dict()
         if self.min_validation_period:
             d["min_validation_period"] = self.min_validation_period.to_dict()
         if self.min_checkpoint_period:

@@ -201,6 +201,37 @@ EXPERIMENT_SCHEMA = {
                 "trace_path": {"type": "string"},
             },
         },
+        # deterministic fault injection (seeded FaultPlan;
+        # docs/fault_tolerance.md)
+        "faults": {
+            "type": "object", "open": False,
+            "properties": {
+                "enabled": {"type": "boolean"},
+                "seed": {"type": "integer"},
+                "rules": {
+                    "type": "array",
+                    "items": {
+                        "type": "object", "open": False,
+                        "properties": {
+                            "point": {"type": "string"},
+                            "action": {"type": "string",
+                                       "enum": ["error", "delay",
+                                                "truncate", "exit"]},
+                            "nth": {"type": "integer"},
+                            "times": {"type": "integer"},
+                            "probability": {"type": "number"},
+                            "delay_s": {"type": "number"},
+                            "exc": {"type": "string",
+                                    "enum": ["fault", "io", "conn"]},
+                            "message": {"type": "string"},
+                            "exit_code": {"type": "integer"},
+                            "keep_bytes": {"type": "integer"},
+                        },
+                        "required": ["point"],
+                    },
+                },
+            },
+        },
         # hot-loop knobs (the TPU-native successor of the reference's
         # horovod-centric optimizations block)
         "optimizations": {

@@ -1,9 +1,11 @@
 """Core API (≈ harness/determined/core — SURVEY.md §2.3)."""
 from determined_clone_tpu.core._checkpoint import (
     CheckpointContext,
+    CheckpointCorruptError,
     CheckpointRegistry,
     LocalCheckpointRegistry,
     NullCheckpointRegistry,
+    validate_checkpoint_dir,
 )
 from determined_clone_tpu.core._context import Context, init
 from determined_clone_tpu.core._distributed import (
@@ -36,7 +38,9 @@ from determined_clone_tpu.core._train import (
 
 __all__ = [
     "CheckpointContext",
+    "CheckpointCorruptError",
     "CheckpointRegistry",
+    "validate_checkpoint_dir",
     "LocalCheckpointRegistry",
     "NullCheckpointRegistry",
     "Context",

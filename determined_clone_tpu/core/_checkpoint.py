@@ -13,16 +13,105 @@ JSONL next to the storage for off-cluster runs — the reference's
 from __future__ import annotations
 
 import contextlib
+import hashlib
 import json
 import os
 import time
 import uuid
 from typing import Any, Dict, Iterator, List, Optional
 
+from determined_clone_tpu import faults
 from determined_clone_tpu.core._distributed import DistributedContext
-from determined_clone_tpu.storage.base import StorageManager
+from determined_clone_tpu.storage.base import COMMIT_FILE, StorageManager
 
 METADATA_FILE = "metadata.json"
+MANIFEST_FILE = "manifest.json"
+# protocol files never appear in the manifest's own file table
+_INTERNAL_FILES = (MANIFEST_FILE, COMMIT_FILE)
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed commit-protocol validation: it was interrupted
+    before its COMMIT marker (crash mid-upload) or its content no longer
+    matches its manifest (torn write, bit rot). Restoring it would load a
+    partial state — callers fall back to the previous committed checkpoint
+    (docs/fault_tolerance.md)."""
+
+    def __init__(self, storage_id: str, reason: str) -> None:
+        super().__init__(
+            f"checkpoint {storage_id} failed commit validation: {reason}")
+        self.storage_id = storage_id
+        self.reason = reason
+
+
+def _sha256(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(chunk), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _file_entries(base: str, rels: List[str]) -> Dict[str, Dict[str, Any]]:
+    """Manifest entries (size + digest) for files under ``base``."""
+    return {
+        rel: {
+            "size": os.path.getsize(os.path.join(base, rel)),
+            "sha256": _sha256(os.path.join(base, rel)),
+        }
+        for rel in rels
+    }
+
+
+def validate_checkpoint_dir(path: str, storage_id: str = "<local>") -> bool:
+    """Enforce the commit protocol on a downloaded checkpoint directory.
+
+    Returns True when the manifest fully verified, False for a legacy
+    checkpoint (written before the commit protocol: no manifest, no COMMIT
+    — nothing to check). Raises :class:`CheckpointCorruptError` for
+    anything in between: a manifest without its COMMIT marker (interrupted
+    before commit), a missing/short/altered file, or an empty directory.
+    """
+    mpath = os.path.join(path, MANIFEST_FILE)
+    cpath = os.path.join(path, COMMIT_FILE)
+    has_manifest, has_commit = os.path.exists(mpath), os.path.exists(cpath)
+    if not has_manifest and not has_commit:
+        if not _relative_files(path):
+            raise CheckpointCorruptError(
+                storage_id, "empty checkpoint (crashed before any file "
+                "finished uploading)")
+        return False
+    if not has_commit:
+        raise CheckpointCorruptError(
+            storage_id, "manifest present but no COMMIT marker — the save "
+            "was interrupted before commit")
+    if not has_manifest:
+        raise CheckpointCorruptError(
+            storage_id, "COMMIT marker without manifest.json")
+    try:
+        with open(mpath) as f:
+            doc = json.load(f)
+    except ValueError as e:
+        raise CheckpointCorruptError(
+            storage_id, f"unreadable manifest: {e}") from None
+    recorded = doc.get("storage_id")
+    if recorded and storage_id != "<local>" and recorded != storage_id:
+        raise CheckpointCorruptError(
+            storage_id, f"manifest belongs to checkpoint {recorded!r}")
+    for rel, want in (doc.get("files") or {}).items():
+        p = os.path.join(path, rel)
+        if not os.path.exists(p):
+            raise CheckpointCorruptError(
+                storage_id, f"file {rel!r} in manifest is missing")
+        size = os.path.getsize(p)
+        if size != want.get("size"):
+            raise CheckpointCorruptError(
+                storage_id, f"file {rel!r} is {size} bytes, manifest says "
+                f"{want.get('size')} (torn write)")
+        if want.get("sha256") and _sha256(p) != want["sha256"]:
+            raise CheckpointCorruptError(
+                storage_id, f"file {rel!r} content digest mismatch")
+    return True
 
 
 class CheckpointRegistry:
@@ -98,13 +187,19 @@ class CheckpointContext:
         ``metadata.json`` which only the chief writes) — the semantics of the
         reference's _upload_sharded/merge_resources
         (core/_checkpoint.py:280,127).
+
+        Commit protocol: the chief writes ``manifest.json`` (per-file size +
+        digest, uploaded FIRST so any partial upload is self-identifying)
+        and, after every rank's files are in storage, the ``COMMIT`` marker
+        as the final act. Only then is the checkpoint published to the
+        registry — restores refuse anything uncommitted.
         """
         storage_id, upload_paths = self._coordinate(ckpt_dir, metadata, shard)
         if upload_paths is not None:
-            self._storage.upload(ckpt_dir, storage_id, paths=upload_paths
-                                 if shard else None)
+            self._storage.upload(ckpt_dir, storage_id, paths=upload_paths)
+        faults.point("checkpoint.post_upload")
         self._dist.barrier()
-        self._publish(storage_id, metadata)
+        self._commit_and_publish(storage_id, metadata)
         return storage_id
 
     def _coordinate(self, ckpt_dir: Optional[str],
@@ -112,28 +207,52 @@ class CheckpointContext:
                     shard: bool) -> tuple:
         """The collective part of a save, shared by the sync and async
         paths: broadcast the storage id, exchange shard manifests, reject
-        conflicts, write metadata. Returns (storage_id, upload_paths) where
-        upload_paths is None when THIS rank has nothing to upload (and a
-        list for sharded uploads; the sync non-shard chief passes the whole
-        directory)."""
+        conflicts, write metadata + the merged manifest. Returns
+        (storage_id, upload_paths) where upload_paths is None when THIS
+        rank has nothing to upload; the chief's list leads with
+        manifest.json so partial uploads always carry their manifest."""
+        faults.point("checkpoint.pre_upload")
         storage_id = self._dist.broadcast(
             str(uuid.uuid4()) if self._dist.is_chief else None
         )
         if shard:
+            if ckpt_dir:
+                self._write_metadata(ckpt_dir, metadata)
             my_files = _relative_files(ckpt_dir) if ckpt_dir else []
             my_files = [f for f in my_files
-                        if f != METADATA_FILE or self._dist.is_chief]
-            all_files = self._dist.allgather(my_files)
-            _check_shard_conflicts(all_files)
+                        if f not in _INTERNAL_FILES
+                        and (f != METADATA_FILE or self._dist.is_chief)]
+            my_entries = (_file_entries(ckpt_dir, my_files)
+                          if ckpt_dir else {})
+            all_entries = self._dist.allgather(my_entries)
+            _check_shard_conflicts([sorted(e) for e in all_entries])
             if not ckpt_dir:
                 return storage_id, None
-            self._write_metadata(ckpt_dir, metadata)
-            return storage_id, sorted(set(
-                my_files + ([METADATA_FILE] if self._dist.is_chief else [])))
+            if not self._dist.is_chief:
+                return storage_id, sorted(my_files)
+            merged: Dict[str, Dict[str, Any]] = {}
+            for entries in all_entries:
+                merged.update(entries)
+            self._write_manifest(ckpt_dir, storage_id, merged)
+            return storage_id, [MANIFEST_FILE] + sorted(my_files)
         if not self._dist.is_chief:
             return storage_id, None
         self._write_metadata(ckpt_dir, metadata)
-        return storage_id, []
+        files = [f for f in _relative_files(ckpt_dir)
+                 if f not in _INTERNAL_FILES]
+        self._write_manifest(ckpt_dir, storage_id,
+                             _file_entries(ckpt_dir, files))
+        return storage_id, [MANIFEST_FILE] + files
+
+    def _commit_and_publish(self, storage_id: str,
+                            metadata: Optional[Dict[str, Any]]) -> None:
+        """Chief-only: COMMIT marker, then the registry record. Publishing
+        strictly after commit is what lets restore trust the registry."""
+        if self._dist.is_chief:
+            faults.point("checkpoint.commit")
+            self._storage.commit(storage_id, {
+                "trial_id": self._trial_id, "time": time.time()})
+        self._publish(storage_id, metadata)
 
     def _publish(self, storage_id: str,
                  metadata: Optional[Dict[str, Any]]) -> None:
@@ -209,8 +328,7 @@ class CheckpointContext:
 
         error: Dict[str, BaseException] = {}
 
-        def io(tmp=tmp, storage_id=storage_id,
-               paths=(upload_paths if shard else None)):
+        def io(tmp=tmp, storage_id=storage_id, paths=upload_paths):
             try:
                 self._storage.upload(tmp, storage_id, paths=paths)
             except BaseException as e:  # noqa: BLE001 - surfaced at wait
@@ -258,7 +376,8 @@ class CheckpointContext:
                 if any(flags[i] for flags in all_failed):
                     continue  # incomplete on some rank: never published
                 drained.append(entry["storage_id"])
-                self._publish(entry["storage_id"], entry["metadata"])
+                self._commit_and_publish(entry["storage_id"],
+                                         entry["metadata"])
         self._pending.clear()
         if first_error is not None:
             raise first_error
@@ -293,18 +412,52 @@ class CheckpointContext:
         with open(os.path.join(ckpt_dir, METADATA_FILE), "w") as f:
             json.dump(meta, f, indent=1)
 
+    def _write_manifest(self, ckpt_dir: str, storage_id: str,
+                        entries: Dict[str, Dict[str, Any]]) -> None:
+        faults.point("checkpoint.manifest")
+        doc = {
+            "format": 1,
+            "storage_id": storage_id,
+            "trial_id": self._trial_id,
+            "files": entries,
+        }
+        with open(os.path.join(ckpt_dir, MANIFEST_FILE), "w") as f:
+            json.dump(doc, f, indent=1)
+
     # -- restore ------------------------------------------------------------
 
     def download(self, storage_id: str, ckpt_dir: str) -> None:
         self._storage.download(storage_id, ckpt_dir)
 
     @contextlib.contextmanager
-    def restore_path(self, storage_id: str) -> Iterator[str]:
+    def restore_path(self, storage_id: str, *,
+                     validate: bool = True) -> Iterator[str]:
         with self._storage.restore_path(storage_id) as path:
+            if validate:
+                validate_checkpoint_dir(path, storage_id)
             yield path
 
+    def committed_checkpoints(self, *, newest_first: bool = True) -> List[str]:
+        """storage_ids of this trial's registry checkpoints. The registry
+        only ever holds committed ones (publish happens strictly after the
+        COMMIT marker), so these are the restore-fallback candidates."""
+        out: List[str] = []
+        for rec in self._registry.list():
+            if rec.get("deleted"):
+                continue
+            # master registry records key the id as "uuid"
+            sid = rec.get("storage_id") or rec.get("uuid")
+            if not sid:
+                continue
+            rec_trial = rec.get("trial_id")
+            if (self._trial_id is not None and rec_trial is not None
+                    and rec_trial != self._trial_id):
+                continue
+            out.append(sid)
+        return out[::-1] if newest_first else out
+
     def get_metadata(self, storage_id: str) -> Dict[str, Any]:
-        with self.restore_path(storage_id) as path:
+        with self.restore_path(storage_id, validate=False) as path:
             mpath = os.path.join(path, METADATA_FILE)
             if os.path.exists(mpath):
                 with open(mpath) as f:

@@ -39,6 +39,7 @@ from determined_clone_tpu.core._train import (
     TrainContext,
 )
 from determined_clone_tpu.storage import base as storage_base
+from determined_clone_tpu.utils import retry as retry_util
 
 
 class Context:
@@ -87,6 +88,27 @@ def init(
     config = config or ExperimentConfig.from_dict({})
     dist = distributed or DistributedContext.single()
 
+    # telemetry first: the preempt watcher, fault plan and retry layer all
+    # want its registry (telemetry_from_config returns None when off)
+    from determined_clone_tpu.telemetry import telemetry_from_config
+
+    telemetry = telemetry_from_config(config)
+    registry_arg = telemetry.registry if telemetry is not None else None
+
+    # fault plan: a config `faults:` block wins; otherwise DCT_FAULT_PLAN.
+    # Config plans are cached by payload so counters survive restart legs;
+    # env plans are process-global and never deactivated here.
+    from determined_clone_tpu import faults as faults_mod
+
+    fault_plan = None
+    if (config.faults is not None and config.faults.enabled
+            and config.faults.rules):
+        fault_plan = faults_mod.activate_from_config(
+            {"seed": config.faults.seed, "rules": config.faults.rules},
+            registry=registry_arg)
+    elif faults_mod.active_plan() is None:
+        faults_mod.install_from_env()
+
     cleanup_dir: Optional[tempfile.TemporaryDirectory] = None
     if config.checkpoint_storage is not None:
         storage = storage_base.build(config.checkpoint_storage)
@@ -120,7 +142,7 @@ def init(
     if source is None:
         flag = os.environ.get("DCT_PREEMPT_FILE")
         source = FilePreemptionSource(flag) if flag else NeverPreempt()
-    preempt = PreemptContext(dist, source).start()
+    preempt = PreemptContext(dist, source, registry=registry_arg).start()
 
     if searcher_source is None:
         searcher_source = LocalSearcherSource(config.searcher.max_length)
@@ -131,9 +153,8 @@ def init(
 
     # local/unmanaged runs still get telemetry when the config asks for it
     # (managed runs: exec/trial.py wires this plus profiler shipping)
-    from determined_clone_tpu.telemetry import telemetry_from_config
-
-    ctx.telemetry = telemetry_from_config(config)
+    ctx.telemetry = telemetry
+    retry_util.set_registry(registry_arg)
     try:
         yield ctx
     finally:
@@ -141,6 +162,9 @@ def init(
             if ctx.telemetry is not None and ctx.telemetry.trace_path:
                 ctx.telemetry.export_chrome_trace()
         finally:
+            if fault_plan is not None:
+                faults_mod.deactivate(fault_plan)
+            retry_util.set_registry(None)
             ctx.close()
             if cleanup_dir is not None:
                 cleanup_dir.cleanup()

@@ -3,6 +3,7 @@ local fallbacks (≈ the real vs Dummy context split in the reference,
 core/_train.py DummyTrainContext etc.)."""
 from __future__ import annotations
 
+import uuid
 from typing import Any, Dict, Iterator
 
 from determined_clone_tpu.api.client import MasterSession
@@ -25,11 +26,14 @@ class MasterMetricsBackend(MetricsBackend):
 
     def report(self, group: str, steps_completed: int,
                metrics: Dict[str, Any]) -> None:
+        # a client-generated idempotency key makes the POST safely
+        # retryable: a replay of a report the master already processed
+        # dedups instead of double-counting the batch
         self.session.post(f"/api/v1/trials/{self.trial_id}/metrics", {
             "group": group,
             "steps_completed": steps_completed,
             "metrics": metrics,
-        })
+        }, retryable=True, idempotency_key=uuid.uuid4().hex)
 
 
 class MasterCheckpointRegistry(CheckpointRegistry):

@@ -10,11 +10,18 @@ broadcast over the control plane — then the trainer saves and exits.
 from __future__ import annotations
 
 import enum
+import logging
 import os
 import threading
-from typing import Optional
+import time
+from typing import Any, Optional
 
 from determined_clone_tpu.core._distributed import DistributedContext
+
+logger = logging.getLogger(__name__)
+
+# a broken source fails every poll; one warning per window, not per poll
+_WARN_INTERVAL_S = 60.0
 
 
 class PreemptMode(enum.Enum):
@@ -47,12 +54,16 @@ class NeverPreempt(PreemptionSource):
 
 
 class _Watcher(threading.Thread):
-    def __init__(self, source: PreemptionSource, interval: float) -> None:
+    def __init__(self, source: PreemptionSource, interval: float,
+                 failure_counter: Any = None) -> None:
         super().__init__(daemon=True, name="preemption-watcher")
         self._source = source
         self._interval = interval
         self._flag = threading.Event()
         self._stop = threading.Event()
+        self._failure_counter = failure_counter
+        self._last_warn = float("-inf")
+        self.poll_failures = 0
 
     def run(self) -> None:
         while not self._stop.is_set():
@@ -60,8 +71,19 @@ class _Watcher(threading.Thread):
                 if self._source.poll():
                     self._flag.set()
                     return
-            except Exception:
-                pass  # transient poll failures must not kill training
+            except Exception as e:
+                # transient poll failures must not kill training — but a
+                # permanently broken source must be visible, so count every
+                # failure and warn at most once per window
+                self.poll_failures += 1
+                if self._failure_counter is not None:
+                    self._failure_counter.inc()
+                now = time.monotonic()
+                if now - self._last_warn >= _WARN_INTERVAL_S:
+                    self._last_warn = now
+                    logger.warning(
+                        "preemption poll failed (%d failures so far): %s",
+                        self.poll_failures, e)
             self._stop.wait(self._interval)
 
     @property
@@ -76,13 +98,17 @@ class PreemptContext:
     def __init__(self, dist: DistributedContext,
                  source: Optional[PreemptionSource] = None, *,
                  mode: PreemptMode = PreemptMode.WORKERS_ASK_CHIEF,
-                 poll_interval: float = 5.0) -> None:
+                 poll_interval: float = 5.0,
+                 registry: Any = None) -> None:
         self._dist = dist
         self._mode = mode
         self._source = source or NeverPreempt()
         self._watcher: Optional[_Watcher] = None
         self._interval = poll_interval
         self._signaled = threading.Event()
+        self._failure_counter = registry.counter(
+            "preempt_poll_failures",
+            "preemption source polls that raised") if registry else None
 
     def start(self) -> "PreemptContext":
         if (self._mode == PreemptMode.WORKERS_ASK_CHIEF
@@ -92,9 +118,15 @@ class PreemptContext:
             self._dist._require_transport()
         watch = self._mode == PreemptMode.CHIEF_ONLY or self._dist.is_chief
         if watch and not isinstance(self._source, NeverPreempt):
-            self._watcher = _Watcher(self._source, self._interval)
+            self._watcher = _Watcher(self._source, self._interval,
+                                     self._failure_counter)
             self._watcher.start()
         return self
+
+    @property
+    def poll_failures(self) -> int:
+        """Failed source polls since start (0 when no watcher runs)."""
+        return self._watcher.poll_failures if self._watcher else 0
 
     def close(self) -> None:
         if self._watcher:

@@ -103,18 +103,26 @@ def cluster_up(*, n_agents: int = 1, slots_per_agent: int = 1,
 
     # wait for the cluster to report all agents
     from determined_clone_tpu.api.client import MasterSession
+    from determined_clone_tpu.utils import retry as retry_util
 
     session = MasterSession("127.0.0.1", port, timeout=5, retries=2)
-    deadline = time.monotonic() + wait_sec
-    up = False
-    while time.monotonic() < deadline:
-        try:
-            if len(session.list_agents()) >= n_agents:
-                up = True
-                break
-        except Exception:
-            pass  # master still booting; poll again until the deadline
-        time.sleep(0.3)
+
+    def _agents_up() -> bool:
+        if len(session.list_agents()) < n_agents:
+            raise ConnectionError("not all agents registered yet")
+        return True
+
+    # Fixed-interval poll (multiplier 1.0, no jitter) bounded by wait_sec:
+    # a boot wait wants steady sampling, not exponential growth.
+    poll = retry_util.RetryPolicy(
+        name="deploy_wait", max_attempts=1_000_000,
+        base_delay_s=0.3, multiplier=1.0, max_delay_s=0.3,
+        jitter="none", deadline_s=wait_sec,
+        retryable=(Exception,))  # master still booting raises URLError too
+    try:
+        up = retry_util.retry_call(_agents_up, policy=poll)
+    except Exception:
+        up = False
 
     state = {
         "port": port,

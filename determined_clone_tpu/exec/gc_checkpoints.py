@@ -12,6 +12,41 @@ import os
 import sys
 
 
+def sweep_uncommitted(manager) -> int:
+    """Delete orphaned uncommitted checkpoint dirs (crash leftovers).
+
+    A save that died between upload and COMMIT leaves a directory no
+    restore will ever accept (core/_checkpoint.py refuses it), so once it
+    is old enough to rule out an in-flight save it is garbage. Opt-in via
+    DCT_GC_SWEEP_UNCOMMITTED=1; the age floor (DCT_GC_UNCOMMITTED_AGE_S,
+    default 3600s) is what keeps a concurrent save's half-written dir
+    safe from us.
+    """
+    age_floor = float(os.environ.get("DCT_GC_UNCOMMITTED_AGE_S", "3600"))
+    try:
+        storage_ids = manager.list_storage_ids()
+    except NotImplementedError:
+        print("storage backend cannot enumerate checkpoints; "
+              "skipping uncommitted sweep")
+        return 0
+    swept = failed = 0
+    for sid in storage_ids:
+        try:
+            if manager.is_committed(sid):
+                continue
+            age = manager.storage_age_s(sid)
+            if age is None or age < age_floor:
+                continue
+            manager.delete(sid)
+            print(f"swept uncommitted checkpoint {sid} (age {age:.0f}s)")
+            swept += 1
+        except Exception as exc:  # keep going; report at the end
+            print(f"failed to sweep {sid}: {exc}")
+            failed += 1
+    print(f"uncommitted sweep: {swept} deleted, {failed} failed")
+    return failed
+
+
 def main() -> int:
     from determined_clone_tpu.config.experiment import CheckpointStorageConfig
     from determined_clone_tpu.storage import build
@@ -34,6 +69,8 @@ def main() -> int:
             print(f"failed to delete {uuid}: {exc}")
             failed += 1
     print(f"gc done: {len(uuids) - failed}/{len(uuids)} deleted")
+    if os.environ.get("DCT_GC_SWEEP_UNCOMMITTED") == "1":
+        failed += sweep_uncommitted(manager)
     return 1 if failed else 0
 
 

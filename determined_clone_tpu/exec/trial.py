@@ -19,6 +19,8 @@ import sys
 import time
 from typing import Any, Dict, Optional
 
+from determined_clone_tpu import faults  # import-light (stdlib only)
+
 
 @dataclasses.dataclass
 class ClusterInfo:
@@ -95,6 +97,7 @@ def do_rendezvous(session, info: ClusterInfo, addr: str) -> dict:
     per-rank ``slice_ids`` the scheduler assigned."""
     deadline = time.monotonic() + 300
     while True:
+        faults.point("trial.rendezvous")
         resp = session.post(
             f"/api/v1/allocations/{info.allocation_id}/rendezvous",
             {"rank": info.rank, "address": addr},
@@ -177,7 +180,12 @@ def main(argv=None) -> int:
     )
     from determined_clone_tpu.training import JaxTrial, Trainer, TrialContext
 
+    # Chaos runs ship their plan through the environment; a no-op when
+    # DCT_FAULT_PLAN is unset.
+    faults.install_from_env()
+
     info = ClusterInfo.from_env()
+    faults.point("trial.startup")
     session = MasterSession(info.master_host, info.master_port)
     config = ExperimentConfig.from_dict(info.experiment_config)
     trial_cls = resolve_entrypoint(argv[0])

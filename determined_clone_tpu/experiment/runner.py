@@ -31,6 +31,18 @@ from determined_clone_tpu.searcher import (
 )
 from determined_clone_tpu.training.trainer import Trainer
 from determined_clone_tpu.training.trial import JaxTrial, TrialContext
+from determined_clone_tpu.telemetry import MetricsRegistry
+from determined_clone_tpu.utils import retry as retry_util
+
+# Restart pacing (≈ the reference's trial restart delay): small enough that
+# single-host test runs stay fast, but each consecutive failure doubles the
+# wait so a persistently-broken trial doesn't spin the orchestration loop.
+RESTART_BACKOFF = retry_util.RetryPolicy(
+    name="runner_restart",
+    max_attempts=1,  # the runner tracks attempts itself via max_restarts
+    base_delay_s=0.25,
+    max_delay_s=10.0,
+)
 
 
 @dataclasses.dataclass
@@ -63,12 +75,20 @@ class LocalExperimentRunner:
                  storage_path: str,
                  mesh: Optional[Any] = None,
                  max_events: int = 10_000,
-                 method: Optional[Any] = None) -> None:
+                 method: Optional[Any] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 restart_backoff: Optional[retry_util.RetryPolicy] = None,
+                 ) -> None:
         self.config = config
         self.trial_cls = trial_cls
         self.storage_path = storage_path
         self.mesh = mesh
         self.max_events = max_events
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.restart_backoff = (restart_backoff if restart_backoff is not None
+                                else RESTART_BACKOFF)
+        self._restarts_total = self.registry.counter(
+            "trial_restarts_total", "trial legs restarted after a failure")
         # method override: a user-provided SearchMethod (custom search via
         # searcher.LocalSearchRunner) instead of the built-in factory
         self.engine = Searcher(method if method is not None else build_method(
@@ -178,6 +198,15 @@ class LocalExperimentRunner:
                         ))
                         self._snapshot()
                         continue
+                    # Back off before the retry (exponential + full jitter)
+                    # so a trial failing on shared-resource contention isn't
+                    # immediately thrown back at the same hot spot, and
+                    # snapshot first so a crash mid-backoff still records
+                    # the restart count.
+                    self._restarts_total.inc()
+                    self._snapshot()
+                    retry_util.sleep_backoff(self.restart_backoff,
+                                             rec.restarts)
                     queue.insert(0, op)  # retry from latest checkpoint
                     continue
                 rec.last_metric = metric

@@ -15,11 +15,30 @@ from __future__ import annotations
 
 import abc
 import contextlib
+import json
 import os
 import shutil
-from typing import Dict, Iterator, List, Optional
+import time
+from typing import Any, Dict, Iterator, List, Optional
 
+from determined_clone_tpu import faults
 from determined_clone_tpu.config.experiment import CheckpointStorageConfig
+from determined_clone_tpu.utils import retry as retry_util
+
+# Commit marker: its presence is the *only* thing that makes a checkpoint
+# restorable under the commit protocol (docs/fault_tolerance.md). Written
+# last, atomically where the backend allows it.
+COMMIT_FILE = "COMMIT"
+
+# Per-file transfer policy: every upload/download below goes through this,
+# which is what gives "per-file resume" — files already transferred are not
+# redone when a later file's copy has to retry.
+STORAGE_IO_POLICY = retry_util.RetryPolicy(
+    name="storage_io", max_attempts=4, base_delay_s=0.05, max_delay_s=2.0)
+
+
+def _transfer(fn: Any, *args: Any) -> Any:
+    return retry_util.retry_call(fn, *args, policy=STORAGE_IO_POLICY)
 
 
 class StorageManager(abc.ABC):
@@ -42,6 +61,42 @@ class StorageManager(abc.ABC):
     @abc.abstractmethod
     def list_files(self, storage_id: str) -> Dict[str, int]:
         """{relative_path: size_bytes} for one checkpoint."""
+
+    def commit(self, storage_id: str,
+               payload: Optional[Dict[str, Any]] = None) -> None:
+        """Write the COMMIT marker as the checkpoint's final act.
+
+        Backends with atomic rename (shared_fs) override this; the default
+        uploads the marker as one more object, which on object stores is
+        already atomic per-key.
+        """
+        faults.point("storage.commit")
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmp:
+            with open(os.path.join(tmp, COMMIT_FILE), "w") as f:
+                json.dump(payload or {}, f)
+            self.upload(tmp, storage_id, paths=[COMMIT_FILE])
+
+    def is_committed(self, storage_id: str) -> bool:
+        return COMMIT_FILE in self.list_files(storage_id)
+
+    def list_storage_ids(self) -> List[str]:
+        """Every checkpoint id this manager can see (for GC sweeps).
+
+        Only backends that can enumerate cheaply implement this; the GC
+        skips the uncommitted sweep when it's unavailable.
+        """
+        raise NotImplementedError(
+            f"{type(self).__name__} cannot enumerate checkpoints")
+
+    def storage_age_s(self, storage_id: str) -> Optional[float]:
+        """Seconds since the checkpoint's newest write, or None if unknown.
+
+        The GC refuses to sweep uncommitted checkpoints of unknown age —
+        they may still be uploading.
+        """
+        return None
 
     @contextlib.contextmanager
     def store_path(self, storage_id: str, base_tmp: Optional[str] = None
@@ -89,10 +144,19 @@ class SharedFSStorageManager(StorageManager):
         dst = self._dir(storage_id)
         os.makedirs(dst, exist_ok=True)
         for rel in paths if paths is not None else _walk_relative(src_dir):
-            src = os.path.join(src_dir, rel)
-            out = os.path.join(dst, rel)
-            os.makedirs(os.path.dirname(out), exist_ok=True)
-            shutil.copy2(src, out)
+            _transfer(self._copy_in,
+                      os.path.join(src_dir, rel), os.path.join(dst, rel))
+
+    @staticmethod
+    def _copy_in(src: str, out: str) -> None:
+        faults.point("storage.upload")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        shutil.copy2(src, out)
+        keep = faults.truncate_bytes("storage.upload")
+        if keep is not None:
+            # injected torn write: the copy "succeeded" but the tail is gone
+            with open(out, "r+b") as f:
+                f.truncate(keep)
 
     def download(self, storage_id: str, dst_dir: str,
                  paths: Optional[List[str]] = None) -> None:
@@ -100,13 +164,46 @@ class SharedFSStorageManager(StorageManager):
         if not os.path.isdir(src):
             raise FileNotFoundError(f"checkpoint {storage_id} not found in {self.base}")
         for rel in paths if paths is not None else _walk_relative(src):
-            s = os.path.join(src, rel)
-            out = os.path.join(dst_dir, rel)
-            os.makedirs(os.path.dirname(out), exist_ok=True)
-            shutil.copy2(s, out)
+            _transfer(self._copy_out,
+                      os.path.join(src, rel), os.path.join(dst_dir, rel))
+
+    @staticmethod
+    def _copy_out(src: str, out: str) -> None:
+        faults.point("storage.download")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        shutil.copy2(src, out)
+
+    def commit(self, storage_id: str,
+               payload: Optional[Dict[str, Any]] = None) -> None:
+        # fsync + rename: the marker either exists complete or not at all,
+        # even through a host crash
+        faults.point("storage.commit")
+        d = self._dir(storage_id)
+        os.makedirs(d, exist_ok=True)
+        tmp = os.path.join(d, ".COMMIT.tmp")
+        with open(tmp, "w") as f:
+            json.dump(payload or {}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(d, COMMIT_FILE))
 
     def delete(self, storage_id: str) -> None:
         shutil.rmtree(self._dir(storage_id), ignore_errors=True)
+
+    def list_storage_ids(self) -> List[str]:
+        if not os.path.isdir(self.base):
+            return []
+        return sorted(d for d in os.listdir(self.base)
+                      if os.path.isdir(os.path.join(self.base, d)))
+
+    def storage_age_s(self, storage_id: str) -> Optional[float]:
+        d = self._dir(storage_id)
+        if not os.path.isdir(d):
+            return None
+        mtimes = [os.path.getmtime(os.path.join(d, rel))
+                  for rel in _walk_relative(d)]
+        newest = max(mtimes) if mtimes else os.path.getmtime(d)
+        return time.time() - newest  # dctlint: disable=TIME001 file mtimes are wall-clock; only wall time can be compared against them
 
     def list_files(self, storage_id: str) -> Dict[str, int]:
         d = self._dir(storage_id)
@@ -156,9 +253,13 @@ class GCSStorageManager(StorageManager):
 
     def upload(self, src_dir, storage_id, paths=None):
         for rel in paths if paths is not None else _walk_relative(src_dir):
-            self.bucket.blob(self._key(storage_id, rel)).upload_from_filename(
-                os.path.join(src_dir, rel)
-            )
+            _transfer(self._upload_one, src_dir, storage_id, rel)
+
+    def _upload_one(self, src_dir, storage_id, rel):
+        faults.point("storage.upload")
+        self.bucket.blob(self._key(storage_id, rel)).upload_from_filename(
+            os.path.join(src_dir, rel)
+        )
 
     def download(self, storage_id, dst_dir, paths=None):
         it = self.client.list_blobs(self.bucket,
@@ -169,7 +270,12 @@ class GCSStorageManager(StorageManager):
                 continue
             out = os.path.join(dst_dir, rel)
             os.makedirs(os.path.dirname(out), exist_ok=True)
-            blob.download_to_filename(out)
+            _transfer(self._download_one, blob, out)
+
+    @staticmethod
+    def _download_one(blob, out):
+        faults.point("storage.download")
+        blob.download_to_filename(out)
 
     def delete(self, storage_id):
         for blob in self.client.list_blobs(
@@ -228,8 +334,12 @@ class S3StorageManager(StorageManager):
 
     def upload(self, src_dir, storage_id, paths=None):
         for rel in paths if paths is not None else _walk_relative(src_dir):
-            self.s3.upload_file(os.path.join(src_dir, rel), self.bucket_name,
-                                self._key(storage_id, rel))
+            _transfer(self._upload_one, src_dir, storage_id, rel)
+
+    def _upload_one(self, src_dir, storage_id, rel):
+        faults.point("storage.upload")
+        self.s3.upload_file(os.path.join(src_dir, rel), self.bucket_name,
+                            self._key(storage_id, rel))
 
     def download(self, storage_id, dst_dir, paths=None):
         for item in self._list_all(self._list_prefix(storage_id)):
@@ -238,7 +348,11 @@ class S3StorageManager(StorageManager):
                 continue
             out = os.path.join(dst_dir, rel)
             os.makedirs(os.path.dirname(out), exist_ok=True)
-            self.s3.download_file(self.bucket_name, item["Key"], out)
+            _transfer(self._download_one, item["Key"], out)
+
+    def _download_one(self, key, out):
+        faults.point("storage.download")
+        self.s3.download_file(self.bucket_name, key, out)
 
     def delete(self, storage_id):
         for item in list(self._list_all(self._list_prefix(storage_id))):
@@ -291,9 +405,13 @@ class AzureStorageManager(StorageManager):
 
     def upload(self, src_dir, storage_id, paths=None):
         for rel in paths if paths is not None else _walk_relative(src_dir):
-            with open(os.path.join(src_dir, rel), "rb") as f:
-                self.container.upload_blob(self._key(storage_id, rel), f,
-                                           overwrite=True)
+            _transfer(self._upload_one, src_dir, storage_id, rel)
+
+    def _upload_one(self, src_dir, storage_id, rel):
+        faults.point("storage.upload")
+        with open(os.path.join(src_dir, rel), "rb") as f:
+            self.container.upload_blob(self._key(storage_id, rel), f,
+                                       overwrite=True)
 
     def download(self, storage_id, dst_dir, paths=None):
         for blob in self.container.list_blobs(
@@ -303,8 +421,12 @@ class AzureStorageManager(StorageManager):
                 continue
             out = os.path.join(dst_dir, rel)
             os.makedirs(os.path.dirname(out), exist_ok=True)
-            with open(out, "wb") as f:
-                f.write(self.container.download_blob(blob.name).readall())
+            _transfer(self._download_one, blob.name, out)
+
+    def _download_one(self, name, out):
+        faults.point("storage.download")
+        with open(out, "wb") as f:
+            f.write(self.container.download_blob(name).readall())
 
     def delete(self, storage_id):
         for blob in list(self.container.list_blobs(

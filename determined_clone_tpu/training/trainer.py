@@ -31,7 +31,9 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding
 
+from determined_clone_tpu import faults
 from determined_clone_tpu.config.length import Length
+from determined_clone_tpu.core._checkpoint import CheckpointCorruptError
 from determined_clone_tpu.core._serialization import load_pytree, save_pytree
 from determined_clone_tpu.telemetry.spans import null_span
 from determined_clone_tpu.training.metrics import MetricAccumulator
@@ -99,6 +101,7 @@ class Trainer:
         merges the manifests (multi-host pjit state is never fully
         addressable on one host). ``metric`` (the searcher metric at save
         time) feeds the master's save_trial_best GC policy."""
+        faults.point("training.checkpoint_save")
         dist = self.core.distributed
         ck = self.core.checkpoint
         sharded = dist.size > 1
@@ -120,6 +123,35 @@ class Trainer:
 
     def _restore(self, storage_id: str, like: TrainState,
                  shardings: TrainState) -> tuple:
+        """Restore with fallback: a checkpoint refused by commit-protocol
+        validation (crash mid-upload, torn write) falls back through the
+        registry's committed checkpoints, newest first. The registry only
+        holds committed ones, so the first candidate that validates is the
+        newest safe state."""
+        ck = self.core.checkpoint
+        candidates = [storage_id] + [
+            sid for sid in ck.committed_checkpoints() if sid != storage_id]
+        first_err: Optional[CheckpointCorruptError] = None
+        for sid in candidates:
+            try:
+                return self._restore_one(sid, like, shardings)
+            except CheckpointCorruptError as e:
+                if first_err is None:
+                    first_err = e
+                logger.warning(
+                    "checkpoint %s refused (%s); falling back to the "
+                    "previous committed checkpoint", sid, e.reason)
+                tel = self._telemetry
+                if tel is not None:
+                    tel.registry.counter(
+                        "checkpoint_restore_fallbacks",
+                        "restores that fell back past an uncommitted/"
+                        "corrupt checkpoint").inc()
+        raise first_err if first_err is not None else RuntimeError(
+            f"no restorable checkpoint for {storage_id}")
+
+    def _restore_one(self, storage_id: str, like: TrainState,
+                     shardings: TrainState) -> tuple:
         ck = self.core.checkpoint
         with self._span("checkpoint_restore"):
             with ck.restore_path(storage_id) as path:
@@ -381,6 +413,9 @@ class Trainer:
                     t0 = time.perf_counter()
                     n0 = batches_trained
                     while batches_trained < chunk_end:
+                        # one pair per dispatch (fused counts as one); a
+                        # None check each when no plan is active
+                        faults.point("training.pre_step")
                         if (fused_step is not None
                                 and chunk_end - batches_trained >= k):
                             # k prefetched device batches → ONE dispatch
@@ -392,6 +427,7 @@ class Trainer:
                             state, metrics = train_step(state, next(feed))
                             acc.add(metrics)
                             batches_trained += 1
+                        faults.point("training.post_step")
                     # ---- reporting boundary (one host sync per chunk) ----
                     with span("host_sync"):
                         train_metrics = acc.result()

@@ -22,6 +22,7 @@ from typing import Any, Callable, Iterable, Iterator, Optional, Tuple
 
 import numpy as np
 
+from determined_clone_tpu import faults
 from determined_clone_tpu.telemetry.spans import null_span
 
 
@@ -242,6 +243,9 @@ class DevicePrefetcher:
             # state) are excluded, matching host_time accounting
             with span("produce_batch") as sp:
                 try:
+                    # injected errors ride the normal forwarding path: the
+                    # consumer re-raises at its next __next__
+                    faults.point("data.produce")
                     with span("dataload_next"):
                         batch = next(self._it)
                 except StopIteration:
@@ -350,6 +354,7 @@ class SyncDeviceFeeder:
 
     def __next__(self) -> Any:
         t0 = time.perf_counter()
+        faults.point("data.produce")  # parity with the prefetching producer
         with self._span("dataload_next"):
             batch = next(self._it)
         t1 = time.perf_counter()

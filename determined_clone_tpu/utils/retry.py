@@ -1,0 +1,121 @@
+"""Unified retry/backoff: one policy type for every transient-failure loop.
+
+Replaces the ad-hoc ``time.sleep`` retry loops that used to live in
+``api/client.py`` (and that dctlint's RETRY001 now rejects elsewhere).
+Every policy gives exponential backoff with *full jitter* — delay drawn
+uniformly from ``[0, min(max_delay, base * mult**(failures-1))]`` — which
+decorrelates a gang of workers hammering the same recovering dependency.
+Deadlines are monotonic-clock, so NTP steps can't make a retry loop spin
+forever or give up early.
+
+Retries are observable: each policy name gets a ``retries_<name>`` counter
+in the registry handed to :func:`set_registry` (the telemetry registry when
+observability is on), plus a module-local :func:`stats` dict for tests.
+
+Test seams: ``_sleep`` and ``_rng`` are module globals looked up at call
+time — monkeypatch them to capture exact backoff sequences without waiting.
+"""
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+DEFAULT_RETRYABLE: Tuple[type, ...] = (ConnectionError, TimeoutError, OSError)
+
+_sleep = time.sleep
+_rng = random.Random()
+_registry = None
+_stats: Dict[str, int] = {}
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """How a named class of operations retries. Frozen: share instances."""
+
+    name: str
+    max_attempts: int = 4
+    base_delay_s: float = 0.1
+    multiplier: float = 2.0
+    max_delay_s: float = 5.0
+    jitter: str = "full"  # "full" | "none"
+    deadline_s: Optional[float] = None
+    retryable: Tuple[type, ...] = DEFAULT_RETRYABLE
+
+    def backoff(self, failures: int,
+                rng: Optional[random.Random] = None) -> float:
+        """Delay before the retry that follows the Nth failure (1-based)."""
+        cap = min(self.max_delay_s,
+                  self.base_delay_s * self.multiplier ** max(failures - 1, 0))
+        if self.jitter == "none":
+            return cap
+        return (rng if rng is not None else _rng).uniform(0.0, cap)
+
+
+def set_registry(registry: Any) -> None:
+    """Route per-policy retry counters into a MetricsRegistry (or None)."""
+    global _registry
+    _registry = registry
+
+
+def stats() -> Dict[str, int]:
+    """{policy name: retries recorded} since the last reset (tests)."""
+    return dict(_stats)
+
+
+def reset_stats() -> None:
+    _stats.clear()
+
+
+def _record(name: str) -> None:
+    _stats[name] = _stats.get(name, 0) + 1
+    if _registry is not None:
+        _registry.counter(f"retries_{name}",
+                          f"retries under policy {name!r}").inc()
+
+
+def retry_call(fn: Callable[..., Any], *args: Any,
+               policy: RetryPolicy,
+               rng: Optional[random.Random] = None,
+               sleep: Optional[Callable[[float], None]] = None,
+               on_retry: Optional[Callable[[BaseException, int, float],
+                                           None]] = None,
+               **kwargs: Any) -> Any:
+    """Call ``fn`` under ``policy``; re-raise on exhaustion or deadline.
+
+    Only ``policy.retryable`` exceptions are retried; anything else
+    propagates immediately. ``on_retry(exc, failures, delay)`` runs before
+    each backoff sleep.
+    """
+    deadline = (time.monotonic() + policy.deadline_s
+                if policy.deadline_s is not None else None)
+    failures = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except policy.retryable as exc:
+            failures += 1
+            if failures >= policy.max_attempts:
+                raise
+            delay = policy.backoff(failures, rng)
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise
+                delay = min(delay, remaining)
+            _record(policy.name)
+            if on_retry is not None:
+                on_retry(exc, failures, delay)
+            (sleep if sleep is not None else _sleep)(delay)
+
+
+def sleep_backoff(policy: RetryPolicy, failures: int,
+                  rng: Optional[random.Random] = None) -> float:
+    """Backoff sleep for loops whose retry structure lives elsewhere
+    (e.g. the experiment runner's restart queue). Records the retry under
+    the policy's name; returns the delay actually slept."""
+    delay = policy.backoff(max(failures, 1), rng)
+    _record(policy.name)
+    _sleep(delay)
+    return delay

@@ -17,6 +17,10 @@
 # `./run_tests.sh --lint` runs the dctlint static-analysis suite over the
 # tier-1 lint set (docs/static_analysis.md) — the same run
 # tests/test_static_checks.py gates in CI.
+#
+# `./run_tests.sh --chaos` runs the fault-tolerance suite
+# (docs/fault_tolerance.md) with no marker filter, so the slow kill -9
+# subprocess test runs too — the tier-1 lane skips it via `-m "not slow"`.
 if [ "$1" = "--lint" ]; then
     shift
     exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
@@ -24,6 +28,9 @@ if [ "$1" = "--lint" ]; then
 elif [ "$1" = "--tier1" ]; then
     shift
     set -- tests/ -m "not slow" "$@"
+elif [ "$1" = "--chaos" ]; then
+    shift
+    set -- tests/test_fault_tolerance.py "$@"
 elif [ "$1" = "--observability" ]; then
     shift
     set -- tests/test_telemetry.py tests/test_profiler_tensorboard.py \

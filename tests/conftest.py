@@ -22,6 +22,13 @@ from determined_clone_tpu.utils.host_steering import steer_to_host_cpu  # noqa: 
 steer_to_host_cpu(8)
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running chaos/e2e tests, excluded from the tier-1 "
+        "lane (-m 'not slow'); run_tests.sh --chaos runs them")
+
+
 # Library threads are daemon (so a leak can't hang interpreter exit), but
 # every one of them has a join()ing owner — a survivor means a test skipped
 # a close()/stop() path. Named prefixes cover the telemetry-adjacent fleet:

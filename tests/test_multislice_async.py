@@ -117,7 +117,8 @@ class TestAsyncCheckpoint:
         with ctx.restore_path(sid) as path:
             import os
 
-            assert sorted(os.listdir(path)) == ["metadata.json",
+            assert sorted(os.listdir(path)) == ["COMMIT", "manifest.json",
+                                                "metadata.json",
                                                 "weights.bin"]
 
     def test_multiple_in_flight_preserved_in_order(self, tmp_path):
@@ -185,7 +186,8 @@ class TestAsyncCheckpoint:
         assert len(set(results.values())) == 1  # one collective id
         sid = results[0]
         files = storage.list_files(sid)
-        assert set(files) == {"metadata.json", "shard-0.bin", "shard-1.bin",
-                              "shard-2.bin", "shard-3.bin"}
+        assert set(files) == {"COMMIT", "manifest.json", "metadata.json",
+                              "shard-0.bin", "shard-1.bin", "shard-2.bin",
+                              "shard-3.bin"}
         recs = LocalCheckpointRegistry(str(tmp_path / "reg.jsonl")).list()
-        assert len(recs) == 1 and len(recs[0]["resources"]) == 5
+        assert len(recs) == 1 and len(recs[0]["resources"]) == 7

@@ -6,7 +6,7 @@ Three layers:
    bench.py`` must exit 0, so a new JAX/concurrency/clock violation
    anywhere in the library, the tools, or the bench harness fails CI.
 2. **Checker fixtures** — every rule (JAX001-003, CONC001-002, TIME001,
-   EXC001) has paired true-positive / true-negative snippets, so a checker
+   EXC001, RETRY001) has paired true-positive / true-negative snippets, so a checker
    that goes blind (or trigger-happy) fails here before it lies in CI.
 3. **Framework mechanics** — suppression comments require reasons,
    baselines filter exactly what they name, the legacy
@@ -71,9 +71,9 @@ def test_cli_lint_subcommand():
     assert cli_main(["lint", str(REPO / "tools" / "dctlint")]) == 0
 
 
-def test_all_seven_checkers_registered():
+def test_all_rules_registered():
     assert {"JAX001", "JAX002", "JAX003", "CONC001", "CONC002",
-            "TIME001", "EXC001"} <= set(CHECKERS)
+            "TIME001", "EXC001", "RETRY001"} <= set(CHECKERS)
 
 
 # ---------------------------------------------------------------------------
@@ -425,6 +425,88 @@ def test_time001_taint_does_not_leak_across_scopes(tmp_path):
             now = time.monotonic()  # same name, different clock
             return now - prev
         """, tmp_path, select=["TIME001"])
+    assert v == []
+
+
+# ---------------------------------------------------------------------------
+# RETRY001 — hand-rolled retry loop (sleep + except in a loop)
+# ---------------------------------------------------------------------------
+
+def test_retry001_sleep_in_retry_loop(tmp_path):
+    v = _lint(
+        """
+        import time as _t
+
+        def fetch(call):
+            while True:
+                try:
+                    return call()
+                except ConnectionError:
+                    _t.sleep(1.0)
+        """, tmp_path, select=["RETRY001"])
+    assert _rules(v) == ["RETRY001"]
+    assert "hand-rolled" in v[0].message
+
+
+def test_retry001_for_loop_with_backoff(tmp_path):
+    v = _lint(
+        """
+        import time
+
+        def fetch(call):
+            for attempt in range(5):
+                try:
+                    return call()
+                except OSError:
+                    pass
+                time.sleep(2 ** attempt)
+        """, tmp_path, select=["RETRY001"])
+    assert _rules(v) == ["RETRY001"]
+
+
+def test_retry001_poll_loop_without_handler_is_fine(tmp_path):
+    v = _lint(
+        """
+        import time
+
+        def wait_ready(check):
+            while not check():
+                time.sleep(0.5)   # plain poll, no exception pacing
+        """, tmp_path, select=["RETRY001"])
+    assert v == []
+
+
+def test_retry001_handler_in_nested_function_is_fine(tmp_path):
+    v = _lint(
+        """
+        import time
+
+        def tick(fns):
+            for fn in fns:
+                def guarded():
+                    try:
+                        fn()
+                    except Exception:
+                        raise
+                guarded()
+                time.sleep(0.1)   # pacing, not retry: no handler in loop
+        """, tmp_path, select=["RETRY001"])
+    assert v == []
+
+
+def test_retry001_retry_module_itself_is_exempt(tmp_path):
+    (tmp_path / "utils").mkdir()
+    v = _lint(
+        """
+        import time
+
+        def retry_call(fn):
+            while True:
+                try:
+                    return fn()
+                except Exception:
+                    time.sleep(0.1)
+        """, tmp_path / "utils", select=["RETRY001"], name="retry.py")
     assert v == []
 
 

@@ -4,5 +4,6 @@ from tools.dctlint.checkers import (  # noqa: F401  (import = registration)
     concurrency,
     exceptions,
     jax_checks,
+    retry,
     timeutils,
 )

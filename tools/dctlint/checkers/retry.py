@@ -1,0 +1,69 @@
+"""RETRY001 — hand-rolled retry loops must go through utils/retry.py.
+
+A loop that catches an exception and ``time.sleep``s is a retry loop, and
+every hand-rolled one reinvents the same bugs: constant delay (thundering
+herd), no jitter, no deadline, no telemetry. ``utils/retry.py`` provides
+``retry_call`` / ``sleep_backoff`` with exponential backoff, full jitter,
+a monotonic deadline, and a per-policy retry counter — that is the one
+place retry pacing lives (docs/fault_tolerance.md has the policy table).
+
+Heuristic: a ``time.sleep`` call lexically inside a for/while loop whose
+body (not counting nested function scopes) also contains an ``except``
+handler. Plain poll loops (sleep without a handler) are fine, as is a
+handler that lives in a function merely *called* from the loop.
+``utils/retry.py`` itself is exempt — its sleep IS the implementation.
+"""
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterator, Set
+
+from tools.dctlint.core import Checker, Diagnostic, FileContext, register
+
+SLEEP = "time.sleep"
+
+
+@register
+class HandRolledRetry(Checker):
+    rule = "RETRY001"
+    title = "hand-rolled retry loop (sleep + except in a loop)"
+    hint = ("use determined_clone_tpu.utils.retry (retry_call / "
+            "sleep_backoff with a named RetryPolicy) instead of a "
+            "bare time.sleep retry loop")
+
+    def _loop_nodes(self, loop: ast.AST) -> Iterator[ast.AST]:
+        """Walk a loop body without descending into nested function
+        scopes (the TIME001 scope rule: a handler inside a closure
+        defined in the loop is not this loop's retry logic)."""
+        stack = list(ast.iter_child_nodes(loop))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
+        # the retry module's own sleep is the implementation, not a bug
+        if Path(ctx.path).as_posix().endswith("utils/retry.py"):
+            return
+        flagged: Set[ast.AST] = set()  # dedupe sleeps under nested loops
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
+                continue
+            body = list(self._loop_nodes(loop))
+            if not any(isinstance(n, ast.ExceptHandler) for n in body):
+                continue
+            for node in body:
+                if node in flagged:
+                    continue
+                if isinstance(node, ast.Call) \
+                        and ctx.qualified_name(node.func) == SLEEP:
+                    flagged.add(node)
+                    yield self.diag(
+                        ctx, node,
+                        "retry loop with hand-rolled time.sleep pacing: "
+                        "constant delay, no jitter, no deadline, no "
+                        "telemetry")
