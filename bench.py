@@ -310,6 +310,74 @@ def _run_child() -> None:
         return {"samples_per_sec": round(batch * timed_steps / dt, 1),
                 "batch": batch}
 
+    def time_checkpoint_io() -> dict:
+        """Checkpoint I/O on the save/restore hot path: 3 saves with ~12%
+        churn + 1 restore through the content-addressed store
+        (storage/cas.py, 1 MiB chunks, shared_fs backend), against a plain
+        shared_fs save of the same payload. Pure host I/O — no devices —
+        so it rides in BENCH regardless of the TPU tunnel's mood."""
+        import shutil
+        import tempfile
+
+        import numpy as np
+
+        from determined_clone_tpu.storage import (
+            CASStorageManager,
+            ChunkCache,
+            SharedFSStorageManager,
+        )
+
+        root = tempfile.mkdtemp(prefix="dct-bench-ckpt-")
+        try:
+            src = os.path.join(root, "src")
+            os.makedirs(src)
+            rng = np.random.RandomState(11)
+            payload = rng.bytes(8 << 20)
+            with open(os.path.join(src, "state.bin"), "wb") as f:
+                f.write(payload)
+            mb = len(payload) / (1 << 20)
+
+            plain = SharedFSStorageManager(os.path.join(root, "plain"))
+            t0 = time.perf_counter()
+            plain.upload(src, "ck-plain")
+            plain_save_s = time.perf_counter() - t0
+
+            cas = CASStorageManager(
+                SharedFSStorageManager(os.path.join(root, "cas-store")),
+                cache=ChunkCache(os.path.join(root, "cache")))
+            save_s = []
+            for i in range(3):
+                if i:
+                    # churn the first MiB of the payload between saves;
+                    # the other 7 chunks dedup against the prior save
+                    blob = bytearray(payload)
+                    blob[: 1 << 20] = rng.bytes(1 << 20)
+                    payload = bytes(blob)
+                    with open(os.path.join(src, "state.bin"), "wb") as f:
+                        f.write(payload)
+                t0 = time.perf_counter()
+                cas.upload(src, f"ck-{i}")
+                cas.commit(f"ck-{i}")
+                save_s.append(round(time.perf_counter() - t0, 4))
+            t0 = time.perf_counter()
+            cas.download("ck-2", os.path.join(root, "restore"))
+            restore_s = time.perf_counter() - t0
+            stats = cas.storage_stats()
+            sess = stats["session"]
+            return {
+                "payload_mb": round(mb, 1),
+                "plain_save_mb_s": round(mb / max(plain_save_s, 1e-9), 1),
+                "cas_save_s": save_s,
+                "cas_save_mb_s": round(mb / max(save_s[-1], 1e-9), 1),
+                "cas_restore_s": round(restore_s, 4),
+                "cas_restore_mb_s": round(mb / max(restore_s, 1e-9), 1),
+                "dedup_ratio": stats["dedup_ratio"],
+                "bytes_uploaded": sess["bytes_uploaded"],
+                "bytes_deduped": sess["bytes_deduped"],
+            }
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
     def gpt_cfg(n_layers: int, d_model: int, n_heads: int, seq: int,
                 attention_impl: str, vocab: int = 50304,
                 remat: bool = True) -> gpt.GPTConfig:
@@ -343,6 +411,7 @@ def _run_child() -> None:
 
     mnist = None
     pipeline = None
+    ckpt_io = None
     flash_over_mha = None
     mha_sps = None
     mha_rung = None
@@ -396,6 +465,9 @@ def _run_child() -> None:
                     "steps_per_dispatch": (pipeline or {}).get(
                         "steps_per_dispatch"),
                     "pipeline": pipeline,
+                    # checkpoint save/restore wall time + effective MB/s +
+                    # dedup ratio through the content-addressed store
+                    "checkpoint_io": ckpt_io,
                     "init_s": round(t_init, 1),
                 },
             }
@@ -426,6 +498,12 @@ def _run_child() -> None:
                     timed_steps=8 if not on_tpu else rung["steps"], k=4)
             except Exception as exc:  # noqa: BLE001
                 pipeline = {"error": repr(exc)[:200]}
+        if ckpt_io is None and (not on_tpu or remaining() > 20):
+            # host-only I/O; cheap, but never let it sink the banked rung
+            try:
+                ckpt_io = time_checkpoint_io()
+            except Exception as exc:  # noqa: BLE001
+                ckpt_io = {"error": repr(exc)[:200]}
 
         # Re-emit enriched with the extras; the parent keeps the last line.
         _emit(result_line())

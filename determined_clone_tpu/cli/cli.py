@@ -265,6 +265,42 @@ def cmd_checkpoint_download(args) -> int:
     return 0
 
 
+def cmd_checkpoint_stats(args) -> int:
+    """Dedup ratio + chunk-cache hit rate of a content-addressed store.
+
+    Reads the `checkpoint_storage:` block from an experiment config yaml
+    (--config), or builds one from --host-path/--cache-path directly —
+    this talks straight to storage, no master needed.
+    """
+    from determined_clone_tpu.config.experiment import (
+        CheckpointStorageConfig,
+    )
+    from determined_clone_tpu.storage import CASStorageManager, build
+
+    if args.config:
+        import yaml
+
+        with open(args.config) as f:
+            doc = yaml.safe_load(f) or {}
+        raw = doc.get("checkpoint_storage") or doc
+    elif args.host_path:
+        raw = {"type": "cas",
+               "inner": {"type": "shared_fs", "host_path": args.host_path}}
+        if args.cache_path:
+            raw["cache_path"] = args.cache_path
+    else:
+        print("checkpoint stats needs --config or --host-path",
+              file=sys.stderr)
+        return 2
+    manager = build(CheckpointStorageConfig.from_dict(raw))
+    if not isinstance(manager, CASStorageManager):
+        print(f"checkpoint_storage type {raw.get('type')!r} is not "
+              "content-addressed; stats need `type: cas`", file=sys.stderr)
+        return 2
+    print_json(manager.storage_stats())
+    return 0
+
+
 def cmd_task_list(args) -> int:
     tasks = make_session(args).list_tasks(args.type)
     print_table(tasks, ["id", "task_type", "name", "state", "proxy_address"])
@@ -881,6 +917,17 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("uuid")
     c.add_argument("-o", "--output-dir", default=".")
     c.set_defaults(func=cmd_checkpoint_download)
+    c = sc.add_parser("stats",
+                      help="content-addressed store dedup ratio + "
+                           "chunk-cache hit rate")
+    c.add_argument("--config", default=None,
+                   help="experiment config yaml with a checkpoint_storage "
+                        "cas block")
+    c.add_argument("--host-path", default=None,
+                   help="shared_fs storage root (shortcut for a config)")
+    c.add_argument("--cache-path", default=None,
+                   help="local chunk-cache dir (with --host-path)")
+    c.set_defaults(func=cmd_checkpoint_stats)
 
     # task (generic) + NTSC types
     p_task = sub.add_parser("task", help="NTSC tasks")

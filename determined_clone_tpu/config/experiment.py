@@ -174,7 +174,7 @@ class ResourcesConfig:
 # harness/determined/common/storage backends)
 # ---------------------------------------------------------------------------
 
-_STORAGE_TYPES = {"shared_fs", "directory", "gcs", "s3", "azure"}
+_STORAGE_TYPES = {"shared_fs", "directory", "gcs", "s3", "azure", "cas"}
 
 
 @dataclasses.dataclass
@@ -190,6 +190,13 @@ class CheckpointStorageConfig:
     save_experiment_best: int = 0
     save_trial_best: int = 1
     save_trial_latest: int = 1
+    # content-addressed store (type: cas) — all default None so non-cas
+    # configs round-trip byte-identically through to_dict
+    chunk_size_kb: Optional[int] = None
+    cache_path: Optional[str] = None
+    cache_size_mb: Optional[int] = None
+    transfer_workers: Optional[int] = None
+    inner: Optional["CheckpointStorageConfig"] = None
 
     @staticmethod
     def from_dict(raw: Dict[str, Any]) -> "CheckpointStorageConfig":
@@ -198,6 +205,29 @@ class CheckpointStorageConfig:
             raise ConfigError(
                 f"unknown checkpoint_storage.type {t!r}; expected one of {sorted(_STORAGE_TYPES)}"
             )
+        inner = None
+        if t == "cas":
+            inner_raw = raw.get("inner")
+            if inner_raw is None:
+                # flat convenience form: `type: cas` + a shared_fs/directory
+                # path — synthesize the inner backend block
+                if raw.get("host_path"):
+                    inner_raw = {"type": "shared_fs",
+                                 "host_path": raw["host_path"],
+                                 "storage_path": raw.get("storage_path")}
+                    inner_raw = {k: v for k, v in inner_raw.items()
+                                 if v is not None}
+                elif raw.get("container_path"):
+                    inner_raw = {"type": "directory",
+                                 "container_path": raw["container_path"]}
+                else:
+                    raise ConfigError(
+                        "checkpoint_storage type 'cas' needs an 'inner' "
+                        "backend block (or a flat host_path/container_path)")
+            if inner_raw.get("type") == "cas":
+                raise ConfigError(
+                    "checkpoint_storage.inner cannot itself be 'cas'")
+            inner = CheckpointStorageConfig.from_dict(inner_raw)
         cfg = CheckpointStorageConfig(
             type=t,
             host_path=raw.get("host_path"),
@@ -210,6 +240,15 @@ class CheckpointStorageConfig:
             save_experiment_best=int(raw.get("save_experiment_best", 0)),
             save_trial_best=int(raw.get("save_trial_best", 1)),
             save_trial_latest=int(raw.get("save_trial_latest", 1)),
+            chunk_size_kb=(int(raw["chunk_size_kb"])
+                           if raw.get("chunk_size_kb") is not None else None),
+            cache_path=raw.get("cache_path"),
+            cache_size_mb=(int(raw["cache_size_mb"])
+                           if raw.get("cache_size_mb") is not None else None),
+            transfer_workers=(int(raw["transfer_workers"])
+                              if raw.get("transfer_workers") is not None
+                              else None),
+            inner=inner,
         )
         if t == "shared_fs" and not cfg.host_path:
             raise ConfigError("checkpoint_storage.host_path is required for shared_fs storage")
@@ -223,10 +262,26 @@ class CheckpointStorageConfig:
             raise ConfigError(
                 "checkpoint_storage.container is required for azure storage"
             )
+        if cfg.chunk_size_kb is not None and cfg.chunk_size_kb < 1:
+            raise ConfigError(
+                f"checkpoint_storage.chunk_size_kb must be >= 1, "
+                f"got {cfg.chunk_size_kb}")
+        if cfg.cache_size_mb is not None and cfg.cache_size_mb < 1:
+            raise ConfigError(
+                f"checkpoint_storage.cache_size_mb must be >= 1, "
+                f"got {cfg.cache_size_mb}")
+        if cfg.transfer_workers is not None and cfg.transfer_workers < 0:
+            raise ConfigError(
+                f"checkpoint_storage.transfer_workers must be >= 0, "
+                f"got {cfg.transfer_workers}")
         return cfg
 
     def to_dict(self) -> Dict[str, Any]:
-        return {k: v for k, v in dataclasses.asdict(self).items() if v is not None}
+        d = {k: v for k, v in dataclasses.asdict(self).items()
+             if v is not None and k != "inner"}
+        if self.inner is not None:
+            d["inner"] = self.inner.to_dict()
+        return d
 
 
 # ---------------------------------------------------------------------------

@@ -82,41 +82,61 @@ SEARCHER_SCHEMA = {
     },
 }
 
+_STORAGE_VARIANTS = {
+    "shared_fs": {
+        "type": "object", "open": True,
+        "properties": {"host_path": {"type": "string"},
+                       "storage_path": {"type": "string"}},
+        "required": ["host_path"],
+    },
+    "directory": {
+        "type": "object", "open": True,
+        "properties": {"container_path": {"type": "string"}},
+        "required": ["container_path"],
+    },
+    "gcs": {
+        "type": "object", "open": True,
+        "properties": {"bucket": {"type": "string"},
+                       "prefix": {"type": "string"}},
+        "required": ["bucket"],
+    },
+    "s3": {
+        "type": "object", "open": True,
+        "properties": {"bucket": {"type": "string"},
+                       "prefix": {"type": "string"}},
+        "required": ["bucket"],
+    },
+    "azure": {
+        "type": "object", "open": True,
+        "properties": {"container": {"type": "string"},
+                       "connection_string": {"type": "string"},
+                       "prefix": {"type": "string"}},
+        "required": ["container"],
+    },
+}
+
+# content-addressed wrapper: nests one concrete backend under `inner`
+# (or uses the flat host_path/container_path convenience form, so nothing
+# is `required` here — from_dict enforces that one of the forms is given)
+_STORAGE_VARIANTS["cas"] = {
+    "type": "object", "open": True,
+    "properties": {
+        "inner": {"union": {"field": "type",
+                            "variants": dict(_STORAGE_VARIANTS)}},
+        "chunk_size_kb": {"type": "integer"},
+        "cache_path": {"type": "string"},
+        "cache_size_mb": {"type": "integer"},
+        "transfer_workers": {"type": "integer"},
+        "host_path": {"type": "string"},
+        "storage_path": {"type": "string"},
+        "container_path": {"type": "string"},
+    },
+}
+
 STORAGE_SCHEMA = {
     "union": {
         "field": "type",
-        "variants": {
-            "shared_fs": {
-                "type": "object", "open": True,
-                "properties": {"host_path": {"type": "string"},
-                               "storage_path": {"type": "string"}},
-                "required": ["host_path"],
-            },
-            "directory": {
-                "type": "object", "open": True,
-                "properties": {"container_path": {"type": "string"}},
-                "required": ["container_path"],
-            },
-            "gcs": {
-                "type": "object", "open": True,
-                "properties": {"bucket": {"type": "string"},
-                               "prefix": {"type": "string"}},
-                "required": ["bucket"],
-            },
-            "s3": {
-                "type": "object", "open": True,
-                "properties": {"bucket": {"type": "string"},
-                               "prefix": {"type": "string"}},
-                "required": ["bucket"],
-            },
-            "azure": {
-                "type": "object", "open": True,
-                "properties": {"container": {"type": "string"},
-                               "connection_string": {"type": "string"},
-                               "prefix": {"type": "string"}},
-                "required": ["container"],
-            },
-        },
+        "variants": _STORAGE_VARIANTS,
     },
 }
 

@@ -97,6 +97,30 @@ def shim(raw: Dict[str, Any]) -> Tuple[Dict[str, Any], List[str]]:
         cfg["observability"] = tel
         notes.append("top-level telemetry is v0; shimmed to observability")
 
+    # flat `type: cas` form (a bare host_path/container_path instead of a
+    # nested inner backend block) is the v0 spelling; rewrite it to the
+    # explicit `inner:` form the v1 schema documents
+    storage = cfg.get("checkpoint_storage")
+    if (isinstance(storage, dict) and storage.get("type") == "cas"
+            and "inner" not in storage):
+        if storage.get("host_path"):
+            storage["inner"] = {
+                "type": "shared_fs",
+                "host_path": storage.pop("host_path"),
+            }
+            if storage.get("storage_path"):
+                storage["inner"]["storage_path"] = storage.pop(
+                    "storage_path")
+            notes.append("checkpoint_storage flat cas host_path is v0; "
+                         "shimmed to inner shared_fs block")
+        elif storage.get("container_path"):
+            storage["inner"] = {
+                "type": "directory",
+                "container_path": storage.pop("container_path"),
+            }
+            notes.append("checkpoint_storage flat cas container_path is "
+                         "v0; shimmed to inner directory block")
+
     # v0 flat `slots` became resources.slots_per_trial
     if "slots" in cfg:
         slots = cfg.pop("slots")

@@ -6,6 +6,7 @@ from determined_clone_tpu.core._checkpoint import (
     LocalCheckpointRegistry,
     NullCheckpointRegistry,
     validate_checkpoint_dir,
+    verify_manifest_digests,
 )
 from determined_clone_tpu.core._context import Context, init
 from determined_clone_tpu.core._distributed import (
@@ -41,6 +42,7 @@ __all__ = [
     "CheckpointCorruptError",
     "CheckpointRegistry",
     "validate_checkpoint_dir",
+    "verify_manifest_digests",
     "LocalCheckpointRegistry",
     "NullCheckpointRegistry",
     "Context",

@@ -114,6 +114,43 @@ def validate_checkpoint_dir(path: str, storage_id: str = "<local>") -> bool:
     return True
 
 
+def verify_manifest_digests(path: str, storage_id: str = "<local>") -> bool:
+    """Digest-verify a downloaded directory against its ``manifest.json``.
+
+    The download-path counterpart of :func:`validate_checkpoint_dir`: it
+    checks only that every file the manifest lists arrived whole (size +
+    sha256) — it does NOT require the COMMIT marker, because callers like
+    ``CheckpointContext.download`` may legitimately fetch a subset or an
+    uncommitted checkpoint for inspection. Returns False silently for a
+    legacy download with no manifest; raises
+    :class:`CheckpointCorruptError` on any mismatch.
+    """
+    mpath = os.path.join(path, MANIFEST_FILE)
+    if not os.path.exists(mpath):
+        return False
+    try:
+        with open(mpath) as f:
+            doc = json.load(f)
+    except ValueError as e:
+        raise CheckpointCorruptError(
+            storage_id, f"unreadable manifest: {e}") from None
+    for rel, want in (doc.get("files") or {}).items():
+        p = os.path.join(path, rel)
+        if not os.path.exists(p):
+            # a partial download (paths subset) is not corruption
+            continue
+        size = os.path.getsize(p)
+        if size != want.get("size"):
+            raise CheckpointCorruptError(
+                storage_id, f"downloaded file {rel!r} is {size} bytes, "
+                f"manifest says {want.get('size')} (torn transfer)")
+        if want.get("sha256") and _sha256(p) != want["sha256"]:
+            raise CheckpointCorruptError(
+                storage_id, f"downloaded file {rel!r} content digest "
+                "mismatch")
+    return True
+
+
 class CheckpointRegistry:
     """Record of reported checkpoints. Subclasses: local JSONL or master REST."""
 
@@ -196,7 +233,7 @@ class CheckpointContext:
         """
         storage_id, upload_paths = self._coordinate(ckpt_dir, metadata, shard)
         if upload_paths is not None:
-            self._storage.upload(ckpt_dir, storage_id, paths=upload_paths)
+            self._upload_ordered(ckpt_dir, storage_id, upload_paths)
         faults.point("checkpoint.post_upload")
         self._dist.barrier()
         self._commit_and_publish(storage_id, metadata)
@@ -243,6 +280,21 @@ class CheckpointContext:
         self._write_manifest(ckpt_dir, storage_id,
                              _file_entries(ckpt_dir, files))
         return storage_id, [MANIFEST_FILE] + files
+
+    def _upload_ordered(self, ckpt_dir: str, storage_id: str,
+                        paths: List[str]) -> None:
+        """Upload with the manifest strictly first, in its own storage
+        call. The transfer pool settles every file of one call even when
+        some fail, so a single call can no longer guarantee list order —
+        and a partial save whose data landed but whose manifest didn't
+        would pass restore validation as a pre-protocol legacy checkpoint.
+        Two calls restore the invariant: manifest durably in place before
+        any data file exists, or no data file at all."""
+        if paths and paths[0] == MANIFEST_FILE:
+            self._storage.upload(ckpt_dir, storage_id, paths=paths[:1])
+            paths = paths[1:]
+        if paths:
+            self._storage.upload(ckpt_dir, storage_id, paths=paths)
 
     def _commit_and_publish(self, storage_id: str,
                             metadata: Optional[Dict[str, Any]]) -> None:
@@ -330,7 +382,7 @@ class CheckpointContext:
 
         def io(tmp=tmp, storage_id=storage_id, paths=upload_paths):
             try:
-                self._storage.upload(tmp, storage_id, paths=paths)
+                self._upload_ordered(tmp, storage_id, paths)
             except BaseException as e:  # noqa: BLE001 - surfaced at wait
                 error["error"] = e
             finally:
@@ -426,8 +478,13 @@ class CheckpointContext:
 
     # -- restore ------------------------------------------------------------
 
-    def download(self, storage_id: str, ckpt_dir: str) -> None:
+    def download(self, storage_id: str, ckpt_dir: str, *,
+                 verify: bool = True) -> None:
         self._storage.download(storage_id, ckpt_dir)
+        if verify:
+            # digest-verify against the manifest even outside restore_path:
+            # a torn transfer must never hand back silently-bad bytes
+            verify_manifest_digests(ckpt_dir, storage_id)
 
     @contextlib.contextmanager
     def restore_path(self, storage_id: str, *,

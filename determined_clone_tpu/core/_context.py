@@ -112,9 +112,12 @@ def init(
     cleanup_dir: Optional[tempfile.TemporaryDirectory] = None
     if config.checkpoint_storage is not None:
         storage = storage_base.build(config.checkpoint_storage)
+        # the cas wrapper keeps its paths on the inner backend block
+        path_cfg = config.checkpoint_storage
+        if path_cfg.type == "cas" and path_cfg.inner is not None:
+            path_cfg = path_cfg.inner
         registry_base = (
-            config.checkpoint_storage.host_path
-            or config.checkpoint_storage.container_path or "."
+            path_cfg.host_path or path_cfg.container_path or "."
         )
     else:
         if storage_path is None:
@@ -124,6 +127,9 @@ def init(
             CheckpointStorageConfig(type="shared_fs", host_path=storage_path)
         )
         registry_base = storage_path
+
+    if telemetry is not None and hasattr(storage, "set_telemetry"):
+        storage.set_telemetry(telemetry.registry, telemetry.tracer)
 
     registry = checkpoint_registry or LocalCheckpointRegistry(
         os.path.join(registry_base, "checkpoints.jsonl")

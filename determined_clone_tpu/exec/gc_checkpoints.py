@@ -31,6 +31,12 @@ def sweep_uncommitted(manager) -> int:
         return 0
     swept = failed = 0
     for sid in storage_ids:
+        if sid == "cas":
+            # the content-addressed chunk namespace (storage/cas.py) is not
+            # a checkpoint and never has a COMMIT marker; a CAS manager
+            # already hides it, but guard here too for legacy GC configs
+            # pointing directly at the inner store
+            continue
         try:
             if manager.is_committed(sid):
                 continue
@@ -56,6 +62,9 @@ def main() -> int:
     if not storage_raw:
         print("DCT_GC_STORAGE not set; nothing to do")
         return 0
+    # when DCT_GC_STORAGE is a `type: cas` block, delete() below also runs
+    # the ref-counted chunk GC: chunks still referenced by any surviving
+    # checkpoint are kept (storage/cas.py, docs/checkpoint_storage.md)
     manager = build(CheckpointStorageConfig.from_dict(json.loads(storage_raw)))
     uuids = [u for u in uuids_raw.split(",") if u]
     failed = 0

@@ -107,6 +107,13 @@ class CheckpointRef:
             raise ValueError("no checkpoint_storage config available")
         manager = build(CheckpointStorageConfig.from_dict(storage_config))
         manager.download(self.uuid, output_dir)
+        # digest-verify what arrived against the checkpoint's manifest —
+        # a torn download should fail loudly here, not at model load
+        from determined_clone_tpu.core._checkpoint import (
+            verify_manifest_digests,
+        )
+
+        verify_manifest_digests(output_dir, self.uuid)
         return output_dir
 
 

@@ -8,13 +8,27 @@ from determined_clone_tpu.storage.base import (
     StorageManager,
     build,
 )
+from determined_clone_tpu.storage.cas import (
+    CASStorageManager,
+    ChunkCache,
+)
+from determined_clone_tpu.storage.transfer import (
+    TransferPool,
+    get_pool,
+    reset_pool,
+)
 
 __all__ = [
     "AzureStorageManager",
+    "CASStorageManager",
+    "ChunkCache",
     "DirectoryStorageManager",
     "GCSStorageManager",
     "S3StorageManager",
     "SharedFSStorageManager",
     "StorageManager",
+    "TransferPool",
     "build",
+    "get_pool",
+    "reset_pool",
 ]

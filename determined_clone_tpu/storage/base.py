@@ -23,6 +23,7 @@ from typing import Any, Dict, Iterator, List, Optional
 
 from determined_clone_tpu import faults
 from determined_clone_tpu.config.experiment import CheckpointStorageConfig
+from determined_clone_tpu.storage import transfer as transfer_pool
 from determined_clone_tpu.utils import retry as retry_util
 
 # Commit marker: its presence is the *only* thing that makes a checkpoint
@@ -90,6 +91,15 @@ class StorageManager(abc.ABC):
         raise NotImplementedError(
             f"{type(self).__name__} cannot enumerate checkpoints")
 
+    def delete_files(self, storage_id: str,
+                     paths: List[str]) -> None:
+        """Delete individual objects of one checkpoint (idempotent:
+        already-missing paths are not an error). Used by the
+        content-addressed store's chunk GC, which must reclaim single
+        chunks without touching the rest of the namespace."""
+        raise NotImplementedError(
+            f"{type(self).__name__} cannot delete individual files")
+
     def storage_age_s(self, storage_id: str) -> Optional[float]:
         """Seconds since the checkpoint's newest write, or None if unknown.
 
@@ -143,9 +153,15 @@ class SharedFSStorageManager(StorageManager):
                paths: Optional[List[str]] = None) -> None:
         dst = self._dir(storage_id)
         os.makedirs(dst, exist_ok=True)
-        for rel in paths if paths is not None else _walk_relative(src_dir):
-            _transfer(self._copy_in,
-                      os.path.join(src_dir, rel), os.path.join(dst, rel))
+        rels = paths if paths is not None else _walk_relative(src_dir)
+        # fan per-file copies over the shared transfer pool; retries stay
+        # per-file (_transfer) so already-copied files are never redone
+        transfer_pool.get_pool().run([
+            (lambda rel=rel: _transfer(
+                self._copy_in,
+                os.path.join(src_dir, rel), os.path.join(dst, rel)))
+            for rel in rels
+        ])
 
     @staticmethod
     def _copy_in(src: str, out: str) -> None:
@@ -189,6 +205,19 @@ class SharedFSStorageManager(StorageManager):
 
     def delete(self, storage_id: str) -> None:
         shutil.rmtree(self._dir(storage_id), ignore_errors=True)
+
+    def delete_files(self, storage_id: str, paths: List[str]) -> None:
+        d = self._dir(storage_id)
+        for rel in paths:
+            try:
+                os.remove(os.path.join(d, rel))
+            except FileNotFoundError:
+                pass  # idempotent: a concurrent GC already removed it
+        # prune now-empty fan-out dirs so list_storage_ids stays tidy
+        for root, _, _ in os.walk(d, topdown=False):
+            if root != d and not os.listdir(root):
+                with contextlib.suppress(OSError):
+                    os.rmdir(root)
 
     def list_storage_ids(self) -> List[str]:
         if not os.path.isdir(self.base):
@@ -282,6 +311,13 @@ class GCSStorageManager(StorageManager):
                 self.bucket, prefix=self._list_prefix(storage_id)):
             blob.delete()
 
+    def delete_files(self, storage_id, paths):
+        for rel in paths:
+            try:
+                self.bucket.blob(self._key(storage_id, rel)).delete()
+            except Exception:
+                pass  # already-missing blob: delete_files is idempotent
+
     def list_files(self, storage_id):
         return {
             blob.name.split(f"{storage_id}/", 1)[1]: blob.size
@@ -358,6 +394,12 @@ class S3StorageManager(StorageManager):
         for item in list(self._list_all(self._list_prefix(storage_id))):
             self.s3.delete_object(Bucket=self.bucket_name, Key=item["Key"])
 
+    def delete_files(self, storage_id, paths):
+        # delete_object is idempotent by API contract (no error on missing)
+        for rel in paths:
+            self.s3.delete_object(Bucket=self.bucket_name,
+                                  Key=self._key(storage_id, rel))
+
     def list_files(self, storage_id):
         return {
             item["Key"].split(f"{storage_id}/", 1)[1]: item["Size"]
@@ -433,6 +475,13 @@ class AzureStorageManager(StorageManager):
                 name_starts_with=self._list_prefix(storage_id))):
             self.container.delete_blob(blob.name)
 
+    def delete_files(self, storage_id, paths):
+        for rel in paths:
+            try:
+                self.container.delete_blob(self._key(storage_id, rel))
+            except Exception:
+                pass  # already-missing blob: delete_files is idempotent
+
     def list_files(self, storage_id):
         return {
             blob.name.split(f"{storage_id}/", 1)[1]: blob.size
@@ -451,6 +500,14 @@ def _walk_relative(base: str) -> List[str]:
 
 def build(cfg: CheckpointStorageConfig) -> StorageManager:
     """Factory from the checkpoint_storage config union."""
+    if cfg.type == "cas":
+        # lazy import: cas.py imports from this module
+        from determined_clone_tpu.storage import cas as cas_mod
+
+        if cfg.inner is None:
+            raise ValueError("checkpoint_storage type 'cas' needs an "
+                             "'inner' backend block")
+        return cas_mod.build_cas(cfg, build(cfg.inner))
     if cfg.type == "shared_fs":
         return SharedFSStorageManager(cfg.host_path, cfg.storage_path)
     if cfg.type == "directory":

@@ -21,6 +21,10 @@
 # `./run_tests.sh --chaos` runs the fault-tolerance suite
 # (docs/fault_tolerance.md) with no marker filter, so the slow kill -9
 # subprocess test runs too — the tier-1 lane skips it via `-m "not slow"`.
+#
+# `./run_tests.sh --storage` runs the checkpoint-storage surface
+# (docs/checkpoint_storage.md): backends, the content-addressed store +
+# transfer pool, and the storage-facing fault-tolerance paths.
 if [ "$1" = "--lint" ]; then
     shift
     exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
@@ -31,6 +35,10 @@ elif [ "$1" = "--tier1" ]; then
 elif [ "$1" = "--chaos" ]; then
     shift
     set -- tests/test_fault_tolerance.py "$@"
+elif [ "$1" = "--storage" ]; then
+    shift
+    set -- tests/test_storage_backends.py tests/test_cas_store.py \
+        tests/test_fault_tolerance.py -m "not slow" "$@"
 elif [ "$1" = "--observability" ]; then
     shift
     set -- tests/test_telemetry.py tests/test_profiler_tensorboard.py \

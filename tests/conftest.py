@@ -39,6 +39,11 @@ _LIBRARY_THREAD_PREFIXES = (
     "profiler-", "ckpt-upload", "tb-sync",
 )
 
+# Deliberately process-lifetime daemon threads: the shared transfer pool's
+# workers (storage/transfer.py) park on a queue between checkpoint
+# uploads/restores by design — surviving a test is correct, not a leak.
+_PERSISTENT_THREAD_PREFIXES = ("dct-xfer",)
+
 
 @pytest.fixture(autouse=True)
 def no_leaked_nondaemon_threads():
@@ -48,7 +53,8 @@ def no_leaked_nondaemon_threads():
     A surviving non-daemon thread would hang interpreter exit in
     production; a surviving library daemon thread means a feeder/profiler
     shutdown path was skipped. A short grace window lets threads a test
-    just signalled finish dying.
+    just signalled finish dying. Threads in _PERSISTENT_THREAD_PREFIXES
+    are exempt — they are shared process-wide by design.
     """
     before = set(threading.enumerate())
     yield
@@ -56,6 +62,7 @@ def no_leaked_nondaemon_threads():
     def leaked():
         return [t for t in threading.enumerate()
                 if t not in before and t.is_alive()
+                and not t.name.startswith(_PERSISTENT_THREAD_PREFIXES)
                 and (not t.daemon
                      or t.name.startswith(_LIBRARY_THREAD_PREFIXES))]
 

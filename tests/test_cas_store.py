@@ -1,0 +1,517 @@
+"""Content-addressed checkpoint store (docs/checkpoint_storage.md):
+the shared transfer pool, the local chunk cache, chunk-level dedup,
+ref-counted chunk GC, the config/schema/shim plumbing, and the
+`dct checkpoint stats` surface."""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from determined_clone_tpu import core
+from determined_clone_tpu.config import ExperimentConfig
+from determined_clone_tpu.config.experiment import (
+    CheckpointStorageConfig,
+    ConfigError,
+)
+from determined_clone_tpu.config.schema import STORAGE_SCHEMA, validate
+from determined_clone_tpu.config.shims import shim
+from determined_clone_tpu.core import CheckpointCorruptError
+from determined_clone_tpu.core._checkpoint import verify_manifest_digests
+from determined_clone_tpu.storage import (
+    CASStorageManager,
+    ChunkCache,
+    SharedFSStorageManager,
+    TransferPool,
+    build,
+)
+from determined_clone_tpu.storage import cas as cas_mod
+
+CHUNK = 1024  # small chunks so a few KiB of payload spans many
+
+
+# ---------------------------------------------------------------------------
+# transfer pool
+# ---------------------------------------------------------------------------
+
+def test_pool_returns_results_in_task_order():
+    pool = TransferPool(workers=4)
+    try:
+        # reversed sleeps: without index tracking, completion order would
+        # invert submission order
+        tasks = [(lambda i=i: (time.sleep(0.02 * (4 - i)), i)[1])
+                 for i in range(5)]
+        assert pool.run(tasks) == [0, 1, 2, 3, 4]
+    finally:
+        pool.shutdown()
+
+
+def test_pool_settles_every_task_then_raises_first_error():
+    pool = TransferPool(workers=2)
+    ran = []
+
+    def ok(i):
+        ran.append(i)
+
+    def boom():
+        raise OSError("copy died")
+
+    try:
+        with pytest.raises(OSError, match="copy died"):
+            pool.run([lambda: ok(0), boom, lambda: ok(2), lambda: ok(3)])
+        # per-file progress is kept even when one transfer dies
+        assert sorted(ran) == [0, 2, 3]
+    finally:
+        pool.shutdown()
+
+
+def test_pool_workers0_runs_inline_and_in_order():
+    pool = TransferPool(workers=0)
+    seen = []
+    pool.run([(lambda i=i: seen.append(
+        (i, threading.current_thread().name))) for i in range(4)])
+    assert [i for i, _ in seen] == [0, 1, 2, 3]
+    me = threading.current_thread().name
+    assert all(name == me for _, name in seen)
+
+
+def test_pool_single_task_never_queues():
+    pool = TransferPool(workers=4)
+    try:
+        holder = []
+        pool.run([lambda: holder.append(threading.current_thread().name)])
+        # inline on the caller: no worker round-trip for a lone transfer
+        assert holder == [threading.current_thread().name]
+    finally:
+        pool.shutdown()
+
+
+def test_pool_nested_run_cannot_deadlock():
+    # one worker: the outer batch occupies it, so the inner run() must be
+    # served by caller participation or the pool would deadlock
+    pool = TransferPool(workers=1)
+    try:
+        def outer(i):
+            return sum(pool.run([(lambda j=j: i * 10 + j)
+                                 for j in range(2)]))
+        assert pool.run([lambda i=i: outer(i) for i in range(3)]) == \
+            [1, 21, 41]
+    finally:
+        pool.shutdown()
+
+
+def test_pool_rejects_negative_workers_and_shutdown_is_final():
+    with pytest.raises(ValueError):
+        TransferPool(workers=-1)
+    pool = TransferPool(workers=2)
+    pool.run([lambda: 1, lambda: 2])
+    pool.shutdown()
+    with pytest.raises(RuntimeError):
+        pool.run([lambda: 1, lambda: 2])
+
+
+# ---------------------------------------------------------------------------
+# chunk cache
+# ---------------------------------------------------------------------------
+
+def _digest(data: bytes) -> str:
+    return cas_mod._sha256_bytes(data)
+
+
+def test_cache_roundtrip_and_persisted_stats(tmp_path):
+    cache = ChunkCache(str(tmp_path / "cache"), max_bytes=1 << 20)
+    data = b"q" * 300
+    d = _digest(data)
+    assert cache.get(d) is None          # miss
+    cache.put(d, data)
+    hit = cache.get(d)
+    assert hit is not None and open(hit, "rb").read() == data
+    s = cache.stats()
+    assert (s["hits"], s["misses"], s["entries"]) == (1, 1, 1)
+    assert s["bytes"] == 300 and s["hit_rate"] == 0.5
+    # counters survive a process restart (stats.json)
+    again = ChunkCache(str(tmp_path / "cache"), max_bytes=1 << 20)
+    assert again.stats()["hits"] == 1 and again.stats()["misses"] == 1
+
+
+def test_cache_discards_corrupt_entry_as_miss(tmp_path):
+    cache = ChunkCache(str(tmp_path / "cache"))
+    data = b"r" * 128
+    d = _digest(data)
+    p = cache.put(d, data)
+    with open(p, "wb") as f:
+        f.write(b"x" * 128)  # bit rot under the same key
+    assert cache.get(d) is None          # verified, evicted, counted a miss
+    assert not os.path.exists(p)
+    assert cache.stats()["misses"] == 1
+
+
+def test_cache_evicts_lru_but_never_the_fresh_entry(tmp_path):
+    cache = ChunkCache(str(tmp_path / "cache"), max_bytes=250)
+    blobs = [bytes([i]) * 100 for i in range(3)]
+    for i, blob in enumerate(blobs):
+        cache.put(_digest(blob), blob)
+        os.utime(cache._entry(_digest(blob)),
+                 (1_000_000 + i, 1_000_000 + i))  # deterministic recency
+    # 300 bytes > 250 cap: the oldest entry went, the other two stayed
+    assert cache.get(_digest(blobs[0])) is None
+    assert cache.get(_digest(blobs[1])) is not None
+    assert cache.get(_digest(blobs[2])) is not None
+    # a cache smaller than one chunk still holds the entry just written
+    tiny = ChunkCache(str(tmp_path / "tiny"), max_bytes=10)
+    big = b"z" * 100
+    tiny.put(_digest(big), big)
+    assert tiny.get(_digest(big)) is not None
+
+
+# ---------------------------------------------------------------------------
+# content-addressed store
+# ---------------------------------------------------------------------------
+
+class CountingFS(SharedFSStorageManager):
+    """SharedFS that counts chunk-object downloads (cache-bypass probe)."""
+
+    def __init__(self, base):
+        super().__init__(base)
+        self.chunk_fetches = 0
+
+    def download(self, storage_id, dst_dir, paths=None):
+        if storage_id == cas_mod.CHUNK_NAMESPACE:
+            self.chunk_fetches += len(paths or [])
+        return super().download(storage_id, dst_dir, paths=paths)
+
+
+def make_cas(tmp_path, *, cache=False, counting=False):
+    inner_cls = CountingFS if counting else SharedFSStorageManager
+    inner = inner_cls(str(tmp_path / "store"))
+    ck_cache = (ChunkCache(str(tmp_path / "cache"), max_bytes=1 << 20)
+                if cache else None)
+    mgr = CASStorageManager(inner, chunk_size=CHUNK, cache=ck_cache,
+                            pool=TransferPool(workers=0))
+    return mgr, inner
+
+
+def write_payload(src, blob, extra=None):
+    os.makedirs(os.path.join(src, "state"), exist_ok=True)
+    with open(os.path.join(src, "state", "weights.bin"), "wb") as f:
+        f.write(blob)
+    if extra is not None:
+        with open(os.path.join(src, "opt.bin"), "wb") as f:
+            f.write(extra)
+
+
+def test_cas_dedup_across_saves(tmp_path):
+    mgr, _ = make_cas(tmp_path)
+    blob = bytearray(8 * CHUNK)
+    for i in range(8):
+        blob[i * CHUNK:(i + 1) * CHUNK] = bytes([i + 1]) * CHUNK
+    src = tmp_path / "src"
+    write_payload(str(src), bytes(blob))
+    mgr.upload(str(src), "ck-1")
+    first = mgr.session_stats["bytes_uploaded"]
+    assert first == 8 * CHUNK
+
+    # one chunk changes between saves: saves 2 and 3 upload only it
+    for n, sid in ((2, "ck-2"), (3, "ck-3")):
+        blob[0:CHUNK] = bytes([0x40 + n]) * CHUNK
+        write_payload(str(src), bytes(blob))
+        before = mgr.session_stats["bytes_uploaded"]
+        mgr.upload(str(src), sid)
+        assert mgr.session_stats["bytes_uploaded"] - before == CHUNK
+        mgr.commit(sid)
+    mgr.commit("ck-1")
+
+    stats = mgr.storage_stats()
+    assert stats["cas_checkpoints"] == 3
+    assert stats["chunk_bytes"] == 10 * CHUNK     # 8 + 1 + 1 unique chunks
+    assert stats["logical_bytes"] == 24 * CHUNK   # 3 x 8 logical
+    assert stats["dedup_ratio"] == 2.4
+    assert mgr.session_stats["chunks_deduped"] == 14
+
+
+def test_cas_restore_bit_identical_with_nested_paths(tmp_path):
+    mgr, _ = make_cas(tmp_path)
+    blob = os.urandom(3 * CHUNK + 17)   # non-aligned tail chunk
+    extra = os.urandom(CHUNK // 2)
+    src = tmp_path / "src"
+    write_payload(str(src), blob, extra)
+    mgr.upload(str(src), "ck-1")
+
+    # the logical listing hides chunk manifests and reports true sizes
+    assert mgr.list_files("ck-1") == {
+        "state/weights.bin": len(blob), "opt.bin": len(extra)}
+
+    dst = tmp_path / "dst"
+    mgr.download("ck-1", str(dst))
+    assert open(dst / "state" / "weights.bin", "rb").read() == blob
+    assert open(dst / "opt.bin", "rb").read() == extra
+
+
+def test_cas_empty_file_roundtrip(tmp_path):
+    mgr, _ = make_cas(tmp_path)
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "empty.bin").write_bytes(b"")
+    mgr.upload(str(src), "ck-1")
+    assert mgr.list_files("ck-1") == {"empty.bin": 0}
+    dst = tmp_path / "dst"
+    mgr.download("ck-1", str(dst))
+    assert (dst / "empty.bin").read_bytes() == b""
+
+
+def test_cas_warm_restore_never_touches_backend(tmp_path):
+    mgr, inner = make_cas(tmp_path, cache=True, counting=True)
+    blob = os.urandom(4 * CHUNK)
+    src = tmp_path / "src"
+    write_payload(str(src), blob)
+    mgr.upload(str(src), "ck-1")
+
+    # chunks were cached on the way up: even the first restore is warm
+    dst = tmp_path / "dst"
+    mgr.download("ck-1", str(dst))
+    assert inner.chunk_fetches == 0
+    assert open(dst / "state" / "weights.bin", "rb").read() == blob
+    assert mgr.session_stats["cache_hits"] == 4
+
+    # cold cache (fresh process, no --cache-path): every chunk is fetched
+    cold = CASStorageManager(inner, chunk_size=CHUNK,
+                             pool=TransferPool(workers=0))
+    dst2 = tmp_path / "dst2"
+    cold.download("ck-1", str(dst2))
+    assert inner.chunk_fetches == 4
+    assert open(dst2 / "state" / "weights.bin", "rb").read() == blob
+
+
+def test_cas_gc_keeps_referenced_chunks(tmp_path):
+    mgr, inner = make_cas(tmp_path)
+    shared = os.urandom(2 * CHUNK)
+    src = tmp_path / "src"
+    write_payload(str(src), shared)
+    mgr.upload(str(src), "ck-1")
+    write_payload(str(src), shared, extra=os.urandom(CHUNK))
+    mgr.upload(str(src), "ck-2")
+    assert len(inner.list_files("cas")) == 3
+
+    # ck-2's unique chunk is reclaimed; the chunks ck-1 still references
+    # survive and ck-1 stays bit-identical
+    mgr.delete("ck-2")
+    assert len(inner.list_files("cas")) == 2
+    dst = tmp_path / "dst"
+    mgr.download("ck-1", str(dst))
+    assert open(dst / "state" / "weights.bin", "rb").read() == shared
+
+    mgr.delete("ck-1")  # last reference: the namespace empties out
+    assert inner.list_files("cas") == {}
+
+
+def test_cas_gc_protects_chunks_of_uncommitted_saves(tmp_path):
+    # an in-flight (uncommitted) save's chunks must survive a concurrent
+    # delete of an older checkpoint that shares them
+    mgr, inner = make_cas(tmp_path)
+    blob = os.urandom(2 * CHUNK)
+    src = tmp_path / "src"
+    write_payload(str(src), blob)
+    mgr.upload(str(src), "ck-old")
+    mgr.commit("ck-old")
+    mgr.upload(str(src), "ck-inflight")  # same content, never committed
+    mgr.delete("ck-old")
+    dst = tmp_path / "dst"
+    mgr.download("ck-inflight", str(dst))
+    assert open(dst / "state" / "weights.bin", "rb").read() == blob
+
+
+def test_cas_constructor_rejects_nesting_and_bad_chunk_size(tmp_path):
+    inner = SharedFSStorageManager(str(tmp_path))
+    wrapped = CASStorageManager(inner, chunk_size=CHUNK)
+    with pytest.raises(ValueError, match="nest"):
+        CASStorageManager(wrapped, chunk_size=CHUNK)
+    with pytest.raises(ValueError, match="chunk_size"):
+        CASStorageManager(inner, chunk_size=0)
+
+
+def test_cas_list_storage_ids_hides_chunk_namespace(tmp_path):
+    mgr, _ = make_cas(tmp_path)
+    src = tmp_path / "src"
+    write_payload(str(src), os.urandom(CHUNK))
+    mgr.upload(str(src), "ck-1")
+    assert mgr.list_storage_ids() == ["ck-1"]
+
+
+# ---------------------------------------------------------------------------
+# config plumbing: from_dict/to_dict, schema, shim, build()
+# ---------------------------------------------------------------------------
+
+CAS_RAW = {
+    "type": "cas",
+    "chunk_size_kb": 64,
+    "cache_path": "/var/cache/dct",
+    "cache_size_mb": 16,
+    "transfer_workers": 2,
+    "inner": {"type": "shared_fs", "host_path": "/data/ckpts"},
+}
+
+
+def test_cas_config_round_trips_and_validates():
+    cfg = CheckpointStorageConfig.from_dict(CAS_RAW)
+    assert cfg.inner.type == "shared_fs"
+    d = cfg.to_dict()
+    assert d["inner"]["host_path"] == "/data/ckpts"
+    assert CheckpointStorageConfig.from_dict(d).to_dict() == d
+    assert validate(d, STORAGE_SCHEMA) == []
+    # the schema union rejects a cas inner (no such variant nested)
+    bad = dict(CAS_RAW, inner={"type": "cas",
+                               "inner": CAS_RAW["inner"]})
+    assert validate(bad, STORAGE_SCHEMA) != []
+
+
+def test_non_cas_config_to_dict_has_no_cas_keys():
+    d = CheckpointStorageConfig.from_dict(
+        {"type": "shared_fs", "host_path": "/x"}).to_dict()
+    assert not {"inner", "chunk_size_kb", "cache_path", "cache_size_mb",
+                "transfer_workers"} & set(d)
+
+
+def test_cas_config_rejections():
+    with pytest.raises(ConfigError, match="inner"):
+        CheckpointStorageConfig.from_dict({"type": "cas"})
+    with pytest.raises(ConfigError, match="cannot itself"):
+        CheckpointStorageConfig.from_dict(
+            {"type": "cas", "inner": dict(CAS_RAW)})
+    with pytest.raises(ConfigError, match="chunk_size_kb"):
+        CheckpointStorageConfig.from_dict(
+            dict(CAS_RAW, chunk_size_kb=0))
+    with pytest.raises(ConfigError, match="transfer_workers"):
+        CheckpointStorageConfig.from_dict(
+            dict(CAS_RAW, transfer_workers=-1))
+
+
+def test_flat_cas_form_synthesizes_inner_and_shims():
+    # from_dict accepts the flat v0 convenience form directly
+    cfg = CheckpointStorageConfig.from_dict(
+        {"type": "cas", "host_path": "/data"})
+    assert cfg.inner.type == "shared_fs"
+    assert cfg.inner.host_path == "/data"
+    # and the shim rewrites it to the explicit nested form, with a note
+    raw, notes = shim({
+        "checkpoint_storage": {"type": "cas", "host_path": "/data"}})
+    storage = raw["checkpoint_storage"]
+    assert storage["inner"] == {"type": "shared_fs", "host_path": "/data"}
+    assert "host_path" not in storage
+    assert any("flat cas" in n for n in notes)
+
+
+def test_build_wires_cas_chain(tmp_path):
+    cfg = CheckpointStorageConfig.from_dict({
+        "type": "cas", "chunk_size_kb": 1, "transfer_workers": 0,
+        "cache_path": str(tmp_path / "cache"), "cache_size_mb": 1,
+        "inner": {"type": "shared_fs", "host_path": str(tmp_path / "s")}})
+    mgr = build(cfg)
+    assert isinstance(mgr, CASStorageManager)
+    assert isinstance(mgr._inner, SharedFSStorageManager)
+    assert mgr._chunk_size == 1024
+    assert mgr._cache is not None and mgr._cache.max_bytes == 1 << 20
+
+    # default off: a plain shared_fs config builds the plain backend
+    plain = build(CheckpointStorageConfig.from_dict(
+        {"type": "shared_fs", "host_path": str(tmp_path / "s")}))
+    assert not isinstance(plain, CASStorageManager)
+
+
+def test_experiment_config_accepts_cas_block(tmp_path):
+    cfg = ExperimentConfig.from_dict({
+        "searcher": {"name": "single", "metric": "loss",
+                     "max_length": {"batches": 4}},
+        "checkpoint_storage": {
+            "type": "cas", "chunk_size_kb": 1,
+            "inner": {"type": "shared_fs", "host_path": str(tmp_path)}},
+    })
+    assert cfg.checkpoint_storage.type == "cas"
+    assert cfg.checkpoint_storage.inner.host_path == str(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# dct checkpoint stats
+# ---------------------------------------------------------------------------
+
+def test_cli_checkpoint_stats_reports_dedup_and_cache(tmp_path, capsys):
+    from determined_clone_tpu.cli.cli import main
+
+    mgr, _ = make_cas(tmp_path)
+    src = tmp_path / "src"
+    blob = os.urandom(2 * CHUNK)
+    write_payload(str(src), blob)
+    mgr.upload(str(src), "ck-1")
+    mgr.commit("ck-1")
+    mgr.upload(str(src), "ck-2")    # full dedup against ck-1
+    mgr.commit("ck-2")
+
+    rc = main(["checkpoint", "stats",
+               "--host-path", str(tmp_path / "store"),
+               "--cache-path", str(tmp_path / "cli-cache")])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["cas_checkpoints"] == 2
+    assert doc["dedup_ratio"] == 2.0
+    assert doc["cache"]["path"] == str(tmp_path / "cli-cache")
+
+
+def test_cli_checkpoint_stats_refuses_non_cas_config(tmp_path, capsys):
+    from determined_clone_tpu.cli.cli import main
+
+    cfg = tmp_path / "exp.yaml"
+    cfg.write_text("checkpoint_storage:\n  type: shared_fs\n"
+                   f"  host_path: {tmp_path}\n")
+    assert main(["checkpoint", "stats", "--config", str(cfg)]) == 2
+    assert "not" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# non-CAS path: downloads digest-verify against manifest.json too
+# ---------------------------------------------------------------------------
+
+def make_core(tmp_path):
+    cfg = ExperimentConfig.from_dict({
+        "searcher": {"name": "single", "metric": "loss",
+                     "max_length": {"batches": 4}},
+        "checkpoint_storage": {"type": "shared_fs",
+                               "host_path": str(tmp_path)},
+    })
+    return core.init(config=cfg, trial_id=1)
+
+
+def test_download_digest_verifies_against_manifest(tmp_path):
+    with make_core(tmp_path / "store") as cctx:
+        ck = cctx.checkpoint
+        with ck.store_path() as (path, holder):
+            with open(os.path.join(path, "weights.bin"), "wb") as f:
+                f.write(b"\x0a" * 64)
+        sid = holder["storage_id"]
+        # same-size content swap: the size check passes, only the sha256
+        # in manifest.json can convict it
+        with open(tmp_path / "store" / sid / "weights.bin", "wb") as f:
+            f.write(b"\x0b" * 64)
+        dst = tmp_path / "dl"
+        with pytest.raises(CheckpointCorruptError) as ei:
+            ck.download(sid, str(dst))
+        assert "digest mismatch" in ei.value.reason
+        # opt-out for forensic inspection of a known-bad checkpoint
+        ck.download(sid, str(tmp_path / "dl2"), verify=False)
+
+
+def test_verify_manifest_digests_semantics(tmp_path):
+    d = tmp_path / "ck"
+    d.mkdir()
+    (d / "a.bin").write_bytes(b"abc")
+    # legacy dir without a manifest: nothing to verify, not refused
+    assert verify_manifest_digests(str(d)) is False
+    manifest = {"files": {
+        "a.bin": {"size": 3, "sha256": cas_mod._sha256_bytes(b"abc")},
+        "b.bin": {"size": 9, "sha256": "0" * 64},
+    }}
+    (d / "manifest.json").write_text(json.dumps(manifest))
+    # b.bin absent = partial download (paths subset), not corruption
+    assert verify_manifest_digests(str(d)) is True
+    (d / "a.bin").write_bytes(b"abX")
+    with pytest.raises(CheckpointCorruptError):
+        verify_manifest_digests(str(d))

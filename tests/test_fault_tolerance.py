@@ -32,6 +32,7 @@ from determined_clone_tpu.core._checkpoint import (
 )
 from determined_clone_tpu.experiment import LocalExperimentRunner
 from determined_clone_tpu.parallel import MeshSpec, make_mesh
+from determined_clone_tpu.storage import transfer
 from determined_clone_tpu.storage.base import (
     COMMIT_FILE,
     STORAGE_IO_POLICY,
@@ -41,6 +42,17 @@ from determined_clone_tpu.training import JaxTrial, Trainer, TrialContext
 from determined_clone_tpu.utils import retry
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def pin_sequential_pool(monkeypatch):
+    """Force the shared transfer pool inline/in-order for this test.
+
+    Fault rules that target the Nth hit of a transfer point (or mirror a
+    seeded RNG draw-for-draw) need per-file order to be deterministic;
+    parallel workers would race the hit counter. monkeypatch restores the
+    real pool afterwards."""
+    monkeypatch.setattr(transfer, "_pool",
+                        transfer.TransferPool(workers=0))
 
 
 @pytest.fixture(autouse=True)
@@ -239,6 +251,7 @@ def test_retry_call_deadline_caps_and_stops():
 # ---------------------------------------------------------------------------
 
 def test_flaky_upload_retries_and_resumes(tmp_path, monkeypatch):
+    pin_sequential_pool(monkeypatch)
     src = tmp_path / "src"
     src.mkdir()
     (src / "a.bin").write_bytes(b"aaaa")
@@ -324,6 +337,7 @@ def test_uncommitted_checkpoint_is_refused(tmp_path):
 
 
 def test_torn_write_detected_by_manifest(tmp_path, monkeypatch):
+    pin_sequential_pool(monkeypatch)
     monkeypatch.setattr(retry, "_sleep", lambda s: None)
     with make_core(tmp_path) as cctx:
         # truncate the 2nd uploaded file (manifest goes first, then data)
@@ -378,6 +392,7 @@ def test_interrupted_saves_never_restorable(tmp_path, monkeypatch, seed):
     storage failures, every checkpoint id on disk is either committed
     (and fully validates) or is refused by restore — there is no third
     state where a partial save loads."""
+    pin_sequential_pool(monkeypatch)
     monkeypatch.setattr(retry, "_sleep", lambda s: None)
     with make_core(tmp_path) as cctx:
         ck = cctx.checkpoint
@@ -513,6 +528,120 @@ def test_restore_raises_when_every_candidate_corrupt(tmp_path):
         ctx2 = TrialContext(config=cfg, hparams={}, core=cctx, mesh=mesh)
         with pytest.raises(CheckpointCorruptError):
             Trainer(DriftTrial(ctx2)).fit(latest_checkpoint=sids[0])
+
+
+# ---------------------------------------------------------------------------
+# content-addressed store: chunk faults during save are refused on restore
+# ---------------------------------------------------------------------------
+
+def cas_storage(tmp_path):
+    return {"type": "cas", "chunk_size_kb": 1,
+            "inner": {"type": "shared_fs", "host_path": str(tmp_path)}}
+
+
+def make_cas_core(tmp_path, trial_id=1):
+    cfg = ExperimentConfig.from_dict({
+        "searcher": {"name": "single", "metric": "loss",
+                     "max_length": {"batches": 4}},
+        "checkpoint_storage": cas_storage(tmp_path),
+    })
+    return core.init(config=cfg, trial_id=trial_id)
+
+
+def test_torn_chunk_makes_checkpoint_unrestorable(tmp_path, monkeypatch):
+    pin_sequential_pool(monkeypatch)
+    monkeypatch.setattr(retry, "_sleep", lambda s: None)
+    with make_cas_core(tmp_path) as cctx:
+        ck = cctx.checkpoint
+        # truncate the 2nd chunk object as it is staged for upload;
+        # chunks must differ or dedup collapses them to one upload
+        with faults.plan_active({"rules": [
+                {"point": "cas.chunk_upload", "action": "truncate",
+                 "nth": 2, "keep_bytes": 5}]}):
+            with ck.store_path() as (path, holder):
+                with open(os.path.join(path, "weights.bin"), "wb") as f:
+                    f.write(b"".join(bytes([i]) * 1024 for i in range(4)))
+        sid = holder["storage_id"]
+        # committed — the torn chunk is only convicted when restore
+        # digest-checks it against the chunk manifest
+        assert (tmp_path / sid / COMMIT_FILE).exists()
+        with pytest.raises(CheckpointCorruptError) as ei:
+            with ck.restore_path(sid):
+                pass
+        assert "torn chunk" in ei.value.reason
+        assert ei.value.storage_id == sid
+
+
+def test_dropped_chunk_makes_checkpoint_unrestorable(tmp_path, monkeypatch):
+    pin_sequential_pool(monkeypatch)
+    monkeypatch.setattr(retry, "_sleep", lambda s: None)
+    with make_cas_core(tmp_path) as cctx:
+        ck = cctx.checkpoint
+        # the 1st chunk silently never reaches the backend (lost PUT)
+        with faults.plan_active({"rules": [
+                {"point": "cas.chunk_drop", "action": "truncate",
+                 "keep_bytes": 0, "nth": 1, "times": 1}]}):
+            with ck.store_path() as (path, holder):
+                with open(os.path.join(path, "weights.bin"), "wb") as f:
+                    f.write(b"\x07" * 3000)
+        sid = holder["storage_id"]
+        assert (tmp_path / sid / COMMIT_FILE).exists()
+        with pytest.raises(CheckpointCorruptError) as ei:
+            with ck.restore_path(sid):
+                pass
+        assert "missing from the chunk store" in ei.value.reason
+
+
+def cas_drift_config(tmp_path, batches=24, telemetry=False):
+    cfg = drift_config(tmp_path, batches)
+    cfg["checkpoint_storage"] = cas_storage(tmp_path)
+    if telemetry:
+        cfg["observability"] = {"enabled": True}
+    return cfg
+
+
+def test_trainer_falls_back_past_missing_chunk_checkpoint(
+        tmp_path, caplog, monkeypatch):
+    """End-to-end: a committed CAS checkpoint that lost a chunk is refused
+    at restore, the trainer falls back to the previous committed one, the
+    fallback is counted, and training still reaches the full length."""
+    from determined_clone_tpu.storage import cas as cas_mod
+
+    pin_sequential_pool(monkeypatch)
+    cfg = ExperimentConfig.from_dict(cas_drift_config(tmp_path, batches=16))
+    mesh = make_mesh(MeshSpec(dp=1), jax.devices()[:1])
+    with core.init(config=cfg, trial_id=1) as cctx:
+        ctx = TrialContext(config=cfg, hparams={}, core=cctx, mesh=mesh)
+        Trainer(DriftTrial(ctx)).fit()
+        sids = cctx.checkpoint.committed_checkpoints()  # newest first
+    assert len(sids) >= 2
+    newest, previous = sids[0], sids[1]
+
+    # lose a chunk only the newest checkpoint references — exactly the
+    # state a `cas.chunk_drop` fault during its save leaves behind
+    mgr = cas_mod.CASStorageManager(
+        SharedFSStorageManager(str(tmp_path)), chunk_size=1024)
+    victims = sorted(mgr._referenced_digests(newest)
+                     - mgr._referenced_digests(previous))
+    assert victims  # the drifted params produced at least one new chunk
+    os.unlink(tmp_path / cas_mod.CHUNK_NAMESPACE
+              / cas_mod.chunk_rel(victims[0]))
+
+    cfg2 = ExperimentConfig.from_dict(
+        cas_drift_config(tmp_path, batches=24, telemetry=True))
+    with core.init(config=cfg2, trial_id=1) as cctx:
+        ctx = TrialContext(config=cfg2, hparams={}, core=cctx, mesh=mesh)
+        with caplog.at_level(
+                logging.WARNING,
+                logger="determined_clone_tpu.training.trainer"):
+            result = Trainer(DriftTrial(ctx)).fit(latest_checkpoint=newest)
+        fallbacks = cctx.telemetry.registry.counter(
+            "checkpoint_restore_fallbacks").value
+    assert result["batches_trained"] == 24
+    assert fallbacks == 1
+    assert any(f"checkpoint {newest} refused" in r.getMessage()
+               for r in caplog.records)
+    assert previous in sids
 
 
 # ---------------------------------------------------------------------------
