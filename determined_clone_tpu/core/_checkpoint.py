@@ -114,15 +114,21 @@ def validate_checkpoint_dir(path: str, storage_id: str = "<local>") -> bool:
     return True
 
 
-def verify_manifest_digests(path: str, storage_id: str = "<local>") -> bool:
+def verify_manifest_digests(path: str, storage_id: str = "<local>", *,
+                            require_all: bool = False) -> bool:
     """Digest-verify a downloaded directory against its ``manifest.json``.
 
     The download-path counterpart of :func:`validate_checkpoint_dir`: it
-    checks only that every file the manifest lists arrived whole (size +
-    sha256) — it does NOT require the COMMIT marker, because callers like
-    ``CheckpointContext.download`` may legitimately fetch a subset or an
-    uncommitted checkpoint for inspection. Returns False silently for a
-    legacy download with no manifest; raises
+    checks that every file the manifest lists arrived whole (size +
+    sha256) — it does NOT require the COMMIT marker, because callers may
+    legitimately fetch an uncommitted checkpoint for inspection.
+
+    ``require_all=False`` tolerates manifest-listed files that are absent
+    locally (a partial ``paths`` download is not corruption). Callers that
+    performed a FULL download must pass ``require_all=True`` so a wholly
+    dropped file is convicted, not just a torn one — otherwise a backend
+    that silently lost an object would pass verification. Returns False
+    silently for a legacy download with no manifest; raises
     :class:`CheckpointCorruptError` on any mismatch.
     """
     mpath = os.path.join(path, MANIFEST_FILE)
@@ -137,6 +143,10 @@ def verify_manifest_digests(path: str, storage_id: str = "<local>") -> bool:
     for rel, want in (doc.get("files") or {}).items():
         p = os.path.join(path, rel)
         if not os.path.exists(p):
+            if require_all:
+                raise CheckpointCorruptError(
+                    storage_id, f"file {rel!r} in manifest is missing from "
+                    "a full download (lost object)")
             # a partial download (paths subset) is not corruption
             continue
         size = os.path.getsize(p)
@@ -483,8 +493,10 @@ class CheckpointContext:
         self._storage.download(storage_id, ckpt_dir)
         if verify:
             # digest-verify against the manifest even outside restore_path:
-            # a torn transfer must never hand back silently-bad bytes
-            verify_manifest_digests(ckpt_dir, storage_id)
+            # a torn transfer must never hand back silently-bad bytes.
+            # This is a full download, so a manifest-listed file that did
+            # not arrive at all is corruption too (require_all)
+            verify_manifest_digests(ckpt_dir, storage_id, require_all=True)
 
     @contextlib.contextmanager
     def restore_path(self, storage_id: str, *,

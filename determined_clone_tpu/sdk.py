@@ -113,7 +113,7 @@ class CheckpointRef:
             verify_manifest_digests,
         )
 
-        verify_manifest_digests(output_dir, self.uuid)
+        verify_manifest_digests(output_dir, self.uuid, require_all=True)
         return output_dir
 
 

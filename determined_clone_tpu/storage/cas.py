@@ -116,10 +116,19 @@ class ChunkCache:
     Every hit is digest-verified before it is served — a corrupted cache
     entry is silently discarded and counts as a miss, so the cache can
     never launder bad bytes into a restore. Hit/miss counters persist in
-    ``stats.json`` so ``dct checkpoint stats`` can report the hit rate
-    across processes. Recency is tracked via file mtimes (touched on every
-    hit), which survives process restarts.
+    ``stats.json`` (flushed every :data:`FLUSH_EVERY` lookups and on every
+    ``stats()`` call, not per-lookup — restores fetch thousands of chunks
+    and must not pay a file write each) so ``dct checkpoint stats`` can
+    report the hit rate across processes. Recency is tracked via file
+    mtimes (touched on every hit), which survives process restarts.
+
+    Two processes may share a cache_path (trainer + ``dct checkpoint
+    stats``, or neighboring trials on one host): every filesystem
+    operation here tolerates entries vanishing underneath it, treating a
+    foreign eviction as a plain miss.
     """
+
+    FLUSH_EVERY = 64
 
     def __init__(self, path: str,
                  max_bytes: int = DEFAULT_CACHE_BYTES) -> None:
@@ -132,6 +141,7 @@ class ChunkCache:
         self._lock = threading.RLock()
         os.makedirs(self._dir, exist_ok=True)
         self._stats = {"hits": 0, "misses": 0}
+        self._unflushed = 0
         if os.path.exists(self._stats_path):
             try:
                 with open(self._stats_path) as f:
@@ -145,34 +155,46 @@ class ChunkCache:
         return os.path.join(self._dir, digest)
 
     def _flush_stats(self) -> None:
-        tmp = self._stats_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self._stats, f)
-        os.replace(tmp, self._stats_path)
+        self._unflushed = 0
+        try:
+            tmp = self._stats_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self._stats, f)
+            os.replace(tmp, self._stats_path)
+        except OSError:
+            pass  # a cache that cannot persist counters must not fail I/O
+
+    def _note(self, key: str) -> None:
+        self._stats[key] += 1
+        self._unflushed += 1
+        if self._unflushed >= self.FLUSH_EVERY:
+            self._flush_stats()
 
     def get(self, digest: str) -> Optional[str]:
         """Path of the verified cached chunk, or None (counted as a miss)."""
         with self._lock:
             p = self._entry(digest)
-            if os.path.exists(p) and _sha256_file(p) == digest:
-                os.utime(p)  # LRU touch
-                self._stats["hits"] += 1
-                self._flush_stats()
-                return p
-            if os.path.exists(p):
-                # digest mismatch: a torn cache write or bit rot — evict so
-                # the next restore re-fetches the real bytes
-                os.remove(p)
-            self._stats["misses"] += 1
-            self._flush_stats()
+            try:
+                if os.path.exists(p) and _sha256_file(p) == digest:
+                    os.utime(p)  # LRU touch
+                    self._note("hits")
+                    return p
+                if os.path.exists(p):
+                    # digest mismatch: a torn cache write or bit rot — evict
+                    # so the next restore re-fetches the real bytes
+                    os.remove(p)
+            except FileNotFoundError:
+                pass  # another process evicted it mid-check: a miss
+            self._note("misses")
             return None
 
     def put(self, digest: str, data: bytes) -> str:
         with self._lock:
             p = self._entry(digest)
-            if os.path.exists(p):
-                os.utime(p)
-                return p
+            with contextlib.suppress(FileNotFoundError):
+                if os.path.exists(p):
+                    os.utime(p)
+                    return p
             fd, tmp = tempfile.mkstemp(dir=self._dir, prefix=".put-")
             try:
                 with os.fdopen(fd, "wb") as f:
@@ -188,9 +210,12 @@ class ChunkCache:
         entries = []
         for name in os.listdir(self._dir):
             ep = os.path.join(self._dir, name)
-            if os.path.isfile(ep) and not name.startswith("."):
-                entries.append((os.path.getmtime(ep), os.path.getsize(ep),
-                                name, ep))
+            try:
+                if os.path.isfile(ep) and not name.startswith("."):
+                    entries.append((os.path.getmtime(ep),
+                                    os.path.getsize(ep), name, ep))
+            except FileNotFoundError:
+                pass  # vanished between listdir and stat (shared cache)
         total = sum(e[1] for e in entries)
         # oldest-first, but never the entry just written (a cache smaller
         # than one chunk would otherwise thrash forever)
@@ -199,16 +224,21 @@ class ChunkCache:
                 return
             if name == keep:
                 continue
-            os.remove(ep)
+            with contextlib.suppress(FileNotFoundError):
+                os.remove(ep)
             total -= size
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
-            entries = [os.path.join(self._dir, n)
-                       for n in os.listdir(self._dir)
-                       if not n.startswith(".")]
-            sizes = [os.path.getsize(p) for p in entries
-                     if os.path.isfile(p)]
+            sizes = []
+            for n in os.listdir(self._dir):
+                p = os.path.join(self._dir, n)
+                try:
+                    if not n.startswith(".") and os.path.isfile(p):
+                        sizes.append(os.path.getsize(p))
+                except FileNotFoundError:
+                    pass  # vanished between listdir and stat (shared cache)
+            self._flush_stats()  # make the durable counters current
             hits, misses = self._stats["hits"], self._stats["misses"]
             looked = hits + misses
             return {
@@ -243,7 +273,12 @@ class CASStorageManager(StorageManager):
         self._cache = cache
         self._pool = pool
         self._lock = threading.Lock()
+        # dedup set: chunks believed present in the backend. Rebuilt from a
+        # fresh listing on every save (never unioned across saves — a chunk
+        # another process GC'd must drop out), plus the chunks this process
+        # uploaded itself (object-store listings can lag just-written keys).
         self._known_chunks: Set[str] = set()
+        self._session_chunks: Set[str] = set()
         # merged chunk manifests memo: (storage_id, manifest-rel tuple) ->
         # {rel: {"size", "chunks": [{"sha256", "size"}, ...]}}
         self._chunkmap_memo: Dict[Tuple[str, Tuple[str, ...]],
@@ -295,11 +330,20 @@ class CASStorageManager(StorageManager):
             return []
         return out
 
-    def _refresh_known_chunks(self) -> Set[str]:
+    def _list_backend_chunks(self) -> Set[str]:
+        """Digests present in the chunk namespace RIGHT NOW (fresh listing,
+        no session memo) — what dedup re-verification checks against."""
         listing = self._inner.list_files(CHUNK_NAMESPACE)
-        digests = {d for d in map(_digest_of_rel, listing) if d}
+        return {d for d in map(_digest_of_rel, listing) if d}
+
+    def _refresh_known_chunks(self) -> Set[str]:
+        digests = self._list_backend_chunks()
         with self._lock:
-            self._known_chunks |= digests
+            # REBUILT, not unioned: unioning forever would keep chunks that
+            # another process's GC reclaimed 'known' for the lifetime of a
+            # long-running trainer, deduping every later save against bytes
+            # the backend no longer has
+            self._known_chunks = digests | self._session_chunks
             return set(self._known_chunks)
 
     def _chunkmaps(self, storage_id: str,
@@ -348,6 +392,10 @@ class CASStorageManager(StorageManager):
             entries: Dict[str, Any] = {}
             to_send: List[Tuple[str, str, Dict[str, Any]]] = []
             seen_this_call: Set[str] = set()
+            # digest -> (src path, chunk) for chunks skipped as already
+            # present, kept so _verify_dedup can re-upload any that a
+            # concurrent GC reclaimed during this window
+            dedup_src: Dict[str, Tuple[str, Dict[str, Any]]] = {}
             for rel in chunked:
                 src = os.path.join(src_dir, rel)
                 chunks = self._scan_chunks(src)
@@ -358,18 +406,56 @@ class CASStorageManager(StorageManager):
                 }
                 for c in chunks:
                     d = c["sha256"]
-                    if d in known or d in seen_this_call:
+                    if d in seen_this_call:
                         self._count("bytes_deduped", c["size"])
                         self._count("chunks_deduped", 1)
                         continue
+                    if d in known:
+                        self._count("bytes_deduped", c["size"])
+                        self._count("chunks_deduped", 1)
+                        dedup_src.setdefault(d, (src, c))
+                        continue
                     seen_this_call.add(d)
                     to_send.append((src, rel, c))
+            # the chunk manifest goes BEFORE the chunk data: once it is
+            # durable, a concurrent GC's ref-count walk sees every chunk
+            # this save references — including the deduped ones it will
+            # never upload — and keeps them (delete() walks twice for the
+            # manifests that land mid-walk)
+            self._write_chunk_manifest(storage_id, entries)
             if to_send:
                 self._upload_chunks(to_send)
+                uploaded = {c["sha256"] for _, _, c in to_send}
                 with self._lock:
-                    self._known_chunks |= {c["sha256"]
-                                           for _, _, c in to_send}
-            self._write_chunk_manifest(storage_id, entries)
+                    self._known_chunks |= uploaded
+                    self._session_chunks |= uploaded
+            self._verify_dedup(dedup_src)
+
+    def _verify_dedup(
+            self,
+            dedup_src: Dict[str, Tuple[str, Dict[str, Any]]]) -> None:
+        """Dedup decisions are provisional until confirmed AFTER the chunk
+        manifest is durable: a GC whose ref-count walk predates the
+        manifest cannot see this save's references, so it may have
+        reclaimed a chunk the save skipped as already present. Re-check
+        every deduped digest against a fresh backend listing and re-upload
+        the ones that vanished — the manifest is visible now, so later GC
+        walks keep them."""
+        if not dedup_src:
+            return
+        present = self._list_backend_chunks()
+        missing = set(dedup_src) - present
+        if not missing:
+            return
+        logger.warning(
+            "cas: %d deduped chunk(s) vanished from the backend during the "
+            "save (concurrent GC); re-uploading", len(missing))
+        self._upload_chunks([(src, "", c)
+                             for d, (src, c) in sorted(dedup_src.items())
+                             if d in missing])
+        with self._lock:
+            self._known_chunks |= missing
+            self._session_chunks |= missing
 
     def _upload_chunks(
             self, to_send: List[Tuple[str, str, Dict[str, Any]]]) -> None:
@@ -533,13 +619,52 @@ class CASStorageManager(StorageManager):
         return {c["sha256"] for entry in chunkmap.values()
                 for c in entry.get("chunks") or []}
 
+    def _survivor_references(self, deleted_id: str) -> Optional[Set[str]]:
+        """Union of chunk digests referenced by every surviving checkpoint
+        dir, or None when the ref-count is unknowable (the backend cannot
+        enumerate, or a neighbor's manifests are unreadable) — the caller
+        must then keep every chunk."""
+        try:
+            survivors = self.list_storage_ids()
+        except NotImplementedError:
+            logger.info("chunk GC skipped: %s cannot enumerate checkpoints",
+                        type(self._inner).__name__)
+            return None
+        out: Set[str] = set()
+        for sid in survivors:
+            if sid == deleted_id:
+                continue
+            try:
+                out |= self._referenced_digests(sid)
+            except Exception as e:
+                # an unreadable neighbor makes the ref-count unknowable:
+                # keep every chunk rather than risk deleting a live one
+                logger.warning(
+                    "chunk GC aborted: cannot read chunk manifests of %s "
+                    "(%s); keeping all chunks", sid, e)
+                return None
+        return out
+
     def delete(self, storage_id: str) -> None:
         """Delete a checkpoint, then reclaim chunks nothing references.
 
         Ref-counting is recomputed from the surviving checkpoint dirs —
-        committed AND uncommitted (an in-flight save's chunks must survive
-        a concurrent GC), so a chunk is only removed when no remaining
-        checkpoint's chunk manifests mention it.
+        committed AND uncommitted. In-flight saves are protected by three
+        interlocking rules rather than any storage-level lock:
+
+        1. upload() writes the chunk manifest BEFORE any chunk data, so a
+           save's references (including chunks it deduped and will never
+           upload) become visible to this walk as early as possible;
+        2. the ref-count walk here runs TWICE, and a chunk is reclaimed
+           only when BOTH walks found it unreferenced — a manifest that
+           lands while the first walk is reading its neighbors still
+           protects its chunks (manifests are immutable and memoized, so
+           the second walk only re-lists and reads manifests that are
+           actually new);
+        3. a save whose dedup nevertheless raced a GC that completed
+           before its manifest landed re-verifies its deduped chunks
+           against a fresh listing and re-uploads any that vanished
+           (upload()/_verify_dedup) before the save returns.
         """
         try:
             doomed = self._referenced_digests(storage_id)
@@ -550,26 +675,16 @@ class CASStorageManager(StorageManager):
         self._forget(storage_id)
         if not doomed:
             return
-        try:
-            survivors = self.list_storage_ids()
-        except NotImplementedError:
-            logger.info("chunk GC skipped: %s cannot enumerate checkpoints",
-                        type(self._inner).__name__)
-            return
         referenced: Set[str] = set()
-        for sid in survivors:
-            if sid == storage_id:
-                continue
-            try:
-                referenced |= self._referenced_digests(sid)
-            except Exception as e:
-                # an unreadable neighbor makes the ref-count unknowable:
-                # keep every chunk rather than risk deleting a live one
-                logger.warning(
-                    "chunk GC aborted: cannot read chunk manifests of %s "
-                    "(%s); keeping all chunks", sid, e)
+        garbage = set(doomed)
+        for _ in range(2):
+            if not garbage:
                 return
-        garbage = doomed - referenced
+            refs = self._survivor_references(storage_id)
+            if refs is None:
+                return
+            referenced |= refs
+            garbage = doomed - referenced
         if not garbage:
             return
         try:
@@ -581,6 +696,7 @@ class CASStorageManager(StorageManager):
             return
         with self._lock:
             self._known_chunks -= garbage
+            self._session_chunks -= garbage
         logger.info("chunk GC: removed %d chunks unreferenced after "
                     "deleting %s (%d still referenced)",
                     len(garbage), storage_id, len(referenced & doomed))

@@ -110,9 +110,13 @@ class TransferPool:
             batch = self._queue.get()
             if batch is _STOP:
                 return
+            # drain the batch: wake tokens are capped at the pool size, so
+            # a worker that stopped after one task would leave the rest of
+            # a large batch to the caller, serializing it
             item = batch.take()
-            if item is not None:
+            while item is not None:
                 batch.run_one(item)
+                item = batch.take()
 
     def run(self, tasks: List[Callable[[], Any]]) -> List[Any]:
         if not tasks:
@@ -120,8 +124,8 @@ class TransferPool:
         batch = _Batch(tasks)
         if self.workers > 0 and len(tasks) > 1:
             self._ensure_workers()
-            # one wake token per task (capped at pool size); a worker that
-            # loses the race for a task just goes back to sleep
+            # one wake token per worker (capped at batch size); each woken
+            # worker drains tasks until the batch deque is empty
             for _ in range(min(len(tasks), self.workers)):
                 self._queue.put(batch)
         item = batch.take()

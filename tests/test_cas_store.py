@@ -2,6 +2,7 @@
 the shared transfer pool, the local chunk cache, chunk-level dedup,
 ref-counted chunk GC, the config/schema/shim plumbing, and the
 `dct checkpoint stats` surface."""
+import contextlib
 import json
 import os
 import threading
@@ -101,6 +102,32 @@ def test_pool_nested_run_cannot_deadlock():
         pool.shutdown()
 
 
+def test_pool_workers_drain_whole_batch():
+    # wake tokens are capped at the pool size, so workers must LOOP over
+    # the batch. Every task parks on a 3-party barrier (caller + both
+    # workers): 9 tasks need 3 full rounds with all three executors each
+    # round — a worker that quit after one task per wake would leave the
+    # barrier short from round 2 on and break it (timeout) instead
+    pool = TransferPool(workers=2)
+    barrier = threading.Barrier(3, timeout=10)
+    names = []
+    lock = threading.Lock()
+
+    def gate():
+        barrier.wait()
+        with lock:
+            names.append(threading.current_thread().name)
+
+    try:
+        pool.run([gate] * 9)
+    finally:
+        pool.shutdown()
+    assert len(names) == 9
+    # both workers took part in every round, not just the first
+    worker_runs = [n for n in names if n.startswith("dct-xfer")]
+    assert len(worker_runs) == 6
+
+
 def test_pool_rejects_negative_workers_and_shutdown_is_final():
     with pytest.raises(ValueError):
         TransferPool(workers=-1)
@@ -163,6 +190,60 @@ def test_cache_evicts_lru_but_never_the_fresh_entry(tmp_path):
     big = b"z" * 100
     tiny.put(_digest(big), big)
     assert tiny.get(_digest(big)) is not None
+
+
+def test_cache_stats_flush_is_amortized(tmp_path):
+    cache = ChunkCache(str(tmp_path / "cache"))
+    data = b"s" * 64
+    d = _digest(data)
+    cache.put(d, data)
+    for _ in range(10):
+        assert cache.get(d) is not None
+    # the hot path does not pay a stats.json write per lookup
+    assert not os.path.exists(cache._stats_path)
+    assert cache.stats()["hits"] == 10   # stats() makes counters durable
+    with open(cache._stats_path) as f:
+        assert json.load(f)["hits"] == 10
+
+
+def test_cache_evict_tolerates_vanished_entry(tmp_path, monkeypatch):
+    # two processes share a cache_path: an entry listed by _evict may be
+    # gone by the time it is stat'ed — that must not fail the put
+    cache = ChunkCache(str(tmp_path / "cache"), max_bytes=250)
+    blobs = [bytes([i]) * 100 for i in range(3)]
+    d0 = _digest(blobs[0])
+    cache.put(d0, blobs[0])
+    cache.put(_digest(blobs[1]), blobs[1])
+    real = os.path.getmtime
+
+    def foreign_evict(p):
+        if os.path.basename(p) == d0:
+            with contextlib.suppress(FileNotFoundError):
+                os.remove(p)
+        return real(p)
+
+    monkeypatch.setattr(os.path, "getmtime", foreign_evict)
+    cache.put(_digest(blobs[2]), blobs[2])   # triggers _evict
+    assert cache.get(_digest(blobs[2])) is not None
+
+
+def test_cache_stats_tolerates_vanished_entry(tmp_path, monkeypatch):
+    cache = ChunkCache(str(tmp_path / "cache"))
+    blobs = [bytes([i]) * 100 for i in range(2)]
+    d0 = _digest(blobs[0])
+    for blob in blobs:
+        cache.put(_digest(blob), blob)
+    real = os.path.getsize
+
+    def foreign_evict(p):
+        if os.path.basename(p) == d0:
+            with contextlib.suppress(FileNotFoundError):
+                os.remove(p)
+        return real(p)
+
+    monkeypatch.setattr(os.path, "getsize", foreign_evict)
+    s = cache.stats()
+    assert s["entries"] == 1 and s["bytes"] == 100
 
 
 # ---------------------------------------------------------------------------
@@ -318,6 +399,113 @@ def test_cas_gc_protects_chunks_of_uncommitted_saves(tmp_path):
     mgr.delete("ck-old")
     dst = tmp_path / "dst"
     mgr.download("ck-inflight", str(dst))
+    assert open(dst / "state" / "weights.bin", "rb").read() == blob
+
+
+def _chunk_paths_of(mgr, storage_id):
+    return [cas_mod.chunk_rel(d)
+            for d in sorted(mgr._referenced_digests(storage_id))]
+
+
+def test_cas_chunk_manifest_written_before_chunk_data(tmp_path, monkeypatch):
+    # the manifest-first invariant concurrent GC safety rests on: if the
+    # save dies mid-chunk-upload, the chunk manifest is already durable
+    # (and the checkpoint, lacking COMMIT, is refused on restore)
+    mgr, inner = make_cas(tmp_path)
+    monkeypatch.setattr(
+        mgr, "_upload_chunks",
+        lambda to_send: (_ for _ in ()).throw(OSError("PUT died")))
+    src = tmp_path / "src"
+    write_payload(str(src), os.urandom(2 * CHUNK))
+    with pytest.raises(OSError, match="PUT died"):
+        mgr.upload(str(src), "ck-1")
+    manifests = [r for r in inner.list_files("ck-1")
+                 if cas_mod._is_chunk_manifest(r)]
+    assert manifests, "chunk manifest must land before any chunk data"
+    assert mgr._referenced_digests("ck-1")  # its references are visible
+
+
+def test_cas_upload_repairs_dedup_against_concurrent_gc(tmp_path,
+                                                        monkeypatch):
+    # a foreign GC reclaims the chunks an in-flight save deduped against,
+    # in the window between the dedup decision and the manifest landing:
+    # the save must notice (fresh listing) and re-upload them
+    mgr, inner = make_cas(tmp_path)
+    blob = os.urandom(2 * CHUNK)
+    src = tmp_path / "src"
+    write_payload(str(src), blob)
+    mgr.upload(str(src), "ck-old")
+    mgr.commit("ck-old")
+    victims = _chunk_paths_of(mgr, "ck-old")
+
+    orig = mgr._write_chunk_manifest
+
+    def hostile(storage_id, entries):
+        orig(storage_id, entries)
+        if storage_id == "ck-new":
+            # simulate the other process's GC completing right here
+            inner.delete("ck-old")
+            inner.delete_files(cas_mod.CHUNK_NAMESPACE, victims)
+
+    monkeypatch.setattr(mgr, "_write_chunk_manifest", hostile)
+    before = mgr.session_stats["bytes_uploaded"]
+    mgr.upload(str(src), "ck-new")   # full dedup, then the repair path
+    assert mgr.session_stats["bytes_uploaded"] - before == 2 * CHUNK
+    mgr.commit("ck-new")
+    dst = tmp_path / "dst"
+    mgr.download("ck-new", str(dst))
+    assert open(dst / "state" / "weights.bin", "rb").read() == blob
+
+
+def test_cas_known_chunks_rebuilt_after_foreign_gc(tmp_path):
+    # a fresh save must not trust a dedup set that outlived the backend:
+    # after a foreign GC empties the chunk namespace, the next save
+    # re-uploads instead of deduping against bytes that are gone
+    mgr, inner = make_cas(tmp_path)
+    blob = os.urandom(2 * CHUNK)
+    src = tmp_path / "src"
+    write_payload(str(src), blob)
+    mgr.upload(str(src), "ck-1")
+    inner.delete("ck-1")
+    inner.delete_files(cas_mod.CHUNK_NAMESPACE, _chunk_paths_of(mgr, "ck-1"))
+    mgr._forget("ck-1")
+
+    mgr.upload(str(src), "ck-2")
+    dst = tmp_path / "dst"
+    mgr.download("ck-2", str(dst))
+    assert open(dst / "state" / "weights.bin", "rb").read() == blob
+
+
+def test_cas_gc_second_walk_honors_late_manifest(tmp_path, monkeypatch):
+    # a save on another manager writes its chunk manifest while this
+    # manager's GC is mid-walk: the second ref-count walk must see it and
+    # keep the shared chunks
+    mgr, inner = make_cas(tmp_path)
+    blob = os.urandom(2 * CHUNK)
+    src = tmp_path / "src"
+    write_payload(str(src), blob)
+    mgr.upload(str(src), "ck-old")
+    mgr.commit("ck-old")
+
+    other = CASStorageManager(inner, chunk_size=CHUNK,
+                              pool=TransferPool(workers=0))
+    orig = mgr.list_storage_ids
+    state = {"walks": 0}
+
+    def walk():
+        out = orig()
+        state["walks"] += 1
+        if state["walks"] == 1:
+            # first walk's listing predates ck-new; its manifest (and full
+            # dedup against ck-old's chunks) lands right after
+            other.upload(str(src), "ck-new")
+        return out
+
+    monkeypatch.setattr(mgr, "list_storage_ids", walk)
+    mgr.delete("ck-old")
+    assert state["walks"] >= 2
+    dst = tmp_path / "dst"
+    mgr.download("ck-new", str(dst))
     assert open(dst / "state" / "weights.bin", "rb").read() == blob
 
 
@@ -510,8 +698,27 @@ def test_verify_manifest_digests_semantics(tmp_path):
         "b.bin": {"size": 9, "sha256": "0" * 64},
     }}
     (d / "manifest.json").write_text(json.dumps(manifest))
-    # b.bin absent = partial download (paths subset), not corruption
+    # b.bin absent = partial download (paths subset), not corruption...
     assert verify_manifest_digests(str(d)) is True
+    # ...but a FULL download missing a whole manifest-listed file is: a
+    # backend that lost an object must not pass verification silently
+    with pytest.raises(CheckpointCorruptError, match="missing"):
+        verify_manifest_digests(str(d), require_all=True)
     (d / "a.bin").write_bytes(b"abX")
     with pytest.raises(CheckpointCorruptError):
         verify_manifest_digests(str(d))
+
+
+def test_download_refuses_wholly_missing_file(tmp_path):
+    # CheckpointContext.download is a full fetch: a data file the backend
+    # dropped entirely (not just tore) must be convicted too
+    with make_core(tmp_path / "store") as cctx:
+        ck = cctx.checkpoint
+        with ck.store_path() as (path, holder):
+            with open(os.path.join(path, "weights.bin"), "wb") as f:
+                f.write(b"\x0c" * 64)
+        sid = holder["storage_id"]
+        os.unlink(tmp_path / "store" / sid / "weights.bin")
+        with pytest.raises(CheckpointCorruptError) as ei:
+            ck.download(sid, str(tmp_path / "dl"))
+        assert "missing" in ei.value.reason
