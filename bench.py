@@ -427,8 +427,21 @@ def _run_child() -> None:
         flash = time_gpt(cfg_flash, rung["batch"], rung["seq"], rung["steps"])
 
         n_params = flash["model_params"]
-        mfu = (6.0 * n_params * flash["tokens_per_sec"] / peak
-               if on_tpu else None)
+        # Analytic FLOPs (attention + MLP + embeddings, telemetry/flops.py)
+        # against the published TPU peak or the labeled CPU estimate — mfu
+        # is never null; mfu_peak_assumed says what the denominator was.
+        from determined_clone_tpu.telemetry import flops as flops_mod
+
+        step_flops = flops_mod.gpt_train_step_flops(
+            cfg_flash, rung["batch"], rung["seq"])
+        flops_per_sec = (step_flops.total * flash["samples_per_sec"]
+                         / max(1, flash["batch"]))
+        if on_tpu:
+            mfu_peak, mfu_peak_label = peak, f"{tpu_gen}:{peak:.0f}"
+        else:
+            mfu_peak, cpu_label = flops_mod.peak_flops_estimate("cpu")
+            mfu_peak_label = f"{cpu_label}:{mfu_peak:.0f}"
+        mfu = flops_mod.mfu(flops_per_sec, mfu_peak)
         # Loss gate: the recorded band (regression) where one exists for
         # this config, the uniform-entropy catastrophe bound otherwise.
         loss_ok = loss_ok_for(rung["name"], flash["final_loss"], vocab)
@@ -438,8 +451,10 @@ def _run_child() -> None:
                 "metric": "gpt_train_throughput",
                 "value": round(flash["samples_per_sec"], 3),
                 "unit": "samples/sec/chip",
+                # the MFU bar is a TPU bar; a CPU estimate-denominated MFU
+                # would misleadingly score ~0 against it
                 "vs_baseline": (round(mfu / MFU_BASELINE_BAR, 3)
-                                if mfu is not None else 1.0),
+                                if on_tpu else 1.0),
                 "detail": {
                     "platform": device.platform,
                     "config": rung["name"],
@@ -448,9 +463,10 @@ def _run_child() -> None:
                     "batch": flash["batch"],
                     "seq_len": flash["seq_len"],
                     "tokens_per_sec": round(flash["tokens_per_sec"], 1),
-                    "mfu": round(mfu, 4) if mfu is not None else None,
-                    "mfu_peak_assumed": (f"{tpu_gen}:{peak:.0f}"
-                                         if on_tpu else None),
+                    "mfu": round(mfu, 6),
+                    "mfu_peak_assumed": mfu_peak_label,
+                    "flops_per_sec": round(flops_per_sec, 1),
+                    "flops_per_step": round(step_flops.total, 1),
                     "final_loss": flash["final_loss"],
                     "loss_ok": loss_ok,
                     "mha_samples_per_sec": mha_sps,
@@ -512,6 +528,52 @@ def _run_child() -> None:
 # --------------------------------------------------------------------------
 # Parent: bounded attempts, guaranteed single JSON line, exit 0.
 # --------------------------------------------------------------------------
+
+def _probe_registry(errors: dict):
+    """TPU probe failures as real telemetry, not just a detail string:
+    a counter + one labeled gauge per failed attempt, Prometheus-dumpable
+    and shippable to a master so `dct metrics` can show the
+    five-rounds-running tunnel timeout."""
+    from determined_clone_tpu.telemetry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    failures = reg.counter(
+        "tpu_probe_failures_total",
+        "bench TPU attempts that failed or silently fell back to CPU")
+    for attempt in ("tpu", "tpu_retry"):
+        # a budget-skipped retry is not a probe failure
+        if attempt in errors and not str(
+                errors[attempt]).startswith("skipped"):
+            failures.inc()
+            reg.gauge(
+                "tpu_error",
+                "constant 1; labels identify the failed TPU attempt",
+                labels={"attempt": attempt,
+                        "error": str(errors[attempt])[:160]}).set(1)
+    return reg
+
+
+def _attach_probe_telemetry(obj: dict, errors: dict) -> None:
+    """Embed the probe registry in the BENCH detail and, when DCT_MASTER
+    names a reachable master, ship it through the component-ingestion
+    route so the failure counters join the cluster rollup."""
+    reg = _probe_registry(errors)
+    if not errors:
+        return
+    detail = obj.setdefault("detail", {})
+    detail["tpu_probe_telemetry"] = reg.dump()
+    master = os.environ.get("DCT_MASTER")
+    if not master:
+        return
+    try:
+        from determined_clone_tpu.api.client import MasterSession
+
+        host, _, port = master.partition(":")
+        MasterSession(host or "127.0.0.1", int(port or "8080")).post(
+            "/api/v1/components/bench/profiler",
+            {"metrics": reg.snapshot()}, retryable=False)
+    except Exception:  # noqa: BLE001 - bench must print its line regardless
+        pass
 
 def _attempt(env: dict, budget: float, probe_budget: float | None) -> tuple:
     """Run the child under ``budget`` seconds; return (result, error).
@@ -684,6 +746,7 @@ def main() -> None:
             if obj is not None and _platform(obj) != "cpu":
                 obj.setdefault("detail", {})["tpu_first_attempt_error"] = (
                     errors.get("tpu"))
+                _attach_probe_telemetry(obj, errors)
                 print(json.dumps(obj))
                 return
             if obj is not None:
@@ -704,19 +767,22 @@ def main() -> None:
                 tpu_err += f"; retry: {errors['tpu_retry']}"
             detail["tpu_error"] = tpu_err
             detail["tpu_diagnostics"] = _tunnel_diagnostics()
+        _attach_probe_telemetry(cpu_obj, errors)
         print(json.dumps(cpu_obj))
         return
 
     detail = {"errors": errors}
     if tpu_wanted:
         detail["tpu_diagnostics"] = _tunnel_diagnostics()
-    print(json.dumps({
+    failed = {
         "metric": "gpt_train_throughput",
         "value": 0.0,
         "unit": "samples/sec/chip",
         "vs_baseline": 0.0,
         "detail": detail,
-    }))
+    }
+    _attach_probe_telemetry(failed, errors)
+    print(json.dumps(failed))
 
 
 if __name__ == "__main__":

@@ -474,20 +474,28 @@ def cmd_job_set_priority(args) -> int:
 
 
 def cmd_trace_export(args) -> int:
-    """Convert a trial's shipped telemetry spans (or a local span-record
-    JSONL) into a Perfetto-loadable Chrome trace-event JSON file."""
+    """Convert shipped telemetry spans (a trial's, a whole experiment's,
+    or a local span-record JSONL) into a Perfetto-loadable Chrome
+    trace-event JSON file. ``--experiment`` stitches every component lane
+    (runner + trials) sharing the experiment's trace_id into one file."""
     from determined_clone_tpu.telemetry.chrome_trace import (
         spans_from_profiler_samples,
+        stitch_chrome_trace,
         to_chrome_trace,
         validate_chrome_trace,
     )
 
+    stitched = args.experiment is not None
     if args.from_file:
         with open(args.from_file) as f:
             samples = [json.loads(line) for line in f if line.strip()]
+    elif stitched:
+        samples = make_session(args).get(
+            f"/api/v1/experiments/{args.experiment}/trace")["samples"]
     else:
         if args.trial_id is None:
-            print("error: give a trial id or --from-file", file=sys.stderr)
+            print("error: give a trial id, --experiment, or --from-file",
+                  file=sys.stderr)
             return 2
         samples = make_session(args).trial_profiler_samples(
             args.trial_id, limit=args.limit)
@@ -497,15 +505,58 @@ def cmd_trace_export(args) -> int:
               "observability: {enabled: true, ship_spans: true}",
               file=sys.stderr)
         return 1
-    trace = to_chrome_trace(spans)
+    if stitched or any(s.get("process") for s in spans):
+        trace = stitch_chrome_trace(spans)
+    else:
+        trace = to_chrome_trace(spans)
     problems = validate_chrome_trace(trace)
     if problems:  # can only come from malformed shipped records
         print("warning: trace has structural problems:\n  " +
               "\n  ".join(problems), file=sys.stderr)
     with open(args.output, "w") as f:
         json.dump(trace, f)
-    print(f"wrote {len(spans)} spans to {args.output} "
+    lanes = trace.get("otherData", {}).get("processes")
+    lane_note = f" across lanes {lanes}" if lanes else ""
+    print(f"wrote {len(spans)} spans to {args.output}{lane_note} "
           f"(load at ui.perfetto.dev or chrome://tracing)")
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    """Cluster-wide metrics view (`GET /metrics` + the master's summary
+    endpoint): top trials by throughput, cluster quantiles, restart/
+    fallback/retry counters — docs/observability.md."""
+    from determined_clone_tpu.telemetry.aggregate import format_summary
+    from determined_clone_tpu.telemetry.metrics import parse_prometheus_text
+
+    if args.raw:
+        master = args.master or os.environ.get("DCT_MASTER",
+                                               "127.0.0.1:8080")
+        url = f"http://{master}/metrics"
+        import urllib.request
+
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            sys.stdout.write(resp.read().decode("utf-8"))
+        return 0
+    session = make_session(args)
+    try:
+        summary = session.get("/api/v1/cluster/metrics")
+    except MasterError as e:
+        if e.status != 404:
+            raise
+        # older/C++ masters have /metrics but no summary route: degrade
+        # to a parsed view of the exposition text
+        import urllib.request
+
+        url = f"http://{session.host}:{session.port}/metrics"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            parsed = parse_prometheus_text(resp.read().decode("utf-8"))
+        for name, labels, value in parsed["samples"]:
+            label_s = ",".join(f"{k}={v}" for k, v in labels.items())
+            label_s = f"{{{label_s}}}" if label_s else ""
+            print(f"{name}{label_s} {value}")
+        return 0
+    print(format_summary(summary))
     return 0
 
 
@@ -1137,6 +1188,9 @@ def build_parser() -> argparse.ArgumentParser:
                         help="build a Chrome trace-event JSON from a "
                              "trial's shipped spans")
     c.add_argument("trial_id", type=int, nargs="?", default=None)
+    c.add_argument("--experiment", type=int, default=None,
+                   help="stitch every lane of this experiment (runner + "
+                        "trials) into one multi-process trace")
     c.add_argument("--from-file", default=None,
                    help="read span records from a local JSONL instead of "
                         "the master")
@@ -1144,6 +1198,14 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--limit", type=int, default=100000,
                    help="max profiler samples to pull from the master")
     c.set_defaults(func=cmd_trace_export)
+
+    # metrics (cluster-wide observability plane — docs/observability.md)
+    c = sub.add_parser("metrics",
+                       help="cluster metrics: top trials by throughput, "
+                            "quantiles, restart/retry counters")
+    c.add_argument("--raw", action="store_true",
+                   help="print the raw Prometheus exposition text")
+    c.set_defaults(func=cmd_metrics)
 
     # lint (dctlint static analysis — docs/static_analysis.md)
     c = sub.add_parser("lint",

@@ -266,6 +266,11 @@ def main(argv=None) -> int:
         if tel is not None and not tel.trace_path:
             tel.trace_path = os.path.abspath(
                 f"trace-trial-{info.trial_id}.json")
+        if tel is not None:
+            # trace stitching: DCT_TRACE_ID (set by the submitter) was
+            # already picked up by telemetry_from_config; the lane name
+            # makes this process a distinct row in the stitched trace
+            tel.set_identity(process_name=f"trial-{info.trial_id}")
         prof = profiler_mod.from_config(
             session, info.trial_id, info.experiment_config,
             registry=tel.registry if tel is not None else None)

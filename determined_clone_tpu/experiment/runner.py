@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import uuid
 from typing import Any, Callable, Dict, List, Optional, Type
 
 from determined_clone_tpu import core as core_mod
@@ -31,8 +32,19 @@ from determined_clone_tpu.searcher import (
 )
 from determined_clone_tpu.training.trainer import Trainer
 from determined_clone_tpu.training.trial import JaxTrial, TrialContext
-from determined_clone_tpu.telemetry import MetricsRegistry
+from determined_clone_tpu.telemetry import MetricsRegistry, Telemetry
 from determined_clone_tpu.utils import retry as retry_util
+
+
+class _SampleCollector:
+    """Duck-typed ProfilerAgent stand-in: ``Telemetry.publish`` feeds it,
+    the runner forwards the collected batch to the in-process master."""
+
+    def __init__(self) -> None:
+        self.samples: List[Dict[str, Any]] = []
+
+    def record(self, sample: Dict[str, Any]) -> None:
+        self.samples.append(sample)
 
 # Restart pacing (≈ the reference's trial restart delay): small enough that
 # single-host test runs stay fast, but each consecutive failure doubles the
@@ -78,6 +90,9 @@ class LocalExperimentRunner:
                  method: Optional[Any] = None,
                  registry: Optional[MetricsRegistry] = None,
                  restart_backoff: Optional[retry_util.RetryPolicy] = None,
+                 master: Optional[Any] = None,
+                 experiment_id: int = 1,
+                 trace_id: Optional[str] = None,
                  ) -> None:
         self.config = config
         self.trial_cls = trial_cls
@@ -85,6 +100,20 @@ class LocalExperimentRunner:
         self.mesh = mesh
         self.max_events = max_events
         self.registry = registry if registry is not None else MetricsRegistry()
+        # observability plane: when an InProcessMaster is attached, trial
+        # telemetry ships there after every leg (deduped by idempotency
+        # key) and the runner contributes its own trace lane so `dct trace
+        # export --experiment` can stitch runner + trials into one trace
+        self.master = master
+        self.experiment_id = int(experiment_id)
+        self.trace_id = trace_id or (uuid.uuid4().hex
+                                     if master is not None else None)
+        self.telemetry: Optional[Telemetry] = None
+        if master is not None:
+            self.telemetry = Telemetry(
+                enabled=True, max_events=max_events, ship_spans=True,
+                ship_metrics=False, trace_id=self.trace_id,
+                process_name="runner")
         self.restart_backoff = (restart_backoff if restart_backoff is not None
                                 else RESTART_BACKOFF)
         self._restarts_total = self.registry.counter(
@@ -131,18 +160,48 @@ class LocalExperimentRunner:
         searcher_source = core_mod.LocalSearcherSource(
             self._units_to_length(target_units)
         )
-        with core_mod.init(
-            config=cfg,
-            storage_path=self.storage_path,
-            metrics_backend=metrics_backend,
-            searcher_source=searcher_source,
-            trial_id=rec.request_id,
-        ) as cctx:
-            tctx = TrialContext(config=cfg, hparams=rec.hparams, core=cctx,
-                                mesh=self.mesh)
-            trial = self.trial_cls(tctx)
-            trainer = Trainer(trial)
-            result = trainer.fit(latest_checkpoint=rec.latest_checkpoint)
+        # export the experiment trace id through the env so the trial's
+        # telemetry (built inside core.init) joins this experiment's
+        # trace — the same contract exec/trial.py uses across a real
+        # process boundary
+        prev_trace_env = os.environ.get("DCT_TRACE_ID")
+        if self.trace_id:
+            os.environ["DCT_TRACE_ID"] = self.trace_id
+        leg_span = (self.telemetry.tracer.span(
+            "trial_leg", trial_id=rec.request_id, restart=rec.restarts,
+            target_units=target_units)
+            if self.telemetry is not None else None)
+        try:
+            with core_mod.init(
+                config=cfg,
+                storage_path=self.storage_path,
+                metrics_backend=metrics_backend,
+                searcher_source=searcher_source,
+                trial_id=rec.request_id,
+            ) as cctx:
+                if cctx.telemetry is not None:
+                    cctx.telemetry.set_identity(
+                        trace_id=self.trace_id,
+                        process_name=f"trial-{rec.request_id}")
+                try:
+                    if leg_span is not None:
+                        leg_span.__enter__()
+                    tctx = TrialContext(config=cfg, hparams=rec.hparams,
+                                        core=cctx, mesh=self.mesh)
+                    trial = self.trial_cls(tctx)
+                    trainer = Trainer(trial)
+                    result = trainer.fit(
+                        latest_checkpoint=rec.latest_checkpoint)
+                finally:
+                    if leg_span is not None:
+                        leg_span.__exit__(None, None, None)
+                    self._ship_trial_telemetry(rec, cctx)
+        finally:
+            if self.trace_id:
+                if prev_trace_env is None:
+                    os.environ.pop("DCT_TRACE_ID", None)
+                else:
+                    os.environ["DCT_TRACE_ID"] = prev_trace_env
         rec.units_done = target_units
         reg = core_mod.LocalCheckpointRegistry(self._registry_path())
         mine = [r for r in reg.list() if r.get("trial_id") == rec.request_id]
@@ -159,6 +218,41 @@ class LocalExperimentRunner:
             f"that validation_data()/min_validation_period are set."
         )
 
+    def _ship_trial_telemetry(self, rec: TrialRecord, cctx: Any) -> None:
+        """Forward the leg's telemetry snapshot + spans to the attached
+        master. Failures never fail the leg (telemetry is lossy by
+        contract); the batch carries an idempotency key so a replayed
+        restart leg can't double-count."""
+        if self.master is None or cctx.telemetry is None:
+            return
+        try:
+            collector = _SampleCollector()
+            cctx.telemetry.publish(collector)
+            if collector.samples:
+                self.master.ingest_trial(
+                    rec.request_id, collector.samples,
+                    idempotency_key=uuid.uuid4().hex)
+        except Exception:  # noqa: BLE001 - observability is best-effort
+            pass
+
+    def _ship_runner_telemetry(self) -> None:
+        """Contribute the runner's own lane (registry + spans) to the
+        master so restarts and orchestration time show up cluster-wide."""
+        if self.master is None:
+            return
+        try:
+            self.master.ingest_component("runner", self.registry)
+            if self.telemetry is not None:
+                collector = _SampleCollector()
+                self.telemetry.publish(collector)
+                spans = [s for s in collector.samples
+                         if s.get("group") == "span"]
+                if spans:
+                    self.master.ingest_component_spans(
+                        "runner", spans, experiment_id=self.experiment_id)
+        except Exception:  # noqa: BLE001 - observability is best-effort
+            pass
+
     def _registry_path(self) -> str:
         """The checkpoint registry lives next to the checkpoint storage —
         same resolution as core.init (core/_context.py)."""
@@ -171,6 +265,19 @@ class LocalExperimentRunner:
     # -- the orchestration loop --------------------------------------------
 
     def run(self) -> ExperimentResult:
+        exp_span = (self.telemetry.tracer.span(
+            "experiment", experiment_id=self.experiment_id)
+            if self.telemetry is not None else None)
+        if exp_span is not None:
+            exp_span.__enter__()
+        try:
+            return self._run_loop()
+        finally:
+            if exp_span is not None:
+                exp_span.__exit__(None, None, None)
+            self._ship_runner_telemetry()
+
+    def _run_loop(self) -> ExperimentResult:
         queue = list(self.engine.initial_operations())
         events = 0
         shutdown = False
@@ -181,6 +288,9 @@ class LocalExperimentRunner:
                 self.trials[op.request_id] = TrialRecord(
                     op.request_id, op.hparams
                 )
+                if self.master is not None:
+                    self.master.register_trial(op.request_id,
+                                               self.experiment_id)
                 queue.extend(self.engine.trial_created(op.request_id))
             elif isinstance(op, ValidateAfter):
                 rec = self.trials[op.request_id]

@@ -27,6 +27,7 @@ from typing import Any, Callable, Dict, Optional
 from determined_clone_tpu.telemetry.chrome_trace import (
     chrome_trace_events,
     spans_from_profiler_samples,
+    stitch_chrome_trace,
     to_chrome_trace,
     validate_chrome_trace,
     write_chrome_trace,
@@ -36,6 +37,7 @@ from determined_clone_tpu.telemetry.metrics import (
     Gauge,
     Histogram,
     MetricsRegistry,
+    parse_prometheus_text,
 )
 from determined_clone_tpu.telemetry.spans import (
     NULL_SPAN,
@@ -47,9 +49,10 @@ from determined_clone_tpu.telemetry.spans import (
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "NULL_SPAN", "Span", "Telemetry", "Tracer",
-    "chrome_trace_events", "null_span", "spans_from_profiler_samples",
-    "telemetry_from_config", "to_chrome_trace", "validate_chrome_trace",
-    "write_chrome_trace",
+    "chrome_trace_events", "null_span", "parse_prometheus_text",
+    "spans_from_profiler_samples",
+    "stitch_chrome_trace", "telemetry_from_config", "to_chrome_trace",
+    "validate_chrome_trace", "write_chrome_trace",
 ]
 
 
@@ -91,14 +94,37 @@ class Telemetry:
 
     def __init__(self, *, enabled: bool = True, max_events: int = 200_000,
                  ship_spans: bool = False, ship_metrics: bool = True,
-                 trace_path: Optional[str] = None) -> None:
+                 trace_path: Optional[str] = None,
+                 trace_id: Optional[str] = None,
+                 process_name: Optional[str] = None) -> None:
         self.enabled = enabled
         self.ship_spans = ship_spans
         self.ship_metrics = ship_metrics
         self.trace_path = trace_path
-        self.tracer = Tracer(enabled=enabled, max_events=max_events)
+        self.tracer = Tracer(enabled=enabled, max_events=max_events,
+                             trace_id=trace_id, process_name=process_name)
         self.registry = MetricsRegistry()
         self._ship_cursor = 0
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        return self.tracer.trace_id
+
+    @property
+    def process_name(self) -> Optional[str]:
+        return self.tracer.process_name
+
+    def set_identity(self, *, trace_id: Optional[str] = None,
+                     process_name: Optional[str] = None) -> None:
+        """Late-bind the cross-component trace identity. The runner (or
+        ``exec/trial.py``) knows the experiment's trace_id and the
+        process's lane name only after the telemetry object exists, so
+        identity is settable — shipped span records pick it up from here
+        on (already-shipped records keep whatever they went out with)."""
+        if trace_id is not None:
+            self.tracer.trace_id = trace_id
+        if process_name is not None:
+            self.tracer.process_name = process_name
 
     # -- instrumentation hooks ---------------------------------------------
 
@@ -189,8 +215,18 @@ class Telemetry:
         if self.ship_spans:
             new, self._ship_cursor = self.tracer.drain_since(
                 self._ship_cursor)
+            # identity + clock anchor ride every shipped record so the
+            # master can stitch lanes from different processes into one
+            # trace (ts_us is relative to each tracer's private epoch;
+            # wall_epoch aligns them)
+            ident: Dict[str, Any] = {"wall_epoch": self.tracer.wall_epoch}
+            if self.tracer.trace_id:
+                ident["trace_id"] = self.tracer.trace_id
+            if self.tracer.process_name:
+                ident["process"] = self.tracer.process_name
             for rec in new:
-                profiler.record({"time": now, "group": "span", **rec})
+                profiler.record(
+                    {"time": now, "group": "span", **ident, **rec})
 
     def export_chrome_trace(self, path: Optional[str] = None) -> str:
         path = path or self.trace_path or "trace.json"
@@ -238,4 +274,8 @@ def telemetry_from_config(config: Any) -> Optional[Telemetry]:
         ship_spans=obs.ship_spans,
         ship_metrics=obs.ship_metrics,
         trace_path=obs.trace_path,
+        # cross-component stitching: the experiment submitter exports its
+        # trace id through the trial env (runner.py / exec/trial.py), so
+        # every component of one experiment shares one trace
+        trace_id=os.environ.get("DCT_TRACE_ID") or None,
     )

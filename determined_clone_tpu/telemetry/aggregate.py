@@ -1,0 +1,440 @@
+"""Master-side telemetry aggregation: the cluster-wide metrics plane.
+
+Trials already ship registry snapshots and span records over the profiler
+channel (``POST /api/v1/trials/{id}/profiler``, groups ``telemetry`` /
+``span`` / ``timing``); until now the master only appended them to a
+JSONL file. :class:`ClusterMetricsAggregator` turns those batches into a
+live cluster view:
+
+- **per-trial series** — the latest registry snapshot per trial is
+  re-exposed with a ``trial_id`` label (gauges/counters as-is, histograms
+  as Prometheus summaries built from the shipped p50/p95/p99);
+- **cluster rollups** — ``dct_cluster_<name>``: counters summed across
+  trials, gauges summed (plus a ``_avg`` series, since "sum" is right for
+  throughput and wrong for ratios like MFU), histogram quantiles merged
+  by count-weighted average (an approximation — exact cluster quantiles
+  would need the raw reservoirs, which we deliberately don't ship);
+- **ingestion hygiene** — malformed/oversized batches are rejected,
+  counted (``dct_master_ingest_rejected_total{reason=...}``) and warned
+  about at most once a minute, mirroring the trial-side
+  ``profiler_samples_dropped`` shedding counter so loss is observable on
+  both ends; duplicate batches are dropped via the PR 4 idempotency keys.
+
+The aggregator is transport-agnostic: the in-process master feeds it
+directly, an HTTP front-end feeds it parsed JSON bodies. ``dump()`` is
+the ``GET /metrics`` payload; ``summary()`` backs ``dct metrics``.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import threading
+import time
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from determined_clone_tpu.telemetry.metrics import (
+    MetricsRegistry,
+    _escape_help,
+    _label_str,
+    _valid_name,
+)
+
+log = logging.getLogger("dct.telemetry.aggregate")
+
+# Mirrors the trial-side profiler shedding thresholds (profiler.py):
+# the agent batches at most 100 samples and sheds past 10x that, so a
+# well-behaved client can never legitimately exceed these.
+MAX_INGEST_BATCH = 1000
+MAX_SAMPLE_BYTES = 64 * 1024
+REJECT_WARN_PERIOD_SEC = 60.0
+SEEN_KEYS_MAX = 8192
+SPANS_PER_TRIAL_MAX = 20_000
+
+_KNOWN_GROUPS = ("telemetry", "span", "timing", "system")
+
+
+def _fmt(v: Any) -> str:
+    f = float(v)
+    return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+class _TrialState:
+    __slots__ = ("snapshot", "batches_trained", "last_time", "spans",
+                 "experiment_id")
+
+    def __init__(self) -> None:
+        self.snapshot: Dict[str, Dict[str, Any]] = {}
+        self.batches_trained: Optional[int] = None
+        self.last_time: float = 0.0
+        self.spans: Deque[Dict[str, Any]] = collections.deque(
+            maxlen=SPANS_PER_TRIAL_MAX)
+        self.experiment_id: Optional[int] = None
+
+
+class ClusterMetricsAggregator:
+    """Ingests trial/component telemetry into one cluster-level view."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._trials: Dict[int, _TrialState] = {}
+        # non-trial components (runner, master) keyed by component name
+        self._components: Dict[str, Dict[str, Dict[str, Any]]] = {}
+        self._component_spans: Dict[
+            str, Deque[Tuple[Optional[int], Dict[str, Any]]]] = {}
+        self._seen_keys: "collections.OrderedDict[str, None]" = (
+            collections.OrderedDict())
+        self._last_reject_warn = 0.0
+        self._rejected_since_warn = 0
+        self.registry = MetricsRegistry()
+        self._batches = self.registry.counter(
+            "dct_master_ingest_batches_total",
+            "telemetry batches accepted by the master")
+        self._samples = self.registry.counter(
+            "dct_master_ingest_samples_total",
+            "telemetry samples accepted by the master")
+        self._duplicates = self.registry.counter(
+            "dct_master_ingest_duplicates_total",
+            "batches dropped as idempotency-key duplicates")
+
+    # -- ingestion ---------------------------------------------------------
+
+    def _reject(self, n: int, reason: str) -> None:
+        self.registry.counter(
+            "dct_master_ingest_rejected_total",
+            "telemetry samples rejected at ingestion, by reason",
+            labels={"reason": reason}).inc(n)
+        now = time.monotonic()
+        with self._lock:
+            self._rejected_since_warn += n
+            if now - self._last_reject_warn < REJECT_WARN_PERIOD_SEC:
+                return
+            self._last_reject_warn = now
+            pending, self._rejected_since_warn = self._rejected_since_warn, 0
+        log.warning(
+            "master rejected %d telemetry samples (latest reason: %s); "
+            "see dct_master_ingest_rejected_total", pending, reason)
+
+    def ingest(self, trial_id: int, samples: Any, *,
+               idempotency_key: Optional[str] = None,
+               experiment_id: Optional[int] = None) -> int:
+        """Ingest one profiler batch for a trial. Returns samples accepted.
+
+        Validation is per-batch for structural problems (not a list, too
+        long, duplicate key) and per-sample for content problems
+        (non-dict, no usable group, oversized) — a single bad sample never
+        discards its siblings, matching the lossy-but-counted contract of
+        the trial-side channel.
+        """
+        if not isinstance(samples, list):
+            self._reject(1, "not_a_list")
+            return 0
+        if len(samples) > MAX_INGEST_BATCH:
+            self._reject(len(samples), "batch_too_large")
+            return 0
+        if idempotency_key:
+            with self._lock:
+                if idempotency_key in self._seen_keys:
+                    self._duplicates.inc()
+                    return 0
+                self._seen_keys[idempotency_key] = None
+                while len(self._seen_keys) > SEEN_KEYS_MAX:
+                    self._seen_keys.popitem(last=False)
+        accepted = 0
+        for sample in samples:
+            if not isinstance(sample, dict):
+                self._reject(1, "malformed")
+                continue
+            try:
+                size = len(json.dumps(sample, default=str))
+            except (TypeError, ValueError):
+                self._reject(1, "malformed")
+                continue
+            if size > MAX_SAMPLE_BYTES:
+                self._reject(1, "oversized")
+                continue
+            group = sample.get("group")
+            if group is not None and not isinstance(group, str):
+                self._reject(1, "malformed")
+                continue
+            self._ingest_one(int(trial_id), sample, experiment_id)
+            accepted += 1
+        if accepted:
+            self._batches.inc()
+            self._samples.inc(accepted)
+        return accepted
+
+    def _ingest_one(self, trial_id: int, sample: Dict[str, Any],
+                    experiment_id: Optional[int]) -> None:
+        with self._lock:
+            st = self._trials.setdefault(trial_id, _TrialState())
+            if experiment_id is not None:
+                st.experiment_id = int(experiment_id)
+            st.last_time = float(sample.get("time") or time.time())
+            group = sample.get("group")
+            if group == "telemetry":
+                metrics = sample.get("metrics")
+                if isinstance(metrics, dict):
+                    # latest-wins: snapshots are cumulative on the trial
+                    # side, so the newest one supersedes older ones
+                    st.snapshot = metrics
+                if sample.get("batches_trained") is not None:
+                    st.batches_trained = int(sample["batches_trained"])
+            elif group == "span":
+                st.spans.append(dict(sample))
+            # timing/system/unknown groups: presence updates last_time
+            # only — the JSONL sink (or file-based tooling) keeps them
+
+    def register_trial(self, trial_id: int,
+                       experiment_id: Optional[int] = None) -> None:
+        with self._lock:
+            st = self._trials.setdefault(int(trial_id), _TrialState())
+            if experiment_id is not None:
+                st.experiment_id = int(experiment_id)
+
+    def ingest_component(self, component: str, registry: Any) -> None:
+        """Fold a non-trial component's registry (runner, master, bench
+        parent) into the cluster view. Accepts a MetricsRegistry or a
+        ``snapshot()``-shaped dict; latest-wins per component."""
+        snap = (registry.snapshot() if hasattr(registry, "snapshot")
+                else dict(registry))
+        if not isinstance(snap, dict):
+            self._reject(1, "malformed")
+            return
+        with self._lock:
+            self._components[str(component)] = snap
+
+    def ingest_component_spans(self, component: str, samples: Any, *,
+                               experiment_id: Optional[int] = None) -> int:
+        """Span records from a non-trial component (runner, master)."""
+        if not isinstance(samples, list):
+            self._reject(1, "not_a_list")
+            return 0
+        accepted = 0
+        with self._lock:
+            dq = self._component_spans.setdefault(
+                str(component),
+                collections.deque(maxlen=SPANS_PER_TRIAL_MAX))
+            for rec in samples:
+                if not isinstance(rec, dict):
+                    continue
+                dq.append((experiment_id, dict(rec)))
+                accepted += 1
+        return accepted
+
+    # -- views -------------------------------------------------------------
+
+    def trial_ids(self) -> List[int]:
+        with self._lock:
+            return sorted(self._trials)
+
+    def spans(self, *, trial_id: Optional[int] = None,
+              experiment_id: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Span samples (shape of ``spans_from_profiler_samples`` input),
+        each annotated with its ``trial_id``; filterable by trial or by
+        experiment for ``dct trace export --experiment``."""
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            for tid, st in sorted(self._trials.items()):
+                if trial_id is not None and tid != trial_id:
+                    continue
+                if (experiment_id is not None
+                        and st.experiment_id != experiment_id):
+                    continue
+                for rec in st.spans:
+                    out.append({**rec, "trial_id": tid})
+            if trial_id is None:
+                for comp, dq in sorted(self._component_spans.items()):
+                    for exp_id, rec in dq:
+                        if (experiment_id is not None
+                                and exp_id != experiment_id):
+                            continue
+                        out.append({"process": comp, **rec})
+        return out
+
+    def _families(self) -> Dict[str, Dict[str, Any]]:
+        """name → {type, help, children: [(labels, sample)]} across every
+        trial snapshot and component snapshot."""
+        fams: Dict[str, Dict[str, Any]] = {}
+
+        def add(owner_labels: Dict[str, str],
+                snap: Dict[str, Dict[str, Any]]) -> None:
+            for key, s in snap.items():
+                if not isinstance(s, dict) or "type" not in s:
+                    continue
+                name = _valid_name(key.split("{", 1)[0])
+                fam = fams.setdefault(
+                    name, {"type": s["type"], "children": []})
+                labels = dict(owner_labels)
+                labels.update(s.get("labels") or {})
+                fam["children"].append((labels, s))
+
+        with self._lock:
+            trials = {tid: st.snapshot for tid, st in self._trials.items()}
+            comps = dict(self._components)
+        for tid, snap in sorted(trials.items()):
+            add({"trial_id": str(tid)}, snap)
+        for comp, snap in sorted(comps.items()):
+            add({"component": comp}, snap)
+        return fams
+
+    def dump(self) -> str:
+        """Prometheus text: master counters + per-trial series + rollups."""
+        lines = [self.registry.dump().rstrip("\n")] if (
+            self.registry.metrics()) else []
+        fams = self._families()
+        for name in sorted(fams):
+            fam = fams[name]
+            mtype = fam["type"]
+            prom_type = {"counter": "counter", "gauge": "gauge",
+                         "histogram": "summary"}.get(mtype, "untyped")
+            lines.append(f"# TYPE {name} {prom_type}")
+            for labels, s in fam["children"]:
+                if mtype == "histogram":
+                    lines.extend(self._summary_lines(name, labels, s))
+                else:
+                    lines.append(
+                        f"{name}{_label_str(labels)} {_fmt(s['value'])}")
+            lines.extend(self._rollup_lines(name, fam))
+        text = "\n".join(ln for ln in lines if ln)
+        return text + ("\n" if text else "")
+
+    @staticmethod
+    def _summary_lines(name: str, labels: Dict[str, str],
+                       s: Dict[str, Any]) -> List[str]:
+        out = []
+        for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+            if key in s:
+                out.append(f"{name}{_label_str(labels, {'quantile': q})} "
+                           f"{_fmt(s[key])}")
+        out.append(f"{name}_sum{_label_str(labels)} {_fmt(s.get('sum', 0))}")
+        out.append(f"{name}_count{_label_str(labels)} "
+                   f"{int(s.get('count', 0))}")
+        return out
+
+    def _rollup_lines(self, name: str, fam: Dict[str, Any]) -> List[str]:
+        children = fam["children"]
+        if len(children) < 1:
+            return []
+        roll = f"dct_cluster_{name}"
+        mtype = fam["type"]
+        help_line = (f"# HELP {roll} "
+                     f"{_escape_help('cluster rollup of ' + name)}")
+        if mtype in ("counter", "gauge"):
+            total = sum(float(s.get("value", 0)) for _, s in children)
+            lines = [help_line,
+                     f"# TYPE {roll} {mtype}",
+                     f"{roll} {_fmt(total)}"]
+            if mtype == "gauge" and len(children) > 1:
+                lines.append(f"# TYPE {roll}_avg gauge")
+                lines.append(f"{roll}_avg {_fmt(total / len(children))}")
+            return lines
+        if mtype == "histogram":
+            count = sum(int(s.get("count", 0)) for _, s in children)
+            total = sum(float(s.get("sum", 0)) for _, s in children)
+            lines = [help_line, f"# TYPE {roll} summary"]
+            if count:
+                for q, key in (("0.5", "p50"), ("0.95", "p95"),
+                               ("0.99", "p99")):
+                    num = sum(float(s[key]) * int(s.get("count", 0))
+                              for _, s in children if key in s)
+                    lines.append(
+                        f"{roll}{{quantile=\"{q}\"}} {_fmt(num / count)}")
+            lines.append(f"{roll}_sum {_fmt(total)}")
+            lines.append(f"{roll}_count {count}")
+            return lines
+        return []
+
+    # -- CLI summary -------------------------------------------------------
+
+    def summary(self, top_n: int = 10) -> Dict[str, Any]:
+        """Structured cluster summary for ``dct metrics``."""
+        fams = self._families()
+
+        def gauge_per_trial(*names: str) -> Dict[str, float]:
+            out: Dict[str, float] = {}
+            for name in names:
+                for labels, s in fams.get(name, {}).get("children", []):
+                    tid = labels.get("trial_id")
+                    if tid is not None and tid not in out:
+                        out[tid] = float(s.get("value", 0))
+            return out
+
+        throughput = gauge_per_trial("samples_per_sec", "samples_per_second")
+        top = sorted(throughput.items(), key=lambda kv: -kv[1])[:top_n]
+
+        quantiles: Dict[str, Dict[str, float]] = {}
+        for name, fam in fams.items():
+            if fam["type"] != "histogram":
+                continue
+            children = fam["children"]
+            count = sum(int(s.get("count", 0)) for _, s in children)
+            if not count:
+                continue
+            quantiles[name] = {
+                q: sum(float(s.get(k, 0)) * int(s.get("count", 0))
+                       for _, s in children) / count
+                for q, k in (("p50", "p50"), ("p95", "p95"), ("p99", "p99"))
+            }
+
+        counters: Dict[str, float] = {}
+        for name, fam in fams.items():
+            if fam["type"] != "counter":
+                continue
+            interesting = (name.startswith("retries_")
+                           or name.startswith("cas_")
+                           or "restart" in name or "fallback" in name
+                           or "dropped" in name or "failures" in name
+                           or "compiles" in name)
+            if interesting:
+                counters[name] = sum(float(s.get("value", 0))
+                                     for _, s in fam["children"])
+        with self._lock:
+            n_trials = len(self._trials)
+            mfu = gauge_per_trial("mfu")
+        ingest = {
+            "batches": self._batches.value,
+            "samples": self._samples.value,
+            "duplicates": self._duplicates.value,
+            "rejected": sum(
+                m.value for m in self.registry.metrics()
+                if m.name == "dct_master_ingest_rejected_total"),
+        }
+        return {
+            "trials": n_trials,
+            "top_trials_by_throughput": top,
+            "throughput_total": sum(throughput.values()),
+            "mfu_by_trial": mfu,
+            "quantiles": quantiles,
+            "counters": dict(sorted(counters.items())),
+            "ingest": ingest,
+        }
+
+
+def format_summary(summary: Dict[str, Any]) -> str:
+    """Human-readable rendering of :meth:`summary` for the CLI."""
+    out: List[str] = []
+    out.append(f"trials reporting: {summary['trials']}   "
+               f"cluster throughput: "
+               f"{summary['throughput_total']:.2f} samples/sec")
+    if summary["top_trials_by_throughput"]:
+        out.append("top trials by throughput:")
+        for tid, sps in summary["top_trials_by_throughput"]:
+            mfu = summary["mfu_by_trial"].get(tid)
+            mfu_s = f"  mfu={mfu:.4f}" if mfu is not None else ""
+            out.append(f"  trial {tid}: {sps:.2f} samples/sec{mfu_s}")
+    if summary["quantiles"]:
+        out.append("latency quantiles (cluster, count-weighted):")
+        for name, qs in sorted(summary["quantiles"].items()):
+            out.append(f"  {name}: p50={qs['p50']:.6f} "
+                       f"p95={qs['p95']:.6f} p99={qs['p99']:.6f}")
+    if summary["counters"]:
+        out.append("counters:")
+        for name, v in summary["counters"].items():
+            out.append(f"  {name}: {int(v)}")
+    ing = summary["ingest"]
+    out.append(f"ingestion: {int(ing['batches'])} batches / "
+               f"{int(ing['samples'])} samples accepted, "
+               f"{int(ing['rejected'])} rejected, "
+               f"{int(ing['duplicates'])} duplicate batches dropped")
+    return "\n".join(out)

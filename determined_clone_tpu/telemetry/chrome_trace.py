@@ -88,6 +88,64 @@ def spans_from_profiler_samples(samples: Iterable[Dict[str, Any]]
     return [s for s in samples if s.get("group") == "span"]
 
 
+def stitch_chrome_trace(samples: Iterable[Dict[str, Any]], *,
+                        other_data: Optional[Dict[str, Any]] = None
+                        ) -> Dict[str, Any]:
+    """Stitch span records from several processes into one Chrome trace.
+
+    Input is the master's aggregated span store (``Telemetry.publish``
+    output, possibly from many trials plus the runner): each record may
+    carry ``process`` (lane name), ``trace_id``, and ``wall_epoch``.
+    Records group into one Chrome *process* per ``process`` label (falling
+    back to ``trial-{trial_id}``), each announced with a ``process_name``
+    metadata event; per-process thread lanes keep their names. Timestamps
+    are re-based onto a shared axis using each tracer's ``wall_epoch``
+    anchor (``ts_us`` alone is relative to a private perf_counter epoch),
+    so restart legs of one trial land after each other, not on top.
+    """
+    by_process: Dict[str, List[Dict[str, Any]]] = {}
+    for rec in samples:
+        if rec.get("group") not in (None, "span"):
+            continue
+        proc = rec.get("process")
+        if not proc:
+            tid = rec.get("trial_id")
+            proc = f"trial-{tid}" if tid is not None else "unknown"
+        by_process.setdefault(str(proc), []).append(rec)
+
+    epochs = [float(r["wall_epoch"])
+              for recs in by_process.values() for r in recs
+              if isinstance(r.get("wall_epoch"), (int, float))]
+    base_epoch = min(epochs) if epochs else 0.0
+
+    events: List[Dict[str, Any]] = []
+    trace_ids = set()
+    for pid, proc in enumerate(sorted(by_process), start=1):
+        events.append({
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": proc},
+        })
+        recs = by_process[proc]
+        shifted = []
+        for rec in recs:
+            if rec.get("trace_id"):
+                trace_ids.add(rec["trace_id"])
+            epoch = rec.get("wall_epoch")
+            shift_us = ((float(epoch) - base_epoch) * 1e6
+                        if isinstance(epoch, (int, float)) else 0.0)
+            shifted.append(
+                {**rec, "ts_us": float(rec.get("ts_us", 0.0)) + shift_us})
+        shifted.sort(key=lambda r: r["ts_us"])
+        events.extend(chrome_trace_events(shifted, pid=pid))
+
+    data = dict(other_data or {})
+    data.setdefault("processes", sorted(by_process))
+    if trace_ids:
+        data.setdefault("trace_ids", sorted(trace_ids))
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": data}
+
+
 def validate_chrome_trace(trace: Any) -> List[str]:
     """Structural check of a loaded trace (tests + ``dct trace export``
     sanity): returns a list of problems, empty when valid."""

@@ -1,0 +1,170 @@
+"""Analytic FLOPs accounting and MFU.
+
+Computes per-training-step floating point operations from a model config
+alone — no tracing, no cost models — so every step can report
+``flops_per_sec`` and ``mfu`` even on hardware where we only *assume* a
+peak. The formulas follow the standard transformer accounting
+(Kaplan/Chinchilla convention): a matmul of ``[m, k] @ [k, n]`` costs
+``2*m*k*n`` FLOPs, and a training step costs roughly 3x the forward pass
+(1x forward + 2x backward).
+
+Per-token forward FLOPs by component, for a model with ``L`` layers,
+model width ``d``, ``H`` heads, FFN width ``f``, sequence length ``s``,
+vocab ``V``:
+
+- attention projections (q,k,v,out):      ``L * 8 * d^2``
+- attention scores + value mix:           ``L * 4 * s * d``
+  (flash and plain MHA perform the same matmuls — flash saves memory
+  traffic, not arithmetic, so both use this count)
+- dense MLP (two matmuls):                ``L * 4 * d * f``
+- MoE MLP (top-k of E experts):           ``L * k * 4 * d * f``
+  plus router:                            ``L * 2 * d * E``
+- embeddings/logits (tied or not, the logit matmul dominates):
+                                          ``2 * d * V``
+
+The widely used ``6 * n_params`` approximation is available as
+:func:`dense_train_flops_per_token` for models we have no config for.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+# Training multiplier: forward + backward(2x).
+TRAIN_MULT = 3.0
+
+# Peak bf16 matmul FLOPs per chip. TPU numbers are published per-chip
+# peaks; the CPU number is a deliberately round order-of-magnitude
+# estimate (tens of GFLOPs for a few vector cores) — its job is to make
+# MFU non-null and *comparable across rounds on the same machine*, not
+# to be accurate in absolute terms. The provenance label says which.
+TPU_PEAK_BF16_FLOPS: Dict[str, float] = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+CPU_PEAK_EST_FLOPS = 50e9
+
+
+@dataclass(frozen=True)
+class StepFlops:
+    """FLOPs for one training step, with a component breakdown."""
+    total: float
+    per_token: float
+    tokens: int
+    breakdown: Dict[str, float]
+
+    def flops_per_sec(self, step_seconds: float) -> float:
+        if step_seconds <= 0:
+            return 0.0
+        return self.total / step_seconds
+
+
+def attention_flops_per_token(d_model: int, seq_len: int,
+                              n_layers: int) -> float:
+    """Projections + scores + value mix, per token, forward pass."""
+    proj = 8.0 * d_model * d_model
+    mix = 4.0 * seq_len * d_model
+    return n_layers * (proj + mix)
+
+
+def mlp_flops_per_token(d_model: int, d_ff: int, n_layers: int, *,
+                        moe_experts: int = 0, moe_k: int = 2) -> float:
+    """Dense or MoE FFN per token, forward pass (router included)."""
+    dense = 4.0 * d_model * d_ff
+    if moe_experts and moe_experts > 1:
+        k = max(1, min(moe_k, moe_experts))
+        router = 2.0 * d_model * moe_experts
+        return n_layers * (k * dense + router)
+    return n_layers * dense
+
+
+def embedding_flops_per_token(d_model: int, vocab_size: int) -> float:
+    """Logit projection; the embedding lookup itself is a gather."""
+    return 2.0 * d_model * vocab_size
+
+
+def gpt_forward_flops_per_token(cfg: Any, seq_len: int) -> Dict[str, float]:
+    """Per-token forward FLOPs breakdown for a GPT-family config.
+
+    ``cfg`` is duck-typed (GPTConfig or anything with the same fields) so
+    this module never imports models and stays dependency-free.
+    """
+    return {
+        "attention": attention_flops_per_token(
+            cfg.d_model, seq_len, cfg.n_layers),
+        "mlp": mlp_flops_per_token(
+            cfg.d_model, cfg.d_ff, cfg.n_layers,
+            moe_experts=getattr(cfg, "moe_experts", 0),
+            moe_k=getattr(cfg, "moe_k", 2)),
+        "embedding": embedding_flops_per_token(cfg.d_model, cfg.vocab_size),
+    }
+
+
+def gpt_train_step_flops(cfg: Any, batch_size: int,
+                         seq_len: Optional[int] = None) -> StepFlops:
+    """Analytic FLOPs for one training step of a GPT-family model."""
+    seq = int(seq_len or cfg.max_seq_len)
+    breakdown = gpt_forward_flops_per_token(cfg, seq)
+    per_token_fwd = sum(breakdown.values())
+    per_token = TRAIN_MULT * per_token_fwd
+    tokens = int(batch_size) * seq
+    return StepFlops(
+        total=per_token * tokens,
+        per_token=per_token,
+        tokens=tokens,
+        breakdown={k: TRAIN_MULT * v * tokens for k, v in breakdown.items()},
+    )
+
+
+def dense_train_flops_per_token(n_params: int) -> float:
+    """The ``6 * N`` approximation for configs we can't decompose."""
+    return 6.0 * float(n_params)
+
+
+def dense_train_step_flops(n_params: int, batch_size: int,
+                           seq_len: int) -> StepFlops:
+    per_token = dense_train_flops_per_token(n_params)
+    tokens = int(batch_size) * int(seq_len)
+    return StepFlops(total=per_token * tokens, per_token=per_token,
+                     tokens=tokens, breakdown={"dense_6n": per_token * tokens})
+
+
+def peak_flops_estimate(platform: Optional[str] = None,
+                        tpu_generation: Optional[str] = None,
+                        ) -> Tuple[float, str]:
+    """Best-available peak FLOPs for the current chip.
+
+    Returns ``(peak_flops, provenance)`` where provenance is a label like
+    ``"tpu:v5e"`` (published spec) or ``"cpu:est"`` (order-of-magnitude
+    assumption). MFU consumers must carry the label next to the number so
+    nobody mistakes an assumed-peak MFU for a measured one.
+    """
+    plat = (platform or "").lower()
+    if not plat:
+        try:  # detect lazily; keep this importable without jax
+            import jax
+            plat = jax.default_backend()
+        except Exception:
+            plat = "cpu"
+    if plat == "tpu":
+        gen = (tpu_generation or os.environ.get("DCT_TPU_GENERATION")
+               or "").lower().lstrip("tpu").strip("-_ ")
+        if gen in TPU_PEAK_BF16_FLOPS:
+            return TPU_PEAK_BF16_FLOPS[gen], f"tpu:{gen}"
+        # Unknown generation: assume the most common fleet chip.
+        return TPU_PEAK_BF16_FLOPS["v5e"], "tpu:v5e:assumed"
+    if plat == "gpu":
+        return 312e12, "gpu:a100:assumed"
+    return CPU_PEAK_EST_FLOPS, "cpu:est"
+
+
+def mfu(flops_per_sec: float, peak_flops: float,
+        n_devices: int = 1) -> float:
+    """Model FLOPs utilization against ``n_devices`` chips of peak."""
+    denom = peak_flops * max(1, n_devices)
+    if denom <= 0:
+        return 0.0
+    return flops_per_sec / denom

@@ -31,12 +31,40 @@ def _valid_name(name: str) -> str:
     return out
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus label-value escaping: backslash, quote, newline."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    """HELP-line escaping: backslash and newline only (quotes are legal)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _label_str(labels: Optional[Dict[str, str]],
+               extra: Optional[Dict[str, str]] = None) -> str:
+    """``{k="v",...}`` rendered sorted (deterministic dumps), or ``""``."""
+    merged: Dict[str, str] = dict(labels or {})
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    inner = ",".join(f'{_valid_name(k)}="{_escape_label_value(v)}"'
+                     for k, v in sorted(merged.items()))
+    return "{" + inner + "}"
+
+
 class Counter:
     """Monotonically increasing count (Prometheus counter)."""
 
-    def __init__(self, name: str, help: str = "") -> None:
+    prom_type = "counter"
+
+    def __init__(self, name: str, help: str = "", *,
+                 labels: Optional[Dict[str, str]] = None) -> None:
         self.name = _valid_name(name)
         self.help = help
+        self.labels = dict(labels) if labels else {}
         self._value = 0.0
         self._lock = threading.Lock()
 
@@ -51,20 +79,29 @@ class Counter:
         with self._lock:
             return self._value
 
+    def sample_lines(self) -> List[str]:
+        return [f"{self.name}{_label_str(self.labels)} {_fmt(self.value)}"]
+
     def expose(self) -> List[str]:
-        return [f"# TYPE {self.name} counter",
-                f"{self.name} {_fmt(self.value)}"]
+        return [f"# TYPE {self.name} counter"] + self.sample_lines()
 
     def sample(self) -> Dict[str, Any]:
-        return {"type": "counter", "value": self.value}
+        out: Dict[str, Any] = {"type": "counter", "value": self.value}
+        if self.labels:
+            out["labels"] = dict(self.labels)
+        return out
 
 
 class Gauge:
     """A value that goes up and down (Prometheus gauge)."""
 
-    def __init__(self, name: str, help: str = "") -> None:
+    prom_type = "gauge"
+
+    def __init__(self, name: str, help: str = "", *,
+                 labels: Optional[Dict[str, str]] = None) -> None:
         self.name = _valid_name(name)
         self.help = help
+        self.labels = dict(labels) if labels else {}
         self._value = 0.0
         self._lock = threading.Lock()
 
@@ -84,12 +121,17 @@ class Gauge:
         with self._lock:
             return self._value
 
+    def sample_lines(self) -> List[str]:
+        return [f"{self.name}{_label_str(self.labels)} {_fmt(self.value)}"]
+
     def expose(self) -> List[str]:
-        return [f"# TYPE {self.name} gauge",
-                f"{self.name} {_fmt(self.value)}"]
+        return [f"# TYPE {self.name} gauge"] + self.sample_lines()
 
     def sample(self) -> Dict[str, Any]:
-        return {"type": "gauge", "value": self.value}
+        out: Dict[str, Any] = {"type": "gauge", "value": self.value}
+        if self.labels:
+            out["labels"] = dict(self.labels)
+        return out
 
 
 class Histogram:
@@ -102,10 +144,14 @@ class Histogram:
 
     QUANTILES = (0.5, 0.95, 0.99)
 
+    prom_type = "summary"
+
     def __init__(self, name: str, help: str = "", *,
-                 reservoir_size: int = 4096, seed: int = 0) -> None:
+                 reservoir_size: int = 4096, seed: int = 0,
+                 labels: Optional[Dict[str, str]] = None) -> None:
         self.name = _valid_name(name)
         self.help = help
+        self.labels = dict(labels) if labels else {}
         self.reservoir_size = int(reservoir_size)
         self._rng = random.Random(seed)  # deterministic for reproducibility
         self._sample: List[float] = []
@@ -159,14 +205,19 @@ class Histogram:
             return xs[lo] * (1.0 - frac) + xs[lo + 1] * frac
         return xs[lo]
 
-    def expose(self) -> List[str]:
-        lines = [f"# TYPE {self.name} summary"]
+    def sample_lines(self) -> List[str]:
+        base = _label_str(self.labels)
+        lines = []
         for q in self.QUANTILES:
-            lines.append(f'{self.name}{{quantile="{q}"}} '
-                         f"{_fmt(self.percentile(100 * q))}")
-        lines.append(f"{self.name}_sum {_fmt(self.sum)}")
-        lines.append(f"{self.name}_count {self.count}")
+            lines.append(
+                f"{self.name}{_label_str(self.labels, {'quantile': str(q)})} "
+                f"{_fmt(self.percentile(100 * q))}")
+        lines.append(f"{self.name}_sum{base} {_fmt(self.sum)}")
+        lines.append(f"{self.name}_count{base} {self.count}")
         return lines
+
+    def expose(self) -> List[str]:
+        return [f"# TYPE {self.name} summary"] + self.sample_lines()
 
     def sample(self) -> Dict[str, Any]:
         with self._lock:
@@ -174,6 +225,8 @@ class Histogram:
             mn, mx = self._min, self._max
         out: Dict[str, Any] = {"type": "histogram", "count": count,
                                "sum": round(total, 6)}
+        if self.labels:
+            out["labels"] = dict(self.labels)
         if count:
             out.update(
                 min=round(mn, 6), max=round(mx, 6),
@@ -185,11 +238,13 @@ class Histogram:
 
 
 class MetricsRegistry:
-    """Name → metric table with get-or-create accessors.
+    """(Name, labels) → metric table with get-or-create accessors.
 
-    Accessors are idempotent (same name returns the same instance) and
-    type-checked: registering ``foo`` as both a counter and a gauge is a
-    bug worth failing loudly on.
+    Accessors are idempotent (same name + labels returns the same instance)
+    and type-checked: registering ``foo`` as both a counter and a gauge is a
+    bug worth failing loudly on. Labeled children of the same name (e.g. one
+    gauge per trial) share one HELP/TYPE header in :meth:`dump` — the
+    Prometheus exposition format requires at most one per metric family.
     """
 
     def __init__(self, prefix: str = "") -> None:
@@ -197,29 +252,34 @@ class MetricsRegistry:
         self._metrics: Dict[str, Any] = {}
         self._lock = threading.Lock()
 
-    def _get_or_create(self, cls, name: str, help: str, **kw) -> Any:
+    def _get_or_create(self, cls, name: str, help: str,
+                       labels: Optional[Dict[str, str]] = None, **kw) -> Any:
         name = self.prefix + name
+        key = _valid_name(name) + _label_str(labels)
         with self._lock:
-            existing = self._metrics.get(name)
+            existing = self._metrics.get(key)
             if existing is not None:
                 if not isinstance(existing, cls):
                     raise TypeError(
-                        f"metric {name!r} already registered as "
+                        f"metric {key!r} already registered as "
                         f"{type(existing).__name__}, not {cls.__name__}")
                 return existing
-            metric = cls(name, help, **kw)
-            self._metrics[metric.name] = metric
+            metric = cls(name, help, labels=labels, **kw)
+            self._metrics[key] = metric
             return metric
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._get_or_create(Counter, name, help)
+    def counter(self, name: str, help: str = "", *,
+                labels: Optional[Dict[str, str]] = None) -> Counter:
+        return self._get_or_create(Counter, name, help, labels)
 
-    def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._get_or_create(Gauge, name, help)
+    def gauge(self, name: str, help: str = "", *,
+              labels: Optional[Dict[str, str]] = None) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labels)
 
     def histogram(self, name: str, help: str = "", *,
-                  reservoir_size: int = 4096) -> Histogram:
-        return self._get_or_create(Histogram, name, help,
+                  reservoir_size: int = 4096,
+                  labels: Optional[Dict[str, str]] = None) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labels,
                                    reservoir_size=reservoir_size)
 
     def metrics(self) -> List[Any]:
@@ -229,15 +289,94 @@ class MetricsRegistry:
     def dump(self) -> str:
         """Prometheus text exposition (text/plain; version=0.0.4)."""
         lines: List[str] = []
-        for metric in sorted(self.metrics(), key=lambda m: m.name):
-            if metric.help:
-                lines.append(f"# HELP {metric.name} {metric.help}")
-            lines.extend(metric.expose())
+        by_name: Dict[str, List[Any]] = {}
+        for metric in self.metrics():
+            by_name.setdefault(metric.name, []).append(metric)
+        for name in sorted(by_name):
+            family = sorted(by_name[name],
+                            key=lambda m: _label_str(m.labels))
+            help_text = next((m.help for m in family if m.help), "")
+            if help_text:
+                lines.append(f"# HELP {name} {_escape_help(help_text)}")
+            lines.append(f"# TYPE {name} {family[0].prom_type}")
+            for metric in family:
+                lines.extend(metric.sample_lines())
         return "\n".join(lines) + ("\n" if lines else "")
 
     def snapshot(self) -> Dict[str, Dict[str, Any]]:
-        """Structured state for shipping through the profiler channel."""
-        return {m.name: m.sample() for m in self.metrics()}
+        """Structured state for shipping through the profiler channel.
+
+        Keyed by name + rendered label string (labels, when present, also
+        ride inside the sample), so labeled children never collide.
+        """
+        with self._lock:
+            items = list(self._metrics.items())
+        return {key: m.sample() for key, m in items}
+
+
+def parse_prometheus_text(text: str) -> Dict[str, Any]:
+    """Parse the text exposition format back into structured samples.
+
+    Returns ``{"samples": [(name, labels_dict, value)], "types": {name:
+    type}, "help": {name: help}}``. Understands the escaping rules
+    :meth:`MetricsRegistry.dump` applies, so tests (and ``dct metrics``)
+    can round-trip the ``/metrics`` endpoint output.
+    """
+    samples: List[Any] = []
+    types: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(None, 3)
+            if len(parts) == 4:
+                types[parts[2]] = parts[3]
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            if len(parts) >= 3:
+                raw = parts[3] if len(parts) == 4 else ""
+                helps[parts[2]] = (raw.replace("\\n", "\n")
+                                   .replace("\\\\", "\\"))
+            continue
+        if line.startswith("#"):
+            continue
+        # <name>{k="v",...} <value>  |  <name> <value>
+        labels: Dict[str, str] = {}
+        if "{" in line:
+            name, _, rest = line.partition("{")
+            body, _, tail = rest.rpartition("}")
+            value_str = tail.strip()
+            i = 0
+            while i < len(body):
+                eq = body.index("=", i)
+                key = body[i:eq].strip().lstrip(",").strip()
+                if body[eq + 1] != '"':
+                    raise ValueError(f"unquoted label value in {line!r}")
+                j = eq + 2
+                buf = []
+                while j < len(body):
+                    c = body[j]
+                    if c == "\\" and j + 1 < len(body):
+                        nxt = body[j + 1]
+                        buf.append({"n": "\n", '"': '"', "\\": "\\"}
+                                   .get(nxt, "\\" + nxt))
+                        j += 2
+                        continue
+                    if c == '"':
+                        break
+                    buf.append(c)
+                    j += 1
+                labels[key] = "".join(buf)
+                i = j + 1
+        else:
+            name, _, value_str = line.partition(" ")
+            value_str = value_str.strip()
+        value = float(value_str)
+        samples.append((name.strip(), labels, value))
+    return {"samples": samples, "types": types, "help": helps}
 
 
 def _fmt(v: float) -> str:

@@ -107,8 +107,14 @@ class Tracer:
     """
 
     def __init__(self, *, enabled: bool = True,
-                 max_events: int = 200_000) -> None:
+                 max_events: int = 200_000,
+                 trace_id: Optional[str] = None,
+                 process_name: Optional[str] = None) -> None:
         self.enabled = enabled
+        # cross-component identity (set lazily by the runner/trial entry):
+        # records stay identity-free in memory; publish/export attach these
+        self.trace_id = trace_id
+        self.process_name = process_name
         self.max_events = int(max_events)
         self.dropped = 0
         self._events: List[Dict[str, Any]] = []

@@ -169,6 +169,13 @@ def make_train_step(
     return jax.jit(fn, **kwargs)
 
 
+def param_count(tree: Any) -> int:
+    """Total parameter count of a pytree — the N in the 6*N FLOPs
+    approximation (telemetry/flops.py) when a trial provides no analytic
+    per-step count."""
+    return sum(int(x.size) for x in jax.tree.leaves(tree))
+
+
 def program_cache_size(fn: Any) -> Optional[int]:
     """Best-effort size of a jitted callable's compilation cache, or None
     when this jax version doesn't expose it. Growth between two reads means

@@ -25,7 +25,7 @@ from __future__ import annotations
 import logging
 import os
 import time
-from typing import Any, Dict, Iterator, Optional
+from typing import Any, Dict, Iterator, Optional, Tuple
 
 import jax
 import numpy as np
@@ -35,6 +35,7 @@ from determined_clone_tpu import faults
 from determined_clone_tpu.config.length import Length
 from determined_clone_tpu.core._checkpoint import CheckpointCorruptError
 from determined_clone_tpu.core._serialization import load_pytree, save_pytree
+from determined_clone_tpu.telemetry import flops as flops_mod
 from determined_clone_tpu.telemetry.spans import null_span
 from determined_clone_tpu.training.metrics import MetricAccumulator
 from determined_clone_tpu.training.train_step import (
@@ -42,6 +43,7 @@ from determined_clone_tpu.training.train_step import (
     create_train_state,
     make_eval_step,
     make_train_step,
+    param_count,
     state_shardings,
 )
 from determined_clone_tpu.training.trial import JaxTrial
@@ -170,6 +172,28 @@ class Trainer:
     def _telemetry(self):
         return getattr(self.core, "telemetry", None)
 
+    @staticmethod
+    def _resolve_step_flops(trial: JaxTrial, state: TrainState
+                            ) -> Tuple[float, str]:
+        """(FLOPs per optimizer step, source label). Prefers the trial's
+        analytic count; falls back to 6*N_params*tokens. A trial hook that
+        raises downgrades to the fallback — FLOPs accounting must never
+        fail training."""
+        try:
+            f = trial.train_step_flops()
+        except Exception:  # noqa: BLE001 - observability is best-effort
+            f = None
+        if f is not None:
+            return float(getattr(f, "total", f)), "analytic"
+        n_params = param_count(state.params)
+        try:
+            tokens_per_sample = int(trial.tokens_per_sample() or 1)
+        except Exception:  # noqa: BLE001 - observability is best-effort
+            tokens_per_sample = 1
+        tokens = trial.global_batch_size * max(1, tokens_per_sample)
+        return flops_mod.dense_train_flops_per_token(n_params) * tokens, \
+            "dense_6n"
+
     @property
     def _span(self):
         """The tracer's span factory, or the shared no-op when telemetry is
@@ -263,6 +287,19 @@ class Trainer:
                                           sync=jax.block_until_ready)
             eval_step = tel.wrap_jit("eval_dispatch", eval_step,
                                      sync=jax.block_until_ready)
+
+        # analytic FLOPs/MFU accounting (telemetry/flops.py) — resolved
+        # once here, reported per chunk. Only when telemetry is on: the
+        # disabled hot loop must stay byte-identical.
+        step_flops = 0.0
+        flops_source = peak_label = ""
+        peak_total = 0.0
+        if tel is not None:
+            step_flops, flops_source = self._resolve_step_flops(trial, state)
+            n_devices = (int(mesh.devices.size) if mesh is not None
+                         else jax.device_count())
+            peak, peak_label = flops_mod.peak_flops_estimate()
+            peak_total = peak * max(1, n_devices)
 
         sched_unit = config.scheduling_unit
         val_period = self._to_batches(config.min_validation_period, 0)
@@ -441,6 +478,35 @@ class Trainer:
                     train_metrics["samples_per_second"] = (
                         (batches_trained - n0) * trial.global_batch_size / dt
                     )
+                    if tel is not None and step_flops:
+                        # FLOPs throughput + MFU against the (measured or
+                        # assumed) peak; the provenance labels travel with
+                        # the number so an assumed-peak MFU can't pass as
+                        # a measured one (docs/observability.md)
+                        fps = step_flops * train_metrics["batches_per_second"]
+                        mfu_val = flops_mod.mfu(fps, peak_total)
+                        train_metrics["flops_per_sec"] = fps
+                        train_metrics["mfu"] = mfu_val
+                        reg = tel.registry
+                        reg.gauge("samples_per_sec",
+                                  "training throughput").set(
+                            train_metrics["samples_per_second"])
+                        reg.gauge("flops_per_sec",
+                                  "analytic model FLOPs per second").set(fps)
+                        reg.gauge("mfu",
+                                  "model FLOPs utilization vs peak "
+                                  "(provenance: mfu_peak_info labels)").set(
+                            mfu_val)
+                        reg.gauge("mfu_peak_flops",
+                                  "peak FLOPs the MFU denominator assumes "
+                                  "(all participating devices)").set(
+                            peak_total)
+                        reg.gauge(
+                            "mfu_peak_info",
+                            "constant 1; labels carry the peak provenance "
+                            "and FLOPs-count source",
+                            labels={"assumed": peak_label,
+                                    "flops_source": flops_source}).set(1)
                     self.core.train.report_training_metrics(batches_trained,
                                                             train_metrics)
                     if profiler is not None:

@@ -103,6 +103,19 @@ class JaxTrial(abc.ABC):
     def validation_data(self) -> Optional[Iterable[Any]]:
         return None
 
+    def train_step_flops(self) -> Optional[Any]:
+        """Analytic FLOPs for ONE optimizer step over one global batch —
+        a :class:`telemetry.flops.StepFlops` or a plain float. Model
+        trials that know their architecture should override (e.g. via
+        ``telemetry.flops.gpt_train_step_flops``); None makes the Trainer
+        fall back to the ``6 * n_params * tokens`` approximation."""
+        return None
+
+    def tokens_per_sample(self) -> Optional[int]:
+        """Tokens per sample (sequence length) for the 6N fallback;
+        None → counted as 1 token per sample."""
+        return None
+
     def sharding_rules(self) -> ShardingRules:
         return ShardingRules()
 

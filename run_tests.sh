@@ -25,7 +25,15 @@
 # `./run_tests.sh --storage` runs the checkpoint-storage surface
 # (docs/checkpoint_storage.md): backends, the content-addressed store +
 # transfer pool, and the storage-facing fault-tolerance paths.
-if [ "$1" = "--lint" ]; then
+#
+# `./run_tests.sh --bench-gate` compares the two newest BENCH_r*.json
+# rounds via tools/bench_gate.py (default -5% samples/sec tolerance; the
+# new round must carry a non-null mfu — docs/observability.md).
+if [ "$1" = "--bench-gate" ]; then
+    shift
+    exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python tools/bench_gate.py "$@"
+elif [ "$1" = "--lint" ]; then
     shift
     exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
         python -m tools.dctlint determined_clone_tpu tools bench.py "$@"
@@ -42,7 +50,8 @@ elif [ "$1" = "--storage" ]; then
 elif [ "$1" = "--observability" ]; then
     shift
     set -- tests/test_telemetry.py tests/test_profiler_tensorboard.py \
-        tests/test_observability_config.py tests/test_static_checks.py \
+        tests/test_observability_config.py tests/test_observability_plane.py \
+        tests/test_static_checks.py \
         -m "not slow" "$@"
 fi
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \

@@ -15,6 +15,7 @@ from determined_clone_tpu.telemetry import (
     Tracer,
     chrome_trace_events,
     null_span,
+    parse_prometheus_text,
     spans_from_profiler_samples,
     telemetry_from_config,
     to_chrome_trace,
@@ -247,6 +248,80 @@ class TestHistogram:
         reg = MetricsRegistry()
         with pytest.raises(ValueError):
             reg.counter("n", "x").inc(-1)
+
+
+class TestPromExposition:
+    """dump() edge cases + round-trip through parse_prometheus_text —
+    the parser `dct metrics` falls back to against a bare /metrics page."""
+
+    def test_empty_registry_dumps_empty(self):
+        reg = MetricsRegistry()
+        assert reg.dump() == ""
+        parsed = parse_prometheus_text(reg.dump())
+        assert parsed["samples"] == []
+
+    def test_single_sample_histogram(self):
+        reg = MetricsRegistry()
+        reg.histogram("lat_seconds", "latency").observe(0.25)
+        text = reg.dump()
+        # one observation: every quantile collapses onto it
+        for q in ("0.5", "0.95", "0.99"):
+            assert f'lat_seconds{{quantile="{q}"}} 0.25' in text
+        assert "lat_seconds_sum 0.25" in text
+        assert "lat_seconds_count 1" in text
+        parsed = parse_prometheus_text(text)
+        assert parsed["types"]["lat_seconds"] == "summary"
+        count = [v for n, labels, v in parsed["samples"]
+                 if n == "lat_seconds_count"]
+        assert count == [1.0]
+
+    def test_label_escaping_round_trips(self):
+        reg = MetricsRegistry()
+        ugly = 'quo"te\\slash\nnewline'
+        reg.counter("errs_total", "errors",
+                    labels={"msg": ugly, "code": "7"}).inc(2)
+        text = reg.dump()
+        assert "\n\n" not in text  # escaped newline never splits the line
+        parsed = parse_prometheus_text(text)
+        (sample,) = [s for s in parsed["samples"] if s[0] == "errs_total"]
+        assert sample[1] == {"msg": ugly, "code": "7"}
+        assert sample[2] == 2.0
+
+    def test_help_escaping(self):
+        reg = MetricsRegistry()
+        reg.gauge("g", "multi\nline \\help").set(1)
+        text = reg.dump()
+        assert "# HELP g multi\\nline \\\\help" in text
+        assert parse_prometheus_text(text)["help"]["g"] == \
+            "multi\nline \\help"
+
+    def test_labeled_children_share_one_family(self):
+        reg = MetricsRegistry()
+        reg.counter("hits_total", "hits", labels={"trial": "1"}).inc(1)
+        reg.counter("hits_total", "hits", labels={"trial": "2"}).inc(4)
+        text = reg.dump()
+        assert text.count("# TYPE hits_total counter") == 1
+        parsed = parse_prometheus_text(text)
+        got = {s[1]["trial"]: s[2] for s in parsed["samples"]
+               if s[0] == "hits_total"}
+        assert got == {"1": 1.0, "2": 4.0}
+
+    def test_full_round_trip_all_types(self):
+        reg = MetricsRegistry()
+        reg.counter("c_total", "c").inc(5)
+        reg.gauge("g", "g").set(-2.5)
+        h = reg.histogram("h_seconds", "h")
+        for v in (1.0, 2.0, 3.0, 4.0):
+            h.observe(v)
+        parsed = parse_prometheus_text(reg.dump())
+        flat = {(n, tuple(sorted(labels.items()))): v
+                for n, labels, v in parsed["samples"]}
+        assert flat[("c_total", ())] == 5.0
+        assert flat[("g", ())] == -2.5
+        assert flat[("h_seconds_sum", ())] == 10.0
+        assert flat[("h_seconds_count", ())] == 4.0
+        assert flat[("h_seconds", (("quantile", "0.5"),))] == \
+            pytest.approx(h.percentile(50))
 
 
 # ---------------------------------------------------------------------------

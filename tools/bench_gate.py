@@ -1,0 +1,161 @@
+#!/usr/bin/env python3
+"""Bench regression gate — compare two BENCH rounds.
+
+First enforcement of ROADMAP item 5's "every perf PR must move MFU or
+tokens/sec": given the previous and the new bench result, fail (exit 1)
+when
+
+- the new round's throughput (samples/sec/chip) dropped more than the
+  tolerance (default -5%) against the old round on the *same platform*
+  (platform changed, e.g. TPU came back → throughput compare is skipped
+  with a warning, not failed: cross-platform numbers are incomparable);
+- the new round has a null ``mfu`` — the analytic FLOPs engine makes the
+  field unconditional, so null means the accounting regressed.
+
+Accepts either the raw bench.py JSON line or the driver's ``BENCH_rN.json``
+wrapper ({"n", "cmd", "rc", "tail"}), where the result is the last JSON
+object with a "metric" key inside ``tail``.
+
+Usage:
+    python tools/bench_gate.py OLD.json NEW.json [--tolerance -0.05]
+    python tools/bench_gate.py            # two newest BENCH_r*.json in cwd
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, Optional, Tuple
+
+DEFAULT_TOLERANCE = -0.05
+
+
+def _last_metric_line(text: str) -> Optional[Dict[str, Any]]:
+    result = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            result = obj
+    return result
+
+
+def load_bench(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        obj = json.load(f)
+    if isinstance(obj, dict) and "metric" in obj:
+        return obj
+    if isinstance(obj, dict) and "tail" in obj:
+        inner = _last_metric_line(str(obj["tail"]))
+        if inner is not None:
+            return inner
+        raise ValueError(f"{path}: wrapper 'tail' holds no bench result line")
+    raise ValueError(f"{path}: neither a bench result nor a BENCH_rN wrapper")
+
+
+def newest_rounds(directory: str = ".") -> Tuple[str, str]:
+    rounds = []
+    for path in glob.glob(os.path.join(directory, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if m:
+            rounds.append((int(m.group(1)), path))
+    rounds.sort()
+    if len(rounds) < 2:
+        raise ValueError(
+            f"need two BENCH_r*.json rounds in {directory!r}, "
+            f"found {len(rounds)}")
+    return rounds[-2][1], rounds[-1][1]
+
+
+def gate(old: Dict[str, Any], new: Dict[str, Any], *,
+         tolerance: float = DEFAULT_TOLERANCE,
+         allow_null_mfu: bool = False) -> Tuple[bool, list]:
+    """Returns (ok, report_lines)."""
+    report = []
+    ok = True
+    old_detail = old.get("detail") or {}
+    new_detail = new.get("detail") or {}
+    old_plat = old_detail.get("platform", "")
+    new_plat = new_detail.get("platform", "")
+    old_v = float(old.get("value") or 0.0)
+    new_v = float(new.get("value") or 0.0)
+
+    if new_detail.get("mfu") is None:
+        if allow_null_mfu:
+            report.append("WARN: new round has mfu=null (allowed by flag)")
+        else:
+            ok = False
+            report.append(
+                "FAIL: new round has mfu=null — the analytic FLOPs engine "
+                "must always produce one (check mfu_peak_assumed wiring)")
+    else:
+        report.append(
+            f"ok: mfu={new_detail['mfu']} "
+            f"(peak {new_detail.get('mfu_peak_assumed', '?')})")
+
+    if old_plat and new_plat and old_plat != new_plat:
+        report.append(
+            f"WARN: platform changed {old_plat!r} → {new_plat!r}; "
+            f"throughput compare skipped (numbers not comparable)")
+    elif old_v <= 0:
+        report.append(
+            "WARN: old round banked no throughput; compare skipped")
+    elif new_v <= 0:
+        ok = False
+        report.append(
+            f"FAIL: new round banked no throughput (old: {old_v:.3f})")
+    else:
+        delta = new_v / old_v - 1.0
+        line = (f"throughput {old_v:.3f} → {new_v:.3f} samples/sec/chip "
+                f"({delta:+.1%}, tolerance {tolerance:+.1%})")
+        if delta < tolerance:
+            ok = False
+            report.append(f"FAIL: {line}")
+        else:
+            report.append(f"ok: {line}")
+    return ok, report
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("old", nargs="?", default=None,
+                        help="previous round (BENCH_rN.json or raw result)")
+    parser.add_argument("new", nargs="?", default=None,
+                        help="new round")
+    parser.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                        help="max allowed relative throughput change, "
+                             "negative = allowed drop (default -0.05)")
+    parser.add_argument("--allow-null-mfu", action="store_true",
+                        help="demote the null-mfu failure to a warning")
+    args = parser.parse_args(argv)
+
+    try:
+        if args.old is None or args.new is None:
+            old_path, new_path = newest_rounds()
+            print(f"auto-selected rounds: {old_path} → {new_path}")
+        else:
+            old_path, new_path = args.old, args.new
+        old = load_bench(old_path)
+        new = load_bench(new_path)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    ok, report = gate(old, new, tolerance=args.tolerance,
+                      allow_null_mfu=args.allow_null_mfu)
+    for line in report:
+        print(line)
+    print("bench gate: " + ("PASS" if ok else "FAIL"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
