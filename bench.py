@@ -575,6 +575,30 @@ def _attach_probe_telemetry(obj: dict, errors: dict) -> None:
     except Exception:  # noqa: BLE001 - bench must print its line regardless
         pass
 
+def _attach_control_plane(obj: dict, t_round0: float) -> None:
+    """Attach the control-plane section: a synthetic scheduler load run
+    against the real C++ master (tools/loadgen.py — simulated agents +
+    no-op trials), reporting submits/sec admitted, decisions/sec, p50/p99
+    submit→running latency and peak queue depth. Host-only (binary +
+    sqlite + HTTP), so it rides in BENCH regardless of the TPU tunnel's
+    mood; a missing build degrades to an error note, never a crash."""
+    trials = int(_budget("DCT_BENCH_CP_TRIALS", 1000))
+    if trials <= 0:
+        return
+    left = TOTAL_BUDGET_S - (time.monotonic() - t_round0)
+    cp_budget = min(_budget("DCT_BENCH_CP_BUDGET_S", 120.0),
+                    max(left, 45.0))
+    detail = obj.setdefault("detail", {})
+    try:
+        sys.path.insert(0, REPO_ROOT)
+        from tools.loadgen import run_load
+
+        detail["control_plane"] = run_load(trials=trials,
+                                           budget_s=cp_budget)
+    except Exception as exc:  # noqa: BLE001 - bench must print its line
+        detail["control_plane"] = {"error": repr(exc)[:200]}
+
+
 def _attempt(env: dict, budget: float, probe_budget: float | None) -> tuple:
     """Run the child under ``budget`` seconds; return (result, error).
 
@@ -713,6 +737,7 @@ def main() -> None:
     if tpu_wanted:
         obj, err = _attempt(env, TPU_BUDGET_S, PROBE_BUDGET_S)
         if obj is not None and _platform(obj) != "cpu":
+            _attach_control_plane(obj, t_round0)
             print(json.dumps(obj))
             return
         if obj is not None:
@@ -747,6 +772,7 @@ def main() -> None:
                 obj.setdefault("detail", {})["tpu_first_attempt_error"] = (
                     errors.get("tpu"))
                 _attach_probe_telemetry(obj, errors)
+                _attach_control_plane(obj, t_round0)
                 print(json.dumps(obj))
                 return
             if obj is not None:
@@ -768,6 +794,7 @@ def main() -> None:
             detail["tpu_error"] = tpu_err
             detail["tpu_diagnostics"] = _tunnel_diagnostics()
         _attach_probe_telemetry(cpu_obj, errors)
+        _attach_control_plane(cpu_obj, t_round0)
         print(json.dumps(cpu_obj))
         return
 
@@ -782,6 +809,7 @@ def main() -> None:
         "detail": detail,
     }
     _attach_probe_telemetry(failed, errors)
+    _attach_control_plane(failed, t_round0)
     print(json.dumps(failed))
 
 

@@ -526,8 +526,10 @@ def cmd_metrics(args) -> int:
     """Cluster-wide metrics view (`GET /metrics` + the master's summary
     endpoint): top trials by throughput, cluster quantiles, restart/
     fallback/retry counters — docs/observability.md."""
-    from determined_clone_tpu.telemetry.aggregate import format_summary
-    from determined_clone_tpu.telemetry.metrics import parse_prometheus_text
+    from determined_clone_tpu.telemetry.aggregate import (
+        ClusterMetricsAggregator,
+        format_summary,
+    )
 
     if args.raw:
         master = args.master or os.environ.get("DCT_MASTER",
@@ -544,17 +546,27 @@ def cmd_metrics(args) -> int:
     except MasterError as e:
         if e.status != 404:
             raise
-        # older/C++ masters have /metrics but no summary route: degrade
-        # to a parsed view of the exposition text
+        # C++ masters have /metrics but no JSON summary route: fold the
+        # exposition text through the aggregator so the scheduler's
+        # dct_master_sched_* families land in the same summary view
         import urllib.request
 
         url = f"http://{session.host}:{session.port}/metrics"
         with urllib.request.urlopen(url, timeout=10) as resp:
-            parsed = parse_prometheus_text(resp.read().decode("utf-8"))
-        for name, labels, value in parsed["samples"]:
-            label_s = ",".join(f"{k}={v}" for k, v in labels.items())
-            label_s = f"{{{label_s}}}" if label_s else ""
-            print(f"{name}{label_s} {value}")
+            text = resp.read().decode("utf-8")
+        agg = ClusterMetricsAggregator()
+        agg.ingest_prometheus_text("master", text)
+        print(format_summary(agg.summary()))
+        try:
+            sched = session.get("/api/v1/cluster/scheduler")
+        except MasterError:
+            return 0
+        c = sched.get("counters") or {}
+        print(f"scheduler: {int(c.get('submitted', 0))} submitted / "
+              f"{int(c.get('scheduled', 0))} scheduled / "
+              f"{int(c.get('running', 0))} running / "
+              f"{int(c.get('completed', 0))} completed; "
+              f"queue depth {int((sched.get('gauges') or {}).get('queue_depth', 0))}")
         return 0
     print(format_summary(summary))
     return 0

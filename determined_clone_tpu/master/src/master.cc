@@ -323,6 +323,22 @@ void Master::load_snapshot() {
   }
 }
 
+void Master::sched_event_locked(const char* name, const Allocation& alloc,
+                                double start, double end) {
+  SchedEvent ev;
+  ev.name = name;
+  ev.alloc_id = alloc.id;
+  ev.trial_id = alloc.trial_id;
+  if (alloc.trial_id) {
+    auto tit = trials_.find(alloc.trial_id);
+    if (tit != trials_.end()) ev.experiment_id = tit->second.experiment_id;
+  }
+  ev.wall_epoch = start > 0 ? start : now_sec();
+  ev.dur_us = end > start ? (end - start) * 1e6 : 0;
+  ev.pool = alloc.resource_pool;
+  sched_.push_event(std::move(ev));
+}
+
 // The jsonl-era names survive as the call sites' vocabulary; the bodies
 // delegate to the pluggable Store (files or sqlite — store.h).
 void Master::log_event(const std::string& level, const std::string& msg) {
@@ -490,8 +506,13 @@ void Master::queue_trial_leg(Trial& trial) {
     alloc.world_size = 1;
     alloc.resource_pool = "unmanaged";
     alloc.queued_at = now_sec();
+    alloc.submitted_at = trial.legs == 1 ? trial.created_at : alloc.queued_at;
+    alloc.running_at = alloc.queued_at;
     alloc.last_activity = alloc.queued_at;
     alloc.token = crypto::random_token();
+    ++sched_.submitted_total;
+    ++sched_.running_total;
+    sched_event_locked("submit", alloc, alloc.submitted_at, alloc.queued_at);
     allocations_[alloc.id] = alloc;
     trial.state = RunState::Running;
     dirty_ = true;
@@ -528,10 +549,18 @@ void Master::queue_trial_leg(Trial& trial) {
     alloc.topology = resources["topology"].as_string();
   }
   alloc.queued_at = now_sec();
+  // first leg: latency is charged from trial creation (the client's
+  // submit); restart/requeue legs re-anchor at the requeue instant so a
+  // long first run does not pollute submit->running quantiles
+  alloc.submitted_at = trial.legs == 1 ? trial.created_at : alloc.queued_at;
   alloc.token = crypto::random_token();
   alloc.spec.set("entrypoint", exp.config["entrypoint"]);
   alloc.spec.set("experiment_id", trial.experiment_id);
   alloc.spec.set("trial_id", trial.id);
+  ++sched_.submitted_total;
+  if (trial.legs > 1) ++sched_.reschedules_total;
+  sched_event_locked(trial.legs > 1 ? "requeue" : "submit", alloc,
+                     alloc.submitted_at, alloc.queued_at);
   allocations_[alloc.id] = alloc;
   trial.state = RunState::Queued;
   dirty_ = true;
@@ -793,7 +822,11 @@ void Master::finish_experiment(Experiment& exp, RunState state,
     auto tit = trials_.find(alloc.trial_id);
     if (tit == trials_.end() || tit->second.experiment_id != exp.id) continue;
     if (alloc.state == RunState::Queued) alloc.state = RunState::Canceled;
-    if (alloc.state == RunState::Running) alloc.preempt_requested = true;
+    if (alloc.state == RunState::Running && !alloc.preempt_requested) {
+      alloc.preempt_requested = true;
+      ++sched_.preemptions_total;
+      sched_event_locked("preempt", alloc, now_sec(), now_sec());
+    }
   }
   dirty_ = true;
 }
@@ -819,6 +852,12 @@ void Master::on_task_done(const std::string& alloc_id, int exit_code,
   if (alloc.state == RunState::Canceled) {
     // killed/idle-reaped: record the exit, close out the trial as CANCELED
     // (not an error), and never run restart logic — idempotently
+    if (alloc.ended_at == 0) {
+      alloc.ended_at = now_sec();
+      ++sched_.completed_total;
+      sched_event_locked("end", alloc, alloc.ended_at, alloc.ended_at);
+      dirty_ = true;
+    }
     if (alloc.exit_code == 0 && exit_code != 0) {
       alloc.exit_code = exit_code;
       dirty_ = true;
@@ -841,6 +880,9 @@ void Master::on_task_done(const std::string& alloc_id, int exit_code,
   bool failed = exit_code != 0;
   alloc.exit_code = exit_code;
   alloc.state = failed ? RunState::Errored : RunState::Completed;
+  alloc.ended_at = now_sec();
+  ++sched_.completed_total;
+  sched_event_locked("end", alloc, alloc.ended_at, alloc.ended_at);
   dirty_ = true;
   if (alloc.trial_id == 0) return;
   auto tit = trials_.find(alloc.trial_id);
@@ -1001,6 +1043,13 @@ void Master::tick_locked() {
           alloc.state = RunState::Queued;
           alloc.reservations.clear();
           alloc.rendezvous.clear();
+          // re-arm the lifecycle clocks: the same allocation id goes back
+          // through scheduled/running, and stale stamps would corrupt the
+          // latency quantiles on the next pass
+          alloc.scheduled_at = 0;
+          alloc.running_at = 0;
+          ++sched_.reschedules_total;
+          sched_event_locked("requeue", alloc, now, now);
           allgather_.erase(id);  // stale barrier payloads die with the leg
           if (alloc.trial_id) {
             auto tit = trials_.find(alloc.trial_id);
@@ -1068,14 +1117,30 @@ void Master::agent_rm_tick_locked(double now) {
     }
   }
 
+  sched_.gang_waiting_by_pool.clear();
   for (auto& [pool, pending] : pool_pending) {
     auto policy_it = config_.pools.find(pool);
     const PoolPolicy& policy = policy_it != config_.pools.end()
                                    ? policy_it->second
                                    : config_.default_pool;
+    auto pass_t0 = std::chrono::steady_clock::now();
     auto decision = schedule_pool(
         policy, pool_agents[pool], pool_free[pool], pending,
         pool_running[pool], share_usage, owner_of);
+    double pass_s = std::chrono::duration<double>(
+                        std::chrono::steady_clock::now() - pass_t0).count();
+    ++sched_.decisions_total;
+    sched_.considered_total += decision.considered;
+    sched_.gangs_admitted_total += decision.gangs_admitted;
+    sched_.gang_wait_ticks_total += decision.gang_waiting;
+    sched_.gang_waiting_by_pool[pool] = decision.gang_waiting;
+    sched_.decision_seconds.observe(pass_s);
+    SchedEvent pass_ev;
+    pass_ev.name = "decision";
+    pass_ev.pool = pool;
+    pass_ev.wall_epoch = now;
+    pass_ev.dur_us = pass_s * 1e6;
+    sched_.push_event(std::move(pass_ev));
     for (const auto& [alloc_id, fit] : decision.assignments) {
       // reservation only; start commands are derived from state at each
       // heartbeat (idempotent re-send — a lost response cannot strand the
@@ -1084,6 +1149,12 @@ void Master::agent_rm_tick_locked(double now) {
       alloc.reservations = fit;
       alloc.state = RunState::Pulling;
       alloc.world_size = static_cast<int>(fit.size());
+      alloc.scheduled_at = now;
+      ++sched_.scheduled_total;
+      if (alloc.queued_at > 0 && now >= alloc.queued_at) {
+        sched_.queue_wait_seconds.observe(now - alloc.queued_at);
+      }
+      sched_event_locked("schedule", alloc, alloc.queued_at, now);
       if (alloc.trial_id) {
         auto tit = trials_.find(alloc.trial_id);
         if (tit != trials_.end()) tit->second.state = RunState::Pulling;
@@ -1094,6 +1165,8 @@ void Master::agent_rm_tick_locked(double now) {
       Allocation& alloc = allocations_[victim];
       if (!alloc.preempt_requested) {
         alloc.preempt_requested = true;
+        ++sched_.preemptions_total;
+        sched_event_locked("preempt", alloc, now, now);
         dirty_ = true;
       }
     }

@@ -26,6 +26,7 @@
 #include "platform.h"
 #include "provisioner.h"
 #include "rm.h"
+#include "sched_telemetry.h"
 #include "scheduler.h"
 #include "searcher.h"
 #include "store.h"
@@ -140,6 +141,17 @@ class Master {
   HttpResponse proxy_route(const HttpRequest& req);
   // GET /metrics — Prometheus text exposition of cluster state gauges
   HttpResponse metrics_route();
+  // GET /api/v1/cluster/scheduler[/events] — control-plane telemetry
+  // summary + master-lane event dump (routes.cc; caller holds mu_)
+  Json sched_summary_locked();
+  Json sched_events_locked();
+  // GET /api/v1/experiments/:id/trace — trial span samples + synthesized
+  // master-lane lifecycle spans for `dct trace export` (caller holds mu_)
+  HttpResponse experiment_trace_locked(int64_t exp_id);
+  // record a master-lane lifecycle event (caller holds mu_); start/end are
+  // epoch seconds (end <= start records an instant)
+  void sched_event_locked(const char* name, const Allocation& alloc,
+                          double start, double end);
   // GET /debug/requests | /debug/stats — request tracing (≈ the
   // reference's otel spans + prom middleware, core.go:1014,1189)
   HttpResponse debug_route(const HttpRequest& req);
@@ -243,6 +255,9 @@ class Master {
   int64_t next_task_id_ = 1;
   std::map<int64_t, Experiment> experiments_;
   std::map<int64_t, Trial> trials_;
+  // control-plane scheduler telemetry (guarded by mu_, like the state it
+  // observes; metrics_route and the cluster routes read it under mu_ too)
+  SchedTelemetry sched_;
   std::map<std::string, Allocation> allocations_;
   std::map<std::string, Agent> agents_;
   std::vector<CheckpointRecord> checkpoints_;

@@ -228,6 +228,14 @@ struct Allocation {
   // DCN; topology then names the PER-SLICE shape. 1 = single-slice.
   int n_slices = 1;
   double queued_at = 0;
+  // lifecycle timestamps (epoch seconds, 0 = not reached): submitted is
+  // when the work first entered the master (trial creation / task POST);
+  // queued_at doubles as the queue-order key, so operator moves rewrite it
+  // while submitted_at stays fixed for latency accounting.
+  double submitted_at = 0;
+  double scheduled_at = 0;   // reservations granted (Queued -> Pulling)
+  double running_at = 0;     // harness reported running
+  double ended_at = 0;       // terminal (Completed/Errored/Canceled)
   // agent_id -> slots reserved
   std::map<std::string, int> reservations;
   // rendezvous: rank -> address
@@ -264,6 +272,8 @@ struct Allocation {
         .set("priority", priority).set("resource_pool", resource_pool)
         .set("topology", topology).set("n_slices", n_slices)
         .set("queued_at", queued_at)
+        .set("submitted_at", submitted_at).set("scheduled_at", scheduled_at)
+        .set("running_at", running_at).set("ended_at", ended_at)
         .set("reservations", res).set("rendezvous", rdv)
         .set("world_size", world_size)
         .set("preempt_requested", preempt_requested).set("spec", spec)
@@ -286,6 +296,12 @@ struct Allocation {
     a.topology = j["topology"].as_string();
     a.n_slices = static_cast<int>(j["n_slices"].as_int(1));
     a.queued_at = j["queued_at"].as_number();
+    // pre-telemetry snapshots: fall back to the queue time so latency
+    // math degrades to zero instead of to 1970-sized values
+    a.submitted_at = j["submitted_at"].as_number(a.queued_at);
+    a.scheduled_at = j["scheduled_at"].as_number(0);
+    a.running_at = j["running_at"].as_number(0);
+    a.ended_at = j["ended_at"].as_number(0);
     for (const auto& [aid, n] : j["reservations"].items()) {
       a.reservations[aid] = static_cast<int>(n.as_int());
     }

@@ -11,6 +11,7 @@
 #include <algorithm>
 #include <cctype>
 #include <chrono>
+#include <cmath>
 #include <fstream>
 #include <regex>
 #include <set>
@@ -64,6 +65,73 @@ std::string url_encode(const std::string& s) {
     }
   }
   return out;
+}
+
+// -- Prometheus exposition helpers, format-compatible with the Python
+// registry (telemetry/metrics.py): parse_prometheus_text must round-trip
+// this output byte-for-byte in meaning, so escaping and number rendering
+// mirror _escape_label_value / _escape_help / _fmt exactly.
+
+std::string prom_escape_label(const std::string& v) {
+  std::string out;
+  out.reserve(v.size());
+  for (char c : v) {
+    if (c == '\\') out += "\\\\";
+    else if (c == '"') out += "\\\"";
+    else if (c == '\n') out += "\\n";
+    else out += c;
+  }
+  return out;
+}
+
+std::string prom_escape_help(const std::string& v) {
+  std::string out;
+  out.reserve(v.size());
+  for (char c : v) {
+    if (c == '\\') out += "\\\\";
+    else if (c == '\n') out += "\\n";
+    else out += c;
+  }
+  return out;
+}
+
+// _fmt: NaN -> "NaN"; integral magnitudes under 1e15 print as integers;
+// everything else prints as the shortest decimal that round-trips
+std::string prom_fmt(double v) {
+  if (std::isnan(v)) return "NaN";
+  if (std::isfinite(v) && std::fabs(v) < 1e15 && v == std::floor(v)) {
+    return std::to_string(static_cast<long long>(v));
+  }
+  for (int prec = 1; prec <= 17; ++prec) {
+    std::ostringstream s;
+    s.precision(prec);
+    s << v;
+    try {
+      if (std::stod(s.str()) == v) return s.str();
+    } catch (const std::exception&) {
+      break;
+    }
+  }
+  std::ostringstream s;
+  s.precision(17);
+  s << v;
+  return s.str();
+}
+
+// one summary family (quantile children + _sum/_count), matching
+// Histogram.sample_lines' layout and quantile set
+void prom_summary(std::ostringstream& out, const std::string& name,
+                  const std::string& help, const SchedReservoir& r) {
+  out << "# HELP " << name << " " << prom_escape_help(help) << "\n"
+      << "# TYPE " << name << " summary\n";
+  const double qs[] = {0.5, 0.95, 0.99};
+  const char* qlabels[] = {"0.5", "0.95", "0.99"};
+  for (int i = 0; i < 3; ++i) {
+    out << name << "{quantile=\"" << qlabels[i] << "\"} "
+        << prom_fmt(r.percentile(qs[i])) << "\n";
+  }
+  out << name << "_sum " << prom_fmt(r.sum()) << "\n";
+  out << name << "_count " << r.count() << "\n";
 }
 
 }  // namespace
@@ -256,11 +324,233 @@ HttpResponse Master::metrics_route() {
   out << "dct_slots_used " << slots_used << "\n";
   gauge("dct_queue_depth", "queued allocations");
   out << "dct_queue_depth " << queue_depth << "\n";
+
+  // -- control-plane scheduler families (docs/observability.md): lifecycle
+  // counters, decision-loop stats, and latency summaries in the Python
+  // registry's exact exposition format --
+  auto counter = [&](const std::string& name, const std::string& help,
+                     int64_t v) {
+    out << "# HELP " << name << " " << prom_escape_help(help) << "\n"
+        << "# TYPE " << name << " counter\n"
+        << name << " " << v << "\n";
+  };
+  counter("dct_master_sched_submitted_total",
+          "allocations entering the queue", sched_.submitted_total);
+  counter("dct_master_sched_scheduled_total",
+          "allocations granted reservations", sched_.scheduled_total);
+  counter("dct_master_sched_running_total",
+          "allocations confirmed running by the harness",
+          sched_.running_total);
+  counter("dct_master_sched_completed_total",
+          "allocations reaching a terminal state", sched_.completed_total);
+  counter("dct_master_sched_preemptions_total",
+          "preempt requests issued", sched_.preemptions_total);
+  counter("dct_master_sched_reschedules_total",
+          "requeues and operator queue reshuffles",
+          sched_.reschedules_total);
+  counter("dct_master_sched_queue_moves_total",
+          "job-queue move-ahead/behind operations",
+          sched_.queue_moves_total);
+  counter("dct_master_sched_priority_changes_total",
+          "job-queue reprioritize operations",
+          sched_.priority_changes_total);
+  counter("dct_master_sched_decisions_total",
+          "scheduling passes (schedule_pool calls)",
+          sched_.decisions_total);
+  counter("dct_master_sched_considered_total",
+          "pending allocations examined across passes",
+          sched_.considered_total);
+  counter("dct_master_sched_gangs_admitted_total",
+          "multi-agent/multislice gang admissions",
+          sched_.gangs_admitted_total);
+  counter("dct_master_sched_gang_wait_ticks_total",
+          "allocation-passes spent waiting for a gang fit",
+          sched_.gang_wait_ticks_total);
+  // per-pool queue depth + gang-wait gauges; pool names are user input, so
+  // label values go through the Python-compatible escaper
+  std::map<std::string, int> pool_depth;
+  for (const auto& [id, a] : allocations_) {
+    if (a.state == RunState::Queued) pool_depth[a.resource_pool]++;
+  }
+  gauge("dct_master_sched_queue_depth", "queued allocations by pool");
+  for (const auto& [pool, n] : pool_depth) {
+    out << "dct_master_sched_queue_depth{pool=\"" << prom_escape_label(pool)
+        << "\"} " << n << "\n";
+  }
+  gauge("dct_master_sched_gang_waiting",
+        "slot-requesting allocations with no fit on the last pass, by pool");
+  for (const auto& [pool, n] : sched_.gang_waiting_by_pool) {
+    out << "dct_master_sched_gang_waiting{pool=\"" << prom_escape_label(pool)
+        << "\"} " << n << "\n";
+  }
+  prom_summary(out, "dct_master_sched_decision_seconds",
+               "wall time of one schedule_pool pass",
+               sched_.decision_seconds);
+  prom_summary(out, "dct_master_sched_queue_wait_seconds",
+               "queued to scheduled latency", sched_.queue_wait_seconds);
+  prom_summary(out, "dct_master_sched_submit_to_running_seconds",
+               "submitted to running latency",
+               sched_.submit_to_running_seconds);
   HttpResponse resp;
   resp.status = 200;
   resp.content_type = "text/plain; version=0.0.4";
   resp.body = out.str();
   return resp;
+}
+
+namespace {
+
+// JSON view of one latency reservoir (quantiles omitted while empty so
+// consumers can distinguish "no data" from "zero latency")
+Json sched_latency_json(const SchedReservoir& r) {
+  Json j = Json::object();
+  j.set("count", r.count());
+  j.set("sum", r.sum());
+  if (r.count() > 0) {
+    j.set("p50", r.percentile(0.5));
+    j.set("p95", r.percentile(0.95));
+    j.set("p99", r.percentile(0.99));
+  }
+  return j;
+}
+
+// one master-lane span record in the shape Telemetry.publish ships trial
+// spans (chrome_trace.py stitches on process/wall_epoch/ts_us/dur_us)
+Json master_span_json(const std::string& name, double start_epoch,
+                      double dur_us, const std::string& tname) {
+  Json rec = Json::object();
+  rec.set("group", "span").set("process", "master").set("name", name)
+      .set("wall_epoch", start_epoch).set("ts_us", 0.0)
+      .set("dur_us", dur_us).set("tid", static_cast<int64_t>(1))
+      .set("tname", tname);
+  return rec;
+}
+
+}  // namespace
+
+// GET /api/v1/cluster/scheduler — the JSON twin of the dct_master_sched_*
+// Prometheus families (caller holds mu_)
+Json Master::sched_summary_locked() {
+  Json counters = Json::object();
+  counters.set("submitted", sched_.submitted_total)
+      .set("scheduled", sched_.scheduled_total)
+      .set("running", sched_.running_total)
+      .set("completed", sched_.completed_total)
+      .set("preemptions", sched_.preemptions_total)
+      .set("reschedules", sched_.reschedules_total)
+      .set("queue_moves", sched_.queue_moves_total)
+      .set("priority_changes", sched_.priority_changes_total)
+      .set("decisions", sched_.decisions_total)
+      .set("considered", sched_.considered_total)
+      .set("gangs_admitted", sched_.gangs_admitted_total)
+      .set("gang_wait_ticks", sched_.gang_wait_ticks_total);
+  Json depth_by_pool = Json::object();
+  int64_t queue_depth = 0;
+  std::map<std::string, int64_t> pool_depth;
+  for (const auto& [id, a] : allocations_) {
+    if (a.state == RunState::Queued) {
+      ++pool_depth[a.resource_pool];
+      ++queue_depth;
+    }
+  }
+  for (const auto& [pool, n] : pool_depth) depth_by_pool.set(pool, n);
+  Json gang_by_pool = Json::object();
+  int64_t gang_waiting = 0;
+  for (const auto& [pool, n] : sched_.gang_waiting_by_pool) {
+    gang_by_pool.set(pool, n);
+    gang_waiting += n;
+  }
+  Json gauges = Json::object();
+  gauges.set("queue_depth", queue_depth)
+      .set("queue_depth_by_pool", depth_by_pool)
+      .set("gang_waiting", gang_waiting)
+      .set("gang_waiting_by_pool", gang_by_pool);
+  Json latency = Json::object();
+  latency.set("decision_seconds", sched_latency_json(sched_.decision_seconds))
+      .set("queue_wait_seconds",
+           sched_latency_json(sched_.queue_wait_seconds))
+      .set("submit_to_running_seconds",
+           sched_latency_json(sched_.submit_to_running_seconds));
+  Json j = Json::object();
+  j.set("counters", counters).set("gauges", gauges).set("latency", latency)
+      .set("events_dropped", sched_.events_dropped)
+      .set("time", now_sec());
+  return j;
+}
+
+// GET /api/v1/cluster/scheduler/events — the bounded master-lane event
+// ring as Chrome-trace-ready span samples (caller holds mu_)
+Json Master::sched_events_locked() {
+  Json samples = Json::array();
+  for (const auto& ev : sched_.events) {
+    Json rec = master_span_json(ev.name, ev.wall_epoch, ev.dur_us,
+                                "scheduler");
+    if (ev.trial_id) rec.set("trial_id", ev.trial_id);
+    Json args = Json::object();
+    if (!ev.alloc_id.empty()) args.set("allocation_id", ev.alloc_id);
+    if (ev.experiment_id) args.set("experiment_id", ev.experiment_id);
+    if (!ev.pool.empty()) args.set("pool", ev.pool);
+    rec.set("args", args);
+    samples.push_back(rec);
+  }
+  Json j = Json::object();
+  j.set("samples", samples).set("dropped", sched_.events_dropped);
+  return j;
+}
+
+// GET /api/v1/experiments/:id/trace — every trial's shipped span samples
+// plus a synthesized master lane (submit→schedule→run per allocation,
+// anchored on the lifecycle timestamps so ring eviction cannot lose an
+// old experiment's lane). Caller holds mu_.
+HttpResponse Master::experiment_trace_locked(int64_t exp_id) {
+  Json samples = Json::array();
+  double now = now_sec();
+  for (const auto& [tid, trial] : trials_) {
+    if (trial.experiment_id != exp_id) continue;
+    // the trial lane: span-group profiler samples the harness shipped
+    std::string trace_id;
+    for (const auto& rec : read_jsonl_tail(
+             "trial-" + std::to_string(tid) + "-profiler.jsonl", 5000)) {
+      if (rec["group"].as_string() != "span") continue;
+      Json out = rec;
+      if (!out.has("trial_id")) out.set("trial_id", tid);
+      if (trace_id.empty()) trace_id = rec["trace_id"].as_string();
+      samples.push_back(out);
+    }
+    // the master lane: one submit→schedule→run triplet per allocation leg,
+    // carrying the trial's trace_id (the DCT_TRACE_ID contract) so the
+    // stitched trace ties both lanes to one identity
+    for (const auto& [aid, alloc] : allocations_) {
+      if (alloc.trial_id != tid) continue;
+      double submitted = alloc.submitted_at > 0 ? alloc.submitted_at
+                                                : alloc.queued_at;
+      double scheduled = alloc.scheduled_at;
+      double running = alloc.running_at;
+      double ended = alloc.ended_at > 0 ? alloc.ended_at : now;
+      struct Leg { const char* name; double start, end; };
+      const Leg legs[] = {
+          {"submit", submitted, scheduled > 0 ? scheduled : ended},
+          {"schedule", scheduled, running > 0 ? running : ended},
+          {"run", running, ended},
+      };
+      for (const auto& leg : legs) {
+        if (leg.start <= 0) continue;
+        double dur_us = leg.end > leg.start ? (leg.end - leg.start) * 1e6 : 0;
+        Json rec = master_span_json(leg.name, leg.start, dur_us, "scheduler");
+        rec.set("trial_id", tid);
+        if (!trace_id.empty()) rec.set("trace_id", trace_id);
+        Json args = Json::object();
+        args.set("allocation_id", alloc.id)
+            .set("experiment_id", exp_id)
+            .set("pool", alloc.resource_pool);
+        rec.set("args", args);
+        samples.push_back(rec);
+      }
+    }
+  }
+  Json j = Json::object();
+  j.set("samples", samples);
+  return ok_json(j);
 }
 
 // WebUI static assets. The reference master embeds and serves the built
@@ -689,7 +979,7 @@ HttpResponse Master::route(const HttpRequest& req) {
       "experiments", "tasks",  "users",    "workspaces", "models",
       "templates",   "webhooks", "job-queue", "provisioner", "groups",
       "rbac", "notebooks", "shells", "commands", "tensorboards",
-      "projects", "checkpoints"};
+      "projects", "checkpoints", "cluster"};
   if (config_.auth_required && kAuthRoots.count(root)) {
     bool alloc_readonly = req.method == "GET" &&
                           (root == "experiments" || root == "users") &&
@@ -1014,7 +1304,11 @@ HttpResponse Master::route(const HttpRequest& req) {
               alloc.reservations.clear();
               tit->second.state = RunState::Paused;
             } else if (alloc.state == RunState::Running) {
-              alloc.preempt_requested = true;  // graceful: ckpt then exit
+              if (!alloc.preempt_requested) {
+                alloc.preempt_requested = true;  // graceful: ckpt then exit
+                ++sched_.preemptions_total;
+                sched_event_locked("preempt", alloc, now_sec(), now_sec());
+              }
             }
           }
           dirty_ = true;
@@ -1262,6 +1556,11 @@ HttpResponse Master::route(const HttpRequest& req) {
           j.set("state", to_string(exp.state));
           return ok_json(j);
         }
+      }
+      // stitched-trace source: trial span samples + synthesized master-lane
+      // lifecycle spans (`dct trace export --experiment N`)
+      if (parts.size() == 5 && parts[4] == "trace" && req.method == "GET") {
+        return experiment_trace_locked(id);
       }
       // context-dir download by agents (≈ prep_container.py:29)
       if (parts.size() == 5 && parts[4] == "context" && req.method == "GET") {
@@ -1912,6 +2211,18 @@ HttpResponse Master::route(const HttpRequest& req) {
           alloc.reservations[aid] = alloc.slots;
           alloc.state = RunState::Running;
           if (alloc.world_size == 0) alloc.world_size = 1;
+          if (alloc.running_at == 0) {
+            double now = now_sec();
+            alloc.scheduled_at = alloc.scheduled_at ? alloc.scheduled_at : now;
+            alloc.running_at = now;
+            ++sched_.running_total;
+            double sub = alloc.submitted_at > 0 ? alloc.submitted_at
+                                                : alloc.queued_at;
+            if (sub > 0 && now >= sub) {
+              sched_.submit_to_running_seconds.observe(now - sub);
+            }
+            sched_event_locked("running", alloc, alloc.scheduled_at, now);
+          }
           if (alloc.trial_id && trials_.count(alloc.trial_id)) {
             trials_[alloc.trial_id].state = RunState::Running;
           }
@@ -1943,9 +2254,25 @@ HttpResponse Master::route(const HttpRequest& req) {
       auto ait = allocations_.find(alloc_id);
       if (ait == allocations_.end()) return not_found("no allocation " + alloc_id);
       if (event == "running") {
-        ait->second.state = RunState::Running;
-        if (ait->second.trial_id) {
-          trials_[ait->second.trial_id].state = RunState::Running;
+        Allocation& alloc = ait->second;
+        alloc.state = RunState::Running;
+        if (alloc.running_at == 0) {
+          // first running report only: gang members each send one, and the
+          // latency sample belongs to the first (the gang is live then)
+          double now = now_sec();
+          alloc.running_at = now;
+          ++sched_.running_total;
+          double sub = alloc.submitted_at > 0 ? alloc.submitted_at
+                                              : alloc.queued_at;
+          if (sub > 0 && now >= sub) {
+            sched_.submit_to_running_seconds.observe(now - sub);
+          }
+          sched_event_locked("running", alloc,
+                             alloc.scheduled_at > 0 ? alloc.scheduled_at : now,
+                             now);
+        }
+        if (alloc.trial_id) {
+          trials_[alloc.trial_id].state = RunState::Running;
         }
         dirty_ = true;
       } else if (event == "exited") {
@@ -2124,6 +2451,15 @@ HttpResponse Master::route(const HttpRequest& req) {
     }
   }
 
+  // ---- cluster: control-plane scheduler telemetry ------------------------
+  if (root == "cluster" && req.method == "GET" && parts.size() >= 4 &&
+      parts[3] == "scheduler") {
+    if (parts.size() == 4) return ok_json(sched_summary_locked());
+    if (parts.size() == 5 && parts[4] == "events") {
+      return ok_json(sched_events_locked());
+    }
+  }
+
   // ---- provisioner (≈ GET provisioner state for ops visibility) ----------
   if (root == "provisioner" && req.method == "GET") {
     if (!provisioner_) {
@@ -2169,6 +2505,11 @@ HttpResponse Master::route(const HttpRequest& req) {
           return bad_request("priority required");
         }
         alloc.priority = static_cast<int>(body["priority"].as_int());
+        // an operator reshuffle is a reschedule of queue order: both the
+        // specific and the umbrella counter move (docs/observability.md)
+        ++sched_.priority_changes_total;
+        ++sched_.reschedules_total;
+        sched_event_locked("reprioritize", alloc, now_sec(), now_sec());
         dirty_ = true;
         Json j = Json::object();
         j.set("job", alloc.to_json());
@@ -2229,6 +2570,9 @@ HttpResponse Master::route(const HttpRequest& req) {
         // in priority mode, ordering is priority-first: adopt the anchor's
         // priority so the move is effective there too
         alloc.priority = anchor.priority;
+        ++sched_.queue_moves_total;
+        ++sched_.reschedules_total;
+        sched_event_locked("move", alloc, now_sec(), now_sec());
         dirty_ = true;
         Json j = Json::object();
         j.set("job", alloc.to_json());

@@ -1,0 +1,129 @@
+// Control-plane scheduler telemetry (docs/observability.md).
+//
+// ≈ the reference's prom collectors over master internals
+// (master/internal/prom/): lifecycle counters, decision-loop timing, and
+// latency quantiles for the scheduling path, plus a bounded ring of
+// master-lane events in Chrome-trace form so `dct trace export` can show
+// submit→schedule→run next to the trial's own spans.
+//
+// Everything here is guarded by the master state lock (mu_): every
+// mutation site (queue_trial_leg, the RM tick, task_event handlers, the
+// job-queue routes) and every reader (metrics_route, the cluster routes)
+// already holds it.
+#pragma once
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <deque>
+#include <map>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace dct {
+
+// Reservoir quantile estimator matching telemetry/metrics.py Histogram:
+// algorithm-R reservoir + numpy-default linear-interpolation percentiles,
+// rendered as a Prometheus summary (quantile children + _sum/_count).
+// Deterministic (fixed-seed xorshift) like the Python side's seeded RNG.
+class SchedReservoir {
+ public:
+  explicit SchedReservoir(size_t cap = 4096) : cap_(cap) {}
+
+  void observe(double v) {
+    ++count_;
+    sum_ += v;
+    if (reservoir_.size() < cap_) {
+      reservoir_.push_back(v);
+    } else {
+      uint64_t j = next_rand() % static_cast<uint64_t>(count_);
+      if (j < cap_) reservoir_[static_cast<size_t>(j)] = v;
+    }
+  }
+
+  int64_t count() const { return count_; }
+  double sum() const { return sum_; }
+
+  // NaN when empty — the exposition renders that as the literal "NaN",
+  // exactly like the Python registry's empty histograms.
+  double percentile(double q) const {
+    if (reservoir_.empty()) return std::nan("");
+    std::vector<double> s = reservoir_;
+    std::sort(s.begin(), s.end());
+    double pos = q * static_cast<double>(s.size() - 1);
+    size_t lo = static_cast<size_t>(pos);
+    size_t hi = std::min(lo + 1, s.size() - 1);
+    double frac = pos - static_cast<double>(lo);
+    return s[lo] * (1.0 - frac) + s[hi] * frac;
+  }
+
+ private:
+  uint64_t next_rand() {
+    // xorshift64*: deterministic replacement decay once the reservoir fills
+    state_ ^= state_ >> 12;
+    state_ ^= state_ << 25;
+    state_ ^= state_ >> 27;
+    return state_ * 0x2545F4914F6CDD1Dull;
+  }
+
+  size_t cap_;
+  int64_t count_ = 0;
+  double sum_ = 0;
+  std::vector<double> reservoir_;
+  uint64_t state_ = 0x9E3779B97F4A7C15ull;
+};
+
+// One master-lane scheduler event, Chrome-trace-ready: wall_epoch anchors
+// the span start (stitch_chrome_trace re-bases each record onto the shared
+// axis), dur_us is the span length (0 = instant).
+struct SchedEvent {
+  std::string name;        // submit | schedule | running | end | preempt |
+                           // requeue | decision
+  std::string alloc_id;
+  int64_t trial_id = 0;
+  int64_t experiment_id = 0;
+  double wall_epoch = 0;   // epoch seconds of event start
+  double dur_us = 0;
+  std::string pool;
+};
+
+// The master's scheduling-path counters/gauges/latency reservoirs.
+struct SchedTelemetry {
+  // lifecycle counters
+  int64_t submitted_total = 0;        // allocations entering the queue
+  int64_t scheduled_total = 0;        // reservations granted
+  int64_t running_total = 0;          // harness-confirmed running
+  int64_t completed_total = 0;        // terminal transitions
+  int64_t preemptions_total = 0;      // preempt requests issued
+  int64_t reschedules_total = 0;      // requeues + operator queue reshuffles
+  int64_t queue_moves_total = 0;      // job-queue move-ahead/behind ops
+  int64_t priority_changes_total = 0; // job-queue reprioritize ops
+  // decision-loop counters
+  int64_t decisions_total = 0;        // schedule_pool passes
+  int64_t considered_total = 0;       // pending allocations examined
+  int64_t gangs_admitted_total = 0;   // multi-agent / multislice admissions
+  int64_t gang_wait_ticks_total = 0;  // alloc-passes spent waiting for a fit
+  // last-pass gauge: slot-requesting allocations that found no fit, by pool
+  std::map<std::string, int64_t> gang_waiting_by_pool;
+  // latency distributions (seconds)
+  SchedReservoir decision_seconds;          // one schedule_pool call
+  SchedReservoir queue_wait_seconds;        // queued -> scheduled
+  SchedReservoir submit_to_running_seconds; // submitted -> running
+  // master-lane event ring (oldest dropped; the per-experiment trace route
+  // synthesizes from allocation timestamps instead, so eviction here only
+  // affects the cluster-wide event dump)
+  std::deque<SchedEvent> events;
+  size_t events_cap = 4096;
+  int64_t events_dropped = 0;
+
+  void push_event(SchedEvent ev) {
+    if (events.size() >= events_cap) {
+      events.pop_front();
+      ++events_dropped;
+    }
+    events.push_back(std::move(ev));
+  }
+};
+
+}  // namespace dct

@@ -257,6 +257,7 @@ SchedulerDecision schedule_pool(
   std::map<std::string, int> usage = share_usage;
   for (auto& alloc : pending) {
     std::string key = owner_key(alloc);
+    ++decision.considered;
     auto fit = find_fit(alloc, agents, free_slots, key, &grids);
     if (fit) {
       for (const auto& [aid, n] : *fit) {
@@ -264,9 +265,11 @@ SchedulerDecision schedule_pool(
         grid_place(grids, alloc, aid, n);
       }
       usage[key] += alloc.slots;
+      if (fit->size() > 1 || alloc.n_slices > 1) ++decision.gangs_admitted;
       decision.assignments[alloc.id] = *fit;
       continue;
     }
+    if (alloc.slots > 0) ++decision.gang_waiting;
     if (policy.type == "priority" && policy.preemption_enabled) {
       // can preempting strictly-lower-priority gangs free enough capacity?
       // (≈ priority.go:199 — victims chosen newest-first)

@@ -25,6 +25,11 @@ struct SchedulerDecision {
   std::map<std::string, std::map<std::string, int>> assignments;
   // allocation ids to preempt (priority policy)
   std::vector<std::string> preemptions;
+  // pass statistics (control-plane telemetry, docs/observability.md):
+  int considered = 0;     // pending allocations examined this pass
+  int gang_waiting = 0;   // examined slot-requesting allocs with no fit —
+                          // still waiting on capacity/gang assembly
+  int gangs_admitted = 0; // assignments spanning >1 agent or >1 slice
 };
 
 struct PoolPolicy {

@@ -204,6 +204,56 @@ class ClusterMetricsAggregator:
         with self._lock:
             self._components[str(component)] = snap
 
+    def ingest_prometheus_text(self, component: str, text: str) -> int:
+        """Fold a component's raw Prometheus exposition (e.g. the C++
+        master's ``GET /metrics``) into the cluster view, so the
+        ``dct_master_sched_*`` families join ``summary()`` next to the
+        trial-shipped series. Summary families are re-folded into the
+        snapshot histogram shape (count/sum/p50/p95/p99); counters and
+        gauges pass through. Returns the number of snapshot entries."""
+        from determined_clone_tpu.telemetry.metrics import (
+            parse_prometheus_text,
+        )
+
+        try:
+            parsed = parse_prometheus_text(text)
+        except ValueError:
+            self._reject(1, "malformed")
+            return 0
+        types = parsed["types"]
+        snap: Dict[str, Dict[str, Any]] = {}
+        summaries: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        for name, labels, value in parsed["samples"]:
+            base, part = name, ""
+            for suffix in ("_sum", "_count"):
+                stem = name[: -len(suffix)]
+                if name.endswith(suffix) and types.get(stem) == "summary":
+                    base, part = stem, suffix
+                    break
+            if types.get(base) == "summary":
+                child = {k: v for k, v in labels.items() if k != "quantile"}
+                rec = summaries.setdefault(
+                    (base, _label_str(child)),
+                    {"type": "histogram", "labels": child,
+                     "count": 0, "sum": 0.0})
+                if part == "_count":
+                    rec["count"] = int(value)
+                elif part == "_sum":
+                    rec["sum"] = value
+                else:
+                    key = {"0.5": "p50", "0.95": "p95",
+                           "0.99": "p99"}.get(labels.get("quantile", ""))
+                    if key and value == value:  # skip NaN (empty summary)
+                        rec[key] = value
+                continue
+            mtype = "counter" if types.get(name) == "counter" else "gauge"
+            snap[name + (_label_str(labels) if labels else "")] = {
+                "type": mtype, "value": value, "labels": labels}
+        for (base, label_s), rec in summaries.items():
+            snap[base + label_s] = rec
+        self.ingest_component(component, snap)
+        return len(snap)
+
     def ingest_component_spans(self, component: str, samples: Any, *,
                                experiment_id: Optional[int] = None) -> int:
         """Span records from a non-trial component (runner, master)."""
@@ -383,6 +433,7 @@ class ClusterMetricsAggregator:
                 continue
             interesting = (name.startswith("retries_")
                            or name.startswith("cas_")
+                           or name.startswith("dct_master_sched_")
                            or "restart" in name or "fallback" in name
                            or "dropped" in name or "failures" in name
                            or "compiles" in name)

@@ -26,6 +26,12 @@
 # (docs/checkpoint_storage.md): backends, the content-addressed store +
 # transfer pool, and the storage-facing fault-tolerance paths.
 #
+# `./run_tests.sh --control-plane` runs the control-plane observability
+# surface (docs/observability.md): scheduler lifecycle telemetry,
+# exposition conformance, trace stitching with the master lane, the
+# job-queue counter checks and the synthetic load harness. Every test in
+# the lane skips cleanly when the C++ master build is unavailable.
+#
 # `./run_tests.sh --bench-gate` compares the two newest BENCH_r*.json
 # rounds via tools/bench_gate.py (default -5% samples/sec tolerance; the
 # new round must carry a non-null mfu — docs/observability.md).
@@ -47,6 +53,11 @@ elif [ "$1" = "--storage" ]; then
     shift
     set -- tests/test_storage_backends.py tests/test_cas_store.py \
         tests/test_fault_tolerance.py -m "not slow" "$@"
+elif [ "$1" = "--control-plane" ]; then
+    shift
+    set -- tests/test_control_plane.py tests/test_load_smoke.py \
+        tests/test_job_queue.py \
+        -m "not slow" "$@"
 elif [ "$1" = "--observability" ]; then
     shift
     set -- tests/test_telemetry.py tests/test_profiler_tensorboard.py \

@@ -27,8 +27,13 @@ def queued(session):
                   key=lambda j: (j["queued_at"], j["id"]))
 
 
+def sched_counters(session):
+    return session.get("/api/v1/cluster/scheduler")["counters"]
+
+
 def test_move_and_reprioritize(master):
     session = master["session"]
+    base = sched_counters(session)
     # no agents: command tasks stay queued, letting us reorder them
     t1 = session.create_task("command", cmd=["echo", "1"], slots=1)
     t2 = session.create_task("command", cmd=["echo", "2"], slots=1)
@@ -51,6 +56,14 @@ def test_move_and_reprioritize(master):
     assert next(j for j in session.job_queue()
                 if j["id"] == t2["id"])["priority"] == 7
 
+    # every operator action above is reflected in the scheduler's
+    # control-plane counters (docs/observability.md): 2 moves + 1
+    # reprioritize, each also counting into the reschedules umbrella
+    c = sched_counters(session)
+    assert c["queue_moves"] - base["queue_moves"] == 2
+    assert c["priority_changes"] - base["priority_changes"] == 1
+    assert c["reschedules"] - base["reschedules"] == 3
+
     # validation
     with pytest.raises(MasterError):
         session.move_job(t1["id"])  # no anchor
@@ -60,6 +73,11 @@ def test_move_and_reprioritize(master):
         session.move_job(t1["id"], ahead_of="task-command-999")
     with pytest.raises(MasterError):
         session.set_job_priority("task-command-999", 3)
+
+    # rejected operations must not have counted
+    after_rejects = sched_counters(session)
+    assert after_rejects["queue_moves"] == c["queue_moves"]
+    assert after_rejects["priority_changes"] == c["priority_changes"]
 
     for tid in ids:
         session.kill_task(tid)

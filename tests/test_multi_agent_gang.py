@@ -177,3 +177,24 @@ def test_two_agent_gang_trains(cluster):
     metrics = session.trial_metrics(trial["id"])
     val = [m for m in metrics if m.get("group") == "validation"]
     assert val and val[-1]["metrics"]["loss"] < 0.5
+
+    # the gang admission shows up in the scheduler's control-plane
+    # telemetry: a 2-reservation fit counts as one admitted gang, and the
+    # full lifecycle ran (submitted → scheduled → running → completed)
+    sched = session.get("/api/v1/cluster/scheduler")
+    c = sched["counters"]
+    assert c["gangs_admitted"] >= 1
+    assert c["submitted"] >= 1 and c["scheduled"] >= 1
+    assert c["running"] >= 1 and c["completed"] >= 1
+    assert "gang_wait_ticks" in c  # ticks spent waiting are tracked too
+    lat = sched["latency"]["submit_to_running_seconds"]
+    assert lat["count"] >= 1 and lat["p50"] > 0
+
+    # and in the Prometheus exposition, including the per-pool gauge family
+    import urllib.request
+
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{cluster['port']}/metrics", timeout=10
+    ).read().decode()
+    assert "dct_master_sched_gangs_admitted_total" in text
+    assert "dct_master_sched_gang_waiting" in text

@@ -75,6 +75,58 @@ def newest_rounds(directory: str = ".") -> Tuple[str, str]:
     return rounds[-2][1], rounds[-1][1]
 
 
+# Optional detail sections that come and go with the environment (TPU
+# tunnel mood, master build availability). A round missing one that the
+# previous round carried is a skip-with-note, never a gate failure — the
+# headline throughput/mfu checks below are the contract.
+OPTIONAL_SECTIONS = ("control_plane", "checkpoint_io", "pipeline",
+                     "mnist_cnn", "tpu_probe_telemetry")
+
+
+def _section_notes(old_detail: Dict[str, Any], new_detail: Dict[str, Any],
+                   report: list) -> None:
+    for name in OPTIONAL_SECTIONS:
+        if old_detail.get(name) is not None and new_detail.get(name) is None:
+            report.append(
+                f"note: section {name!r} present in the previous round is "
+                f"missing in the new one; compare skipped")
+
+
+def _control_plane_lines(old_detail: Dict[str, Any],
+                         new_detail: Dict[str, Any], report: list) -> None:
+    """Advisory control-plane reporting (tools/loadgen.py section): the
+    numbers land in the report so regressions are visible in BENCH
+    history, but only a round that errored where the previous one
+    succeeded warrants a WARN — the synthetic load shares the box with
+    the bench itself, so absolute latency is too noisy to hard-gate."""
+    cp_new = new_detail.get("control_plane")
+    if not isinstance(cp_new, dict):
+        return
+    if cp_new.get("error"):
+        report.append(f"WARN: control_plane errored: {cp_new['error']}")
+        return
+    s2r = cp_new.get("submit_to_running_s") or {}
+
+    def _f(v: Any) -> str:
+        return f"{v:.3f}" if isinstance(v, (int, float)) else "null"
+
+    report.append(
+        f"ok: control_plane {cp_new.get('completed')}/{cp_new.get('trials')} "
+        f"trials: {cp_new.get('submits_per_sec')} submits/s, "
+        f"{cp_new.get('decisions_per_sec')} decisions/s, "
+        f"submit→running p50={_f(s2r.get('p50'))}s p99={_f(s2r.get('p99'))}s, "
+        f"peak queue {cp_new.get('peak_queue_depth')}")
+    cp_old = old_detail.get("control_plane")
+    if (isinstance(cp_old, dict) and not cp_old.get("error")
+            and isinstance(s2r.get("p99"), (int, float))):
+        old_p99 = (cp_old.get("submit_to_running_s") or {}).get("p99")
+        if isinstance(old_p99, (int, float)) and old_p99 > 0 \
+                and s2r["p99"] > 2.0 * old_p99:
+            report.append(
+                f"WARN: control_plane submit→running p99 "
+                f"{old_p99:.3f}s → {s2r['p99']:.3f}s (>2x)")
+
+
 def gate(old: Dict[str, Any], new: Dict[str, Any], *,
          tolerance: float = DEFAULT_TOLERANCE,
          allow_null_mfu: bool = False) -> Tuple[bool, list]:
@@ -121,6 +173,8 @@ def gate(old: Dict[str, Any], new: Dict[str, Any], *,
             report.append(f"FAIL: {line}")
         else:
             report.append(f"ok: {line}")
+    _section_notes(old_detail, new_detail, report)
+    _control_plane_lines(old_detail, new_detail, report)
     return ok, report
 
 

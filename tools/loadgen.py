@@ -1,0 +1,332 @@
+#!/usr/bin/env python3
+"""Synthetic control-plane load harness for the C++ master.
+
+Drives a REAL ``dct-master`` binary (spawned here, or an existing one via
+``--master``) with simulated agents and thousands of no-op trials, then
+reads the scheduler's own telemetry back out of
+``GET /api/v1/cluster/scheduler`` to produce the ``control_plane``
+section of BENCH (docs/observability.md):
+
+- **submits/sec admitted** — trials minted through the custom-searcher
+  operations route over the submission wall time;
+- **decisions/sec** — scheduler decision passes over the run;
+- **p50/p99 submit→running** — the master's own lifecycle-timestamp
+  latency reservoir (``dct_master_sched_submit_to_running_seconds``);
+- **peak queue depth** — max of the queue-depth gauge polled over the run.
+
+The simulated agent protocol is the real one: ``POST
+/api/v1/agents/register``, heartbeats that receive derived ``start``
+commands, ``task_event running`` → ``searcher/completed_op`` →
+``task_event exited``. Completing the searcher op before the clean exit
+parks each trial instead of requeueing it, so slots recycle and the
+queue drains at scheduler speed, not harness speed.
+
+Usage:
+    python tools/loadgen.py --trials 1000 --agents 8 --slots 8
+    python tools/loadgen.py --trials 10000 --budget 300   # the 10k run
+
+Importable: ``run_load(trials=1000, ...) -> dict`` (bench.py calls this).
+Never raises on an unavailable master build — returns ``{"error": ...}``.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MASTER_DIR = os.path.join(REPO, "determined_clone_tpu", "master")
+MASTER_BIN = os.path.join(MASTER_DIR, "build", "dct-master")
+
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from determined_clone_tpu.utils.retry import (  # noqa: E402
+    RetryPolicy, retry_call, sleep_backoff)
+
+OPS_PER_BATCH = 200  # creates per searcher/operations POST
+
+# boot wait: steady sampling, no jitter (the deploy_wait pattern in
+# docs/fault_tolerance.md); ValueError covers a half-up server returning
+# a torn JSON body
+_MASTER_UP = RetryPolicy(
+    name="loadgen_master_up", max_attempts=1_000_000, base_delay_s=0.2,
+    multiplier=1.0, max_delay_s=0.2, jitter="none",
+    retryable=(OSError, ValueError))
+_HEARTBEAT = RetryPolicy(name="loadgen_heartbeat", base_delay_s=0.1,
+                         max_delay_s=2.0, retryable=(OSError, ValueError))
+
+
+def _req(port: int, method: str, path: str, body=None, timeout: float = 30):
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {})
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        payload = resp.read()
+    return json.loads(payload) if payload else {}
+
+
+def ensure_master_binary() -> str | None:
+    if os.path.exists(MASTER_BIN):
+        return MASTER_BIN
+    r = subprocess.run(["make", "-C", MASTER_DIR], capture_output=True)
+    return MASTER_BIN if r.returncode == 0 and os.path.exists(MASTER_BIN) \
+        else None
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_up(port: int, deadline_s: float = 15.0) -> bool:
+    policy = dataclasses.replace(_MASTER_UP, deadline_s=deadline_s)
+    try:
+        retry_call(_req, port, "GET", "/api/v1/master", timeout=3,
+                   policy=policy)
+        return True
+    except (OSError, ValueError):
+        return False
+
+
+def _sched(port: int) -> dict:
+    return _req(port, "GET", "/api/v1/cluster/scheduler")
+
+
+class _AgentSim(threading.Thread):
+    """One fake agent: heartbeats, runs every ``start`` it receives as a
+    no-op (running → completed_op → clean exit), all inside one beat."""
+
+    def __init__(self, port: int, agent_id: str, stop: threading.Event):
+        super().__init__(daemon=True, name=f"loadgen-{agent_id}")
+        self.port = port
+        self.agent_id = agent_id
+        self.stop_ev = stop
+        self.ran = 0
+        self.errors = 0
+
+    def run(self) -> None:
+        hb_failures = 0
+        while not self.stop_ev.is_set():
+            try:
+                resp = _req(self.port, "POST",
+                            f"/api/v1/agents/{self.agent_id}/heartbeat",
+                            {"exited": [], "running": []})
+                hb_failures = 0
+            except (OSError, ValueError):
+                self.errors += 1
+                hb_failures += 1
+                sleep_backoff(_HEARTBEAT, hb_failures)
+                continue
+            cmds = [c for c in resp.get("commands", [])
+                    if c.get("type") == "start"]
+            for cmd in cmds:
+                try:
+                    self._run_task(cmd)
+                    self.ran += 1
+                except (OSError, ValueError):
+                    self.errors += 1
+            # beat fast while work flows, back off when idle — poll pacing
+            # (the Event doubles as the stop signal)
+            self.stop_ev.wait(0.02 if cmds else 0.1)
+
+    def _run_task(self, cmd: dict) -> None:
+        alloc_id = cmd["allocation_id"]
+        trial = cmd.get("trial") or {}
+        _req(self.port, "POST",
+             f"/api/v1/agents/{self.agent_id}/task_event",
+             {"allocation_id": alloc_id, "event": "running"})
+        tid = trial.get("id")
+        if tid:
+            # satisfy the searcher op BEFORE exiting: units_done reaches
+            # target, so the clean exit completes the trial leg instead of
+            # requeueing it — the slot frees for the next queued trial
+            _req(self.port, "POST",
+                 f"/api/v1/trials/{tid}/searcher/completed_op",
+                 {"metric": 0.0, "units": trial.get("target_units", 1)})
+        _req(self.port, "POST",
+             f"/api/v1/agents/{self.agent_id}/task_event",
+             {"allocation_id": alloc_id, "event": "exited", "exit_code": 0})
+
+
+def _counters(summary: dict) -> dict:
+    return summary.get("counters") or {}
+
+
+def run_load(trials: int = 1000, agents: int = 8, slots_per_agent: int = 8,
+             budget_s: float = 180.0, master_port: int | None = None,
+             keep_master: bool = False) -> dict:
+    """Run the synthetic load and return the control-plane measurement.
+
+    Spawns its own master (``--db sqlite``) unless ``master_port`` points
+    at a live one. Always returns a dict; ``error`` is set (and the
+    latency fields None) when the master can't be built or reached.
+    """
+    t_total0 = time.monotonic()
+    proc = None
+    tmp = None
+    port = master_port
+    try:
+        if port is None:
+            binary = ensure_master_binary()
+            if binary is None:
+                return {"error": "dct-master build unavailable"}
+            tmp = tempfile.mkdtemp(prefix="dct-loadgen-")
+            port = _free_port()
+            proc = subprocess.Popen(
+                [binary, "--port", str(port), "--data-dir",
+                 os.path.join(tmp, "data"), "--db", "sqlite"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            if not _wait_up(port):
+                return {"error": "spawned master did not come up"}
+        elif not _wait_up(port, 5.0):
+            return {"error": f"no master on port {port}"}
+
+        base = _sched(port)
+        base_c = _counters(base)
+
+        for i in range(agents):
+            _req(port, "POST", "/api/v1/agents/register",
+                 {"id": f"loadgen-agent-{i}", "slots": slots_per_agent,
+                  "topology": f"fake-{slots_per_agent}",
+                  "address": "127.0.0.1:0", "resource_pool": "default"})
+
+        stop = threading.Event()
+        sims = [_AgentSim(port, f"loadgen-agent-{i}", stop)
+                for i in range(agents)]
+        for s in sims:
+            s.start()
+
+        exp = _req(port, "POST", "/api/v1/experiments", {"config": {
+            "name": "loadgen", "entrypoint": "noop:Noop",
+            "searcher": {"name": "custom", "metric": "loss"},
+            "resources": {"slots_per_trial": 1},
+            "hyperparameters": {},
+        }})
+        exp_id = (exp.get("experiment") or exp)["id"]
+
+        # -- submission phase: mint trials through the searcher ops route --
+        t_sub0 = time.monotonic()
+        submitted = 0
+        rid = 0
+        while submitted < trials:
+            if time.monotonic() - t_total0 > budget_s:
+                break
+            n = min(OPS_PER_BATCH, trials - submitted)
+            ops = []
+            for _ in range(n):
+                ops.append({"type": "create", "request_id": rid,
+                            "hparams": {}})
+                ops.append({"type": "validate_after", "request_id": rid,
+                            "units": 1})
+                rid += 1
+            _req(port, "POST",
+                 f"/api/v1/experiments/{exp_id}/searcher/operations",
+                 {"ops": ops}, timeout=60)
+            submitted += n
+        submit_wall = max(time.monotonic() - t_sub0, 1e-9)
+
+        # -- drain phase: poll the scheduler summary until done/budget ----
+        peak_queue = 0
+        done = 0
+        incomplete = False
+        while True:
+            s = _sched(port)
+            gauges = s.get("gauges") or {}
+            peak_queue = max(peak_queue, int(gauges.get("queue_depth") or 0))
+            done = int(_counters(s).get("completed", 0)
+                       - base_c.get("completed", 0))
+            if done >= submitted:
+                break
+            if time.monotonic() - t_total0 > budget_s:
+                incomplete = True
+                break
+            time.sleep(0.25)
+        stop.set()
+        for s_ in sims:
+            s_.join(timeout=5)
+
+        final = _sched(port)
+        wall = max(time.monotonic() - t_total0, 1e-9)
+        fc, lat = _counters(final), final.get("latency") or {}
+
+        def delta(name: str) -> int:
+            return int(fc.get(name, 0) - base_c.get(name, 0))
+
+        s2r = lat.get("submit_to_running_seconds") or {}
+        return {
+            "trials": trials,
+            "submitted": delta("submitted"),
+            "completed": done,
+            "agents": agents,
+            "slots": agents * slots_per_agent,
+            "duration_s": round(wall, 3),
+            "submit_wall_s": round(submit_wall, 3),
+            "submits_per_sec": round(submitted / submit_wall, 2),
+            "decisions": delta("decisions"),
+            "decisions_per_sec": round(delta("decisions") / wall, 2),
+            "considered": delta("considered"),
+            "scheduled": delta("scheduled"),
+            "reschedules": delta("reschedules"),
+            "preemptions": delta("preemptions"),
+            "peak_queue_depth": peak_queue,
+            "submit_to_running_s": {
+                "p50": s2r.get("p50"), "p95": s2r.get("p95"),
+                "p99": s2r.get("p99"), "count": s2r.get("count"),
+            },
+            "queue_wait_s": {
+                k: (lat.get("queue_wait_seconds") or {}).get(k)
+                for k in ("p50", "p95", "p99", "count")
+            },
+            "decision_s": {
+                k: (lat.get("decision_seconds") or {}).get(k)
+                for k in ("p50", "p95", "p99", "count")
+            },
+            "agent_errors": sum(s_.errors for s_ in sims),
+            "incomplete": incomplete,
+        }
+    except (OSError, ValueError, KeyError) as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+    finally:
+        if proc is not None and not keep_master:
+            proc.kill()
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+        if tmp is not None and not keep_master:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--trials", type=int, default=1000)
+    parser.add_argument("--agents", type=int, default=8)
+    parser.add_argument("--slots", type=int, default=8,
+                        help="slots per simulated agent")
+    parser.add_argument("--budget", type=float, default=180.0,
+                        help="total wall-clock budget in seconds")
+    parser.add_argument("--master", default=None,
+                        help="PORT of a live master (default: spawn one)")
+    args = parser.parse_args(argv)
+    result = run_load(trials=args.trials, agents=args.agents,
+                      slots_per_agent=args.slots, budget_s=args.budget,
+                      master_port=int(args.master) if args.master else None)
+    print(json.dumps(result, indent=2))
+    return 1 if result.get("error") else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
