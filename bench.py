@@ -167,6 +167,7 @@ def _run_child() -> None:
 
     from determined_clone_tpu.models import gpt, mnist_cnn
     from determined_clone_tpu.training.train_step import (
+        capture_compile,
         create_train_state,
         make_train_step,
     )
@@ -190,7 +191,9 @@ def _run_child() -> None:
         sys.exit(3)
 
     def time_gpt(cfg: gpt.GPTConfig, batch: int, seq: int,
-                 timed_steps: int) -> dict:
+                 timed_steps: int, repeats: int = 1) -> dict:
+        from determined_clone_tpu.telemetry.device import device_memory_stats
+
         params = gpt.init(jax.random.PRNGKey(0), cfg)
         tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
         state = create_train_state(params, tx, jax.random.PRNGKey(1))
@@ -200,24 +203,44 @@ def _run_child() -> None:
         def loss(p, b, rng):
             return gpt.loss_fn(p, cfg, b[:, :-1], b[:, 1:]), {}
 
+        # explicit lower()/compile() capture (telemetry/xla.py): compile
+        # wall time, HLO fingerprint, and cost_analysis FLOPs land in the
+        # BENCH json's `xla` section; the measured AOT executable is the
+        # one timed below
         step = make_train_step(loss, tx)
-        for _ in range(2):  # compile + one executed step
+        step, compile_rec = capture_compile(step, (state, tokens))
+        for _ in range(2):  # two warm executed steps (compile was above)
             state, metrics = step(state, tokens)
         float(metrics["loss"])  # value fetch: a REAL barrier (the axon
         # tunnel's block_until_ready returns before execution completes,
         # which once inflated throughput ~900x)
-        t0 = time.perf_counter()
-        for _ in range(timed_steps):
-            state, metrics = step(state, tokens)
-        final_loss = float(metrics["loss"])  # fetch = barrier
-        dt = time.perf_counter() - t0
+        # median-of-repeats: a single short timing window on a shared CPU
+        # host swings +/-10% run to run (the r03->r04 "regression" band —
+        # ROADMAP item 5); the median of several windows is stable
+        durations = []
+        for _ in range(max(1, repeats)):
+            t0 = time.perf_counter()
+            for _ in range(timed_steps):
+                state, metrics = step(state, tokens)
+            final_loss = float(metrics["loss"])  # fetch = barrier
+            durations.append(time.perf_counter() - t0)
+        durations.sort()
+        dt = durations[len(durations) // 2]
+        mem = device_memory_stats()
         return {
             "samples_per_sec": batch * timed_steps / dt,
             "tokens_per_sec": batch * seq * timed_steps / dt,
+            "timing_spread": (round(durations[-1] / durations[0], 3)
+                              if len(durations) > 1 else None),
             "final_loss": round(final_loss, 4),
             "model_params": gpt.param_count(params),
             "batch": batch,
             "seq_len": seq,
+            "compile": compile_rec.as_dict() if compile_rec else None,
+            "peak_memory_bytes": (
+                mem.get("device_peak_bytes_in_use")
+                or mem.get("device_bytes_in_use")),
+            "memory_device_count": mem.get("device_count"),
         }
 
     def time_pipeline(cfg: gpt.GPTConfig, batch: int, seq: int,
@@ -400,10 +423,13 @@ def _run_child() -> None:
              "seq": 1024, "batch": 8, "steps": 10, "min_s": 60.0},
         ]
     else:
+        # steps/repeats sized so the timed window is long enough to beat
+        # scheduler noise: the old 2-step single window swung the CPU
+        # throughput +/-10% run to run (the r03->r04 band, ROADMAP item 5)
         ladder = [
             {"name": "gpt-tiny-cpu", "layers": 2, "d": 128, "heads": 4,
-             "seq": 128, "batch": 4, "steps": 2, "min_s": 0.0,
-             "vocab": 512},
+             "seq": 128, "batch": 4, "steps": 4, "repeats": 3,
+             "min_s": 0.0, "vocab": 512},
         ]
 
     tpu_gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
@@ -424,7 +450,8 @@ def _run_child() -> None:
         cfg_flash = gpt_cfg(rung["layers"], rung["d"], rung["heads"],
                             rung["seq"], "flash", vocab=vocab,
                             remat=on_tpu)
-        flash = time_gpt(cfg_flash, rung["batch"], rung["seq"], rung["steps"])
+        flash = time_gpt(cfg_flash, rung["batch"], rung["seq"],
+                         rung["steps"], repeats=rung.get("repeats", 1))
 
         n_params = flash["model_params"]
         # Analytic FLOPs (attention + MLP + embeddings, telemetry/flops.py)
@@ -446,6 +473,32 @@ def _run_child() -> None:
         # this config, the uniform-entropy catastrophe bound otherwise.
         loss_ok = loss_ok_for(rung["name"], flash["final_loss"], vocab)
 
+        # XLA-level section: what the COMPILED program cost (cost_analysis
+        # FLOPs -> measured MFU, vs the analytic `mfu` above), what the
+        # compile itself cost (ROADMAP item 4 needs this to prove
+        # compile_time_saved), and the per-program fingerprint that lets
+        # future rounds prove the program did/didn't change (item 5).
+        comp = flash.get("compile") or {}
+        measured_flops = comp.get("flops")
+        measured_fps = (measured_flops * flash["samples_per_sec"]
+                        / max(1, flash["batch"])
+                        if measured_flops else None)
+        xla_section = {
+            "compile_time_s": (
+                round(comp["lower_seconds"] + comp["compile_seconds"], 4)
+                if comp else None),
+            "fingerprint": (comp.get("fingerprint") or "")[:16] or None,
+            "program_flops": measured_flops,
+            "program_bytes_accessed": comp.get("bytes_accessed"),
+            "measured_flops_per_sec": (round(measured_fps, 1)
+                                       if measured_fps else None),
+            "measured_mfu": (round(measured_fps / mfu_peak, 6)
+                             if measured_fps else None),
+            "peak_memory_bytes": flash.get("peak_memory_bytes"),
+            "memory_device_count": flash.get("memory_device_count"),
+            "timing_spread": flash.get("timing_spread"),
+        }
+
         def result_line() -> dict:
             return {
                 "metric": "gpt_train_throughput",
@@ -465,6 +518,7 @@ def _run_child() -> None:
                     "tokens_per_sec": round(flash["tokens_per_sec"], 1),
                     "mfu": round(mfu, 6),
                     "mfu_peak_assumed": mfu_peak_label,
+                    "xla": xla_section,
                     "flops_per_sec": round(flops_per_sec, 1),
                     "flops_per_step": round(step_flops.total, 1),
                     "final_loss": flash["final_loss"],

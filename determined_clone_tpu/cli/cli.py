@@ -522,6 +522,50 @@ def cmd_trace_export(args) -> int:
     return 0
 
 
+def cmd_debug_flight(args) -> int:
+    """Post-mortem dump of a flight-recorder ring (docs/observability.md):
+    merge the surviving segments — including the ones a kill -9 left
+    behind — into a validated Chrome trace plus a one-screen summary of
+    what the process was doing when it died."""
+    from determined_clone_tpu.telemetry.chrome_trace import (
+        validate_chrome_trace,
+    )
+    from determined_clone_tpu.telemetry.flight import (
+        flight_summary,
+        flight_to_chrome_trace,
+    )
+
+    summary = flight_summary(args.directory)
+    if not summary["segments"]:
+        print(f"no flight segments found under {args.directory}",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(summary, indent=2, default=str))
+    else:
+        print(f"flight ring: {summary['segments']} segments, "
+              f"{summary['spans']} spans, "
+              f"{summary['metric_snapshots']} metric snapshots")
+        if summary["processes"]:
+            print(f"processes: {', '.join(summary['processes'])}")
+        if summary["last_batches_trained"] is not None:
+            print(f"last recorded batches_trained: "
+                  f"{summary['last_batches_trained']}")
+        for name, n in sorted(summary["span_names"].items(),
+                              key=lambda kv: -kv[1]):
+            print(f"  {name}: {n}")
+    trace = flight_to_chrome_trace(args.directory)
+    problems = validate_chrome_trace(trace)
+    if problems:  # only malformed records on disk can cause this
+        print("warning: trace has structural problems:\n  " +
+              "\n  ".join(problems), file=sys.stderr)
+    with open(args.output, "w") as f:
+        json.dump(trace, f)
+    print(f"wrote {len(trace.get('traceEvents', []))} trace events to "
+          f"{args.output} (load at ui.perfetto.dev or chrome://tracing)")
+    return 0
+
+
 def cmd_metrics(args) -> int:
     """Cluster-wide metrics view (`GET /metrics` + the master's summary
     endpoint): top trials by throughput, cluster quantiles, restart/
@@ -1210,6 +1254,20 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--limit", type=int, default=100000,
                    help="max profiler samples to pull from the master")
     c.set_defaults(func=cmd_trace_export)
+
+    # debug (post-mortem tooling — docs/observability.md)
+    p_dbg = sub.add_parser("debug", help="post-mortem debugging tools")
+    sdbg = p_dbg.add_subparsers(dest="subcommand", required=True)
+    c = sdbg.add_parser("flight",
+                        help="dump a flight-recorder ring (crash black "
+                             "box) into a Chrome trace + summary")
+    c.add_argument("directory",
+                   help="the flight dir (observability.flight_dir / "
+                        "DCT_FLIGHT_DIR) of the dead process")
+    c.add_argument("-o", "--output", default="flight-trace.json")
+    c.add_argument("--json", action="store_true",
+                   help="print the summary as JSON")
+    c.set_defaults(func=cmd_debug_flight)
 
     # metrics (cluster-wide observability plane — docs/observability.md)
     c = sub.add_parser("metrics",

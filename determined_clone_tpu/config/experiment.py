@@ -333,6 +333,14 @@ class ObservabilityConfig:
     ship_spans: bool = False       # ship span records over profiler channel
     ship_metrics: bool = True      # ship registry snapshots over it
     trace_path: Optional[str] = None  # trace.json destination; None = default
+    # flight recorder (crash black box — telemetry/flight.py); None = off
+    flight_dir: Optional[str] = None
+    flight_segment_events: int = 256  # records per segment file
+    flight_segments: int = 8          # ring size (oldest deleted)
+    # step-time anomaly detector (rolling median/MAD over train_dispatch)
+    anomaly_window: int = 64       # rolling baseline length
+    anomaly_threshold: float = 5.0  # MAD multiples above median to fire
+    anomaly_min_samples: int = 16  # warmup before the detector arms
 
     @staticmethod
     def from_dict(raw: Dict[str, Any]) -> "ObservabilityConfig":
@@ -344,6 +352,12 @@ class ObservabilityConfig:
             ship_spans=bool(raw.get("ship_spans", False)),
             ship_metrics=bool(raw.get("ship_metrics", True)),
             trace_path=raw.get("trace_path"),
+            flight_dir=raw.get("flight_dir"),
+            flight_segment_events=int(raw.get("flight_segment_events", 256)),
+            flight_segments=int(raw.get("flight_segments", 8)),
+            anomaly_window=int(raw.get("anomaly_window", 64)),
+            anomaly_threshold=float(raw.get("anomaly_threshold", 5.0)),
+            anomaly_min_samples=int(raw.get("anomaly_min_samples", 16)),
         )
         cfg.validate()
         return cfg
@@ -353,6 +367,26 @@ class ObservabilityConfig:
             raise ConfigError(
                 f"observability.max_events must be >= 1, "
                 f"got {self.max_events}")
+        if self.flight_segment_events < 1:
+            raise ConfigError(
+                f"observability.flight_segment_events must be >= 1, "
+                f"got {self.flight_segment_events}")
+        if self.flight_segments < 2:
+            raise ConfigError(
+                f"observability.flight_segments must be >= 2, "
+                f"got {self.flight_segments}")
+        if self.anomaly_window < 4:
+            raise ConfigError(
+                f"observability.anomaly_window must be >= 4, "
+                f"got {self.anomaly_window}")
+        if self.anomaly_threshold <= 0:
+            raise ConfigError(
+                f"observability.anomaly_threshold must be > 0, "
+                f"got {self.anomaly_threshold}")
+        if self.anomaly_min_samples < 2:
+            raise ConfigError(
+                f"observability.anomaly_min_samples must be >= 2, "
+                f"got {self.anomaly_min_samples}")
 
     def to_dict(self) -> Dict[str, Any]:
         return {k: v for k, v in dataclasses.asdict(self).items()

@@ -219,6 +219,12 @@ EXPERIMENT_SCHEMA = {
                 "ship_spans": {"type": "boolean"},
                 "ship_metrics": {"type": "boolean"},
                 "trace_path": {"type": "string"},
+                "flight_dir": {"type": "string"},
+                "flight_segment_events": {"type": "integer"},
+                "flight_segments": {"type": "integer"},
+                "anomaly_window": {"type": "integer"},
+                "anomaly_threshold": {"type": "number"},
+                "anomaly_min_samples": {"type": "integer"},
             },
         },
         # deterministic fault injection (seeded FaultPlan;

@@ -168,6 +168,11 @@ def init(
             if ctx.telemetry is not None and ctx.telemetry.trace_path:
                 ctx.telemetry.export_chrome_trace()
         finally:
+            if ctx.telemetry is not None:
+                # flush+fsync the live flight segment on clean shutdown
+                # (a crash skips this — the recorder's line-buffered
+                # writes are already on disk, which is its whole point)
+                ctx.telemetry.close()
             if fault_plan is not None:
                 faults_mod.deactivate(fault_plan)
             retry_util.set_registry(None)

@@ -64,15 +64,18 @@ def _read_net_bytes() -> Dict[str, int]:
 
 
 def _device_memory_stats() -> Dict[str, float]:
-    """TPU/accelerator memory via JAX (the pynvml analogue on TPU)."""
-    try:
-        import jax
+    """Accelerator memory via JAX (the pynvml analogue on TPU).
 
-        stats = jax.devices()[0].memory_stats() or {}
-        return {
-            "device_bytes_in_use": float(stats.get("bytes_in_use", 0)),
-            "device_bytes_limit": float(stats.get("bytes_limit", 0)),
-        }
+    Delegates to :mod:`determined_clone_tpu.telemetry.device`: sums across
+    ALL local devices (the old sample read ``jax.devices()[0]`` only — an
+    8x under-report on a multi-chip host that also hid per-device skew)
+    and falls back to process RSS on CPU. Every call raises the process
+    peak watermark the trainer publishes per chunk.
+    """
+    try:
+        from determined_clone_tpu.telemetry.device import device_memory_stats
+
+        return device_memory_stats()
     except Exception:
         return {}
 

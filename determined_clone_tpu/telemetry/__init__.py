@@ -32,6 +32,12 @@ from determined_clone_tpu.telemetry.chrome_trace import (
     validate_chrome_trace,
     write_chrome_trace,
 )
+from determined_clone_tpu.telemetry.flight import (
+    FlightRecorder,
+    flight_summary,
+    flight_to_chrome_trace,
+    read_flight,
+)
 from determined_clone_tpu.telemetry.metrics import (
     Counter,
     Gauge,
@@ -47,10 +53,11 @@ from determined_clone_tpu.telemetry.spans import (
 )
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "Counter", "FlightRecorder", "Gauge", "Histogram", "MetricsRegistry",
     "NULL_SPAN", "Span", "Telemetry", "Tracer",
-    "chrome_trace_events", "null_span", "parse_prometheus_text",
-    "spans_from_profiler_samples",
+    "chrome_trace_events", "flight_summary", "flight_to_chrome_trace",
+    "null_span", "parse_prometheus_text",
+    "read_flight", "spans_from_profiler_samples",
     "stitch_chrome_trace", "telemetry_from_config", "to_chrome_trace",
     "validate_chrome_trace", "write_chrome_trace",
 ]
@@ -105,6 +112,12 @@ class Telemetry:
                              trace_id=trace_id, process_name=process_name)
         self.registry = MetricsRegistry()
         self._ship_cursor = 0
+        # crash black box (attach_flight) + anomaly-detector tuning the
+        # trainer reads off this facade; both set by telemetry_from_config
+        self.flight: Optional[FlightRecorder] = None
+        self.anomaly_window = 64
+        self.anomaly_threshold = 5.0
+        self.anomaly_min_samples = 16
 
     @property
     def trace_id(self) -> Optional[str]:
@@ -125,11 +138,33 @@ class Telemetry:
             self.tracer.trace_id = trace_id
         if process_name is not None:
             self.tracer.process_name = process_name
+        if self.flight is not None:
+            self.flight.set_identity(trace_id=self.tracer.trace_id,
+                                     process=self.tracer.process_name)
+
+    def attach_flight(self, recorder: FlightRecorder) -> None:
+        """Wire the flight recorder: it becomes a tracer sink (every
+        finished span hits disk) and inherits this trial's identity so
+        ``dct debug flight`` can stitch the ring into the same trace as
+        the master-shipped spans."""
+        self.flight = recorder
+        recorder.set_identity(
+            wall_epoch=self.tracer.wall_epoch,
+            trace_id=self.tracer.trace_id,
+            process=self.tracer.process_name,
+            pid=os.getpid())
+        self.tracer.add_sink(recorder.record_span)
+
+    def close(self) -> None:
+        """Flush durable state (flight segment) on clean shutdown."""
+        if self.flight is not None:
+            self.flight.close()
 
     # -- instrumentation hooks ---------------------------------------------
 
     def wrap_jit(self, name: str, fn: Callable[..., Any], *,
                  sync: Optional[Callable[[Any], Any]] = None,
+                 observe: Optional[Callable[[float], None]] = None,
                  ) -> Callable[..., Any]:
         """Wrap a jitted callable: every call is a ``name`` span feeding a
         ``{name}_seconds`` histogram, and XLA compiles are detected and
@@ -146,6 +181,10 @@ class Telemetry:
         overhead, not device compute. This is the tracing observer effect
         (docs/observability.md) — dispatch pipelining is traded for
         attributable timings while telemetry is on.
+
+        ``observe`` receives each steady-state duration (seconds) —
+        compile calls are excluded, so an anomaly detector's baseline is
+        not poisoned by the one legitimate 1000x outlier.
         """
         if not self.enabled:
             return fn
@@ -175,6 +214,8 @@ class Telemetry:
                 sp.set(compiled=True)
                 compiles.inc()
                 tracer.record_span("xla_compile", t0, dt, program=name)
+            elif observe is not None:
+                observe(dt)
             return out
 
         wrapped.__name__ = f"traced_{name}"
@@ -201,7 +242,14 @@ class Telemetry:
         ``telemetry``) and, when ``ship_spans``, the span records finished
         since the last publish (group ``span``). Called at the trainer's
         chunk boundary, so shipping is batched and off the hot path."""
-        if not self.enabled or profiler is None:
+        if not self.enabled:
+            return
+        if self.flight is not None:
+            # the black box gets a snapshot even when no profiler channel
+            # is wired (bench runs, unit tests, stripped-down subprocesses)
+            self.flight.record_metrics(self.registry.snapshot(),
+                                       batches_trained=batches_trained)
+        if profiler is None:
             return
         now = time.time()
         if self.ship_metrics:
@@ -262,13 +310,19 @@ def telemetry_from_config(config: Any) -> Optional[Telemetry]:
     enabled = bool(obs is not None and obs.enabled)
     if os.environ.get("DCT_OBSERVABILITY") == "1":
         enabled = True
+    # the flight recorder needs the tracer, so a flight dir (config or the
+    # DCT_FLIGHT_DIR escape hatch the chaos harness uses) implies enabled
+    flight_dir = os.environ.get("DCT_FLIGHT_DIR") or (
+        obs.flight_dir if obs is not None else None)
+    if flight_dir:
+        enabled = True
     if not enabled:
         return None
     if obs is None:
         from determined_clone_tpu.config.experiment import ObservabilityConfig
 
         obs = ObservabilityConfig()
-    return Telemetry(
+    tel = Telemetry(
         enabled=True,
         max_events=obs.max_events,
         ship_spans=obs.ship_spans,
@@ -279,3 +333,13 @@ def telemetry_from_config(config: Any) -> Optional[Telemetry]:
         # every component of one experiment shares one trace
         trace_id=os.environ.get("DCT_TRACE_ID") or None,
     )
+    tel.anomaly_window = obs.anomaly_window
+    tel.anomaly_threshold = obs.anomaly_threshold
+    tel.anomaly_min_samples = obs.anomaly_min_samples
+    if flight_dir:
+        tel.attach_flight(FlightRecorder(
+            flight_dir,
+            segment_events=obs.flight_segment_events,
+            max_segments=obs.flight_segments,
+            registry=tel.registry))
+    return tel

@@ -29,6 +29,7 @@ from __future__ import annotations
 import collections
 import json
 import logging
+import statistics
 import threading
 import time
 from typing import Any, Deque, Dict, List, Optional, Tuple
@@ -436,13 +437,36 @@ class ClusterMetricsAggregator:
                            or name.startswith("dct_master_sched_")
                            or "restart" in name or "fallback" in name
                            or "dropped" in name or "failures" in name
-                           or "compiles" in name)
+                           or "compiles" in name or "anomalies" in name
+                           or "divergence" in name)
             if interesting:
                 counters[name] = sum(float(s.get("value", 0))
                                      for _, s in fam["children"])
+        # cross-trial straggler view: per-trial train_dispatch p50 — the
+        # slowest host vs the cluster median. A mild skew is topology; a
+        # big one plus step_time_anomalies_total on the same trial is a
+        # straggler to act on (drain, reschedule).
+        straggler: Optional[Dict[str, Any]] = None
+        dispatch_p50: Dict[str, float] = {}
+        for labels, s in fams.get("train_dispatch_seconds",
+                                  {}).get("children", []):
+            tid = labels.get("trial_id")
+            if tid is not None and int(s.get("count", 0)) and "p50" in s:
+                dispatch_p50[tid] = float(s["p50"])
+        if dispatch_p50:
+            med = statistics.median(dispatch_p50.values())
+            slowest_tid = max(dispatch_p50, key=dispatch_p50.get)
+            slowest = dispatch_p50[slowest_tid]
+            straggler = {
+                "slowest_trial": slowest_tid,
+                "slowest_p50_s": slowest,
+                "median_p50_s": med,
+                "slowdown_ratio": (slowest / med) if med > 0 else 0.0,
+            }
         with self._lock:
             n_trials = len(self._trials)
             mfu = gauge_per_trial("mfu")
+            mfu_measured = gauge_per_trial("mfu_measured")
         ingest = {
             "batches": self._batches.value,
             "samples": self._samples.value,
@@ -456,6 +480,8 @@ class ClusterMetricsAggregator:
             "top_trials_by_throughput": top,
             "throughput_total": sum(throughput.values()),
             "mfu_by_trial": mfu,
+            "mfu_measured_by_trial": mfu_measured,
+            "straggler": straggler,
             "quantiles": quantiles,
             "counters": dict(sorted(counters.items())),
             "ingest": ingest,
@@ -473,7 +499,17 @@ def format_summary(summary: Dict[str, Any]) -> str:
         for tid, sps in summary["top_trials_by_throughput"]:
             mfu = summary["mfu_by_trial"].get(tid)
             mfu_s = f"  mfu={mfu:.4f}" if mfu is not None else ""
+            mmfu = summary.get("mfu_measured_by_trial", {}).get(tid)
+            if mmfu is not None:
+                mfu_s += f"  mfu_measured={mmfu:.4f}"
             out.append(f"  trial {tid}: {sps:.2f} samples/sec{mfu_s}")
+    straggler = summary.get("straggler")
+    if straggler:
+        out.append(
+            f"straggler: trial {straggler['slowest_trial']} "
+            f"p50={straggler['slowest_p50_s']:.6f}s vs cluster median "
+            f"{straggler['median_p50_s']:.6f}s "
+            f"({straggler['slowdown_ratio']:.2f}x)")
     if summary["quantiles"]:
         out.append("latency quantiles (cluster, count-weighted):")
         for name, qs in sorted(summary["quantiles"].items()):

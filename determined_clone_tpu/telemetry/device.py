@@ -1,0 +1,183 @@
+"""Per-device memory telemetry: gauges for every local device + watermarks.
+
+The profiler's original device sample read ``jax.devices()[0]`` only — on
+an 8-chip host that under-reports HBM pressure by 8x and hides the skewed
+case entirely (one device OOM-adjacent while device 0 idles, the classic
+unbalanced-sharding symptom the ROADMAP's sharding work needs to see).
+This module samples **all local devices**:
+
+- TPU/GPU expose ``Device.memory_stats()`` (``bytes_in_use`` /
+  ``bytes_limit`` / ``peak_bytes_in_use``) — each device becomes a labeled
+  gauge child and the flat sum keeps the profiler's historical keys alive.
+- CPU returns ``memory_stats() is None``; the fallback is the process RSS
+  from ``/proc/self/status`` (host memory IS device memory on CPU), so the
+  plumbing — and every test on the CPU mesh — exercises the same code
+  path that runs on real accelerators.
+
+:class:`DeviceMemoryMonitor` adds the per-chunk **peak watermark**: the
+trainer samples at chunk boundaries, the profiler's sampler thread every
+second; the watermark keeps the max seen since the last ``take_peak()``
+so a between-boundary spike (optimizer update + donation overlap) is not
+averaged away.
+
+Import-light: jax is imported lazily inside the samplers, so this module
+loads in processes that never touch a device (``dct debug flight``).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+# process-wide peak watermark over summed bytes_in_use: EVERY snapshot
+# (trainer chunk boundary, profiler 1 Hz sampler thread) raises it, so the
+# trainer's per-chunk take sees spikes that happened between boundaries
+_WATERMARK_LOCK = threading.Lock()
+_WATERMARK = 0.0
+
+
+def _raise_watermark(total: float) -> None:
+    global _WATERMARK
+    with _WATERMARK_LOCK:
+        if total > _WATERMARK:
+            _WATERMARK = total
+
+
+def take_peak_bytes() -> float:
+    """Process-wide peak of summed device bytes_in_use since the last
+    take; resets. One taker (the trainer) at a time is the contract."""
+    global _WATERMARK
+    with _WATERMARK_LOCK:
+        peak, _WATERMARK = _WATERMARK, 0.0
+    return peak
+
+
+def host_rss_bytes() -> Optional[int]:
+    """Resident set size of this process, or None off-Linux."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024  # kB -> bytes
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
+def device_memory_snapshot() -> List[Dict[str, Any]]:
+    """One record per local device.
+
+    Each record: ``{"device": "cpu:0", "platform", "bytes_in_use",
+    "bytes_limit", "peak_bytes_in_use", "source"}``. ``source`` is
+    ``"memory_stats"`` on backends that report real per-device stats and
+    ``"rss"`` for the CPU fallback (where the *process* RSS is attributed
+    to device 0 once, not multiplied across the virtual device count).
+    """
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        return []
+    records: List[Dict[str, Any]] = []
+    rss_attributed = False
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        rec: Dict[str, Any] = {
+            "device": f"{d.platform}:{d.id}",
+            "platform": str(d.platform),
+        }
+        if stats:
+            rec.update(
+                bytes_in_use=float(stats.get("bytes_in_use", 0)),
+                bytes_limit=float(stats.get("bytes_limit", 0)),
+                peak_bytes_in_use=float(
+                    stats.get("peak_bytes_in_use",
+                              stats.get("bytes_in_use", 0))),
+                source="memory_stats",
+            )
+            records.append(rec)
+        elif not rss_attributed:
+            # CPU (or a backend without memory introspection): host RSS
+            # stands in, attributed once — the virtual 8-device CPU mesh
+            # shares one address space
+            rss = host_rss_bytes()
+            if rss is None:
+                continue
+            rss_attributed = True
+            rec.update(bytes_in_use=float(rss), bytes_limit=0.0,
+                       peak_bytes_in_use=float(rss), source="rss")
+            records.append(rec)
+    if records:
+        _raise_watermark(sum(r["bytes_in_use"] for r in records))
+    return records
+
+
+def device_memory_stats() -> Dict[str, float]:
+    """Flat cross-device sums in the profiler's historical sample shape.
+
+    ``device_bytes_in_use`` / ``device_bytes_limit`` keep their PR-2 key
+    names but now cover **every** local device (the device-0-only bug this
+    replaces); ``device_count`` says how many contributed so a dashboard
+    can tell 8 idle chips from 1 busy one.
+    """
+    records = device_memory_snapshot()
+    if not records:
+        return {}
+    out = {
+        "device_bytes_in_use": sum(r["bytes_in_use"] for r in records),
+        "device_bytes_limit": sum(r["bytes_limit"] for r in records),
+        "device_count": float(len(records)),
+    }
+    peak = sum(r["peak_bytes_in_use"] for r in records)
+    if peak:
+        out["device_peak_bytes_in_use"] = peak
+    return out
+
+
+class DeviceMemoryMonitor:
+    """Feeds per-device gauges and keeps a resettable peak watermark.
+
+    ``sample()`` may be called from the trainer (chunk boundary) and the
+    profiler's sampler thread concurrently; the watermark update is
+    guarded. ``take_peak()`` returns the high-water mark of summed
+    ``bytes_in_use`` since the last take — the trainer publishes it as
+    ``device_memory_peak_bytes`` per chunk, so a spike between boundaries
+    still lands in the shipped snapshot.
+    """
+
+    def __init__(self, registry: Optional[Any] = None) -> None:
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._peak = 0.0
+
+    def sample(self) -> Dict[str, float]:
+        records = device_memory_snapshot()
+        total_in_use = sum(r["bytes_in_use"] for r in records)
+        with self._lock:
+            self._peak = max(self._peak, total_in_use)
+        reg = self._registry
+        if reg is not None and records:
+            for r in records:
+                labels = {"device": r["device"], "source": r["source"]}
+                reg.gauge("device_memory_bytes_in_use",
+                          "device memory in use (RSS on CPU fallback)",
+                          labels=labels).set(r["bytes_in_use"])
+                if r["bytes_limit"]:
+                    reg.gauge("device_memory_bytes_limit",
+                              "device memory capacity",
+                              labels=labels).set(r["bytes_limit"])
+        return device_memory_stats()
+
+    def take_peak(self) -> float:
+        """Max summed bytes_in_use since the previous take; resets.
+
+        Covers the process-wide watermark too, so samples taken by OTHER
+        actors (the profiler's 1 Hz thread goes through
+        ``device_memory_stats``) raise this monitor's peak between its
+        own samples."""
+        with self._lock:
+            peak, self._peak = self._peak, 0.0
+        return max(peak, take_peak_bytes())

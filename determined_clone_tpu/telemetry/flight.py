@@ -1,0 +1,273 @@
+"""Flight recorder: a bounded on-disk ring of recent spans + snapshots.
+
+PR 4's chaos tests kill -9 a trainer mid-step on purpose; until now the
+run died *dataless* — the tracer's span ring lives in process memory and
+the profiler channel only ships at chunk boundaries, so the most
+interesting steps (the ones right before the crash) were exactly the ones
+lost. The flight recorder is the black box: every finished span (and each
+published metric snapshot) is appended as a JSONL line to the current
+*segment* file, and the segment ring is bounded, so a crash leaves the
+last N steps readable on disk.
+
+Durability model, from cheapest to strongest:
+
+- every record is written through Python's buffer immediately
+  (line-buffered file): ``kill -9`` / ``os._exit`` keeps everything
+  already handed to the kernel — the page cache belongs to the OS, not
+  the process. This is the property the chaos tests rely on.
+- at segment **rotation** the closing segment is ``fsync``\\ ed, so even a
+  host power loss keeps all full segments. The live segment trades that
+  last level of durability for not paying an fsync per span.
+
+The ring: ``segment_events`` records per file, ``max_segments`` files
+(oldest deleted), filenames strictly increasing (``flight-00001.jsonl``)
+so a reader merges by name. Each segment opens with a ``meta`` line
+(wall_epoch, trace_id, process, pid) — everything
+:func:`flight_to_chrome_trace` needs to stitch segments from one or many
+processes into a valid Chrome trace for ``dct debug flight``.
+
+Failure policy: a write error (disk full, injected ``flight.write``
+fault) disables nothing and raises nothing — it increments a drop counter
+and moves on. The recorder observes training; it must never take it down.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+from determined_clone_tpu import faults
+
+SEGMENT_RE = re.compile(r"flight-(\d+)\.jsonl$")
+
+
+class FlightRecorder:
+    """Appends tracer records + metric snapshots to a segment ring."""
+
+    def __init__(self, directory: str, *,
+                 segment_events: int = 256,
+                 max_segments: int = 8,
+                 registry: Optional[Any] = None,
+                 identity: Optional[Dict[str, Any]] = None) -> None:
+        self.directory = directory
+        self.segment_events = max(1, int(segment_events))
+        self.max_segments = max(2, int(max_segments))
+        self._identity = dict(identity or {})
+        self._lock = threading.Lock()
+        self._file: Optional[Any] = None
+        self._seq = 0
+        self._events_in_segment = 0
+        self._dropped = (registry.counter(
+            "flight_records_dropped",
+            "flight-recorder records lost to write errors")
+            if registry is not None else None)
+        self._dropped_total = 0
+        os.makedirs(directory, exist_ok=True)
+        # resume after existing segments (a restart leg appends new
+        # segments rather than clobbering the previous leg's evidence)
+        existing = _segment_paths(directory)
+        if existing:
+            self._seq = max(
+                int(SEGMENT_RE.search(p).group(1)) for p in existing)
+
+    # -- identity ----------------------------------------------------------
+
+    def set_identity(self, **identity: Any) -> None:
+        """Late-bound process identity (trace_id arrives after core.init);
+        lands in the NEXT segment's meta line."""
+        self._identity.update(
+            {k: v for k, v in identity.items() if v is not None})
+
+    # -- writing -----------------------------------------------------------
+
+    def record_span(self, rec: Dict[str, Any]) -> None:
+        """Tracer sink: one finished span record."""
+        self._write({"kind": "span", **rec})
+
+    def record_metrics(self, snapshot: Dict[str, Any], *,
+                       batches_trained: Optional[int] = None) -> None:
+        """One registry snapshot (called at the publish boundary)."""
+        entry: Dict[str, Any] = {"kind": "metrics", "time": time.time(),
+                                 "snapshot": snapshot}
+        if batches_trained is not None:
+            entry["batches_trained"] = int(batches_trained)
+        self._write(entry)
+
+    def _write(self, entry: Dict[str, Any]) -> None:
+        try:
+            line = json.dumps(entry, default=str)
+        except (TypeError, ValueError):
+            self._drop(1)
+            return
+        with self._lock:
+            try:
+                faults.point("flight.write")
+                if self._file is None:
+                    self._open_segment()
+                self._file.write(line + "\n")
+                self._events_in_segment += 1
+                if self._events_in_segment >= self.segment_events:
+                    self._rotate()
+            except Exception:  # noqa: BLE001 - observer, never a dependency
+                self._drop(1)
+
+    def _open_segment(self) -> None:
+        self._seq += 1
+        path = os.path.join(self.directory, f"flight-{self._seq:05d}.jsonl")
+        # buffering=1: line-buffered, every record reaches the kernel —
+        # the kill -9 durability level (see module docstring)
+        self._file = open(path, "w", buffering=1)
+        self._events_in_segment = 0
+        meta = {"kind": "meta", "segment": self._seq,
+                "wall_epoch_write": time.time(), **self._identity}
+        self._file.write(json.dumps(meta, default=str) + "\n")
+
+    def _rotate(self) -> None:
+        """fsync + close the full segment, open the next, trim the ring."""
+        f, self._file = self._file, None
+        if f is not None:
+            f.flush()
+            os.fsync(f.fileno())
+            f.close()
+        paths = _segment_paths(self.directory)
+        while len(paths) > self.max_segments - 1:  # leave room for the next
+            try:
+                os.unlink(paths.pop(0))
+            except OSError:
+                break
+
+    def _drop(self, n: int) -> None:
+        self._dropped_total += n
+        if self._dropped is not None:
+            self._dropped.inc(n)
+
+    @property
+    def records_dropped(self) -> int:
+        return self._dropped_total
+
+    def close(self) -> None:
+        """Clean-exit flush+fsync (a crash never gets here — by design
+        it doesn't need to)."""
+        with self._lock:
+            f, self._file = self._file, None
+        if f is not None:
+            try:
+                f.flush()
+                os.fsync(f.fileno())
+                f.close()
+            except OSError:
+                self._drop(1)
+
+
+# -- reading ---------------------------------------------------------------
+
+
+def _segment_paths(directory: str) -> List[str]:
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    return [os.path.join(directory, n)
+            for n in sorted(names) if SEGMENT_RE.search(n)]
+
+
+def read_flight(directory: str) -> Iterator[Dict[str, Any]]:
+    """Yield every parseable record across segments, oldest first.
+
+    A torn final line (the crash landed mid-write) is skipped, not
+    fatal — that is the expected end state of a kill -9 run.
+    """
+    for path in _segment_paths(directory):
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn write at the crash point
+                    if isinstance(rec, dict):
+                        yield rec
+        except OSError:
+            continue
+
+
+def flight_summary(directory: str) -> Dict[str, Any]:
+    """Counts + last-snapshot digest for the CLI's one-screen readout."""
+    spans = 0
+    snapshots = 0
+    metas: List[Dict[str, Any]] = []
+    last_snapshot: Optional[Dict[str, Any]] = None
+    last_batches: Optional[int] = None
+    span_names: Dict[str, int] = {}
+    for rec in read_flight(directory):
+        kind = rec.get("kind")
+        if kind == "span":
+            spans += 1
+            name = str(rec.get("name", "?"))
+            span_names[name] = span_names.get(name, 0) + 1
+        elif kind == "metrics":
+            snapshots += 1
+            last_snapshot = rec.get("snapshot")
+            if rec.get("batches_trained") is not None:
+                last_batches = int(rec["batches_trained"])
+        elif kind == "meta":
+            metas.append(rec)
+    return {
+        "segments": len(_segment_paths(directory)),
+        "spans": spans,
+        "metric_snapshots": snapshots,
+        "span_names": span_names,
+        "last_batches_trained": last_batches,
+        "last_snapshot": last_snapshot,
+        "processes": sorted({str(m.get("process"))
+                             for m in metas if m.get("process")}),
+    }
+
+
+def flight_to_chrome_trace(directory: str) -> Dict[str, Any]:
+    """Merge a flight ring into one Chrome trace (stitched across any
+    processes that shared the directory), ready for Perfetto and
+    ``validate_chrome_trace``."""
+    from determined_clone_tpu.telemetry.chrome_trace import (
+        stitch_chrome_trace,
+        to_chrome_trace,
+    )
+
+    spans: List[Dict[str, Any]] = []
+    ident: Dict[str, Any] = {}
+    multi_process = False
+    for rec in read_flight(directory):
+        kind = rec.get("kind")
+        if kind == "meta":
+            new_ident = {k: rec[k] for k in
+                         ("wall_epoch", "trace_id", "process") if k in rec}
+            if (ident.get("process") and new_ident.get("process")
+                    and new_ident["process"] != ident["process"]):
+                multi_process = True
+            ident.update(new_ident)
+        elif kind == "span":
+            span = {k: v for k, v in rec.items() if k != "kind"}
+            for k, v in ident.items():
+                span.setdefault(k, v)
+            spans.append(span)
+    summary = flight_summary(directory)
+    other = {"source": "flight_recorder", "directory": directory,
+             "span_counts": summary["span_names"],
+             "last_batches_trained": summary["last_batches_trained"]}
+    if multi_process or any(s.get("process") for s in spans):
+        return stitch_chrome_trace(spans, other_data=other)
+    return to_chrome_trace(spans, other_data=other)
+
+
+__all__ = [
+    "FlightRecorder",
+    "flight_summary",
+    "flight_to_chrome_trace",
+    "read_flight",
+]

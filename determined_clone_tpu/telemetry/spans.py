@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 
 class _NullSpan:
@@ -118,10 +118,18 @@ class Tracer:
         self.max_events = int(max_events)
         self.dropped = 0
         self._events: List[Dict[str, Any]] = []
+        self._sinks: List[Callable[[Dict[str, Any]], None]] = []
         self._lock = threading.Lock()
         self._local = threading.local()
         self._epoch = time.perf_counter()
         self.wall_epoch = time.time()
+
+    def add_sink(self, sink: Callable[[Dict[str, Any]], None]) -> None:
+        """Register a per-record hook (e.g. the flight recorder). Sinks
+        see every finished record — including ones past ``max_events``,
+        where the in-memory ring keeps the head but a recorder wants the
+        *tail* (the steps right before a crash)."""
+        self._sinks.append(sink)
 
     # -- recording ----------------------------------------------------------
 
@@ -173,8 +181,15 @@ class Tracer:
                 # keep the head: startup + compile spans are unrepeatable,
                 # steady-state step spans are statistically redundant
                 self.dropped += 1
-                return
-            self._events.append(rec)
+            else:
+                self._events.append(rec)
+        # sinks (flight recorder) see every record, including past the
+        # in-memory cap — a black box wants the tail, not the head
+        for sink in self._sinks:
+            try:
+                sink(rec)
+            except Exception:  # noqa: BLE001 - sinks never break tracing
+                pass
 
     # -- reading ------------------------------------------------------------
 

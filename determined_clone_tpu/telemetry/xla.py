@@ -1,0 +1,369 @@
+"""XLA-level telemetry: explicit compile capture, measured MFU, anomalies.
+
+The telemetry stack so far watches the *Python* side of the hot loop —
+spans time dispatches, ``xla_compiles_total`` counts cache growth — but
+the compiled program itself stayed a black box: compile time was invisible
+(ROADMAP item 4's persistent executable cache needs it to prove
+``compile_time_saved``) and MFU was analytic-only (a formula about the
+architecture, not the program XLA actually emitted). This module opens the
+box via JAX's AOT path:
+
+- :func:`aot_compile` replaces a jitted callable's first-call implicit
+  compile with an explicit ``lower()`` / ``compile()`` whose wall time is
+  measured, whose lowered StableHLO text is fingerprinted (sha256 — the
+  keying groundwork for the content-addressed executable cache), and whose
+  ``cost_analysis()`` FLOPs/bytes become per-program metrics. The returned
+  callable runs the AOT executable (no double compile) and falls back to
+  the original jit wrapper on argument-shape mismatch.
+- :class:`MfuComparator` turns the compiled program's *measured* FLOPs
+  into a second MFU gauge next to PR 6's analytic one, and warns —
+  rate-limited — when the two diverge more than 20%: either the analytic
+  formula drifted from the model, or XLA emitted something unexpected.
+- :class:`StepTimeAnomalyDetector` — a rolling median/MAD detector over
+  dispatch durations. MAD (median absolute deviation) is robust to the
+  very outliers it hunts: a straggler step moves a mean-based z-score's
+  own baseline, but barely moves the median. Anomalies increment
+  ``step_time_anomalies_total`` and are kept as bounded events for the
+  flight recorder / cluster summary.
+
+Everything degrades to no-ops: a backend without AOT or cost analysis
+returns the original callable and ``None`` — telemetry must never fail
+training.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import hashlib
+import logging
+import statistics
+import time
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+# Measured-vs-analytic MFU divergence: warn past this ratio, at most once
+# per WARN_PERIOD (per comparator) so a long run can't spam the log.
+MFU_DIVERGENCE_RATIO = 1.2
+MFU_WARN_PERIOD_SEC = 300.0
+
+# 1.4826 * MAD estimates the standard deviation for normal data; the
+# detector's threshold is expressed in these robust sigmas.
+MAD_SIGMA_SCALE = 1.4826
+
+
+@dataclasses.dataclass
+class CompileRecord:
+    """What one explicit lower()/compile() observed."""
+
+    program: str
+    fingerprint: str          # sha256 hex of the lowered StableHLO text
+    lower_seconds: float
+    compile_seconds: float
+    flops: Optional[float] = None          # compiled.cost_analysis()
+    bytes_accessed: Optional[float] = None
+    # compiled.memory_analysis(): what the executable will hold live
+    argument_bytes: Optional[float] = None
+    output_bytes: Optional[float] = None
+    temp_bytes: Optional[float] = None
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if v is not None}
+
+
+def _cost_analysis(compiled: Any) -> Tuple[Optional[float], Optional[float]]:
+    """(flops, bytes_accessed) from ``compiled.cost_analysis()``.
+
+    jax returns a dict on newer versions and a one-element list of dicts
+    on older ones (0.4.x); a backend without cost modeling returns
+    None/empty — map all of it to (None, None) rather than raising.
+    """
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None, None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None, None
+    flops = ca.get("flops")
+    byts = ca.get("bytes accessed")
+    return (float(flops) if flops is not None else None,
+            float(byts) if byts is not None else None)
+
+
+def _memory_analysis(compiled: Any) -> Dict[str, float]:
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return {}
+    out = {}
+    for field, key in (("argument_size_in_bytes", "argument_bytes"),
+                       ("output_size_in_bytes", "output_bytes"),
+                       ("temp_size_in_bytes", "temp_bytes")):
+        v = getattr(ma, field, None)
+        if v is not None:
+            out[key] = float(v)
+    return out
+
+
+def fingerprint_stablehlo(text: str) -> str:
+    """sha256 of the lowered program text — the stable identity a
+    persistent executable cache would key on (with mesh + jaxlib version
+    alongside; see ROADMAP item 4)."""
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+def aot_compile(
+    fn: Callable[..., Any],
+    example_args: Tuple[Any, ...],
+    *,
+    program: str = "train_step",
+    registry: Optional[Any] = None,
+    tracer: Optional[Any] = None,
+) -> Tuple[Callable[..., Any], Optional[CompileRecord]]:
+    """Explicitly lower + compile a jitted callable, capturing telemetry.
+
+    Returns ``(callable, record)``. On success the callable runs the AOT
+    executable for matching argument shapes (so the measured compile is
+    the one that actually executes — no second implicit compile) and
+    falls back to ``fn`` on shape mismatch (e.g. a remainder batch), which
+    then compiles through the normal jit cache where ``wrap_jit`` counts
+    it as a retrace. On any AOT failure — backend without ``lower``,
+    donation quirk, cost-model gap — the original ``fn`` comes back
+    unwrapped with ``record=None``: capture is an observer, never a
+    dependency.
+
+    ``example_args`` only contribute shapes/dtypes/shardings; nothing
+    executes during lowering.
+    """
+    try:
+        t0 = time.perf_counter()
+        lowered = fn.lower(*example_args)
+        text = lowered.as_text()
+        t1 = time.perf_counter()
+        compiled = lowered.compile()
+        t2 = time.perf_counter()
+        flops, bytes_accessed = _cost_analysis(compiled)
+        record = CompileRecord(
+            program=program,
+            fingerprint=fingerprint_stablehlo(text),
+            lower_seconds=t1 - t0,
+            compile_seconds=t2 - t1,
+            flops=flops,
+            bytes_accessed=bytes_accessed,
+            **_memory_analysis(compiled),
+        )
+    except Exception as exc:  # noqa: BLE001 - capture must never fail training
+        logger.debug("aot compile capture unavailable for %s: %r",
+                     program, exc)
+        return fn, None
+
+    export_compile_record(record, registry=registry, tracer=tracer,
+                          start=t0)
+
+    def call(*args: Any, **kwargs: Any) -> Any:
+        try:
+            return compiled(*args, **kwargs)
+        except (TypeError, ValueError):
+            # argument shapes differ from the captured program (remainder
+            # batch, dtype change): the jit cache handles it — raised
+            # before any buffer is consumed, so donation state is intact
+            return fn(*args, **kwargs)
+
+    call.__name__ = f"aot_{program}"
+    probe = getattr(fn, "_cache_size", None)
+    if probe is not None:
+        call._cache_size = probe
+    call._compile_record = record
+    return call, record
+
+
+def export_compile_record(record: CompileRecord, *,
+                          registry: Optional[Any] = None,
+                          tracer: Optional[Any] = None,
+                          start: Optional[float] = None) -> None:
+    """Land one compile capture in the metric registry + span stream.
+
+    Families are keyed by ``{program, fingerprint}`` labels — two rounds
+    (or two legs) that compiled the *same* fingerprint should report the
+    same ``xla_program_flops``, and a fingerprint change between rounds is
+    itself the signal (the program changed, not just the timing).
+    """
+    if registry is not None:
+        labels = {"program": record.program,
+                  "fingerprint": record.fingerprint[:16]}
+        # the AOT capture replaces the implicit first-call compile that
+        # wrap_jit would have counted, so count it here (same family)
+        registry.counter(
+            "xla_compiles_total",
+            "jitted-program compilations observed (first calls + retraces)"
+        ).inc()
+        registry.gauge(
+            "xla_compile_seconds",
+            "explicit lower+compile wall time per program",
+            labels=labels).set(record.lower_seconds + record.compile_seconds)
+        if record.flops is not None:
+            registry.gauge(
+                "xla_program_flops",
+                "per-execution FLOPs from compiled.cost_analysis()",
+                labels=labels).set(record.flops)
+        if record.bytes_accessed is not None:
+            registry.gauge(
+                "xla_program_bytes_accessed",
+                "per-execution bytes accessed from cost_analysis()",
+                labels=labels).set(record.bytes_accessed)
+        if record.temp_bytes is not None:
+            registry.gauge(
+                "xla_program_temp_bytes",
+                "executable scratch memory from memory_analysis()",
+                labels=labels).set(record.temp_bytes)
+    if tracer is not None:
+        tracer.record_span(
+            "xla_compile",
+            start if start is not None else time.perf_counter(),
+            record.lower_seconds + record.compile_seconds,
+            program=record.program, fingerprint=record.fingerprint[:16],
+            explicit=True)
+
+
+class MfuComparator:
+    """Measured MFU (cost_analysis FLOPs) next to the analytic gauge.
+
+    The analytic number says what the *architecture* costs; the measured
+    number says what the *compiled program* costs. They legitimately
+    differ a little (rematerialization recomputes the forward pass,
+    fusion eliminates ops the formula counts), so the warn threshold is
+    20% — past that either the analytic formula no longer matches the
+    model (e.g. a new block type not in flops.py) or XLA emitted
+    something pathological. The warning is rate-limited; gauges update
+    every chunk regardless.
+    """
+
+    def __init__(self, registry: Any, *, peak_flops_total: float,
+                 warn_period_s: float = MFU_WARN_PERIOD_SEC) -> None:
+        self._registry = registry
+        self._peak = float(peak_flops_total)
+        self._warn_period = warn_period_s
+        self._last_warn = -warn_period_s  # first divergence warns
+        self._warned = 0
+
+    def report(self, *, measured_flops_per_batch: float,
+               batches_per_second: float,
+               analytic_mfu: Optional[float] = None) -> float:
+        """Update the measured gauges; compare against the analytic MFU.
+
+        Returns the measured MFU. Call at the chunk boundary (never per
+        step).
+        """
+        fps = measured_flops_per_batch * batches_per_second
+        measured = fps / self._peak if self._peak > 0 else 0.0
+        reg = self._registry
+        reg.gauge("measured_flops_per_sec",
+                  "throughput x per-program FLOPs from cost_analysis()"
+                  ).set(fps)
+        reg.gauge("mfu_measured",
+                  "MFU from the compiled program's measured FLOPs "
+                  "(vs the analytic `mfu` gauge)").set(measured)
+        if analytic_mfu and measured > 0:
+            ratio = max(measured / analytic_mfu, analytic_mfu / measured)
+            if ratio > MFU_DIVERGENCE_RATIO:
+                now = time.monotonic()
+                if now - self._last_warn >= self._warn_period:
+                    self._last_warn = now
+                    self._warned += 1
+                    logger.warning(
+                        "measured MFU %.4f vs analytic MFU %.4f diverge "
+                        "%.0f%% (>20%%): the analytic FLOPs formula and the "
+                        "compiled program disagree — check flops.py against "
+                        "the model, or a recompile changed the program",
+                        measured, analytic_mfu, (ratio - 1.0) * 100.0)
+                reg.counter(
+                    "mfu_divergence_total",
+                    "chunks where measured and analytic MFU diverged >20%"
+                ).inc()
+        return measured
+
+
+class StepTimeAnomalyDetector:
+    """Rolling median/MAD detector over dispatch durations.
+
+    A step is anomalous when it exceeds
+    ``median + threshold * max(1.4826 * MAD, rel_floor * median)`` —
+    the floor keeps a near-constant baseline (MAD ≈ 0 on an idle CPU
+    mesh) from flagging scheduler jitter as stragglers. Only the slow
+    side fires: fast steps (remainder dispatches of a fused program) are
+    not a problem worth paging about.
+
+    The window holds *pre-anomaly* history: an anomalous duration is NOT
+    fed back into the window, so one straggler can't raise the baseline
+    and mask the next one (detect-then-admit would do exactly that).
+    Warmup (``min_samples``) covers compile + cache-warm steps.
+    """
+
+    def __init__(self, registry: Optional[Any] = None, *,
+                 tracer: Optional[Any] = None,
+                 window: int = 64, threshold: float = 5.0,
+                 min_samples: int = 16, rel_floor: float = 0.05,
+                 max_events: int = 256) -> None:
+        self._registry = registry
+        self._tracer = tracer
+        self.window: Deque[float] = collections.deque(maxlen=int(window))
+        self.threshold = float(threshold)
+        self.min_samples = int(min_samples)
+        self.rel_floor = float(rel_floor)
+        self.events: Deque[Dict[str, Any]] = collections.deque(
+            maxlen=int(max_events))
+        self.anomalies = 0
+        self._seen = 0
+        self._counter = (registry.counter(
+            "step_time_anomalies_total",
+            "train dispatches flagged by the rolling median/MAD detector")
+            if registry is not None else None)
+
+    def observe(self, duration_s: float) -> bool:
+        """Feed one dispatch duration; True when flagged anomalous."""
+        duration_s = float(duration_s)
+        self._seen += 1
+        if len(self.window) < self.min_samples:
+            self.window.append(duration_s)
+            return False
+        med = statistics.median(self.window)
+        mad = statistics.median(abs(x - med) for x in self.window)
+        sigma = max(MAD_SIGMA_SCALE * mad, self.rel_floor * med)
+        limit = med + self.threshold * sigma
+        if duration_s <= limit:
+            self.window.append(duration_s)
+            return False
+        self.anomalies += 1
+        if self._counter is not None:
+            self._counter.inc()
+        event = {
+            "duration_s": round(duration_s, 6),
+            "median_s": round(med, 6),
+            "mad_s": round(mad, 6),
+            "limit_s": round(limit, 6),
+            "step_index": self._seen,
+        }
+        self.events.append(event)
+        if self._tracer is not None:
+            self._tracer.instant("step_time_anomaly", **event)
+        return True
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "anomalies": self.anomalies,
+            "window_len": len(self.window),
+            "recent_events": list(self.events)[-8:],
+        }
+
+
+__all__ = [
+    "CompileRecord",
+    "MfuComparator",
+    "StepTimeAnomalyDetector",
+    "aot_compile",
+    "export_compile_record",
+    "fingerprint_stablehlo",
+]

@@ -169,6 +169,33 @@ def make_train_step(
     return jax.jit(fn, **kwargs)
 
 
+def capture_compile(
+    step: Callable[..., Any],
+    example_args: Tuple[Any, ...],
+    *,
+    program: str = "train_step",
+    registry: Optional[Any] = None,
+    tracer: Optional[Any] = None,
+) -> Tuple[Callable[..., Any], Optional[Any]]:
+    """Explicit ``lower()``/``compile()`` capture for a built step.
+
+    Replaces the implicit first-call compile with a measured one: compile
+    wall time, a sha256 fingerprint of the lowered StableHLO, and the
+    compiled program's cost/memory analysis land in the registry/tracer
+    (telemetry/xla.py has the mechanics). The returned callable runs the
+    AOT executable — the program that was measured is the program that
+    executes — and falls back to ``step``'s jit cache on a shape mismatch
+    (remainder batches). ``example_args`` contribute shapes only; nothing
+    runs during lowering. On any failure the original ``step`` comes back
+    with a ``None`` record.
+    """
+    from determined_clone_tpu.telemetry import xla as xla_telemetry
+
+    return xla_telemetry.aot_compile(
+        step, example_args, program=program,
+        registry=registry, tracer=tracer)
+
+
 def param_count(tree: Any) -> int:
     """Total parameter count of a pytree — the N in the 6*N FLOPs
     approximation (telemetry/flops.py) when a trial provides no analytic

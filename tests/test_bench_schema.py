@@ -1,0 +1,100 @@
+"""Bench result-schema regression test: a REAL bench child run must emit
+the fields the regression gate (tools/bench_gate.py) and the BENCH history
+depend on — non-null analytic ``mfu``, its labeled denominator, and the
+XLA section (compile time, HLO fingerprint, measured MFU, peak memory)
+added by the observability issue. A schema drift here silently turns the
+gate advisory, so it is pinned by running the actual child, not a mock.
+
+The child is killed right after it banks the first rung's result line (the
+mha/mnist/pipeline extras are budget-dependent and not schema-load-bearing),
+keeping the test inside the tier-1 lane.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+@pytest.fixture(scope="module")
+def bench_result():
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    }
+    proc = subprocess.Popen(
+        [sys.executable, BENCH, "--child"], env=env, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+    result = None
+    deadline = time.monotonic() + 300
+    try:
+        for line in proc.stdout:
+            if time.monotonic() > deadline:
+                break
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            obj = json.loads(line)
+            if "metric" in obj:
+                result = obj
+                break
+    finally:
+        try:
+            proc.send_signal(signal.SIGKILL)
+        except OSError:
+            pass
+        proc.wait(timeout=30)
+    assert result is not None, "bench child banked no result line"
+    return result
+
+
+def test_headline_fields(bench_result):
+    assert bench_result["metric"] == "gpt_train_throughput"
+    assert bench_result["value"] > 0
+    assert bench_result["unit"] == "samples/sec/chip"
+
+
+def test_analytic_mfu_never_null(bench_result):
+    """The bench gate hard-fails on mfu=null; the analytic engine must
+    produce one on every platform, with the denominator labeled."""
+    detail = bench_result["detail"]
+    assert detail["mfu"] is not None and detail["mfu"] > 0
+    assert isinstance(detail["mfu_peak_assumed"], str)
+    assert ":" in detail["mfu_peak_assumed"]  # "<label>:<peak flops>"
+    assert detail["flops_per_step"] > 0
+
+
+def test_xla_section_schema(bench_result):
+    """The XLA section: every field the gate's _xla_lines reads, non-null
+    on the CPU lane (the lane that always runs)."""
+    xla = bench_result["detail"]["xla"]
+    assert xla["compile_time_s"] > 0
+    assert isinstance(xla["fingerprint"], str)
+    assert len(xla["fingerprint"]) == 16
+    assert xla["program_flops"] > 0
+    assert xla["program_bytes_accessed"] > 0
+    assert xla["measured_flops_per_sec"] > 0
+    assert 0 < xla["measured_mfu"] < 1
+    assert xla["peak_memory_bytes"] > 0
+    assert xla["memory_device_count"] >= 1
+    # median-of-repeats ran (the r03->r04 noise fix): spread recorded
+    assert xla["timing_spread"] is None or xla["timing_spread"] >= 1.0
+
+
+def test_gate_accepts_fresh_round(bench_result):
+    """The regression gate passes a round against itself and prints the
+    advisory xla line — wiring proof that gate and schema agree."""
+    from tools.bench_gate import gate
+
+    ok, report = gate(bench_result, bench_result)
+    assert ok, report
+    assert any(line.startswith("ok: xla compile=") for line in report)
+    assert not any(line.startswith("WARN: xla") for line in report)

@@ -547,6 +547,31 @@ class TestConfig:
         tel = telemetry_from_config({"observability": {"enabled": True}})
         assert tel is not None
 
+    def test_flight_and_anomaly_fields_pass_full_schema(self, tmp_path):
+        """The flight/anomaly knobs must survive the FULL ExperimentConfig
+        path — the closed `observability` block in config/schema.py, not
+        just ObservabilityConfig.from_dict — and build a wired Telemetry."""
+        from determined_clone_tpu.config.experiment import ExperimentConfig
+
+        flight_dir = str(tmp_path / "flight")
+        cfg = ExperimentConfig.from_dict({
+            "name": "t",
+            "observability": {"flight_dir": flight_dir,
+                              "flight_segment_events": 32,
+                              "flight_segments": 4,
+                              "anomaly_window": 16,
+                              "anomaly_threshold": 4.0,
+                              "anomaly_min_samples": 8},
+        })
+        assert cfg.observability.flight_dir == flight_dir
+        tel = telemetry_from_config(cfg)
+        # flight_dir implies enabled: telemetry built without enabled: true
+        assert tel is not None and tel.flight is not None
+        assert tel.flight.segment_events == 32
+        assert tel.anomaly_window == 16
+        assert tel.anomaly_min_samples == 8
+        tel.close()
+
 
 # ---------------------------------------------------------------------------
 # CLI: dct trace export --from-file
@@ -691,15 +716,36 @@ class TestTrainerSmoke:
         assert {"train_dispatch", "host_sync", "validate",
                 "checkpoint_save", "xla_compile"} <= names
 
-        # summed train_dispatch agrees with profiler compute_s within 10%
+        # span/profiler reconciliation: compute_s is (chunk wall - queue
+        # wait), so it still contains host_sync and the consumer-visible
+        # input cost beyond the queue wait (sync device_put). Before the
+        # explicit AOT capture the first-call compile (~100ms) sat in both
+        # sums and amortized those residues under 10%; now compile happens
+        # out-of-band, so reconcile the residues explicitly.
         dispatch_s = sum(e["dur_us"] for e in events
-                         if e["name"] == "train_dispatch") / 1e6
-        compute_s = sum(s["compute_s"] for s in prof.samples
-                        if s["group"] == "timing")
+                         if e["name"] in ("train_dispatch",
+                                          "host_sync")) / 1e6
+        dataload_s = sum(e["dur_us"] for e in events
+                         if e["name"] == "dataload_wait") / 1e6
+        timing = [s for s in prof.samples if s["group"] == "timing"]
+        compute_s = sum(s["compute_s"] for s in timing)
+        queue_wait_s = sum(s["queue_wait_s"] for s in timing)
         assert compute_s > 0
-        assert abs(dispatch_s - compute_s) / compute_s < 0.10, (
-            f"train_dispatch sum {dispatch_s:.4f}s vs "
-            f"compute_s {compute_s:.4f}s")
+        adjusted = compute_s - max(dataload_s - queue_wait_s, 0.0)
+        # spans can't exceed the wall they live in (2% timing jitter)
+        assert dispatch_s <= adjusted * 1.02, (
+            f"span sum {dispatch_s:.4f}s exceeds chunk compute "
+            f"{adjusted:.4f}s")
+        # what remains is per-step loop overhead outside any span (fault
+        # points, cache probes, span bookkeeping, accumulator) — budget it
+        # per step rather than as a fraction of compute, which at this toy
+        # step size (~3ms) would make the bound about Python, not tracing
+        overhead_per_step = (adjusted - dispatch_s) / 48
+        assert overhead_per_step < 1e-3, (
+            f"{overhead_per_step * 1e3:.3f}ms/step untraced overhead "
+            f"(dispatch+host_sync {dispatch_s:.4f}s, adjusted compute "
+            f"{adjusted:.4f}s, dataload {dataload_s:.4f}s, queue_wait "
+            f"{queue_wait_s:.4f}s)")
 
         # telemetry snapshots rode the profiler channel at chunk boundaries
         snaps = [s for s in prof.samples if s.get("group") == "telemetry"]

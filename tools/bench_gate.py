@@ -80,7 +80,7 @@ def newest_rounds(directory: str = ".") -> Tuple[str, str]:
 # previous round carried is a skip-with-note, never a gate failure — the
 # headline throughput/mfu checks below are the contract.
 OPTIONAL_SECTIONS = ("control_plane", "checkpoint_io", "pipeline",
-                     "mnist_cnn", "tpu_probe_telemetry")
+                     "mnist_cnn", "tpu_probe_telemetry", "xla")
 
 
 def _section_notes(old_detail: Dict[str, Any], new_detail: Dict[str, Any],
@@ -125,6 +125,42 @@ def _control_plane_lines(old_detail: Dict[str, Any],
             report.append(
                 f"WARN: control_plane submit→running p99 "
                 f"{old_p99:.3f}s → {s2r['p99']:.3f}s (>2x)")
+
+
+def _xla_lines(old_detail: Dict[str, Any],
+               new_detail: Dict[str, Any], report: list) -> None:
+    """Advisory XLA-section reporting: compile time and measured MFU land
+    in the report so drift is visible in BENCH history, with WARNs on a
+    compile-time blowup (>2x — what ROADMAP item 4's executable cache is
+    meant to erase) or a measured-MFU drop beyond the throughput
+    tolerance. Advisory-only: compile time shares the box with everything
+    else, and a fingerprint change legitimately resets both numbers."""
+    xla_new = new_detail.get("xla")
+    if not isinstance(xla_new, dict):
+        return
+    ct = xla_new.get("compile_time_s")
+    mm = xla_new.get("measured_mfu")
+    fp = xla_new.get("fingerprint")
+    report.append(
+        f"ok: xla compile={ct}s measured_mfu={mm} "
+        f"program={fp or '?'} peak_mem={xla_new.get('peak_memory_bytes')}")
+    xla_old = old_detail.get("xla")
+    if not isinstance(xla_old, dict):
+        return
+    same_program = fp and xla_old.get("fingerprint") == fp
+    old_ct = xla_old.get("compile_time_s")
+    if (isinstance(old_ct, (int, float)) and old_ct > 0
+            and isinstance(ct, (int, float)) and ct > 2.0 * old_ct):
+        note = "" if same_program else " (program fingerprint changed)"
+        report.append(
+            f"WARN: xla compile time {old_ct:.3f}s → {ct:.3f}s (>2x){note}")
+    old_mm = xla_old.get("measured_mfu")
+    if (same_program and isinstance(old_mm, (int, float)) and old_mm > 0
+            and isinstance(mm, (int, float))
+            and mm / old_mm - 1.0 < DEFAULT_TOLERANCE):
+        report.append(
+            f"WARN: measured MFU {old_mm:.6f} → {mm:.6f} on the same "
+            f"program fingerprint ({mm / old_mm - 1.0:+.1%})")
 
 
 def gate(old: Dict[str, Any], new: Dict[str, Any], *,
@@ -175,6 +211,7 @@ def gate(old: Dict[str, Any], new: Dict[str, Any], *,
             report.append(f"ok: {line}")
     _section_notes(old_detail, new_detail, report)
     _control_plane_lines(old_detail, new_detail, report)
+    _xla_lines(old_detail, new_detail, report)
     return ok, report
 
 
