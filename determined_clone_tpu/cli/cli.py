@@ -616,6 +616,82 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_goodput(args) -> int:
+    """Goodput readout (docs/observability.md): what fraction of each
+    trial's wall-clock trained the model, and where the badput went.
+    Reads the master's rollup (``GET /api/v1/cluster/goodput``), falling
+    back to the exposition text for masters without the JSON route; or
+    merges an on-disk journal directory offline (``--dir``), restart legs
+    folded into trial-lifetime accounts."""
+    from determined_clone_tpu.telemetry.goodput import (
+        format_goodput,
+        merge_goodput,
+    )
+
+    if args.dir:
+        accounts = merge_goodput(args.dir)
+        if args.experiment is not None:
+            print("note: --experiment is ignored with --dir (journals are "
+                  "keyed by trial id only)", file=sys.stderr)
+        if not accounts:
+            print(f"no goodput journals found under {args.dir}",
+                  file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(accounts, indent=2, default=str))
+        else:
+            print(format_goodput(accounts))
+        return 0
+
+    session = make_session(args)
+    try:
+        roll = session.get("/api/v1/cluster/goodput")
+    except MasterError as e:
+        if e.status != 404:
+            raise
+        # masters without the JSON route still expose the goodput_* gauge
+        # families in /metrics: fold the text back through the aggregator
+        from determined_clone_tpu.telemetry.aggregate import (
+            ClusterMetricsAggregator,
+        )
+        import urllib.request
+
+        url = f"http://{session.host}:{session.port}/metrics"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            text = resp.read().decode("utf-8")
+        agg = ClusterMetricsAggregator()
+        agg.ingest_prometheus_text("master", text)
+        roll = agg.goodput_rollup()
+    by_trial = roll.get("by_trial") or {}
+    if args.experiment is not None:
+        by_trial = {tid: acct for tid, acct in by_trial.items()
+                    if acct.get("experiment_id") == args.experiment}
+        roll = dict(roll, by_trial=by_trial)
+    if args.json:
+        print(json.dumps(roll, indent=2, default=str))
+        return 0
+    if not by_trial:
+        print("no trials reporting goodput", file=sys.stderr)
+        return 1
+    cf = roll.get("cluster_fraction")
+    cf_s = f"{cf:.1%}" if cf is not None else "n/a"
+    print(f"cluster goodput (time-weighted): {cf_s} over "
+          f"{roll.get('wall_total_s', 0.0):.1f}s wall")
+    for tid in sorted(by_trial, key=lambda t: int(t) if str(t).isdigit()
+                      else 0):
+        acct = by_trial[tid]
+        frac = acct.get("goodput_fraction")
+        frac_s = f"{frac:.1%}" if frac is not None else "n/a"
+        print(f"trial {tid}: goodput {frac_s} over "
+              f"{acct.get('wall_s', 0.0):.2f}s wall")
+        cats = acct.get("categories") or {}
+        wall = max(float(acct.get("wall_s") or 0.0), 1e-9)
+        for cat, secs in sorted(cats.items(), key=lambda kv: -kv[1]):
+            if secs > 0:
+                print(f"  {cat:<18} {secs:>9.3f}s  {secs / wall:6.1%}")
+    return 0
+
+
 def cmd_lint(args) -> int:
     """Run the dctlint static-analysis suite (docs/static_analysis.md).
     The linter lives in the repo's tools/ package (it is developer
@@ -1276,6 +1352,20 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--raw", action="store_true",
                    help="print the raw Prometheus exposition text")
     c.set_defaults(func=cmd_metrics)
+
+    # goodput (wall-clock attribution ledger — docs/observability.md)
+    c = sub.add_parser("goodput",
+                       help="goodput/badput accounting: fraction of each "
+                            "trial's wall-clock that trained the model")
+    c.add_argument("--experiment", type=int, default=None,
+                   help="only trials of this experiment")
+    c.add_argument("--dir", default=None,
+                   help="merge an on-disk goodput journal directory "
+                        "(observability.goodput_dir / DCT_GOODPUT_DIR) "
+                        "instead of asking the master")
+    c.add_argument("--json", action="store_true",
+                   help="print the accounts as JSON")
+    c.set_defaults(func=cmd_goodput)
 
     # lint (dctlint static analysis — docs/static_analysis.md)
     c = sub.add_parser("lint",

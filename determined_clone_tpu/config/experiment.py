@@ -337,6 +337,9 @@ class ObservabilityConfig:
     flight_dir: Optional[str] = None
     flight_segment_events: int = 256  # records per segment file
     flight_segments: int = 8          # ring size (oldest deleted)
+    # goodput ledger journal (telemetry/goodput.py); None = ledger in
+    # memory only (still published as goodput_* gauges)
+    goodput_dir: Optional[str] = None
     # step-time anomaly detector (rolling median/MAD over train_dispatch)
     anomaly_window: int = 64       # rolling baseline length
     anomaly_threshold: float = 5.0  # MAD multiples above median to fire
@@ -355,6 +358,7 @@ class ObservabilityConfig:
             flight_dir=raw.get("flight_dir"),
             flight_segment_events=int(raw.get("flight_segment_events", 256)),
             flight_segments=int(raw.get("flight_segments", 8)),
+            goodput_dir=raw.get("goodput_dir"),
             anomaly_window=int(raw.get("anomaly_window", 64)),
             anomaly_threshold=float(raw.get("anomaly_threshold", 5.0)),
             anomaly_min_samples=int(raw.get("anomaly_min_samples", 16)),

@@ -222,6 +222,7 @@ EXPERIMENT_SCHEMA = {
                 "flight_dir": {"type": "string"},
                 "flight_segment_events": {"type": "integer"},
                 "flight_segments": {"type": "integer"},
+                "goodput_dir": {"type": "string"},
                 "anomaly_window": {"type": "integer"},
                 "anomaly_threshold": {"type": "number"},
                 "anomaly_min_samples": {"type": "integer"},

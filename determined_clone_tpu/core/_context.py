@@ -94,6 +94,11 @@ def init(
 
     telemetry = telemetry_from_config(config)
     registry_arg = telemetry.registry if telemetry is not None else None
+    if (telemetry is not None and telemetry.goodput is not None
+            and trial_id is not None):
+        # the goodput journal file is named by trial id, so identity must
+        # land before the ledger's first durable write (first publish)
+        telemetry.goodput.set_identity(trial_id=trial_id)
 
     # fault plan: a config `faults:` block wins; otherwise DCT_FAULT_PLAN.
     # Config plans are cached by payload so counters survive restart legs;

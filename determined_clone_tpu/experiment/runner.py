@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Type
 
@@ -315,8 +316,16 @@ class LocalExperimentRunner:
                     # the restart count.
                     self._restarts_total.inc()
                     self._snapshot()
+                    t0 = time.perf_counter()
                     retry_util.sleep_backoff(self.restart_backoff,
                                              rec.restarts)
+                    if (self.telemetry is not None
+                            and self.telemetry.goodput is not None):
+                        # the backoff sleep is restart badput in the
+                        # runner's own wall-clock account (the trial-side
+                        # inter-leg gap is booked by the journal merge)
+                        self.telemetry.goodput.note(
+                            "restart_backoff", time.perf_counter() - t0)
                     queue.insert(0, op)  # retry from latest checkpoint
                     continue
                 rec.last_metric = metric

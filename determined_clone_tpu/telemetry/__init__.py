@@ -38,6 +38,15 @@ from determined_clone_tpu.telemetry.flight import (
     flight_to_chrome_trace,
     read_flight,
 )
+from determined_clone_tpu.telemetry.goodput import (
+    CATEGORIES as GOODPUT_CATEGORIES,
+    GoodputJournal,
+    GoodputLedger,
+    check_conservation,
+    format_goodput,
+    merge_goodput,
+    read_goodput,
+)
 from determined_clone_tpu.telemetry.metrics import (
     Counter,
     Gauge,
@@ -53,11 +62,13 @@ from determined_clone_tpu.telemetry.spans import (
 )
 
 __all__ = [
-    "Counter", "FlightRecorder", "Gauge", "Histogram", "MetricsRegistry",
+    "Counter", "FlightRecorder", "GOODPUT_CATEGORIES", "Gauge",
+    "GoodputJournal", "GoodputLedger", "Histogram", "MetricsRegistry",
     "NULL_SPAN", "Span", "Telemetry", "Tracer",
-    "chrome_trace_events", "flight_summary", "flight_to_chrome_trace",
-    "null_span", "parse_prometheus_text",
-    "read_flight", "spans_from_profiler_samples",
+    "check_conservation", "chrome_trace_events",
+    "flight_summary", "flight_to_chrome_trace", "format_goodput",
+    "merge_goodput", "null_span", "parse_prometheus_text",
+    "read_flight", "read_goodput", "spans_from_profiler_samples",
     "stitch_chrome_trace", "telemetry_from_config", "to_chrome_trace",
     "validate_chrome_trace", "write_chrome_trace",
 ]
@@ -118,6 +129,13 @@ class Telemetry:
         self.anomaly_window = 64
         self.anomaly_threshold = 5.0
         self.anomaly_min_samples = 16
+        # wall-clock attribution (docs/observability.md goodput section):
+        # the ledger rides the tracer sink hook, so every finished span is
+        # bucketed with no extra work on the hot path
+        self.goodput: Optional[GoodputLedger] = None
+        if enabled:
+            self.goodput = GoodputLedger(registry=self.registry)
+            self.tracer.add_sink(self.goodput.observe_span)
 
     @property
     def trace_id(self) -> Optional[str]:
@@ -141,6 +159,8 @@ class Telemetry:
         if self.flight is not None:
             self.flight.set_identity(trace_id=self.tracer.trace_id,
                                      process=self.tracer.process_name)
+        if self.goodput is not None and trace_id is not None:
+            self.goodput.set_identity(trace_id=trace_id)
 
     def attach_flight(self, recorder: FlightRecorder) -> None:
         """Wire the flight recorder: it becomes a tracer sink (every
@@ -156,7 +176,10 @@ class Telemetry:
         self.tracer.add_sink(recorder.record_span)
 
     def close(self) -> None:
-        """Flush durable state (flight segment) on clean shutdown."""
+        """Flush durable state (flight segment, goodput journal) on clean
+        shutdown."""
+        if self.goodput is not None:
+            self.goodput.close()
         if self.flight is not None:
             self.flight.close()
 
@@ -244,6 +267,11 @@ class Telemetry:
         chunk boundary, so shipping is batched and off the hot path."""
         if not self.enabled:
             return
+        if self.goodput is not None:
+            # land the wall-clock account in the registry *before* the
+            # snapshot below, so both the flight recorder and the shipped
+            # sample carry goodput_* gauges; also journals a durable line
+            self.goodput.publish_metrics()
         if self.flight is not None:
             # the black box gets a snapshot even when no profiler channel
             # is wired (bench runs, unit tests, stripped-down subprocesses)
@@ -298,6 +326,11 @@ def telemetry_from_config(config: Any) -> Optional[Telemetry]:
     fast path instead of threading a disabled object through the hot loop.
     ``DCT_OBSERVABILITY=1`` force-enables, mirroring ``DCT_PROFILING``.
     """
+    # hard off-switch, beating every force-enable below: CI lanes use it
+    # to prove the suite (and the goodput tests in particular) skip
+    # cleanly when the telemetry plane is compiled out of a run
+    if os.environ.get("DCT_TELEMETRY_DISABLED") == "1":
+        return None
     obs = getattr(config, "observability", None)
     if obs is None and isinstance(config, dict):
         from determined_clone_tpu.config.experiment import ObservabilityConfig
@@ -315,6 +348,12 @@ def telemetry_from_config(config: Any) -> Optional[Telemetry]:
     flight_dir = os.environ.get("DCT_FLIGHT_DIR") or (
         obs.flight_dir if obs is not None else None)
     if flight_dir:
+        enabled = True
+    # same contract for the goodput journal: a journal dir implies enabled
+    # (the chaos harness points restart legs at one shared directory)
+    goodput_dir = os.environ.get("DCT_GOODPUT_DIR") or (
+        getattr(obs, "goodput_dir", None) if obs is not None else None)
+    if goodput_dir:
         enabled = True
     if not enabled:
         return None
@@ -342,4 +381,19 @@ def telemetry_from_config(config: Any) -> Optional[Telemetry]:
             segment_events=obs.flight_segment_events,
             max_segments=obs.flight_segments,
             registry=tel.registry))
+    if goodput_dir and tel.goodput is not None:
+        tel.goodput.attach_journal(goodput_dir)
+    if tel.goodput is not None:
+        # PR 7 lifecycle timestamps: the master's submitted_at→scheduled_at
+        # wait for this leg, exported by the runner so the trial's ledger
+        # can book scheduler time it never saw (it wasn't alive yet)
+        queue_wait = os.environ.get("DCT_QUEUE_WAIT_S")
+        if queue_wait:
+            try:
+                # pre_wall: the queue wait happened before this process
+                # was born, so it extends the accountable wall-clock
+                tel.goodput.note("queue_wait", float(queue_wait),
+                                 pre_wall=True)
+            except (TypeError, ValueError):
+                pass
     return tel

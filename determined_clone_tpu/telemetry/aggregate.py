@@ -347,8 +347,79 @@ class ClusterMetricsAggregator:
                     lines.append(
                         f"{name}{_label_str(labels)} {_fmt(s['value'])}")
             lines.extend(self._rollup_lines(name, fam))
+        lines.extend(self._goodput_lines(fams))
         text = "\n".join(ln for ln in lines if ln)
         return text + ("\n" if text else "")
+
+    def goodput_rollup(self, fams: Optional[Dict[str, Any]] = None
+                       ) -> Dict[str, Any]:
+        """Per-trial + cluster goodput from the shipped ledger gauges
+        (``goodput_seconds_total{category=...}`` / ``goodput_wall_seconds``
+        / ``goodput_fraction``). The cluster fraction is *time-weighted*
+        (Σ productive / Σ wall) — an idle tiny trial must not drag down a
+        busy big one the way a plain average of fractions would."""
+        fams = fams if fams is not None else self._families()
+        by_trial: Dict[str, Dict[str, Any]] = {}
+
+        def trial_acct(tid: str) -> Dict[str, Any]:
+            return by_trial.setdefault(
+                tid, {"wall_s": 0.0, "goodput_fraction": None,
+                      "categories": {}, "experiment_id": None})
+
+        for labels, s in fams.get("goodput_wall_seconds",
+                                  {}).get("children", []):
+            tid = labels.get("trial_id")
+            if tid is not None:
+                trial_acct(tid)["wall_s"] = float(s.get("value", 0))
+        for labels, s in fams.get("goodput_fraction",
+                                  {}).get("children", []):
+            tid = labels.get("trial_id")
+            if tid is not None:
+                trial_acct(tid)["goodput_fraction"] = float(
+                    s.get("value", 0))
+        for labels, s in fams.get("goodput_seconds_total",
+                                  {}).get("children", []):
+            tid, cat = labels.get("trial_id"), labels.get("category")
+            if tid is not None and cat:
+                trial_acct(tid)["categories"][cat] = float(
+                    s.get("value", 0))
+        with self._lock:
+            for tid_s, acct in by_trial.items():
+                st = self._trials.get(int(tid_s)) if tid_s.isdigit() else None
+                if st is not None:
+                    acct["experiment_id"] = st.experiment_id
+        wall_total = sum(a["wall_s"] for a in by_trial.values())
+        productive_total = sum(
+            a["categories"].get("productive", 0.0)
+            for a in by_trial.values())
+        return {
+            "by_trial": by_trial,
+            "wall_total_s": wall_total,
+            "cluster_fraction": (productive_total / wall_total
+                                 if wall_total > 0 else None),
+        }
+
+    def _goodput_lines(self, fams: Dict[str, Any]) -> List[str]:
+        """``dct_goodput_*`` families: the per-trial fraction under its
+        canonical name plus the time-weighted cluster-wide fraction (the
+        generic ``dct_cluster_goodput_fraction_avg`` rollup is unweighted,
+        which is the wrong semantics for a utilization ratio)."""
+        roll = self.goodput_rollup(fams)
+        if not roll["by_trial"]:
+            return []
+        lines = ["# TYPE dct_goodput_fraction gauge"]
+        for tid in sorted(roll["by_trial"]):
+            frac = roll["by_trial"][tid]["goodput_fraction"]
+            if frac is not None:
+                lines.append(
+                    f"dct_goodput_fraction{_label_str({'trial_id': tid})} "
+                    f"{_fmt(frac)}")
+        if roll["cluster_fraction"] is not None:
+            lines.append("# TYPE dct_goodput_cluster_fraction gauge")
+            lines.append(
+                f"dct_goodput_cluster_fraction "
+                f"{_fmt(roll['cluster_fraction'])}")
+        return lines
 
     @staticmethod
     def _summary_lines(name: str, labels: Dict[str, str],
@@ -482,6 +553,7 @@ class ClusterMetricsAggregator:
             "mfu_by_trial": mfu,
             "mfu_measured_by_trial": mfu_measured,
             "straggler": straggler,
+            "goodput": self.goodput_rollup(fams),
             "quantiles": quantiles,
             "counters": dict(sorted(counters.items())),
             "ingest": ingest,
@@ -510,6 +582,25 @@ def format_summary(summary: Dict[str, Any]) -> str:
             f"p50={straggler['slowest_p50_s']:.6f}s vs cluster median "
             f"{straggler['median_p50_s']:.6f}s "
             f"({straggler['slowdown_ratio']:.2f}x)")
+    goodput = summary.get("goodput")
+    if goodput and goodput.get("by_trial"):
+        cf = goodput.get("cluster_fraction")
+        cf_s = f"{cf:.1%}" if cf is not None else "n/a"
+        out.append(f"goodput (cluster, time-weighted): {cf_s} over "
+                   f"{goodput.get('wall_total_s', 0.0):.1f}s wall")
+        for tid in sorted(goodput["by_trial"]):
+            acct = goodput["by_trial"][tid]
+            frac = acct.get("goodput_fraction")
+            frac_s = f"{frac:.1%}" if frac is not None else "n/a"
+            cats = acct.get("categories") or {}
+            badput = sorted(
+                ((c, s) for c, s in cats.items()
+                 if c != "productive" and s > 0),
+                key=lambda kv: -kv[1])[:3]
+            bad_s = ("  top badput: " + ", ".join(
+                f"{c}={s:.2f}s" for c, s in badput)) if badput else ""
+            out.append(f"  trial {tid}: goodput {frac_s} of "
+                       f"{acct.get('wall_s', 0.0):.2f}s{bad_s}")
     if summary["quantiles"]:
         out.append("latency quantiles (cluster, count-weighted):")
         for name, qs in sorted(summary["quantiles"].items()):

@@ -27,6 +27,7 @@ The widely used ``6 * n_params`` approximation is available as
 """
 from __future__ import annotations
 
+import math
 import os
 from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
@@ -72,13 +73,56 @@ def attention_flops_per_token(d_model: int, seq_len: int,
 
 def mlp_flops_per_token(d_model: int, d_ff: int, n_layers: int, *,
                         moe_experts: int = 0, moe_k: int = 2) -> float:
-    """Dense or MoE FFN per token, forward pass (router included)."""
+    """Dense or MoE FFN per token, forward pass (router included).
+
+    The MoE branch is the textbook top-k approximation (each token visits
+    k experts, dispatch/combine free); :func:`moe_layer_flops` has the
+    exact count for the einsum-dispatch implementation in ops/moe.py,
+    which needs the token count and is what
+    :func:`gpt_train_step_flops` uses when the config routes.
+    """
     dense = 4.0 * d_model * d_ff
     if moe_experts and moe_experts > 1:
         k = max(1, min(moe_k, moe_experts))
         router = 2.0 * d_model * moe_experts
         return n_layers * (k * dense + router)
     return n_layers * dense
+
+
+def moe_layer_flops(n_tokens: int, d_model: int, d_ff: int,
+                    n_experts: int, *,
+                    capacity_factor: float = 1.25) -> Dict[str, float]:
+    """Exact forward FLOPs of one capacity-based MoE FFN layer for a
+    batch of ``n_tokens`` tokens, matching the einsum-dispatch path in
+    ops/moe.py term by term.
+
+    With N tokens, E experts, capacity ``C = ceil(N/E · cf)``, width D,
+    FFN width F, the five matmuls/einsums cost (2 FLOPs per MAC):
+
+    - router  ``[N,D]@[D,E]``:            ``2·N·D·E``
+    - dispatch ``nec,nd->ecd``:           ``2·N·E·C·D``
+    - up      ``ecd,edf->ecf``:           ``2·E·C·D·F``
+    - down    ``ecf,efd->ecd``:           ``2·E·C·F·D``
+    - combine ``nec,ecd->nd``:            ``2·N·E·C·D``
+
+    Note the count is shaped by E·C (experts always compute their full
+    capacity buffer, padded slots included), not by top-k — that is the
+    price of the static-shape dispatch form, and exactly why this differs
+    from the per-token approximation in :func:`mlp_flops_per_token`.
+    """
+    n = float(n_tokens)
+    d, f, e = float(d_model), float(d_ff), float(n_experts)
+    c = float(max(1, math.ceil(n_tokens / n_experts * capacity_factor)))
+    out = {
+        "router": 2.0 * n * d * e,
+        "dispatch": 2.0 * n * e * c * d,
+        "up": 2.0 * e * c * d * f,
+        "down": 2.0 * e * c * f * d,
+        "combine": 2.0 * n * e * c * d,
+    }
+    out["total"] = sum(out.values())
+    out["capacity"] = c
+    return out
 
 
 def embedding_flops_per_token(d_model: int, vocab_size: int) -> float:
@@ -105,12 +149,24 @@ def gpt_forward_flops_per_token(cfg: Any, seq_len: int) -> Dict[str, float]:
 
 def gpt_train_step_flops(cfg: Any, batch_size: int,
                          seq_len: Optional[int] = None) -> StepFlops:
-    """Analytic FLOPs for one training step of a GPT-family model."""
+    """Analytic FLOPs for one training step of a GPT-family model.
+
+    MoE configs get the exact capacity-based count (dispatch/combine
+    einsums grow with the token count, so only the step level — which
+    knows the batch — can be exact; the per-token breakdown is derived
+    back from it).
+    """
     seq = int(seq_len or cfg.max_seq_len)
+    tokens = int(batch_size) * seq
     breakdown = gpt_forward_flops_per_token(cfg, seq)
+    moe_experts = getattr(cfg, "moe_experts", 0)
+    if moe_experts and moe_experts > 1 and tokens > 0:
+        layer = moe_layer_flops(
+            tokens, cfg.d_model, cfg.d_ff, moe_experts,
+            capacity_factor=getattr(cfg, "moe_capacity_factor", 1.25))
+        breakdown["mlp"] = cfg.n_layers * layer["total"] / tokens
     per_token_fwd = sum(breakdown.values())
     per_token = TRAIN_MULT * per_token_fwd
-    tokens = int(batch_size) * seq
     return StepFlops(
         total=per_token * tokens,
         per_token=per_token,

@@ -356,23 +356,27 @@ class Trainer:
         # by arithmetic instead of materializing every replayed batch
         restored = batches_trained > 0
         if restored:
-            to_skip = batches_trained - 1  # first_batch is discarded below
-            while to_skip > 0:
-                skipped = _skip_batches(data_iter, to_skip)
-                to_skip -= skipped
-                if to_skip > 0:
-                    # epoch exhausted mid-replay: roll into the next one
-                    data_iter = iter(trial.training_data())
-                    if skipped == 0:
-                        # the previous epoch was already drained, so a
-                        # zero-progress round means the fresh epoch must
-                        # move — probe one batch to rule out an empty
-                        # dataset (would otherwise loop forever)
-                        if _skip_batches(data_iter, 1) == 0:
-                            raise RuntimeError(
-                                "training_data() yielded no batches while "
-                                "replaying restored progress")
-                        to_skip -= 1
+            # spanned so the goodput ledger books replay as restore badput,
+            # not unattributed time (the restore itself is already spanned
+            # as checkpoint_restore in _restore_one)
+            with self._span("restore_replay", batches=batches_trained - 1):
+                to_skip = batches_trained - 1  # first_batch discarded below
+                while to_skip > 0:
+                    skipped = _skip_batches(data_iter, to_skip)
+                    to_skip -= skipped
+                    if to_skip > 0:
+                        # epoch exhausted mid-replay: roll into the next one
+                        data_iter = iter(trial.training_data())
+                        if skipped == 0:
+                            # the previous epoch was already drained, so a
+                            # zero-progress round means the fresh epoch must
+                            # move — probe one batch to rule out an empty
+                            # dataset (would otherwise loop forever)
+                            if _skip_batches(data_iter, 1) == 0:
+                                raise RuntimeError(
+                                    "training_data() yielded no batches "
+                                    "while replaying restored progress")
+                            to_skip -= 1
 
         def batches() -> Iterator[Any]:
             if not restored:

@@ -13,16 +13,17 @@
 # `./run_tests.sh --observability` runs just the telemetry + profiler
 # surface (docs/observability.md): the telemetry core, profiler/tensorboard
 # shipping, the observability config round-trip, the XLA/device lane +
-# flight recorder + bench result schema, and the static checks.
+# flight recorder + goodput ledger + bench result schema, and the static
+# checks. The goodput suite skips cleanly under DCT_TELEMETRY_DISABLED=1.
 #
 # `./run_tests.sh --lint` runs the dctlint static-analysis suite over the
 # tier-1 lint set (docs/static_analysis.md) — the same run
 # tests/test_static_checks.py gates in CI.
 #
-# `./run_tests.sh --chaos` runs the fault-tolerance + flight-recorder
-# suites (docs/fault_tolerance.md) with no marker filter, so the slow
-# kill -9 subprocess tests run too — the tier-1 lane skips them via
-# `-m "not slow"`.
+# `./run_tests.sh --chaos` runs the fault-tolerance + flight-recorder +
+# goodput-ledger suites (docs/fault_tolerance.md) with no marker filter,
+# so the slow kill -9 subprocess tests (including the restart-leg ledger
+# merge) run too — the tier-1 lane skips them via `-m "not slow"`.
 #
 # `./run_tests.sh --storage` runs the checkpoint-storage surface
 # (docs/checkpoint_storage.md): backends, the content-addressed store +
@@ -50,7 +51,8 @@ elif [ "$1" = "--tier1" ]; then
     set -- tests/ -m "not slow" "$@"
 elif [ "$1" = "--chaos" ]; then
     shift
-    set -- tests/test_fault_tolerance.py tests/test_flight_recorder.py "$@"
+    set -- tests/test_fault_tolerance.py tests/test_flight_recorder.py \
+        tests/test_goodput.py "$@"
 elif [ "$1" = "--storage" ]; then
     shift
     set -- tests/test_storage_backends.py tests/test_cas_store.py \
@@ -65,8 +67,8 @@ elif [ "$1" = "--observability" ]; then
     set -- tests/test_telemetry.py tests/test_profiler_tensorboard.py \
         tests/test_observability_config.py tests/test_observability_plane.py \
         tests/test_xla_telemetry.py tests/test_device_telemetry.py \
-        tests/test_flight_recorder.py tests/test_bench_schema.py \
-        tests/test_static_checks.py \
+        tests/test_flight_recorder.py tests/test_goodput.py \
+        tests/test_bench_schema.py tests/test_static_checks.py \
         -m "not slow" "$@"
 fi
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \

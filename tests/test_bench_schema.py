@@ -89,12 +89,53 @@ def test_xla_section_schema(bench_result):
     assert xla["timing_spread"] is None or xla["timing_spread"] >= 1.0
 
 
+def test_goodput_section_schema(bench_result):
+    """The goodput section (telemetry/goodput.py, measured on a real
+    trainer mini-run inside the bench child): the acceptance criterion is
+    a non-null fraction with the conservation invariant holding — a null
+    here means the ledger fell out of the bench wiring."""
+    gp = bench_result["detail"]["goodput"]
+    assert gp.get("error") is None
+    assert gp["goodput_fraction"] is not None
+    assert 0 <= gp["goodput_fraction"] <= 1
+    assert gp["wall_s"] > 0
+    assert gp["conservation_ok"] is True
+    assert gp["conservation_error_fraction"] <= 0.01
+    cats = gp["categories"]
+    assert cats["productive"] > 0
+    assert cats["checkpoint_save"] > 0  # the mini-run commits at batch 8
+
+
 def test_gate_accepts_fresh_round(bench_result):
     """The regression gate passes a round against itself and prints the
-    advisory xla line — wiring proof that gate and schema agree."""
+    advisory xla + goodput lines — wiring proof that gate and schema
+    agree."""
     from tools.bench_gate import gate
 
     ok, report = gate(bench_result, bench_result)
     assert ok, report
     assert any(line.startswith("ok: xla compile=") for line in report)
-    assert not any(line.startswith("WARN: xla") for line in report)
+    assert any(line.startswith("ok: goodput fraction=") for line in report)
+    assert not any(line.startswith("WARN:") for line in report)
+
+
+def test_gate_enforces_bench_history():
+    """The throughput compare is ENFORCED, not advisory: the two newest
+    committed BENCH rounds must gate clean at the -5% tolerance. A PR
+    that regresses throughput past the tolerance fails tier-1 here, per
+    ROADMAP item 5's 'every perf PR must move MFU or tokens/sec'.
+
+    Skips (never fails) when the history can't support a compare: fewer
+    than two rounds, or a round whose driver wrapper banked no result
+    line (early rounds predate the result-line contract). mfu=null is
+    allowed: pre-analytic-engine rounds carry it."""
+    from tools.bench_gate import gate, load_bench, newest_rounds
+
+    try:
+        old_path, new_path = newest_rounds(REPO)
+        old, new = load_bench(old_path), load_bench(new_path)
+    except ValueError as e:
+        pytest.skip(f"BENCH history not comparable: {e}")
+    ok, report = gate(old, new, allow_null_mfu=True)
+    assert ok, (f"{old_path} -> {new_path} failed the enforced "
+                f"throughput gate:\n" + "\n".join(report))

@@ -54,6 +54,53 @@ class TestFlops:
             64, 256, n_layers=2, moe_experts=8, moe_k=1)
         assert moe == 2 * (4 * 64 * 256 + 2 * 64 * 8)
 
+    def test_moe_layer_flops_hand_computed(self):
+        # N=8 tokens, D=4, F=8, E=2 experts, cf=1.0 -> C = ceil(8/2) = 4
+        # router  2*8*4*2        = 128
+        # dispatch 2*8*2*4*4     = 512
+        # up      2*2*4*4*8      = 512
+        # down    2*2*4*8*4      = 512
+        # combine 2*8*2*4*4      = 512
+        out = flops_mod.moe_layer_flops(8, 4, 8, 2, capacity_factor=1.0)
+        assert out["capacity"] == 4
+        assert out["router"] == 128
+        assert out["dispatch"] == 512
+        assert out["up"] == 512
+        assert out["down"] == 512
+        assert out["combine"] == 512
+        assert out["total"] == 2176
+
+    def test_moe_capacity_shapes_the_count(self):
+        # the einsum-dispatch count grows with E*C, not top-k: raising the
+        # capacity factor raises expert + dispatch/combine terms alike
+        lo = flops_mod.moe_layer_flops(8, 4, 8, 2, capacity_factor=1.0)
+        hi = flops_mod.moe_layer_flops(8, 4, 8, 2, capacity_factor=1.25)
+        assert hi["capacity"] == 5 and lo["capacity"] == 4
+        assert hi["up"] / lo["up"] == pytest.approx(5 / 4)
+        assert hi["dispatch"] / lo["dispatch"] == pytest.approx(5 / 4)
+        assert hi["router"] == lo["router"]  # router sees N, not C
+        # capacity floors at one slot per expert
+        tiny = flops_mod.moe_layer_flops(2, 4, 8, 8, capacity_factor=1.0)
+        assert tiny["capacity"] == 1
+
+    def test_gpt_step_uses_exact_moe_count(self):
+        class Cfg:
+            n_layers, d_model, n_heads = 2, 64, 4
+            d_ff, vocab_size, max_seq_len = 256, 512, 32
+            moe_experts, moe_capacity_factor = 4, 1.0
+
+        step = flops_mod.gpt_train_step_flops(Cfg(), batch_size=2)
+        layer = flops_mod.moe_layer_flops(
+            step.tokens, 64, 256, 4, capacity_factor=1.0)
+        # the step-level mlp term is the exact capacity-based layer count
+        # (x layers x train multiplier), not the top-k approximation
+        assert step.breakdown["mlp"] == pytest.approx(
+            Cfg.n_layers * layer["total"] * flops_mod.TRAIN_MULT)
+        approx = (flops_mod.mlp_flops_per_token(
+            64, 256, n_layers=2, moe_experts=4) * flops_mod.TRAIN_MULT
+            * step.tokens)
+        assert step.breakdown["mlp"] != pytest.approx(approx)
+
     def test_gpt_step_scales_with_batch(self):
         class Cfg:
             n_layers, d_model, n_heads = 2, 64, 4

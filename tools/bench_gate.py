@@ -80,7 +80,7 @@ def newest_rounds(directory: str = ".") -> Tuple[str, str]:
 # previous round carried is a skip-with-note, never a gate failure — the
 # headline throughput/mfu checks below are the contract.
 OPTIONAL_SECTIONS = ("control_plane", "checkpoint_io", "pipeline",
-                     "mnist_cnn", "tpu_probe_telemetry", "xla")
+                     "mnist_cnn", "tpu_probe_telemetry", "xla", "goodput")
 
 
 def _section_notes(old_detail: Dict[str, Any], new_detail: Dict[str, Any],
@@ -163,6 +163,49 @@ def _xla_lines(old_detail: Dict[str, Any],
             f"program fingerprint ({mm / old_mm - 1.0:+.1%})")
 
 
+def _goodput_lines(old_detail: Dict[str, Any],
+                   new_detail: Dict[str, Any], report: list) -> None:
+    """Advisory goodput-section reporting (telemetry/goodput.py, measured
+    on a real trainer mini-run inside bench): the fraction lands in the
+    report so badput drift is visible in BENCH history. WARNs when the
+    section errored, when the conservation invariant broke (the ledger
+    over-counted — a wiring bug, not an environment mood), when the
+    fraction is null, or when it dropped more than 10 points against the
+    previous round. Advisory-only: the mini-run shares the box with the
+    bench ladder, so absolute goodput is noisy; the enforced contract is
+    the tier-1 conservation test."""
+    gp_new = new_detail.get("goodput")
+    if not isinstance(gp_new, dict):
+        return
+    if gp_new.get("error"):
+        report.append(f"WARN: goodput errored: {gp_new['error']}")
+        return
+    frac = gp_new.get("goodput_fraction")
+    if not gp_new.get("conservation_ok", False):
+        report.append(
+            "WARN: goodput conservation violated "
+            f"(error_fraction={gp_new.get('conservation_error_fraction')})")
+    if not isinstance(frac, (int, float)):
+        report.append("WARN: goodput_fraction is null")
+        return
+    cats = gp_new.get("categories") or {}
+    badput = sorted(((c, s) for c, s in cats.items()
+                     if c != "productive" and isinstance(s, (int, float))),
+                    key=lambda kv: -kv[1])[:2]
+    bad_s = " ".join(f"{c}={s:.2f}s" for c, s in badput)
+    report.append(
+        f"ok: goodput fraction={frac:.4f} over {gp_new.get('wall_s')}s "
+        f"(top badput: {bad_s or 'none'})")
+    gp_old = old_detail.get("goodput")
+    if isinstance(gp_old, dict):
+        old_frac = gp_old.get("goodput_fraction")
+        if (isinstance(old_frac, (int, float))
+                and frac < old_frac - 0.10):
+            report.append(
+                f"WARN: goodput fraction {old_frac:.4f} → {frac:.4f} "
+                f"(dropped more than 10 points)")
+
+
 def gate(old: Dict[str, Any], new: Dict[str, Any], *,
          tolerance: float = DEFAULT_TOLERANCE,
          allow_null_mfu: bool = False) -> Tuple[bool, list]:
@@ -212,6 +255,7 @@ def gate(old: Dict[str, Any], new: Dict[str, Any], *,
     _section_notes(old_detail, new_detail, report)
     _control_plane_lines(old_detail, new_detail, report)
     _xla_lines(old_detail, new_detail, report)
+    _goodput_lines(old_detail, new_detail, report)
     return ok, report
 
 
