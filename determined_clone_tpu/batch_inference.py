@@ -22,10 +22,18 @@ jit/shard its model over the mesh); rank r owns batches r, r+size, ...
 from __future__ import annotations
 
 import contextlib
+import logging
 import math
 from typing import Any, Dict, Optional, Sequence, Type
 
 from determined_clone_tpu import core
+
+logger = logging.getLogger(__name__)
+
+# warn-once guard for dropped-example reporting (same contract as the
+# trainer's eval_examples_dropped warning): the counter is always exact
+# in the summary/metric, the log line fires once per process
+_dropped_warned = False
 
 
 class BatchProcessor:
@@ -77,6 +85,13 @@ def jax_batch_process(
         n_batches = math.ceil(len(dataset) / batch_size)
         if max_batches is not None:
             n_batches = min(n_batches, max_batches)
+        # Examples beyond the planned batch range are DROPPED, and used
+        # to be dropped silently: max_batches clips the tail here (on a
+        # fresh run or a resume whose plan tightened alike) and nothing
+        # ever revisits the difference. Count them exactly and surface
+        # via the trainer's eval_examples_dropped contract: warn once,
+        # always report.
+        examples_dropped = max(0, len(dataset) - n_batches * batch_size)
 
         # resume: skip this rank's already-completed batches. The sharding
         # arithmetic (idx = rank + pos*size, slice = idx*batch_size) only
@@ -95,6 +110,10 @@ def jax_batch_process(
                 raise ValueError(
                     f"resume world size {size} != checkpointed {old_size}; "
                     f"per-rank progress would map to different data")
+            # meta["n_batches"] records the original plan; a resume whose
+            # plan shrank (max_batches tightened) drops the difference,
+            # which the examples_dropped formula above already counts —
+            # the tail examples are still in the dataset, just unplanned
             completed = int(meta.get(_progress_key(rank), 0))
 
         processor = processor_cls(ctx)
@@ -109,7 +128,8 @@ def jax_batch_process(
             # (≈ _upload_sharded + merge_resources, core/_checkpoint.py:280)
             processor.on_checkpoint_start()
             merged: Dict[str, Any] = {"batch_size": batch_size,
-                                      "world_size": size}
+                                      "world_size": size,
+                                      "n_batches": n_batches}
             for d in dist.allgather({_progress_key(rank): processed}):
                 merged.update(d)
             with ctx.checkpoint.store_path(
@@ -146,10 +166,29 @@ def jax_batch_process(
         if not preempted:
             processor.on_finish()
 
+        if examples_dropped:
+            global _dropped_warned
+            if not _dropped_warned:
+                _dropped_warned = True
+                logger.warning(
+                    "batch inference dropped %d examples outside the "
+                    "processed batch range (max_batches clipping or a "
+                    "shrunken dataset on resume); raise max_batches or "
+                    "re-run without a stale checkpoint for full coverage",
+                    examples_dropped)
+            tel = getattr(ctx, "telemetry", None)
+            if tel is not None and getattr(tel, "registry", None) is not None:
+                tel.registry.gauge(
+                    "batch_inference_examples_dropped",
+                    "examples outside the processed batch range this run "
+                    "(max_batches clipping / shrunken dataset on resume)"
+                ).set(examples_dropped)
+
         return {
             "rank": rank,
             "batches_processed": processed,
             "total_batches": n_batches,
+            "examples_dropped": examples_dropped,
             "preempted": preempted,
             "storage_id": storage_id,
         }

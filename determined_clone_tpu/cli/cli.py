@@ -692,6 +692,78 @@ def cmd_goodput(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Serve a GPT checkpoint over HTTP with continuous batching over a
+    paged KV cache (docs/serving.md). `--selftest` binds an ephemeral
+    port, drives a few generations through the HTTP surface, prints the
+    engine stats as JSON, and exits — the smoke path CI runs."""
+    import dataclasses
+    import time
+
+    from determined_clone_tpu.config.experiment import ServingConfig
+    from determined_clone_tpu.models import gpt as gpt_model
+    from determined_clone_tpu.serving import InferenceEngine
+    from determined_clone_tpu.serving.http import (
+        ServingHTTPServer,
+        generate_over_http,
+    )
+
+    scfg = ServingConfig()
+    if args.config:
+        raw = load_config_file(args.config)
+        if raw.get("serving"):
+            scfg = ServingConfig.from_dict(raw["serving"])
+    if args.port is not None:
+        scfg = dataclasses.replace(scfg, port=args.port)
+    if args.host is not None:
+        scfg = dataclasses.replace(scfg, host=args.host)
+
+    if args.model != "tiny":
+        print(f"error: unknown model preset {args.model!r} (have: tiny)",
+              file=sys.stderr)
+        return 2
+    model_cfg = gpt_model.GPTConfig.tiny()
+    import jax
+
+    params = gpt_model.init(jax.random.PRNGKey(args.seed), model_cfg)
+    if args.checkpoint:
+        from determined_clone_tpu.core._serialization import load_pytree
+
+        params = load_pytree(args.checkpoint, like=params)
+    with InferenceEngine.from_serving_config(params, model_cfg,
+                                             scfg) as engine:
+        # precompile the full bucket ladder before taking traffic: the
+        # first request to hit a cold bucket would otherwise stall the
+        # scheduler (and everyone behind it) on an XLA compile
+        t0 = time.monotonic()
+        n_programs = engine.warmup()
+        print(f"warmup: {n_programs} programs compiled "
+              f"in {time.monotonic() - t0:.1f}s", file=sys.stderr)
+        port = 0 if args.selftest else scfg.port
+        with ServingHTTPServer(engine, host=scfg.host, port=port) as server:
+            if args.selftest:
+                for prompt in ([1, 2, 3], [5, 6, 7, 8, 9], [11]):
+                    out = generate_over_http(server.url, prompt,
+                                             max_new_tokens=4)
+                    if len(out["tokens"]) != 4:
+                        print(f"error: selftest got {out}", file=sys.stderr)
+                        return 1
+                print(json.dumps(
+                    {"selftest": "ok", "url": server.url,
+                     "stats": dataclasses.asdict(engine.stats())}))
+                return 0
+            print(f"serving {args.model} on {server.url} "
+                  f"(buckets: batch {engine.buckets.batch_buckets}, "
+                  f"prefill {engine.buckets.prefill_len_buckets}; "
+                  f"{engine.cache.num_blocks}x{engine.cache.block_size} "
+                  f"KV blocks)")
+            try:
+                while True:
+                    time.sleep(3600)
+            except KeyboardInterrupt:
+                return 0
+
+
 def cmd_lint(args) -> int:
     """Run the dctlint static-analysis suite (docs/static_analysis.md).
     The linter lives in the repo's tools/ package (it is developer
@@ -1366,6 +1438,28 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--json", action="store_true",
                    help="print the accounts as JSON")
     c.set_defaults(func=cmd_goodput)
+
+    # serve (online inference: continuous batching + paged KV cache —
+    # docs/serving.md)
+    c = sub.add_parser("serve",
+                       help="serve a GPT checkpoint over HTTP with "
+                            "continuous batching and a paged KV cache")
+    c.add_argument("--config", default=None,
+                   help="experiment config yaml; its `serving:` block "
+                        "sets buckets, KV pool, and admission knobs")
+    c.add_argument("--checkpoint", default=None,
+                   help="local checkpoint dir (core save_pytree layout) "
+                        "to load params from; default: random init")
+    c.add_argument("--model", default="tiny",
+                   help="model preset (currently: tiny)")
+    c.add_argument("--seed", type=int, default=0,
+                   help="init seed when no checkpoint is given")
+    c.add_argument("--host", default=None)
+    c.add_argument("--port", type=int, default=None)
+    c.add_argument("--selftest", action="store_true",
+                   help="bind an ephemeral port, run a few generations "
+                        "through the HTTP surface, print stats, exit")
+    c.set_defaults(func=cmd_serve)
 
     # lint (dctlint static analysis — docs/static_analysis.md)
     c = sub.add_parser("lint",

@@ -398,6 +398,69 @@ class ObservabilityConfig:
 
 
 # ---------------------------------------------------------------------------
+# Serving (the `serving:` block) — online inference via `dct serve`:
+# continuous batching over a paged KV cache; see docs/serving.md. The knobs
+# are the engine's shape/capacity contract: buckets bound the XLA program
+# count, kv blocks bound concurrent context, queue depth is the admission
+# valve. No reference equivalent (the reference serves only batch jobs).
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ServingConfig:
+    max_batch: int = 8              # largest (pow2) batch bucket
+    max_prefill_len: int = 128      # largest (pow2) prompt-length bucket
+    kv_block_size: int = 16         # KV pool block size (pow2 positions)
+    kv_blocks: int = 0              # pool blocks; 0 = size for max_batch
+    max_queue_depth: int = 64       # admission valve → 429/ServerOverloaded
+    default_max_new_tokens: int = 64
+    host: str = "127.0.0.1"
+    port: int = 8191
+
+    @staticmethod
+    def from_dict(raw: Dict[str, Any]) -> "ServingConfig":
+        if not isinstance(raw, dict):
+            raise ConfigError(f"serving must be a mapping, got {raw!r}")
+        cfg = ServingConfig(
+            max_batch=int(raw.get("max_batch", 8)),
+            max_prefill_len=int(raw.get("max_prefill_len", 128)),
+            kv_block_size=int(raw.get("kv_block_size", 16)),
+            kv_blocks=int(raw.get("kv_blocks", 0)),
+            max_queue_depth=int(raw.get("max_queue_depth", 64)),
+            default_max_new_tokens=int(raw.get("default_max_new_tokens", 64)),
+            host=str(raw.get("host", "127.0.0.1")),
+            port=int(raw.get("port", 8191)),
+        )
+        cfg.validate()
+        return cfg
+
+    def validate(self) -> None:
+        for name, v in (("max_batch", self.max_batch),
+                        ("max_prefill_len", self.max_prefill_len),
+                        ("kv_block_size", self.kv_block_size)):
+            if v < 1 or v & (v - 1):
+                raise ConfigError(
+                    f"serving.{name} must be a power of two >= 1, got {v}")
+        if self.kv_blocks < 0:
+            raise ConfigError(
+                f"serving.kv_blocks must be >= 0 (0 = auto), "
+                f"got {self.kv_blocks}")
+        if self.max_queue_depth < 1:
+            raise ConfigError(
+                f"serving.max_queue_depth must be >= 1, "
+                f"got {self.max_queue_depth}")
+        if self.default_max_new_tokens < 1:
+            raise ConfigError(
+                f"serving.default_max_new_tokens must be >= 1, "
+                f"got {self.default_max_new_tokens}")
+        if not 0 < self.port < 65536:
+            raise ConfigError(
+                f"serving.port must be in (0, 65536), got {self.port}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
 # Fault injection (the `faults:` block) — a seeded, deterministic FaultPlan
 # for chaos testing; see docs/fault_tolerance.md. No reference equivalent:
 # the reference exercises failure paths with live clusters, we do it by seed.
@@ -493,6 +556,7 @@ class ExperimentConfig:
     observability: ObservabilityConfig = dataclasses.field(
         default_factory=ObservabilityConfig
     )
+    serving: Optional[ServingConfig] = None
     faults: Optional[FaultsConfig] = None
     checkpoint_policy: str = "best"     # best | all | none
     min_validation_period: Optional[Length] = None
@@ -548,6 +612,8 @@ class ExperimentConfig:
             observability=ObservabilityConfig.from_dict(
                 raw.get("observability") or {}
             ),
+            serving=(ServingConfig.from_dict(raw["serving"])
+                     if raw.get("serving") else None),
             faults=(FaultsConfig.from_dict(raw["faults"])
                     if raw.get("faults") else None),
             checkpoint_policy=raw.get("checkpoint_policy", "best"),
@@ -624,6 +690,8 @@ class ExperimentConfig:
             d["optimizations"] = self.optimizations.to_dict()
         if self.observability != ObservabilityConfig():
             d["observability"] = self.observability.to_dict()
+        if self.serving is not None:
+            d["serving"] = self.serving.to_dict()
         if self.faults is not None:
             d["faults"] = self.faults.to_dict()
         if self.min_validation_period:

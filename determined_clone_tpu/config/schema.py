@@ -228,6 +228,21 @@ EXPERIMENT_SCHEMA = {
                 "anomaly_min_samples": {"type": "integer"},
             },
         },
+        # online inference via `dct serve` (continuous batching over a
+        # paged KV cache; docs/serving.md)
+        "serving": {
+            "type": "object", "open": False,
+            "properties": {
+                "max_batch": {"type": "integer"},
+                "max_prefill_len": {"type": "integer"},
+                "kv_block_size": {"type": "integer"},
+                "kv_blocks": {"type": "integer"},
+                "max_queue_depth": {"type": "integer"},
+                "default_max_new_tokens": {"type": "integer"},
+                "host": {"type": "string"},
+                "port": {"type": "integer"},
+            },
+        },
         # deterministic fault injection (seeded FaultPlan;
         # docs/fault_tolerance.md)
         "faults": {

@@ -343,5 +343,137 @@ def loss_fn(params: Params, cfg: GPTConfig, tokens: jax.Array,
     return ce
 
 
+def _block_paged(cfg: GPTConfig, block_params: Params, x: jax.Array,
+                 positions: jax.Array, k_pool_l: jax.Array,
+                 v_pool_l: jax.Array, scatter_idx: jax.Array,
+                 gather_idx: jax.Array, attn_mask: jax.Array):
+    """One pre-LN block on the paged-KV serving path.
+
+    x: [B, T, D] new tokens only (prefill: the prompt; decode: T=1).
+    k_pool_l/v_pool_l: [N, bs, H, hd] — this layer's slice of the paged
+    pool. The new tokens' K/V are scattered into the pool at
+    ``scatter_idx`` ([B*T] flat slot ids, out-of-range = padding →
+    dropped), then attention gathers the full paged context back via
+    ``gather_idx`` ([B, S] flat slot ids) under ``attn_mask``
+    ([B, 1, T, S]). Two sequences never share a pool block, so the
+    scatter indices are collision-free by construction.
+
+    Returns (x, k_pool_l, v_pool_l) — the same block math as ``_block``
+    (dense or MoE FFN), minus dropout (inference) and remat.
+    """
+    B, T, D = x.shape
+    H, hd = cfg.n_heads, cfg.head_dim
+    N, bs = k_pool_l.shape[0], k_pool_l.shape[1]
+
+    h = layernorm(block_params["ln1"], x)
+    qkv = dense(block_params["attn_qkv"], h, compute_dtype=cfg.compute_dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = rotary_embedding(q.reshape(B, T, H, hd), positions)
+    k = rotary_embedding(k.reshape(B, T, H, hd), positions)
+    v = v.reshape(B, T, H, hd)
+
+    k_flat = k_pool_l.reshape(N * bs, H, hd)
+    v_flat = v_pool_l.reshape(N * bs, H, hd)
+    k_flat = k_flat.at[scatter_idx].set(k.reshape(B * T, H, hd), mode="drop")
+    v_flat = v_flat.at[scatter_idx].set(v.reshape(B * T, H, hd), mode="drop")
+    # gather the whole paged context: [B, S, H, hd]; slot j of the gathered
+    # context is sequence position j (block tables map contiguously)
+    ctx_k = k_flat[gather_idx]
+    ctx_v = v_flat[gather_idx]
+    attn = mha(q, ctx_k, ctx_v, causal=False, mask=attn_mask)
+    attn = dense(block_params["attn_out"], attn.reshape(B, T, D),
+                 compute_dtype=cfg.compute_dtype)
+    x = x + attn
+
+    h = layernorm(block_params["ln2"], x)
+    if cfg.moe_experts > 0:
+        h, _ = moe_ffn(block_params["moe"], h, k=cfg.moe_k,
+                       capacity_factor=cfg.moe_capacity_factor,
+                       compute_dtype=cfg.compute_dtype)
+    else:
+        h = dense(block_params["mlp_up"], h, compute_dtype=cfg.compute_dtype)
+        h = jax.nn.gelu(h, approximate=True)
+        h = dense(block_params["mlp_down"], h, compute_dtype=cfg.compute_dtype)
+    x = x + h
+    return x, k_flat.reshape(N, bs, H, hd), v_flat.reshape(N, bs, H, hd)
+
+
+def forward_paged(params: Params, cfg: GPTConfig, tokens: jax.Array,
+                  positions: jax.Array, token_mask: jax.Array,
+                  last_index: jax.Array, k_pool: jax.Array,
+                  v_pool: jax.Array, block_tables: jax.Array):
+    """KV-cache-aware forward for online serving (paged attention).
+
+    ONE function covers both halves of the prefill/decode split — the
+    serving engine jits it once and XLA compiles one program per
+    (batch-bucket, length-bucket) shape:
+
+    - **prefill**: ``tokens`` is the bucketed-padded prompt ([B, T]),
+      every prompt token's K/V is written into the pool, and the returned
+      logits are each row's *last real token* (→ first sampled token);
+    - **decode**: ``T == 1`` — one new token per running sequence is
+      appended to the pool and attends to its full paged context.
+
+    Args:
+      tokens:     int32 [B, T] new token ids.
+      positions:  int32 [B, T] absolute sequence positions of ``tokens``.
+      token_mask: bool  [B, T] — False marks batch/length padding; padded
+                  tokens are neither written to the pool nor attended to.
+      last_index: int32 [B] — index into T of each row's last real token
+                  (prefill: prompt_len-1; decode: 0).
+      k_pool/v_pool: [L, N, block, H, hd] paged pools. Callers jitting
+                  this should donate both (the pool is updated in place).
+      block_tables: int32 [B, W] pool block ids per sequence; entry w
+                  backs sequence positions [w*block, (w+1)*block). Padding
+                  entries may hold any valid id — they are never written
+                  (mask) and reads of them are masked out of attention.
+
+    Returns ``(logits [B, V] fp32, k_pool, v_pool)``.
+
+    Numerics match :func:`apply` (same dtypes, fp32 softmax/logits): a
+    greedy decode through this path is token-identical to re-running the
+    full uncached forward each step — tests/test_serving.py asserts it.
+    """
+    B, T = tokens.shape
+    N, bs = k_pool.shape[1], k_pool.shape[2]
+    W = block_tables.shape[1]
+    S = W * bs
+
+    # scatter slots for the new tokens: pool block backing position p is
+    # block_tables[b, p // bs]; padding tokens get an out-of-range slot so
+    # .at[].set(mode="drop") discards them
+    blk = jnp.take_along_axis(block_tables, positions // bs, axis=1)
+    scatter_idx = jnp.where(token_mask, blk * bs + positions % bs,
+                            N * bs).reshape(B * T)
+    gather_idx = (block_tables[:, :, None] * bs
+                  + jnp.arange(bs)[None, None, :]).reshape(B, S)
+    # context slot j == sequence position j: causal = "j <= my position"
+    attn_mask = (jnp.arange(S)[None, None, :] <= positions[:, :, None]
+                 ) & token_mask[:, :, None]
+    attn_mask = attn_mask[:, None]  # [B, 1, T, S] broadcast over heads
+
+    x = jnp.take(params["embed"]["table"], tokens,
+                 axis=0).astype(cfg.compute_dtype)
+
+    def scan_body(x, layer_in):
+        layer_params, k_l, v_l = layer_in
+        x, k_l, v_l = _block_paged(cfg, layer_params, x, positions, k_l,
+                                   v_l, scatter_idx, gather_idx, attn_mask)
+        return x, (k_l, v_l)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        scan_body, x, (params["blocks"], k_pool, v_pool))
+
+    x = layernorm(params["final_norm"], x)
+    h_last = jnp.take_along_axis(
+        x, last_index[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    if cfg.tie_embeddings:
+        logits = (h_last.astype(jnp.float32)
+                  @ params["embed"]["table"].astype(jnp.float32).T)
+    else:
+        logits = dense(params["lm_head"], h_last, compute_dtype=jnp.float32)
+    return logits.astype(jnp.float32), k_pool, v_pool
+
+
 def param_count(params: Params) -> int:
     return sum(int(x.size) for x in jax.tree.leaves(params))

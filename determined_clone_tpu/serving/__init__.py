@@ -1,0 +1,32 @@
+"""Online GPT serving: continuous batching over a paged KV cache.
+
+The subsystem that turns a trained ``models/gpt.py`` checkpoint into a
+service (docs/serving.md):
+
+- :mod:`.bucketing` — powers-of-two padding buckets so the whole service
+  compiles a small fixed set of XLA programs;
+- :mod:`.kv_cache` — the preallocated paged KV pool and its block
+  allocator (vLLM-style block tables, per-sequence);
+- :mod:`.engine` — the iteration-level continuous-batching scheduler
+  (Orca-style): prefill/decode split, admission control on RetryPolicy,
+  CAS checkpoint hot-load, per-request telemetry spans;
+- :mod:`.http` — a stdlib HTTP front-end for ``dct serve``.
+"""
+from determined_clone_tpu.serving.bucketing import (  # noqa: F401
+    BucketSpec,
+    bucket_for,
+    pow2_buckets,
+)
+from determined_clone_tpu.serving.kv_cache import (  # noqa: F401
+    BlockAllocator,
+    KVCacheConfig,
+    init_kv_pools,
+)
+from determined_clone_tpu.serving.engine import (  # noqa: F401
+    ADMISSION_RETRY,
+    EngineStats,
+    InferenceEngine,
+    Request,
+    RequestResult,
+    ServerOverloaded,
+)

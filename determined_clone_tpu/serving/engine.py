@@ -1,0 +1,735 @@
+"""Iteration-level continuous batching over the paged KV cache.
+
+The Orca-style scheduler loop at the heart of ``dct serve``: requests
+enter a bounded thread-safe queue; every scheduler iteration first
+admits queued requests into the running batch (one bucketed prefill call
+for the newcomers), then runs ONE decode step for every active sequence
+(one bucketed T=1 call), retiring finished sequences immediately so
+their pool blocks and batch slots free up for the next iteration. No
+sequence ever waits for a stranger's completion — the property that
+makes continuous batching beat run-to-completion batching on tokens/sec
+under load (bench.py's ``serving`` section measures exactly that, with
+:meth:`InferenceEngine.run_static` as the same-program baseline).
+
+Compile discipline: all device work funnels through ONE jitted
+``forward_paged`` whose shapes are padded to :class:`BucketSpec` buckets,
+so the XLA program count is bounded by ``buckets.program_budget`` for
+the lifetime of the engine — asserted by the tier-1 compile-discipline
+test via :meth:`InferenceEngine.programs_compiled` (the PR 2 retrace
+probe).
+
+Backpressure: a full queue raises :class:`ServerOverloaded`;
+:meth:`InferenceEngine.submit_with_backoff` wraps admission in the
+repo-standard ``RetryPolicy`` (utils/retry.py) so clients back off with
+full jitter instead of hammering. KV-pool exhaustion is *deferred*
+admission (requests wait in queue until blocks free), never mid-decode
+eviction.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import dataclasses
+import math
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from determined_clone_tpu.models import gpt
+from determined_clone_tpu.serving.bucketing import BucketSpec, bucket_for
+from determined_clone_tpu.serving.kv_cache import (
+    BlockAllocator,
+    KVCacheConfig,
+    init_kv_pools,
+)
+from determined_clone_tpu.telemetry import MetricsRegistry
+from determined_clone_tpu.utils.retry import RetryPolicy, retry_call
+
+
+class ServerOverloaded(RuntimeError):
+    """Admission rejected: queue full. Retryable — clients should back
+    off (see :meth:`InferenceEngine.submit_with_backoff`)."""
+
+
+ADMISSION_RETRY = RetryPolicy(
+    name="serving_admission", max_attempts=6, base_delay_s=0.05,
+    multiplier=2.0, max_delay_s=2.0, retryable=(ServerOverloaded,))
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One generation request. Greedy decoding (argmax) — the serving
+    contract that keeps paged output token-identical to the uncached
+    forward, which the tier-1 parity test pins."""
+    prompt: Tuple[int, ...]
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+    request_id: str = ""
+
+
+@dataclasses.dataclass
+class RequestResult:
+    request_id: str
+    prompt_len: int
+    tokens: List[int]
+    finish_reason: str          # "length" | "eos"
+    queue_wait_s: float
+    prefill_s: float            # duration of the prefill call it rode
+    decode_s: float             # prefill-done → last token
+    total_s: float              # submit → last token
+
+
+@dataclasses.dataclass
+class EngineStats:
+    submitted: int
+    rejected: int
+    completed: int
+    tokens_generated: int
+    peak_active: int
+    queue_depth: int
+    free_blocks: int
+    programs_compiled: int
+    program_budget: int
+
+
+class _Handle:
+    """Future for one in-flight request."""
+
+    def __init__(self, req: Request) -> None:
+        self.req = req
+        self._done = threading.Event()
+        self._result: Optional[RequestResult] = None
+        self._error: Optional[BaseException] = None
+        # timestamps stamped by the engine (monotonic)
+        self.submit_t = 0.0
+        self.admit_t = 0.0
+        self.prefill_s = 0.0
+        self.prefill_done_t = 0.0
+
+    def _finish(self, result: RequestResult) -> None:
+        self._result = result
+        self._done.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        self._error = exc
+        self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> RequestResult:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.req.request_id!r} not done in {timeout}s")
+        if self._error is not None:
+            raise self._error
+        assert self._result is not None
+        return self._result
+
+
+class _Active:
+    """Scheduler-private state of one running sequence."""
+
+    __slots__ = ("handle", "blocks", "prompt_len", "out", "last_token")
+
+    def __init__(self, handle: _Handle, blocks: List[int],
+                 prompt_len: int) -> None:
+        self.handle = handle
+        self.blocks = blocks
+        self.prompt_len = prompt_len
+        self.out: List[int] = []
+        self.last_token = -1
+
+
+class InferenceEngine:
+    """Continuous-batching GPT server over a paged KV cache.
+
+    One scheduler thread (named ``serving-engine`` — the conftest
+    thread-leak fixture knows it) owns all device work; request threads
+    only touch the queue and their handle. Use as a context manager or
+    call :meth:`close` — the thread must be joined.
+    """
+
+    def __init__(self, params: gpt.Params, model_cfg: gpt.GPTConfig, *,
+                 buckets: Optional[BucketSpec] = None,
+                 cache: Optional[KVCacheConfig] = None,
+                 max_queue_depth: int = 64,
+                 telemetry: Any = None) -> None:
+        self.model_cfg = model_cfg
+        self.buckets = buckets or BucketSpec.build(
+            8, min(128, model_cfg.max_seq_len))
+        if self.buckets.max_prefill_len > model_cfg.max_seq_len:
+            raise ValueError(
+                f"prefill bucket {self.buckets.max_prefill_len} exceeds "
+                f"model max_seq_len {model_cfg.max_seq_len}")
+        if cache is None:
+            block = 16
+            cache = KVCacheConfig(
+                num_blocks=self.buckets.max_batch
+                * max(1, math.ceil(model_cfg.max_seq_len / block)),
+                block_size=block)
+        self.cache = cache
+        self.max_queue_depth = int(max_queue_depth)
+
+        self._params = params
+        self._pending_params: Optional[gpt.Params] = None
+        self._allocator = BlockAllocator(cache)
+        self._k_pool, self._v_pool = init_kv_pools(model_cfg, cache)
+        # fixed block-table width: every call sees the same W, so table
+        # shape never causes a retrace
+        self._table_width = max(
+            1, math.ceil(model_cfg.max_seq_len / cache.block_size))
+        self._fwd = jax.jit(gpt.forward_paged, static_argnums=(1,),
+                            donate_argnums=(6, 7))
+
+        registry = getattr(telemetry, "registry", telemetry)
+        self.registry: MetricsRegistry = (
+            registry if isinstance(registry, MetricsRegistry)
+            else MetricsRegistry())
+        tracer = getattr(telemetry, "tracer", None)
+        self._span = (tracer.span if tracer is not None
+                      else lambda name, **kw: contextlib.nullcontext())
+        m = self.registry
+        self._h_queue_wait = m.histogram(
+            "serving_queue_wait_seconds", "submit → admitted into the batch")
+        self._h_prefill = m.histogram(
+            "serving_prefill_seconds", "one bucketed prefill call")
+        self._h_decode = m.histogram(
+            "serving_decode_step_seconds", "one bucketed decode step")
+        self._h_total = m.histogram(
+            "serving_request_total_seconds", "submit → last token")
+        self._c_admitted = m.counter(
+            "serving_requests_admitted_total", "requests accepted into queue")
+        self._c_rejected = m.counter(
+            "serving_requests_rejected_total",
+            "admission rejections (queue full → ServerOverloaded)")
+        self._c_completed = m.counter(
+            "serving_requests_completed_total", "requests fully generated")
+        self._c_tokens = m.counter(
+            "serving_tokens_generated_total", "decoded tokens (all requests)")
+        self._g_active = m.gauge(
+            "serving_active_sequences", "sequences in the running batch")
+        self._g_queue = m.gauge(
+            "serving_queue_depth", "requests waiting for admission")
+        self._g_free_blocks = m.gauge(
+            "serving_free_kv_blocks", "unallocated KV pool blocks")
+        self._g_free_blocks.set(self._allocator.free_blocks())
+
+        self._cond = threading.Condition()
+        self._queue: collections.deque[_Handle] = collections.deque()
+        self._active: List[_Active] = []
+        self._stop = False
+        self._warming = False
+        self._busy = False  # scheduler outside its wait with device work
+        self._fatal: Optional[BaseException] = None
+        self._submitted = 0
+        self._completed = 0
+        self._total_tokens = 0
+        self._peak_active = 0
+        self._req_seq = 0
+        self._thread = threading.Thread(target=self._run,
+                                        name="serving-engine", daemon=True)
+        self._thread.start()
+
+    @classmethod
+    def from_serving_config(cls, params: gpt.Params,
+                            model_cfg: gpt.GPTConfig, scfg: Any, *,
+                            telemetry: Any = None) -> "InferenceEngine":
+        """Build an engine from a config/experiment.py ServingConfig
+        (the `serving:` block of an experiment YAML)."""
+        buckets = BucketSpec.build(
+            scfg.max_batch, min(scfg.max_prefill_len, model_cfg.max_seq_len))
+        blocks = scfg.kv_blocks or scfg.max_batch * max(
+            1, math.ceil(model_cfg.max_seq_len / scfg.kv_block_size))
+        return cls(params, model_cfg, buckets=buckets,
+                   cache=KVCacheConfig(num_blocks=blocks,
+                                       block_size=scfg.kv_block_size),
+                   max_queue_depth=scfg.max_queue_depth,
+                   telemetry=telemetry)
+
+    # -- client surface ----------------------------------------------------
+
+    def __enter__(self) -> "InferenceEngine":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    def close(self, timeout: float = 30.0) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout)
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 16, *,
+               eos_token_id: Optional[int] = None,
+               request_id: Optional[str] = None) -> _Handle:
+        """Enqueue one request. Raises ValueError for never-servable
+        requests and ServerOverloaded when the queue is full."""
+        prompt = tuple(int(t) for t in prompt)
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, "
+                             f"got {max_new_tokens}")
+        if len(prompt) > self.buckets.max_prefill_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds the largest prefill "
+                f"bucket {self.buckets.max_prefill_len}")
+        total = len(prompt) + max_new_tokens
+        if total > self.model_cfg.max_seq_len:
+            raise ValueError(
+                f"prompt + max_new_tokens = {total} exceeds model "
+                f"max_seq_len {self.model_cfg.max_seq_len}")
+        with self._cond:
+            if self._fatal is not None:
+                raise RuntimeError("serving engine died") from self._fatal
+            if self._stop:
+                raise RuntimeError("serving engine is closed")
+            if len(self._queue) >= self.max_queue_depth:
+                self._c_rejected.inc()
+                raise ServerOverloaded(
+                    f"queue full ({self.max_queue_depth} waiting)")
+            self._req_seq += 1
+            rid = request_id or f"req-{self._req_seq}"
+            handle = _Handle(Request(prompt, int(max_new_tokens),
+                                     eos_token_id, rid))
+            handle.submit_t = time.monotonic()
+            self._queue.append(handle)
+            self._submitted += 1
+            self._c_admitted.inc()
+            self._g_queue.set(len(self._queue))
+            self._cond.notify_all()
+        return handle
+
+    def submit_with_backoff(self, prompt: Sequence[int],
+                            max_new_tokens: int = 16, *,
+                            eos_token_id: Optional[int] = None,
+                            request_id: Optional[str] = None,
+                            policy: RetryPolicy = ADMISSION_RETRY) -> _Handle:
+        """submit() under the repo-standard retry/backoff policy: full-
+        jitter exponential backoff on ServerOverloaded, re-raised on
+        exhaustion. The client half of admission control."""
+        return retry_call(self.submit, prompt, max_new_tokens,
+                          eos_token_id=eos_token_id, request_id=request_id,
+                          policy=policy)
+
+    def generate(self, prompt: Sequence[int], max_new_tokens: int = 16, *,
+                 eos_token_id: Optional[int] = None,
+                 timeout: Optional[float] = 120.0) -> RequestResult:
+        return self.submit(prompt, max_new_tokens,
+                           eos_token_id=eos_token_id).result(timeout)
+
+    # -- model hot-swap ----------------------------------------------------
+
+    def hot_swap(self, params: gpt.Params) -> None:
+        """Queue a new parameter pytree; the scheduler installs it at the
+        next iteration boundary (never mid-step), so in-flight sequences
+        finish under whichever params their next step sees — the standard
+        online-swap semantics."""
+        with self._cond:
+            self._pending_params = params
+            self._cond.notify_all()
+
+    def hot_load(self, storage: Any, storage_id: str, *,
+                 base_tmp: Optional[str] = None,
+                 ckpt_subdir: str = "") -> float:
+        """Hot-load a checkpoint from a StorageManager (CAS-backed
+        managers reuse their chunk cache, making repeat loads cheap) and
+        swap it in. Returns the load wall-time in seconds."""
+        from determined_clone_tpu.core._serialization import load_pytree
+
+        t0 = time.monotonic()
+        with self._span("serving_hot_load", storage_id=storage_id):
+            with storage.restore_path(storage_id, base_tmp) as d:
+                src = os.path.join(d, ckpt_subdir) if ckpt_subdir else d
+                new_params = load_pytree(src, like=self._params)
+        self.hot_swap(new_params)
+        dt = time.monotonic() - t0
+        self.registry.histogram(
+            "serving_hot_load_seconds",
+            "checkpoint fetch + deserialize + swap").observe(dt)
+        return dt
+
+    def warmup(self) -> int:
+        """Pre-compile the FULL bucket ladder — one prefill program per
+        (batch-bucket, length-bucket) plus one decode program per
+        batch-bucket — so no request ever pays an XLA compile. A warm
+        burst only covers the shapes the burst happens to hit; paced
+        arrivals later trickle into the running batch one or two at a
+        time and exercise the small batch-bucket prefills for the first
+        time, stalling the whole scheduler behind a mid-traffic compile
+        that can dwarf the actual work. Serving stacks precompile at
+        startup for exactly this reason.
+
+        The dummy inputs are fully masked (``token_mask`` all False), so
+        nothing is written to the KV pools — warmup is invisible to
+        every later request. Requires an idle engine; the scheduler is
+        parked for the duration (racing submits queue up and are served
+        once warmup finishes). Returns :meth:`programs_compiled`, which
+        now equals ``buckets.program_budget``.
+        """
+        with self._cond:
+            self._await_idle_locked("warmup")
+            self._warming = True
+        t0 = time.monotonic()
+        try:
+            with self._span("serving_warmup"):
+                for b in self.buckets.batch_buckets:
+                    tables = jnp.zeros((b, self._table_width), jnp.int32)
+                    for t in (*self.buckets.prefill_len_buckets, 1):
+                        logits, self._k_pool, self._v_pool = self._fwd(
+                            self._params, self.model_cfg,
+                            jnp.zeros((b, t), jnp.int32),
+                            jnp.zeros((b, t), jnp.int32),
+                            jnp.zeros((b, t), bool),
+                            jnp.zeros((b,), jnp.int32),
+                            self._k_pool, self._v_pool, tables)
+                        # the sampling step is its own (tiny) program per
+                        # batch bucket — leave it cold and the first real
+                        # request pays its compile
+                        jnp.argmax(logits, axis=-1).block_until_ready()
+        finally:
+            with self._cond:
+                self._warming = False
+                self._cond.notify_all()
+        self.registry.histogram(
+            "serving_warmup_seconds",
+            "full bucket-ladder precompile at startup"
+        ).observe(time.monotonic() - t0)
+        return self.programs_compiled()
+
+    def _await_idle_locked(self, what: str) -> None:
+        """Under ``self._cond``: refuse if traffic is queued or running,
+        and wait out the scheduler's in-flight device call (queue and
+        active both look empty while a prefill is on the device — the
+        ``_busy`` flag covers that window, or donated pools would be
+        used from two threads at once)."""
+        if self._stop:
+            raise RuntimeError("serving engine is closed")
+        if self._fatal is not None:
+            raise RuntimeError("serving engine died") from self._fatal
+        if self._queue or self._active:
+            raise RuntimeError(f"{what} requires an idle engine")
+        while self._busy and not self._stop and self._fatal is None:
+            self._cond.wait()
+        if self._stop:
+            raise RuntimeError("serving engine is closed")
+        if self._fatal is not None:
+            raise RuntimeError("serving engine died") from self._fatal
+        if self._queue or self._active:
+            raise RuntimeError(f"{what} requires an idle engine")
+
+    # -- introspection -----------------------------------------------------
+
+    def programs_compiled(self) -> int:
+        """XLA programs behind the shared jitted forward (the PR 2
+        retrace probe). The tier-1 compile-discipline test asserts this
+        never exceeds ``buckets.program_budget``."""
+        probe = getattr(self._fwd, "_cache_size", None)
+        return int(probe()) if callable(probe) else -1
+
+    def stats(self) -> EngineStats:
+        with self._cond:
+            return EngineStats(
+                submitted=self._submitted,
+                rejected=int(self._c_rejected.value),
+                completed=self._completed,
+                tokens_generated=self._total_tokens,
+                peak_active=self._peak_active,
+                queue_depth=len(self._queue),
+                free_blocks=self._allocator.free_blocks(),
+                programs_compiled=self.programs_compiled(),
+                program_budget=self.buckets.program_budget)
+
+    # -- scheduler ---------------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            while True:
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()  # wakes warmup's idle wait
+                    while (not self._stop
+                           and (self._warming
+                                or (not self._queue and not self._active
+                                    and self._pending_params is None))):
+                        self._cond.wait()
+                    if self._stop:
+                        for h in self._queue:
+                            h._fail(RuntimeError("serving engine closed"))
+                        self._queue.clear()
+                        for a in self._active:
+                            a.handle._fail(
+                                RuntimeError("serving engine closed"))
+                        self._active.clear()
+                        return
+                    if self._pending_params is not None:
+                        self._params = self._pending_params
+                        self._pending_params = None
+                    newcomers = self._admit_locked()
+                    self._busy = True
+                if newcomers:
+                    self._prefill(newcomers)
+                if self._active:
+                    self._decode_step()
+        except BaseException as exc:  # noqa: BLE001 — fail every waiter
+            with self._cond:
+                self._fatal = exc
+                self._busy = False
+                self._cond.notify_all()
+                for h in self._queue:
+                    h._fail(exc)
+                self._queue.clear()
+                for a in self._active:
+                    a.handle._fail(exc)
+                self._active.clear()
+
+    def _admit_locked(self) -> List[_Active]:
+        """Move queued requests into the batch while slots AND pool
+        blocks allow. FIFO — a head-of-line request the pool can't fit
+        yet blocks later ones (no starvation by bypass)."""
+        newcomers: List[_Active] = []
+        now = time.monotonic()
+        while self._queue and len(self._active) + len(newcomers) \
+                < self.buckets.max_batch:
+            head = self._queue[0]
+            total = len(head.req.prompt) + head.req.max_new_tokens
+            if not self._allocator.can_allocate(total):
+                break
+            self._queue.popleft()
+            head.admit_t = now
+            self._h_queue_wait.observe(now - head.submit_t)
+            blocks = self._allocator.allocate(total)
+            newcomers.append(_Active(head, blocks, len(head.req.prompt)))
+        self._g_queue.set(len(self._queue))
+        self._g_free_blocks.set(self._allocator.free_blocks())
+        return newcomers
+
+    def _tables_for(self, rows: Sequence[_Active], padded_b: int
+                    ) -> jnp.ndarray:
+        tables = np.zeros((padded_b, self._table_width), np.int32)
+        for i, a in enumerate(rows):
+            tables[i, :len(a.blocks)] = a.blocks
+        return jnp.asarray(tables)
+
+    def _prefill(self, rows: List[_Active]) -> None:
+        """One bucketed prefill call for the newcomers; samples each
+        row's first token."""
+        b = bucket_for(len(rows), self.buckets.batch_buckets)
+        t = bucket_for(max(a.prompt_len for a in rows),
+                       self.buckets.prefill_len_buckets)
+        tok = np.zeros((b, t), np.int32)
+        pos = np.zeros((b, t), np.int32)
+        msk = np.zeros((b, t), bool)
+        last = np.zeros((b,), np.int32)
+        for i, a in enumerate(rows):
+            n = a.prompt_len
+            tok[i, :n] = a.handle.req.prompt
+            pos[i, :n] = np.arange(n)
+            msk[i, :n] = True
+            last[i] = n - 1
+        t0 = time.monotonic()
+        with self._span("serving_prefill", batch=b, length=t):
+            logits, self._k_pool, self._v_pool = self._fwd(
+                self._params, self.model_cfg, jnp.asarray(tok),
+                jnp.asarray(pos), jnp.asarray(msk), jnp.asarray(last),
+                self._k_pool, self._v_pool, self._tables_for(rows, b))
+            first = np.asarray(jnp.argmax(logits, axis=-1))
+        dt = time.monotonic() - t0
+        self._h_prefill.observe(dt)
+        done_t = time.monotonic()
+        still_running: List[_Active] = []
+        for i, a in enumerate(rows):
+            a.handle.prefill_s = dt
+            a.handle.prefill_done_t = done_t
+            a.out.append(int(first[i]))
+            a.last_token = int(first[i])
+            if not self._maybe_finish(a):
+                still_running.append(a)
+        with self._cond:
+            self._active.extend(still_running)
+            self._peak_active = max(self._peak_active, len(self._active))
+            self._g_active.set(len(self._active))
+
+    def _decode_step(self) -> None:
+        """One decode iteration for every active sequence: append each
+        row's last sampled token to the pool, sample the next."""
+        rows = list(self._active)
+        b = bucket_for(len(rows), self.buckets.batch_buckets)
+        tok = np.zeros((b, 1), np.int32)
+        pos = np.zeros((b, 1), np.int32)
+        msk = np.zeros((b, 1), bool)
+        for i, a in enumerate(rows):
+            tok[i, 0] = a.last_token
+            pos[i, 0] = a.prompt_len + len(a.out) - 1
+            msk[i, 0] = True
+        t0 = time.monotonic()
+        with self._span("serving_decode_step", batch=b, rows=len(rows)):
+            logits, self._k_pool, self._v_pool = self._fwd(
+                self._params, self.model_cfg, jnp.asarray(tok),
+                jnp.asarray(pos), jnp.asarray(msk),
+                jnp.zeros((b,), jnp.int32),
+                self._k_pool, self._v_pool, self._tables_for(rows, b))
+            nxt = np.asarray(jnp.argmax(logits, axis=-1))
+        self._h_decode.observe(time.monotonic() - t0)
+        survivors: List[_Active] = []
+        for i, a in enumerate(rows):
+            a.out.append(int(nxt[i]))
+            a.last_token = int(nxt[i])
+            if not self._maybe_finish(a):
+                survivors.append(a)
+        with self._cond:
+            self._active = survivors
+            self._g_active.set(len(self._active))
+            self._g_free_blocks.set(self._allocator.free_blocks())
+
+    def _maybe_finish(self, a: _Active) -> bool:
+        req = a.handle.req
+        reason = None
+        if req.eos_token_id is not None and a.last_token == req.eos_token_id:
+            reason = "eos"
+        elif len(a.out) >= req.max_new_tokens:
+            reason = "length"
+        if reason is None:
+            return False
+        now = time.monotonic()
+        self._allocator.release(a.blocks)
+        result = RequestResult(
+            request_id=req.request_id,
+            prompt_len=a.prompt_len,
+            tokens=list(a.out),
+            finish_reason=reason,
+            queue_wait_s=a.handle.admit_t - a.handle.submit_t,
+            prefill_s=a.handle.prefill_s,
+            decode_s=now - a.handle.prefill_done_t,
+            total_s=now - a.handle.submit_t)
+        self._h_total.observe(result.total_s)
+        self._c_completed.inc()
+        self._c_tokens.inc(len(a.out))
+        with self._cond:
+            self._completed += 1
+            self._total_tokens += len(a.out)
+        a.handle._finish(result)
+        return True
+
+    # -- static (run-to-completion) baseline -------------------------------
+
+    def run_static(self, requests: Sequence[Tuple[Sequence[int], int]], *,
+                   arrivals: Optional[Sequence[float]] = None,
+                   timeout: Optional[float] = 300.0
+                   ) -> List[RequestResult]:
+        """Serve ``requests`` [(prompt, max_new_tokens), ...] the
+        pre-continuous-batching way: FIFO groups of up to ``max_batch``,
+        each run to completion (every decode step runs until the LAST
+        member of the group finishes — early finishers burn batch slots),
+        and no one joins a running group. Uses the very same jitted
+        programs and pool as the continuous path, so bench comparisons
+        isolate the *scheduling* policy. ``arrivals`` (seconds from call
+        start, ascending) simulates offered load; latency for each
+        request counts from its arrival instant.
+
+        The engine must be idle (nothing queued or running) — this is a
+        benchmarking harness, not a second serving mode.
+        """
+        with self._cond:
+            self._await_idle_locked("run_static")
+        arrivals = list(arrivals) if arrivals is not None \
+            else [0.0] * len(requests)
+        if len(arrivals) != len(requests):
+            raise ValueError("arrivals must match requests")
+        pending = sorted(
+            ((arr, i, tuple(int(t) for t in p), int(mx))
+             for i, ((p, mx), arr) in enumerate(zip(requests, arrivals))),
+            key=lambda x: (x[0], x[1]))
+        results: List[Optional[RequestResult]] = [None] * len(requests)
+        t0 = time.monotonic()
+        while pending:
+            now = time.monotonic() - t0
+            if pending[0][0] > now:
+                time.sleep(min(pending[0][0] - now, 0.05))
+                continue
+            group = []
+            while (pending and len(group) < self.buckets.max_batch
+                   and pending[0][0] <= now):
+                group.append(pending.pop(0))
+            rows = []
+            for arr, i, prompt, max_new in group:
+                h = _Handle(Request(prompt, max_new, None, f"static-{i}"))
+                h.submit_t = t0 + arr
+                h.admit_t = time.monotonic()
+                rows.append(_Active(h, self._allocator.allocate(
+                    len(prompt) + max_new), len(prompt)))
+            self._static_group(rows)
+            for (arr, i, _, _), a in zip(group, rows):
+                end = time.monotonic()
+                self._allocator.release(a.blocks)
+                results[i] = RequestResult(
+                    request_id=f"static-{i}", prompt_len=a.prompt_len,
+                    tokens=list(a.out), finish_reason="length",
+                    queue_wait_s=a.handle.admit_t - a.handle.submit_t,
+                    prefill_s=a.handle.prefill_s,
+                    decode_s=end - a.handle.prefill_done_t,
+                    total_s=end - a.handle.submit_t)
+            if timeout is not None and time.monotonic() - t0 > timeout:
+                raise TimeoutError("run_static exceeded its timeout")
+        return [r for r in results if r is not None]
+
+    def _static_group(self, rows: List[_Active]) -> None:
+        """Prefill + decode one group run-to-completion: every step runs
+        at the full group batch until the slowest member finishes;
+        finished rows are masked (no pool writes) but keep burning their
+        slot — the static-batching cost the continuous scheduler
+        eliminates."""
+        b = bucket_for(len(rows), self.buckets.batch_buckets)
+        t = bucket_for(max(a.prompt_len for a in rows),
+                       self.buckets.prefill_len_buckets)
+        tok = np.zeros((b, t), np.int32)
+        pos = np.zeros((b, t), np.int32)
+        msk = np.zeros((b, t), bool)
+        last = np.zeros((b,), np.int32)
+        for i, a in enumerate(rows):
+            n = a.prompt_len
+            tok[i, :n] = a.handle.req.prompt
+            pos[i, :n] = np.arange(n)
+            msk[i, :n] = True
+            last[i] = n - 1
+        tables = self._tables_for(rows, b)
+        t0 = time.monotonic()
+        logits, self._k_pool, self._v_pool = self._fwd(
+            self._params, self.model_cfg, jnp.asarray(tok), jnp.asarray(pos),
+            jnp.asarray(msk), jnp.asarray(last),
+            self._k_pool, self._v_pool, tables)
+        first = np.asarray(jnp.argmax(logits, axis=-1))
+        dt = time.monotonic() - t0
+        done_t = time.monotonic()
+        for i, a in enumerate(rows):
+            a.handle.prefill_s = dt
+            a.handle.prefill_done_t = done_t
+            a.out.append(int(first[i]))
+            a.last_token = int(first[i])
+        group_max = max(a.handle.req.max_new_tokens for a in rows)
+        for _ in range(group_max - 1):
+            tok1 = np.zeros((b, 1), np.int32)
+            pos1 = np.zeros((b, 1), np.int32)
+            msk1 = np.zeros((b, 1), bool)
+            for i, a in enumerate(rows):
+                running = len(a.out) < a.handle.req.max_new_tokens
+                tok1[i, 0] = a.last_token
+                pos1[i, 0] = a.prompt_len + len(a.out) - 1
+                msk1[i, 0] = running
+            logits, self._k_pool, self._v_pool = self._fwd(
+                self._params, self.model_cfg, jnp.asarray(tok1),
+                jnp.asarray(pos1), jnp.asarray(msk1),
+                jnp.zeros((b,), jnp.int32),
+                self._k_pool, self._v_pool, tables)
+            nxt = np.asarray(jnp.argmax(logits, axis=-1))
+            for i, a in enumerate(rows):
+                if len(a.out) < a.handle.req.max_new_tokens:
+                    a.out.append(int(nxt[i]))
+                    a.last_token = int(nxt[i])

@@ -1,0 +1,106 @@
+"""Paged KV cache: a preallocated block pool plus per-sequence block tables.
+
+vLLM's PagedAttention memory model, sized for the engine at startup and
+never reallocated: the pools are ``[L, num_blocks, block_size, H, hd]``
+device arrays (compute dtype — the exact values ``mha`` would see, which
+is what makes paged decode token-identical to the uncached forward), and
+each admitted sequence owns a list of block ids covering
+``ceil((prompt_len + max_new_tokens) / block_size)`` slots. The
+:class:`BlockAllocator` is plain host-side bookkeeping — a free list —
+because block assignment happens at admission time, outside jit; the
+device side only ever sees dense int32 block tables.
+
+Allocation is all-upfront per sequence (reservation = worst case decode
+length) rather than on-demand per step: simpler, and it converts pool
+exhaustion into *admission-time* backpressure (ServerOverloaded → client
+retry/backoff) instead of a mid-decode eviction story.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+from typing import Any, List, Tuple
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheConfig:
+    num_blocks: int
+    block_size: int
+
+    def __post_init__(self) -> None:
+        if self.num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {self.num_blocks}")
+        if self.block_size < 1 or self.block_size & (self.block_size - 1):
+            raise ValueError(
+                f"block_size must be a power of two, got {self.block_size}")
+
+    def blocks_needed(self, total_len: int) -> int:
+        return max(1, math.ceil(total_len / self.block_size))
+
+    def pool_bytes(self, n_layers: int, n_heads: int, head_dim: int,
+                   dtype_bytes: int = 2) -> int:
+        """K + V pool footprint, for docs/serving.md-style sizing."""
+        return (2 * n_layers * self.num_blocks * self.block_size
+                * n_heads * head_dim * dtype_bytes)
+
+
+def init_kv_pools(cfg: Any, cache: KVCacheConfig) -> Tuple[jnp.ndarray,
+                                                           jnp.ndarray]:
+    """Zero K/V pools [L, N, block, H, hd] in the model's compute dtype.
+
+    Zeros (not garbage) so never-written slots contribute exactly
+    0-probability * 0-value under the attention mask — see
+    models/gpt.py:forward_paged's parity contract.
+    """
+    shape = (cfg.n_layers, cache.num_blocks, cache.block_size,
+             cfg.n_heads, cfg.head_dim)
+    return (jnp.zeros(shape, cfg.compute_dtype),
+            jnp.zeros(shape, cfg.compute_dtype))
+
+
+class BlockAllocator:
+    """Thread-safe free-list over the pool's block ids.
+
+    The engine's scheduler thread allocates at admission and frees at
+    retirement; the HTTP threads only observe :meth:`free_blocks` for
+    backpressure headroom, hence the lock.
+    """
+
+    def __init__(self, cache: KVCacheConfig) -> None:
+        self._cache = cache
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(cache.num_blocks - 1, -1, -1))
+
+    @property
+    def num_blocks(self) -> int:
+        return self._cache.num_blocks
+
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def can_allocate(self, total_len: int) -> bool:
+        return self.free_blocks() >= self._cache.blocks_needed(total_len)
+
+    def allocate(self, total_len: int) -> List[int]:
+        """Reserve blocks covering ``total_len`` positions; raises
+        MemoryError when the pool can't — the engine maps that to
+        ServerOverloaded (admission backpressure)."""
+        need = self._cache.blocks_needed(total_len)
+        with self._lock:
+            if need > len(self._free):
+                raise MemoryError(
+                    f"KV pool exhausted: need {need} blocks, "
+                    f"{len(self._free)}/{self._cache.num_blocks} free")
+            got = [self._free.pop() for _ in range(need)]
+        return got
+
+    def release(self, blocks: List[int]) -> None:
+        with self._lock:
+            for b in blocks:
+                if not 0 <= b < self._cache.num_blocks or b in self._free:
+                    raise ValueError(f"double/bogus free of block {b}")
+                self._free.append(b)

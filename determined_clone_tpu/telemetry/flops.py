@@ -175,6 +175,61 @@ def gpt_train_step_flops(cfg: Any, batch_size: int,
     )
 
 
+def gpt_prefill_flops(cfg: Any, prompt_len: int) -> Dict[str, float]:
+    """Forward FLOPs of one serving prefill over a ``prompt_len`` prompt.
+
+    Same accounting convention as training (full [T, S] score matmul —
+    masking saves nothing arithmetically): each of the P prompt tokens
+    costs ``attention(s=P) + mlp + embedding``, so the call total is just
+    P times the per-token forward breakdown at sequence length P. Keys
+    are component totals for the whole call, plus ``"total"``.
+    """
+    per_tok = gpt_forward_flops_per_token(cfg, int(prompt_len))
+    out = {k: v * float(prompt_len) for k, v in per_tok.items()}
+    out["total"] = sum(out.values())
+    return out
+
+
+def gpt_decode_flops_per_token(cfg: Any, context_len: int) -> Dict[str, float]:
+    """Forward FLOPs of ONE incremental decode step at KV-cache context
+    length ``context_len`` — the formula that makes serving MFU honest.
+
+    With the KV cache, the new token pays the full projections
+    (``L·8d²``) and MLP (``L·4df``) but its attention mix is linear in
+    the *context*, not quadratic in the sequence: scores ``[1, c]`` and
+    the value mix cost ``L·4·c·d`` (2cd QKᵀ + 2cd PV per layer). Compare
+    :func:`gpt_prefill_flops`, where every prompt token pays ``4·P·d`` —
+    the asymmetry is exactly why serving splits prefill from decode.
+    """
+    c = float(context_len)
+    out = {
+        "attention": cfg.n_layers * (8.0 * cfg.d_model * cfg.d_model
+                                     + 4.0 * c * cfg.d_model),
+        "mlp": mlp_flops_per_token(
+            cfg.d_model, cfg.d_ff, cfg.n_layers,
+            moe_experts=getattr(cfg, "moe_experts", 0),
+            moe_k=getattr(cfg, "moe_k", 2)),
+        "embedding": embedding_flops_per_token(cfg.d_model, cfg.vocab_size),
+    }
+    out["total"] = sum(out.values())
+    return out
+
+
+def gpt_generation_flops(cfg: Any, prompt_len: int,
+                         new_tokens: int) -> float:
+    """Total forward FLOPs to serve one request: one prefill of
+    ``prompt_len`` plus ``new_tokens - 1`` incremental decode steps (the
+    first generated token falls out of the prefill logits; decode step j
+    runs at context ``prompt_len + j``). The serving bench divides the
+    sum of this over all completed requests by wall-clock for a real
+    tokens-level MFU."""
+    p, n = int(prompt_len), int(new_tokens)
+    total = gpt_prefill_flops(cfg, p)["total"]
+    for j in range(1, n):
+        total += gpt_decode_flops_per_token(cfg, p + j)["total"]
+    return total
+
+
 def dense_train_flops_per_token(n_params: int) -> float:
     """The ``6 * N`` approximation for configs we can't decompose."""
     return 6.0 * float(n_params)

@@ -35,6 +35,11 @@
 # job-queue counter checks and the synthetic load harness. Every test in
 # the lane skips cleanly when the C++ master build is unavailable.
 #
+# `./run_tests.sh --serving` runs the online-inference surface
+# (docs/serving.md): the continuous-batching engine, paged-KV parity and
+# compile discipline, the HTTP surface, the KV-cached decode FLOPs
+# accounting, and the batch-inference dropped-example counter.
+#
 # `./run_tests.sh --bench-gate` compares the two newest BENCH_r*.json
 # rounds via tools/bench_gate.py (default -5% samples/sec tolerance; the
 # new round must carry a non-null mfu — docs/observability.md).
@@ -61,6 +66,10 @@ elif [ "$1" = "--control-plane" ]; then
     shift
     set -- tests/test_control_plane.py tests/test_load_smoke.py \
         tests/test_job_queue.py \
+        -m "not slow" "$@"
+elif [ "$1" = "--serving" ]; then
+    shift
+    set -- tests/test_serving.py tests/test_batch_inference.py \
         -m "not slow" "$@"
 elif [ "$1" = "--observability" ]; then
     shift

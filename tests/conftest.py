@@ -37,6 +37,7 @@ def pytest_configure(config):
 _LIBRARY_THREAD_PREFIXES = (
     "train-prefetch", "eval-prefetch", "device-prefetch",
     "profiler-", "ckpt-upload", "tb-sync",
+    "serving-engine", "serving-http",
 )
 
 # Deliberately process-lifetime daemon threads: the shared transfer pool's

@@ -136,3 +136,68 @@ def test_max_batches_cap(tmp_path):
                                    max_batches=3)
     assert result["batches_processed"] == 3
     assert result["total_batches"] == 3
+
+
+def test_dropped_examples_counted_and_warned(tmp_path, caplog):
+    """max_batches clipping drops the tail examples — used to be silent;
+    now counted exactly in the summary and warned once per process."""
+    import logging
+
+    import determined_clone_tpu.batch_inference as bi
+
+    class P(Collector):
+        seen = []
+
+    bi._dropped_warned = False
+    with contextlib.ExitStack() as stack:
+        ctx = stack.enter_context(core.init(storage_path=str(tmp_path)))
+        with caplog.at_level(logging.WARNING,
+                             logger="determined_clone_tpu.batch_inference"):
+            result = jax_batch_process(
+                P, list(range(100)), batch_size=10, checkpoint_interval=100,
+                core_context=ctx, max_batches=3)
+            # second run: counter still exact, warning not repeated
+            result2 = jax_batch_process(
+                P, list(range(100)), batch_size=10, checkpoint_interval=100,
+                core_context=ctx, max_batches=3)
+    assert result["examples_dropped"] == 70
+    assert result2["examples_dropped"] == 70
+    warnings = [r for r in caplog.records
+                if "dropped 70 examples" in r.getMessage()]
+    assert len(warnings) == 1, "warn-once contract"
+
+
+def test_no_drop_reports_zero(tmp_path):
+    class P(Collector):
+        seen = []
+
+    with contextlib.ExitStack() as stack:
+        ctx = stack.enter_context(core.init(storage_path=str(tmp_path)))
+        result = jax_batch_process(P, list(range(9)), batch_size=3,
+                                   checkpoint_interval=100, core_context=ctx)
+    assert result["examples_dropped"] == 0
+
+
+def test_resume_with_shrunken_plan_counts_dropped(tmp_path):
+    """A resume whose checkpoint recorded a larger n_batches (dataset
+    shrank / max_batches tightened) silently abandons the difference —
+    the counter now says so."""
+    class P(Collector):
+        seen = []
+
+    dataset = list(range(12))
+    with contextlib.ExitStack() as stack:
+        ctx = stack.enter_context(core.init(storage_path=str(tmp_path)))
+        first = jax_batch_process(P, dataset, batch_size=2,
+                                  checkpoint_interval=100, core_context=ctx)
+        assert first["total_batches"] == 6
+
+        class P2(Collector):
+            seen = []
+
+        second = jax_batch_process(
+            P2, dataset, batch_size=2, checkpoint_interval=100,
+            core_context=ctx, max_batches=4,
+            latest_checkpoint=first["storage_id"])
+    # 2 planned batches vanished from the resume plan = 4 examples
+    assert second["examples_dropped"] == 4

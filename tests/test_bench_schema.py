@@ -106,6 +106,33 @@ def test_goodput_section_schema(bench_result):
     assert cats["checkpoint_save"] > 0  # the mini-run commits at batch 8
 
 
+def test_serving_section_schema(bench_result):
+    """The serving section (serving/engine.py measured by bench's
+    latency-vs-load sweep): non-null tokens/sec and p50/p99 at >= 3
+    offered loads, continuous batching beating the static
+    run-to-completion baseline at the highest load in the same run, and
+    the compile count inside the bucket budget — the serving-lane
+    acceptance criteria, pinned against the real child."""
+    sv = bench_result["detail"]["serving"]
+    assert sv.get("error") is None, sv
+    points = sv["load_points"]
+    assert len(points) >= 3
+    rates = [p["offered_rps"] for p in points]
+    assert rates == sorted(rates) and len(set(rates)) == len(rates)
+    for p in points:
+        assert p["tokens_per_sec"] > 0
+        assert p["p50_total_s"] > 0
+        assert p["p99_total_s"] >= p["p50_total_s"]
+        assert p["completed"] == sv["requests"]
+    assert sv["static"]["tokens_per_sec"] > 0
+    # the point of continuous batching — same programs, same pool, same
+    # request set; only the scheduling policy differs
+    assert sv["continuous_over_static"] > 1.0, sv
+    assert 0 < sv["programs_compiled"] <= sv["program_budget"]
+    assert sv["serving_mfu"] > 0
+    assert ":" in sv["mfu_peak_assumed"]
+
+
 def test_gate_accepts_fresh_round(bench_result):
     """The regression gate passes a round against itself and prints the
     advisory xla + goodput lines — wiring proof that gate and schema
@@ -116,6 +143,7 @@ def test_gate_accepts_fresh_round(bench_result):
     assert ok, report
     assert any(line.startswith("ok: xla compile=") for line in report)
     assert any(line.startswith("ok: goodput fraction=") for line in report)
+    assert any(line.startswith("ok: serving ") for line in report)
     assert not any(line.startswith("WARN:") for line in report)
 
 

@@ -1,0 +1,362 @@
+"""Continuous-batching serving surface (docs/serving.md): paged-KV
+parity against the uncached forward, compile discipline under the bucket
+budget, admission control/backpressure, checkpoint hot-load, the static
+run-to-completion baseline, the HTTP front-end, and the KV-cached decode
+FLOPs accounting that makes serving MFU honest."""
+import json
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from determined_clone_tpu.core._serialization import save_pytree
+from determined_clone_tpu.models import gpt
+from determined_clone_tpu.serving import (
+    BlockAllocator,
+    BucketSpec,
+    InferenceEngine,
+    KVCacheConfig,
+    ServerOverloaded,
+    bucket_for,
+    pow2_buckets,
+)
+from determined_clone_tpu.serving.http import (
+    ServingHTTPServer,
+    generate_over_http,
+)
+from determined_clone_tpu.storage import (
+    CASStorageManager,
+    SharedFSStorageManager,
+)
+from determined_clone_tpu.telemetry import flops as flops_mod
+from determined_clone_tpu.utils.retry import RetryPolicy
+
+CFG = gpt.GPTConfig(vocab_size=97, n_layers=2, d_model=32, n_heads=4,
+                    d_ff=64, max_seq_len=48, remat=False,
+                    attention_impl="mha")
+
+BUCKETS = BucketSpec.build(4, 16)
+CACHE = KVCacheConfig(num_blocks=16, block_size=8)
+
+# mixed lengths on purpose: the parity + compile-discipline tests must
+# exercise several (batch, prompt-length) shapes
+PROMPTS = [[5, 17, 3, 88, 41], [9] * 11, [1, 2, 3]]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt.init(jax.random.PRNGKey(0), CFG)
+
+
+def naive_greedy(params, prompt, max_new):
+    """Reference decode: full-context uncached forward every step."""
+    toks = list(prompt)
+    for _ in range(max_new):
+        logits = gpt.apply(params, CFG, jnp.asarray([toks], jnp.int32))
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+def make_engine(params, **kw):
+    kw.setdefault("buckets", BUCKETS)
+    kw.setdefault("cache", CACHE)
+    return InferenceEngine(params, CFG, **kw)
+
+
+# -- bucketing / allocator units --------------------------------------------
+
+def test_pow2_buckets():
+    assert pow2_buckets(1, 8) == (1, 2, 4, 8)
+    assert pow2_buckets(4, 100) == (4, 8, 16, 32, 64, 128)
+    assert bucket_for(5, (4, 8, 16)) == 8
+    assert bucket_for(8, (4, 8, 16)) == 8
+    with pytest.raises(ValueError):
+        bucket_for(17, (4, 8, 16))
+    with pytest.raises(ValueError):
+        pow2_buckets(0, 4)
+
+
+def test_bucket_spec_validation_and_budget():
+    spec = BucketSpec(batch_buckets=(1, 2, 4), prefill_len_buckets=(8, 16))
+    assert spec.max_batch == 4
+    assert spec.max_prefill_len == 16
+    assert spec.program_budget == 3 * 2 + 3
+    with pytest.raises(ValueError):
+        BucketSpec(batch_buckets=(3,), prefill_len_buckets=(8,))
+    with pytest.raises(ValueError):
+        BucketSpec(batch_buckets=(4, 2), prefill_len_buckets=(8,))
+    with pytest.raises(ValueError):
+        BucketSpec(batch_buckets=(), prefill_len_buckets=(8,))
+
+
+def test_block_allocator():
+    alloc = BlockAllocator(KVCacheConfig(num_blocks=4, block_size=8))
+    assert alloc.free_blocks() == 4
+    a = alloc.allocate(17)  # 3 blocks
+    assert len(a) == 3 and alloc.free_blocks() == 1
+    assert alloc.can_allocate(8) and not alloc.can_allocate(9)
+    with pytest.raises(MemoryError):
+        alloc.allocate(16)
+    alloc.release(a)
+    assert alloc.free_blocks() == 4
+    with pytest.raises(ValueError):
+        alloc.release(a[:1])  # double free
+    with pytest.raises(ValueError):
+        alloc.release([99])  # bogus id
+
+
+# -- the tier-1 contract: parity + compile discipline ------------------------
+
+def test_paged_decode_token_identical_and_compile_budget(params):
+    """Mixed-length requests through the continuous scheduler produce
+    EXACTLY the tokens of the naive uncached forward (greedy), and the
+    shared jitted forward never compiles more programs than the bucket
+    budget — the two acceptance properties of the serving tentpole."""
+    expected = {i: naive_greedy(params, p, 12)
+                for i, p in enumerate(PROMPTS)}
+    with make_engine(params) as eng:
+        handles = [eng.submit(p, 12, request_id=str(i))
+                   for i, p in enumerate(PROMPTS)]
+        results = {int(h.result(timeout=120.0).request_id):
+                   h.result(timeout=120.0) for h in handles}
+        # a second wave at different batch sizes exercises more shapes
+        again = [eng.submit(p, 5) for p in PROMPTS[:2]]
+        for h in again:
+            h.result(timeout=120.0)
+        compiled = eng.programs_compiled()
+        budget = eng.buckets.program_budget
+        stats = eng.stats()
+    for i in range(len(PROMPTS)):
+        assert results[i].tokens == expected[i], f"request {i} diverged"
+        assert results[i].finish_reason == "length"
+        assert results[i].prompt_len == len(PROMPTS[i])
+    assert 0 < compiled <= budget, (compiled, budget)
+    assert stats.completed == 5
+    assert stats.tokens_generated == 3 * 12 + 2 * 5
+    assert stats.free_blocks == CACHE.num_blocks  # everything released
+
+
+def test_warmup_precompiles_full_ladder(params):
+    """warmup() compiles EXACTLY the program budget up front, leaves the
+    KV pools untouched (dummy calls are fully masked), and no later
+    traffic — including the one-request-at-a-time arrival pattern that
+    hits the small batch buckets a burst never exercises — adds a
+    single program. The mid-traffic compile stall this prevents is what
+    collapsed the bench's top load point ~10x before warmup existed."""
+    expected = naive_greedy(params, PROMPTS[0], 8)
+    with make_engine(params) as eng:
+        compiled = eng.warmup()
+        assert compiled == eng.buckets.program_budget
+        # trickle: each request admitted alone → batch-bucket-1 prefill,
+        # the shape a warm burst at full batch never compiles
+        for _ in range(2):
+            r = eng.generate(PROMPTS[0], 8)
+            assert r.tokens == expected  # pools uncorrupted by warmup
+        # then a burst at full batch for the other buckets
+        hs = [eng.submit(p, 4) for p in PROMPTS]
+        for h in hs:
+            h.result(timeout=120.0)
+        assert eng.programs_compiled() == compiled  # nothing new to compile
+    with make_engine(params) as eng:
+        # white-box: an un-notified queue entry keeps the scheduler
+        # parked, so the busy engine is observed deterministically
+        eng._queue.append(object())
+        with pytest.raises(RuntimeError, match="idle"):
+            eng.warmup()
+        eng._queue.clear()
+        eng.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            eng.warmup()
+
+
+def test_eos_stops_early(params):
+    ref = naive_greedy(params, PROMPTS[0], 12)
+    eos = ref[3]
+    # the engine stops at the FIRST occurrence of eos (an untrained model
+    # may emit it earlier than position 3 — don't assume distinct tokens)
+    stop = ref.index(eos) + 1
+    with make_engine(params) as eng:
+        r = eng.generate(PROMPTS[0], 12, eos_token_id=eos)
+    assert r.finish_reason == "eos"
+    assert r.tokens == ref[:stop]
+
+
+def test_static_baseline_matches_and_shares_programs(params):
+    """run_static (run-to-completion groups) must emit the same tokens —
+    same params, same greedy rule, same jitted programs — so the bench
+    comparison isolates scheduling policy alone."""
+    expected = [naive_greedy(params, p, n)
+                for p, n in zip(PROMPTS, (4, 9, 2))]
+    with make_engine(params) as eng:
+        out = eng.run_static(list(zip(PROMPTS, (4, 9, 2))), timeout=120.0)
+        compiled = eng.programs_compiled()
+    assert [r.tokens for r in out] == expected
+    assert 0 < compiled <= BUCKETS.program_budget
+
+
+def test_telemetry_spans_and_metrics(params):
+    with make_engine(params) as eng:
+        eng.generate(PROMPTS[0], 4)
+        dump = eng.registry.dump()
+    for name in ("serving_queue_wait_seconds", "serving_prefill_seconds",
+                 "serving_decode_step_seconds",
+                 "serving_request_total_seconds",
+                 "serving_requests_completed_total",
+                 "serving_tokens_generated_total"):
+        assert name in dump, name
+
+
+# -- admission control / backpressure ----------------------------------------
+
+def test_admission_rejects_and_backoff(params):
+    fast = RetryPolicy(name="t", max_attempts=2, base_delay_s=0.01,
+                       multiplier=1.0, max_delay_s=0.01,
+                       retryable=(ServerOverloaded,))
+    with make_engine(params, max_queue_depth=0) as eng:
+        with pytest.raises(ServerOverloaded):
+            eng.submit(PROMPTS[0], 2)
+        with pytest.raises(ServerOverloaded):
+            eng.submit_with_backoff(PROMPTS[0], 2, policy=fast)
+        assert eng.stats().rejected >= 3  # 1 direct + 2 backoff attempts
+
+
+def test_never_servable_requests_rejected_upfront(params):
+    with make_engine(params) as eng:
+        with pytest.raises(ValueError):
+            eng.submit([], 4)  # empty prompt
+        with pytest.raises(ValueError):
+            eng.submit(list(range(17)), 4)  # > largest prefill bucket
+        with pytest.raises(ValueError):
+            eng.submit([1, 2], CFG.max_seq_len)  # total > max_seq_len
+        with pytest.raises(ValueError):
+            eng.submit([1, 2], 0)  # no tokens requested
+
+
+def test_closed_engine_refuses(params):
+    eng = make_engine(params)
+    eng.close()
+    with pytest.raises(RuntimeError):
+        eng.submit(PROMPTS[0], 2)
+
+
+# -- checkpoint hot-load ------------------------------------------------------
+
+def test_hot_load_from_cas_swaps_params(params, tmp_path):
+    """Serve under params A, hot-load params B from a CAS-backed store,
+    and the very next generation must match the naive forward under B —
+    no restart, no re-jit (program count stays bounded)."""
+    params_b = gpt.init(jax.random.PRNGKey(7), CFG)
+    store = CASStorageManager(
+        SharedFSStorageManager(str(tmp_path / "store")))
+    with store.store_path("ck-b", str(tmp_path)) as d:
+        save_pytree(d, params_b)
+    store.commit("ck-b")
+
+    ref_a = naive_greedy(params, PROMPTS[0], 6)
+    with make_engine(params) as eng:
+        assert eng.generate(PROMPTS[0], 6).tokens == ref_a
+        dt = eng.hot_load(store, "ck-b", base_tmp=str(tmp_path))
+        assert dt >= 0.0
+        got = eng.generate(PROMPTS[0], 6).tokens
+        compiled = eng.programs_compiled()
+        # the swap installed the restored tree (greedy token streams of
+        # two untrained models can coincide — check the params, not the
+        # sampled tokens, to prove the swap happened)
+        swapped = jax.tree.leaves(eng._params)
+    ref_b = naive_greedy(params_b, PROMPTS[0], 6)
+    assert got == ref_b
+    leaves_a = jax.tree.leaves(params)
+    leaves_b = jax.tree.leaves(params_b)
+    assert any(not jnp.array_equal(a, b)
+               for a, b in zip(leaves_a, leaves_b))
+    assert all(jnp.array_equal(s, b) for s, b in zip(swapped, leaves_b))
+    assert compiled <= BUCKETS.program_budget
+
+
+# -- HTTP surface -------------------------------------------------------------
+
+def test_http_generate_healthz_metrics(params):
+    ref = naive_greedy(params, PROMPTS[2], 5)
+    with make_engine(params) as eng, ServingHTTPServer(eng) as srv:
+        out = generate_over_http(srv.url, PROMPTS[2], max_new_tokens=5)
+        assert out["tokens"] == ref
+        assert out["finish_reason"] == "length"
+        assert out["latency"]["total_s"] >= 0
+
+        with urllib.request.urlopen(f"{srv.url}/healthz",
+                                    timeout=30) as resp:
+            health = json.loads(resp.read())
+        assert health["ok"] and health["stats"]["completed"] >= 1
+
+        with urllib.request.urlopen(f"{srv.url}/metrics",
+                                    timeout=30) as resp:
+            metrics = resp.read().decode()
+        assert "serving_requests_completed_total" in metrics
+
+
+def test_http_error_codes(params):
+    with make_engine(params) as eng, ServingHTTPServer(eng) as srv:
+        bad = urllib.request.Request(
+            f"{srv.url}/v1/generate", data=b'{"prompt": "nope"}',
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(bad, timeout=30)
+        assert exc.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{srv.url}/nope", timeout=30)
+        assert exc.value.code == 404
+
+
+# -- KV-cached decode FLOPs (telemetry/flops.py) ------------------------------
+
+@dataclass
+class _TinyCfg:
+    d_model: int = 4
+    d_ff: int = 8
+    n_layers: int = 2
+    vocab_size: int = 16
+
+
+def test_decode_flops_hand_computed():
+    """d=4, f=8, L=2, V=16 at context 10, worked by hand:
+    attention = L·(8d² + 4cd) = 2·(128 + 160) = 576
+    mlp       = L·4df         = 2·128        = 256
+    embedding = 2dV           =                128
+    """
+    out = flops_mod.gpt_decode_flops_per_token(_TinyCfg(), 10)
+    assert out["attention"] == 576.0
+    assert out["mlp"] == 256.0
+    assert out["embedding"] == 128.0
+    assert out["total"] == 960.0
+
+
+def test_prefill_flops_hand_computed():
+    """P=4 prompt: per-token at s=4 is 2·(128+64) + 256 + 128 = 768,
+    times 4 tokens = 3072."""
+    out = flops_mod.gpt_prefill_flops(_TinyCfg(), 4)
+    assert out["total"] == 3072.0
+    assert out["attention"] == 4 * 2 * (128 + 64)
+
+
+def test_generation_flops_is_prefill_plus_decode_tail():
+    """prefill(4) + decode@ctx5 + decode@ctx6: the first generated token
+    falls out of the prefill logits, so n=3 pays only 2 decode steps."""
+    cfg = _TinyCfg()
+    total = flops_mod.gpt_generation_flops(cfg, 4, 3)
+    expect = (flops_mod.gpt_prefill_flops(cfg, 4)["total"]
+              + flops_mod.gpt_decode_flops_per_token(cfg, 5)["total"]
+              + flops_mod.gpt_decode_flops_per_token(cfg, 6)["total"])
+    assert total == expect == 3072.0 + 800.0 + 832.0
+
+
+def test_decode_flops_linear_in_context_not_quadratic():
+    """The whole point of the split: decode cost grows linearly with
+    context while prefill per-token cost grows with prompt length."""
+    cfg = _TinyCfg()
+    d1 = flops_mod.gpt_decode_flops_per_token(cfg, 100)["total"]
+    d2 = flops_mod.gpt_decode_flops_per_token(cfg, 200)["total"]
+    d3 = flops_mod.gpt_decode_flops_per_token(cfg, 300)["total"]
+    assert d3 - d2 == d2 - d1  # constant marginal cost per context token

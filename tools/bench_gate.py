@@ -80,7 +80,8 @@ def newest_rounds(directory: str = ".") -> Tuple[str, str]:
 # previous round carried is a skip-with-note, never a gate failure — the
 # headline throughput/mfu checks below are the contract.
 OPTIONAL_SECTIONS = ("control_plane", "checkpoint_io", "pipeline",
-                     "mnist_cnn", "tpu_probe_telemetry", "xla", "goodput")
+                     "mnist_cnn", "tpu_probe_telemetry", "xla", "goodput",
+                     "serving")
 
 
 def _section_notes(old_detail: Dict[str, Any], new_detail: Dict[str, Any],
@@ -206,6 +207,68 @@ def _goodput_lines(old_detail: Dict[str, Any],
                 f"(dropped more than 10 points)")
 
 
+def _serving_lines(old_detail: Dict[str, Any],
+                   new_detail: Dict[str, Any], report: list) -> None:
+    """Advisory serving-section reporting (serving/engine.py measured by
+    bench's latency-vs-load sweep): tokens/sec and p50/p99 at the highest
+    offered load land in the report, with WARNs when the section errored,
+    when continuous batching stopped beating the static run-to-completion
+    baseline (continuous_over_static < 1 — the whole point of the
+    scheduler), or when tokens/sec dropped / p99 grew more than 10%
+    against the previous round at the same offered load. Advisory-only:
+    the tiny-model sweep shares the box with the bench ladder; the
+    enforced contracts are the tier-1 parity and compile-discipline
+    tests."""
+    sv_new = new_detail.get("serving")
+    if not isinstance(sv_new, dict):
+        return
+    if sv_new.get("error"):
+        report.append(f"WARN: serving errored: {sv_new['error']}")
+        return
+    points = [p for p in (sv_new.get("load_points") or [])
+              if isinstance(p, dict)]
+    if not points:
+        report.append("WARN: serving section has no load points")
+        return
+    top = points[-1]
+    report.append(
+        f"ok: serving {len(points)} load points, top "
+        f"{top.get('offered_rps')} req/s: {top.get('tokens_per_sec')} tok/s, "
+        f"p50={top.get('p50_total_s')}s p99={top.get('p99_total_s')}s, "
+        f"programs {sv_new.get('programs_compiled')}/"
+        f"{sv_new.get('program_budget')}")
+    ratio = sv_new.get("continuous_over_static")
+    if isinstance(ratio, (int, float)) and ratio < 1.0:
+        report.append(
+            f"WARN: continuous batching no longer beats static "
+            f"run-to-completion (continuous_over_static={ratio})")
+    sv_old = old_detail.get("serving")
+    if not isinstance(sv_old, dict) or sv_old.get("error"):
+        return
+    old_by_rate = {p.get("offered_rps"): p
+                   for p in (sv_old.get("load_points") or [])
+                   if isinstance(p, dict)}
+    for p in points:
+        q = old_by_rate.get(p.get("offered_rps"))
+        if not isinstance(q, dict):
+            continue
+        rate = p.get("offered_rps")
+        tps_old, tps_new = q.get("tokens_per_sec"), p.get("tokens_per_sec")
+        if (isinstance(tps_old, (int, float)) and tps_old > 0
+                and isinstance(tps_new, (int, float))
+                and tps_new / tps_old - 1.0 < -0.10):
+            report.append(
+                f"WARN: serving tokens/sec at {rate} req/s "
+                f"{tps_old} → {tps_new} ({tps_new / tps_old - 1.0:+.1%})")
+        p99_old, p99_new = q.get("p99_total_s"), p.get("p99_total_s")
+        if (isinstance(p99_old, (int, float)) and p99_old > 0
+                and isinstance(p99_new, (int, float))
+                and p99_new / p99_old - 1.0 > 0.10):
+            report.append(
+                f"WARN: serving p99 at {rate} req/s "
+                f"{p99_old}s → {p99_new}s ({p99_new / p99_old - 1.0:+.1%})")
+
+
 def gate(old: Dict[str, Any], new: Dict[str, Any], *,
          tolerance: float = DEFAULT_TOLERANCE,
          allow_null_mfu: bool = False) -> Tuple[bool, list]:
@@ -256,6 +319,7 @@ def gate(old: Dict[str, Any], new: Dict[str, Any], *,
     _control_plane_lines(old_detail, new_detail, report)
     _xla_lines(old_detail, new_detail, report)
     _goodput_lines(old_detail, new_detail, report)
+    _serving_lines(old_detail, new_detail, report)
     return ok, report
 
 
