@@ -622,6 +622,137 @@ def _run_child() -> None:
         finally:
             engine.close()
 
+    def time_serving_fleet() -> dict:
+        """Throughput scaling of the replica fleet (serving/fleet.py,
+        docs/serving.md): the SAME burst of requests goes through the
+        least-loaded router at 1, 2 and 4 replicas. Replicas share one
+        host core here, so raw compute would not scale; each engine
+        paces iterations with a simulated device-step floor instead,
+        making a replica's ceiling ~batch/floor tokens/sec — exactly
+        the regime the fleet targets, where the device step dominates
+        and replicas multiply capacity. All replicas share one jitted
+        forward, so only the fleet's first warmup compiles. After the
+        ladder, a blue-green rollout runs mid-burst at the widest
+        point; new params are the old ones x3 (every random tiny-GPT
+        init emits the same degenerate greedy stream, so scaling the
+        weights is what provably changes the output). The bar: zero
+        failed requests, and every response bit-identical to the old-
+        or new-version reference — drains serialize each replica's
+        stream around its swap, so no output may mix versions."""
+        import numpy as np
+
+        from determined_clone_tpu.serving import (
+            BucketSpec,
+            KVCacheConfig,
+            ServingFleet,
+        )
+
+        cfg = gpt_cfg(2, 32, 4, 48, "mha", vocab=97, remat=False)
+        params = gpt.init(jax.random.PRNGKey(0), cfg)
+        floor_s = 0.02
+        n_req, max_new = 96, 8
+        prompt = (1, 2, 3)
+
+        fleet = ServingFleet(
+            params, cfg, name="bench", buckets=BucketSpec.build(4, 16),
+            cache=KVCacheConfig(num_blocks=24, block_size=8),
+            max_queue_depth=2 * n_req, iteration_floor_s=floor_s)
+
+        def burst(count: int) -> tuple:
+            t0 = time.monotonic()
+            handles = [fleet.submit(list(prompt), max_new, timeout=120.0)
+                       for _ in range(count)]
+            results, errors = [], 0
+            for h in handles:
+                try:
+                    results.append(h.result(timeout=120.0))
+                except Exception:  # noqa: BLE001 - counted, not raised
+                    errors += 1
+            return results, errors, time.monotonic() - t0
+
+        try:
+            points = []
+            for n in (1, 2, 4):
+                fleet.scale_to(n)
+                results, errors, wall = burst(n_req)
+                toks = sum(len(r.tokens) for r in results)
+                lats = [r.total_s for r in results] or [0.0]
+                points.append({
+                    "replicas": n,
+                    "tokens_per_sec": round(toks / max(wall, 1e-9), 1),
+                    "p50_total_s": round(float(np.percentile(lats, 50)), 4),
+                    "p99_total_s": round(float(np.percentile(lats, 99)), 4),
+                    "completed": len(results),
+                    "failed": errors,
+                    "wall_s": round(wall, 3),
+                })
+            tps = [p["tokens_per_sec"] for p in points]
+
+            # blue-green rollout mid-burst at the widest point
+            old_ref = fleet.submit(list(prompt), max_new,
+                                   timeout=60.0).result(60.0).tokens
+            new_params = jax.tree_util.tree_map(lambda x: x * 3.0, params)
+            box: dict = {}
+
+            def do_rollout() -> None:
+                box["report"] = fleet.rollout(new_params)
+
+            roller = threading.Thread(target=do_rollout,
+                                      name="bench-rollout", daemon=True)
+            t0 = time.monotonic()
+            handles = []
+            for i in range(n_req):
+                handles.append(fleet.submit(list(prompt), max_new,
+                                            timeout=120.0))
+                if i == n_req // 4:
+                    roller.start()
+                # paced so the burst spans the whole rollout window
+                time.sleep(floor_s / 4)
+            rollout_results, rollout_errors = [], 0
+            for h in handles:
+                try:
+                    rollout_results.append(h.result(timeout=120.0))
+                except Exception:  # noqa: BLE001
+                    rollout_errors += 1
+            roller.join(180.0)
+            rollout_wall = time.monotonic() - t0
+            new_ref = fleet.submit(list(prompt), max_new,
+                                   timeout=60.0).result(60.0).tokens
+
+            old_phase = sum(1 for r in rollout_results
+                            if r.tokens == old_ref)
+            new_phase = sum(1 for r in rollout_results
+                            if r.tokens == new_ref)
+            report = box.get("report")
+            stats = fleet.stats()
+            return {
+                "model": {"n_layers": cfg.n_layers, "d_model": cfg.d_model,
+                          "vocab": cfg.vocab_size},
+                "requests_per_point": n_req,
+                "tokens_per_request": max_new,
+                "iteration_floor_s": floor_s,
+                "points": points,
+                "speedup_2": round(tps[1] / max(tps[0], 1e-9), 3),
+                "speedup_4": round(tps[2] / max(tps[0], 1e-9), 3),
+                "monotonic": tps[0] < tps[1] < tps[2],
+                "rollout": {
+                    "replicas": 4,
+                    "requests": n_req,
+                    "failed": rollout_errors,
+                    "parity_ok": (old_ref != new_ref
+                                  and old_phase + new_phase
+                                  == len(rollout_results)),
+                    "old_version_responses": old_phase,
+                    "new_version_responses": new_phase,
+                    "wall_s": round(rollout_wall, 3),
+                    "rollout_duration_s": (round(report.duration_s, 3)
+                                           if report else None),
+                },
+                "rejected_total": stats.rejected,
+            }
+        finally:
+            fleet.close()
+
     def gpt_cfg(n_layers: int, d_model: int, n_heads: int, seq: int,
                 attention_impl: str, vocab: int = 50304,
                 remat: bool = True) -> gpt.GPTConfig:
@@ -672,6 +803,7 @@ def _run_child() -> None:
     mha_rung = None
     goodput_section = None
     serving_section = None
+    serving_fleet_section = None
     if not on_tpu:
         # cheap on CPU, and computing it before the ladder means the very
         # first banked result line already carries a non-null
@@ -688,6 +820,13 @@ def _run_child() -> None:
             serving_section = time_serving()
         except Exception as exc:  # noqa: BLE001
             serving_section = {"error": repr(exc)[:200]}
+        # fleet scaling ladder + mid-burst rollout: pre-ladder for the
+        # same reason — the first banked line carries the replica-count
+        # scaling numbers the bench gate's advisory fleet check reads
+        try:
+            serving_fleet_section = time_serving_fleet()
+        except Exception as exc:  # noqa: BLE001
+            serving_fleet_section = {"error": repr(exc)[:200]}
     for i, rung in enumerate(ladder):
         if remaining() < rung["min_s"]:
             _emit({"skipped_rung": rung["name"],
@@ -792,6 +931,10 @@ def _run_child() -> None:
                     # several offered loads, vs the static run-to-completion
                     # baseline on the same programs (docs/serving.md)
                     "serving": serving_section,
+                    # replica-fleet scaling: aggregate tokens/sec + p99 at
+                    # 1/2/4 replicas under the same burst, plus a mid-burst
+                    # blue-green rollout (zero failures, version parity)
+                    "serving_fleet": serving_fleet_section,
                     "init_s": round(t_init, 1),
                 },
             }
@@ -841,6 +984,13 @@ def _run_child() -> None:
                 serving_section = time_serving()
             except Exception as exc:  # noqa: BLE001
                 serving_section = {"error": repr(exc)[:200]}
+        if serving_fleet_section is None and remaining() > 60:
+            # TPU lane: the fleet ladder shares the serving programs'
+            # compile cache, but budget it like a full extra anyway
+            try:
+                serving_fleet_section = time_serving_fleet()
+            except Exception as exc:  # noqa: BLE001
+                serving_fleet_section = {"error": repr(exc)[:200]}
 
         # Re-emit enriched with the extras; the parent keeps the last line.
         _emit(result_line())

@@ -764,6 +764,168 @@ def cmd_serve(args) -> int:
                 return 0
 
 
+def cmd_fleet_up(args) -> int:
+    """Run a serving fleet: N engine replicas behind the least-loaded
+    router with an HTTP front door (docs/serving.md). With --with-master
+    the replicas are gang allocations of the master's `serving` type
+    (they occupy scheduler slots and show up in dct_master_sched_*);
+    standalone otherwise. `--selftest` drives traffic through the HTTP
+    surface, prints fleet stats as JSON, and exits."""
+    import dataclasses as _dc
+    import time
+
+    import jax
+
+    from determined_clone_tpu.models import gpt as gpt_model
+    from determined_clone_tpu.serving import MasterLink, ServingFleet
+    from determined_clone_tpu.serving.http import (
+        FleetHTTPServer,
+        generate_over_http,
+    )
+
+    if args.model != "tiny":
+        print(f"error: unknown model preset {args.model!r} (have: tiny)",
+              file=sys.stderr)
+        return 2
+    model_cfg = gpt_model.GPTConfig.tiny()
+    params = gpt_model.init(jax.random.PRNGKey(args.seed), model_cfg)
+    if args.checkpoint:
+        from determined_clone_tpu.core._serialization import load_pytree
+
+        params = load_pytree(args.checkpoint, like=params)
+    fleet = ServingFleet(params, model_cfg, name=args.name,
+                         iteration_floor_s=args.iteration_floor)
+    link = None
+    try:
+        if args.with_master:
+            session = make_session(args)
+            if session.host not in ("127.0.0.1", "localhost"):
+                print("error: --with-master needs a local master "
+                      "(the fleet link speaks the loopback agent "
+                      "protocol)", file=sys.stderr)
+                return 2
+            link = MasterLink(fleet, session.port, replicas=args.replicas)
+            link.wait_replicas(args.replicas, timeout=120)
+        else:
+            fleet.scale_up(args.replicas)
+        port = 0 if args.selftest else (args.port or 8085)
+        with FleetHTTPServer(fleet, host=args.host or "127.0.0.1",
+                             port=port) as server:
+            if args.selftest:
+                outs = [generate_over_http(server.url, [1, 2, 3],
+                                           max_new_tokens=4)
+                        for _ in range(2 * args.replicas)]
+                if any(len(o["tokens"]) != 4 for o in outs):
+                    print(f"error: selftest got {outs}", file=sys.stderr)
+                    return 1
+                print(json.dumps({
+                    "selftest": "ok", "url": server.url,
+                    "replicas": fleet.replica_ids(),
+                    "with_master": bool(link),
+                    "stats": _dc.asdict(fleet.stats())}))
+                return 0
+            print(f"fleet {fleet.name!r}: {args.replicas} replicas on "
+                  f"{server.url}"
+                  + (" (master-managed)" if link else " (standalone)"))
+            try:
+                while True:
+                    time.sleep(3600)
+            except KeyboardInterrupt:
+                return 0
+    finally:
+        if link is not None:
+            link.close(kill_fleet=True)
+        fleet.close()
+
+
+def cmd_fleet_status(args) -> int:
+    """Fleet health: from a fleet front door (--url → GET /v1/fleet) or
+    from the master's serving-fleet records (GET /api/v1/serving/fleets)."""
+    import urllib.request
+
+    if args.url:
+        with urllib.request.urlopen(f"{args.url.rstrip('/')}/v1/fleet",
+                                    timeout=10) as resp:
+            view = json.loads(resp.read().decode("utf-8"))
+        if args.json:
+            print(json.dumps(view, indent=2))
+            return 0
+        st = view["stats"]
+        print(f"fleet {view['name']!r}: {st['healthy']}/{st['replicas']} "
+              f"healthy, queue depth {st['queue_depth']}, "
+              f"{st['free_blocks']} free KV blocks, "
+              f"{st['completed']} completed, "
+              f"{st['tokens_generated']} tokens")
+        for rep in view["replicas"]:
+            mark = (" [excluded]" if rep["id"] in view.get("excluded", [])
+                    else "")
+            print(f"  {rep['id']}: {rep['state']}{mark}")
+        return 0
+    session = make_session(args)
+    fleets = session.get("/api/v1/serving/fleets").get("fleets", [])
+    if args.json:
+        print(json.dumps(fleets, indent=2))
+        return 0
+    if not fleets:
+        print("no serving fleets")
+        return 0
+    for f in fleets:
+        print(f"fleet {f['name']!r}: {f['running']} running / "
+              f"{f['queued']} queued / {f['desired']} desired "
+              f"(pool {f['resource_pool']}, "
+              f"{f['slots_per_replica']} slots/replica)")
+        for rep in f.get("replicas", []):
+            print(f"  {rep['id']}: {rep['state']}")
+    return 0
+
+
+def cmd_fleet_rollout(args) -> int:
+    """Blue-green checkpoint rollout through a fleet front door: the new
+    version is proven on a drained canary before the rest of the fleet
+    swaps, and no in-flight request ever spans a parameter change."""
+    import urllib.request
+
+    body = json.dumps({"checkpoint": args.checkpoint}).encode("utf-8")
+    req = urllib.request.Request(
+        f"{args.url.rstrip('/')}/v1/rollout", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=args.timeout) as resp:
+        report = json.loads(resp.read().decode("utf-8"))
+    if args.json:
+        print(json.dumps(report, indent=2))
+        return 0
+    order = report.get("order", [])
+    print(f"rollout complete in {report.get('duration_s', 0.0):.2f}s: "
+          f"canary {order[0] if order else '?'}, "
+          f"{len(order)} replicas swapped")
+    for rid in order:
+        print(f"  {rid}: drained in {report['drain_s'].get(rid, 0.0):.3f}s")
+    return 0
+
+
+def cmd_fleet_scale(args) -> int:
+    """Resize a fleet: through the front door (--url, in-process drain)
+    or through the master (drain-protected kill commands)."""
+    import urllib.request
+
+    if args.url:
+        body = json.dumps({"replicas": args.replicas}).encode("utf-8")
+        req = urllib.request.Request(
+            f"{args.url.rstrip('/')}/v1/scale", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=args.timeout) as resp:
+            view = json.loads(resp.read().decode("utf-8"))
+        print(f"fleet now has {len(view['replicas'])} replicas: "
+              f"{view['replicas']}")
+        return 0
+    session = make_session(args)
+    session.post(f"/api/v1/serving/fleets/{args.name}/scale",
+                 {"replicas": args.replicas})
+    print(f"fleet {args.name!r} scaling to {args.replicas} replicas "
+          f"(drain-protected)")
+    return 0
+
+
 def cmd_lint(args) -> int:
     """Run the dctlint static-analysis suite (docs/static_analysis.md).
     The linter lives in the repo's tools/ package (it is developer
@@ -1460,6 +1622,64 @@ def build_parser() -> argparse.ArgumentParser:
                    help="bind an ephemeral port, run a few generations "
                         "through the HTTP surface, print stats, exit")
     c.set_defaults(func=cmd_serve)
+
+    # fleet (replica gangs + router + blue-green rollout — docs/serving.md)
+    p_fleet = sub.add_parser("fleet",
+                             help="serving fleet: replica gangs behind a "
+                                  "least-loaded router with blue-green "
+                                  "rollout")
+    fleet_sub = p_fleet.add_subparsers(dest="fleet_cmd", required=True)
+
+    c = fleet_sub.add_parser("up", help="run a fleet of engine replicas "
+                                        "with an HTTP front door")
+    c.add_argument("--replicas", type=int, default=2)
+    c.add_argument("--name", default="fleet")
+    c.add_argument("--model", default="tiny",
+                   help="model preset (currently: tiny)")
+    c.add_argument("--seed", type=int, default=0,
+                   help="init seed when no checkpoint is given")
+    c.add_argument("--checkpoint", default=None,
+                   help="local checkpoint dir (core save_pytree layout)")
+    c.add_argument("--iteration-floor", type=float, default=0.0,
+                   help="simulated device-step floor in seconds (single-"
+                        "host capacity modeling; see docs/serving.md)")
+    c.add_argument("--with-master", action="store_true",
+                   help="register the replicas as `serving` gang "
+                        "allocations with the master (needs a local one)")
+    c.add_argument("--host", default=None)
+    c.add_argument("--port", type=int, default=None)
+    c.add_argument("--selftest", action="store_true",
+                   help="drive traffic through the HTTP surface, print "
+                        "fleet stats as JSON, exit")
+    c.set_defaults(func=cmd_fleet_up)
+
+    c = fleet_sub.add_parser("status", help="fleet health from the front "
+                                            "door or the master")
+    c.add_argument("--url", default=None,
+                   help="fleet front-door URL (default: ask the master)")
+    c.add_argument("--json", action="store_true")
+    c.set_defaults(func=cmd_fleet_status)
+
+    c = fleet_sub.add_parser("rollout",
+                             help="blue-green checkpoint rollout: canary "
+                                  "first, drained swaps, zero failed "
+                                  "requests")
+    c.add_argument("--url", required=True,
+                   help="fleet front-door URL")
+    c.add_argument("--checkpoint", required=True,
+                   help="checkpoint dir to roll out")
+    c.add_argument("--timeout", type=float, default=300.0)
+    c.add_argument("--json", action="store_true")
+    c.set_defaults(func=cmd_fleet_rollout)
+
+    c = fleet_sub.add_parser("scale", help="drain-protected fleet resize")
+    c.add_argument("--replicas", type=int, required=True)
+    c.add_argument("--url", default=None,
+                   help="fleet front-door URL (default: ask the master; "
+                        "--name selects the fleet)")
+    c.add_argument("--name", default="fleet")
+    c.add_argument("--timeout", type=float, default=300.0)
+    c.set_defaults(func=cmd_fleet_scale)
 
     # lint (dctlint static analysis — docs/static_analysis.md)
     c = sub.add_parser("lint",

@@ -162,6 +162,8 @@ void Master::save_snapshot_locked() {
   for (const auto& [id, a] : agents_) agents.push_back(a.to_json());
   Json ckpts = Json::array();
   for (const auto& c : checkpoints_) ckpts.push_back(c.to_json());
+  Json fleets = Json::array();
+  for (const auto& [name, f] : fleets_) fleets.push_back(f.to_json());
   Json req_map = Json::object();
   for (const auto& [eid, m] : request_to_trial_) {
     Json inner = Json::object();
@@ -201,7 +203,8 @@ void Master::save_snapshot_locked() {
       .set("next_assignment_id", next_assignment_id_)
       .set("experiments", exps).set("trials", trials)
       .set("allocations", allocs).set("agents", agents)
-      .set("checkpoints", ckpts).set("request_to_trial", req_map)
+      .set("checkpoints", ckpts).set("fleets", fleets)
+      .set("request_to_trial", req_map)
       .set("users", users).set("sessions", sessions)
       .set("user_settings", [this] {
         Json j = Json::object();
@@ -257,6 +260,10 @@ void Master::load_snapshot() {
   }
   for (const auto& c : snap["checkpoints"].elements()) {
     checkpoints_.push_back(CheckpointRecord::from_json(c));
+  }
+  for (const auto& f : snap["fleets"].elements()) {
+    ServingFleetRec fleet = ServingFleetRec::from_json(f);
+    if (!fleet.name.empty()) fleets_[fleet.name] = std::move(fleet);
   }
   for (const auto& [eid, inner] : snap["request_to_trial"].items()) {
     for (const auto& [rid, tid] : inner.items()) {
@@ -855,6 +862,7 @@ void Master::on_task_done(const std::string& alloc_id, int exit_code,
     if (alloc.ended_at == 0) {
       alloc.ended_at = now_sec();
       ++sched_.completed_total;
+      if (alloc.task_type == "serving") ++sched_.serving_completed_total;
       sched_event_locked("end", alloc, alloc.ended_at, alloc.ended_at);
       dirty_ = true;
     }
@@ -882,6 +890,7 @@ void Master::on_task_done(const std::string& alloc_id, int exit_code,
   alloc.state = failed ? RunState::Errored : RunState::Completed;
   alloc.ended_at = now_sec();
   ++sched_.completed_total;
+  if (alloc.task_type == "serving") ++sched_.serving_completed_total;
   sched_event_locked("end", alloc, alloc.ended_at, alloc.ended_at);
   dirty_ = true;
   if (alloc.trial_id == 0) return;
@@ -1221,6 +1230,7 @@ Json Master::allocation_start_command(const Allocation& alloc,
   cmd.set("n_slices", alloc.n_slices);
   cmd.set("alloc_token", alloc.token);
   cmd.set("spec", alloc.spec);
+  if (!alloc.fleet.empty()) cmd.set("fleet", alloc.fleet);
   if (alloc.trial_id) {
     auto tit = trials_.find(alloc.trial_id);
     if (tit != trials_.end()) {

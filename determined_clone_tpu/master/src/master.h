@@ -177,6 +177,15 @@ class Master {
   HttpResponse tasks_route(const HttpRequest& req,
                            const std::string& forced_type,
                            const char* singular, const char* plural);
+  // serving fleets: /api/v1/serving/fleets[...] — replica gang
+  // allocations of task_type "serving" (docs/serving.md). Caller holds
+  // mu_ (dispatched from route()).
+  HttpResponse serving_route(const HttpRequest& req);
+  // enqueue one serving replica allocation for the fleet (holding mu_)
+  Allocation& queue_serving_replica_locked(ServingFleetRec& fleet);
+  // cancel the highest-seq live replicas down to `target` (holding mu_)
+  void shrink_serving_fleet_locked(ServingFleetRec& fleet, int target);
+  Json serving_fleet_json_locked(const ServingFleetRec& fleet);
 
   // -- platform helpers (routes_platform.cc) --
   User* current_user(const HttpRequest& req);   // nullptr if no valid token
@@ -259,6 +268,8 @@ class Master {
   // observes; metrics_route and the cluster routes read it under mu_ too)
   SchedTelemetry sched_;
   std::map<std::string, Allocation> allocations_;
+  // serving fleets by name (replicas live in allocations_)
+  std::map<std::string, ServingFleetRec> fleets_;
   std::map<std::string, Agent> agents_;
   std::vector<CheckpointRecord> checkpoints_;
   // live searcher methods (rebuilt from snapshots on restore)

@@ -218,7 +218,10 @@ struct Agent {
 struct Allocation {
   std::string id;            // "trial-<id>.<attempt>" or "task-<uuid>"
   int64_t trial_id = 0;      // 0 for non-trial tasks
-  std::string task_type = "trial";  // trial | command | notebook | tensorboard | shell
+  std::string task_type = "trial";  // trial | command | notebook |
+                                    // tensorboard | shell | serving
+  // serving replicas: the fleet this replica belongs to ("" otherwise)
+  std::string fleet;
   RunState state = RunState::Queued;
   int slots = 0;
   int priority = 42;
@@ -268,6 +271,7 @@ struct Allocation {
     }
     Json j = Json::object();
     j.set("id", id).set("trial_id", trial_id).set("task_type", task_type)
+        .set("fleet", fleet)
         .set("state", to_string(state)).set("slots", slots)
         .set("priority", priority).set("resource_pool", resource_pool)
         .set("topology", topology).set("n_slices", n_slices)
@@ -289,6 +293,7 @@ struct Allocation {
     a.id = j["id"].as_string();
     a.trial_id = j["trial_id"].as_int();
     a.task_type = j["task_type"].as_string();
+    a.fleet = j["fleet"].as_string();
     a.state = run_state_from(j["state"].as_string());
     a.slots = static_cast<int>(j["slots"].as_int());
     a.priority = static_cast<int>(j["priority"].as_int());
@@ -319,6 +324,47 @@ struct Allocation {
     a.exit_code = static_cast<int>(j["exit_code"].as_int());
     a.token = j["token"].as_string();
     return a;
+  }
+};
+
+// One serving fleet: a named gang of `serving` replica allocations
+// scheduled against a resource pool (docs/serving.md). The replicas are
+// ordinary Allocations (task_type "serving", fleet = name); this record
+// holds the desired size and the id sequence.
+struct ServingFleetRec {
+  std::string name;
+  std::string resource_pool = "default";
+  int slots_per_replica = 1;
+  int priority = 42;
+  int desired = 0;       // replicas the fleet should be running
+  int64_t next_seq = 1;  // replica id sequence ("serving-<name>-<seq>")
+  std::string owner = "admin";
+  double created_at = 0;
+
+  Json to_json() const {
+    Json j = Json::object();
+    j.set("name", name).set("resource_pool", resource_pool)
+        .set("slots_per_replica", slots_per_replica)
+        .set("priority", priority).set("desired", desired)
+        .set("next_seq", next_seq).set("owner", owner)
+        .set("created_at", created_at);
+    return j;
+  }
+  static ServingFleetRec from_json(const Json& j) {
+    ServingFleetRec f;
+    f.name = j["name"].as_string();
+    f.resource_pool = j["resource_pool"].as_string().empty()
+                          ? "default"
+                          : j["resource_pool"].as_string();
+    f.slots_per_replica =
+        static_cast<int>(j["slots_per_replica"].as_int(1));
+    f.priority = static_cast<int>(j["priority"].as_int(42));
+    f.desired = static_cast<int>(j["desired"].as_int(0));
+    f.next_seq = j["next_seq"].as_int(1);
+    f.owner = j["owner"].as_string().empty() ? "admin"
+                                             : j["owner"].as_string();
+    f.created_at = j["created_at"].as_number(0);
+    return f;
   }
 };
 

@@ -366,6 +366,36 @@ HttpResponse Master::metrics_route() {
   counter("dct_master_sched_gang_wait_ticks_total",
           "allocation-passes spent waiting for a gang fit",
           sched_.gang_wait_ticks_total);
+  // -- serving-fleet families (docs/serving.md): replica gang lifecycle
+  // counters plus live/desired gauges labeled by fleet --
+  counter("dct_master_sched_serving_submitted_total",
+          "serving replica allocations created",
+          sched_.serving_submitted_total);
+  counter("dct_master_sched_serving_running_total",
+          "serving replicas confirmed running",
+          sched_.serving_running_total);
+  counter("dct_master_sched_serving_completed_total",
+          "serving replicas reaching a terminal state",
+          sched_.serving_completed_total);
+  std::map<std::string, int> fleet_live;
+  for (const auto& [id, a] : allocations_) {
+    if (a.task_type == "serving" &&
+        (a.state == RunState::Running || a.state == RunState::Pulling)) {
+      fleet_live[a.fleet]++;
+    }
+  }
+  gauge("dct_master_sched_serving_replicas",
+        "live serving replicas by fleet");
+  for (const auto& [fleet, n] : fleet_live) {
+    out << "dct_master_sched_serving_replicas{fleet=\""
+        << prom_escape_label(fleet) << "\"} " << n << "\n";
+  }
+  gauge("dct_master_sched_serving_replicas_desired",
+        "desired serving replicas by fleet");
+  for (const auto& [name, fleet] : fleets_) {
+    out << "dct_master_sched_serving_replicas_desired{fleet=\""
+        << prom_escape_label(name) << "\"} " << fleet.desired << "\n";
+  }
   // per-pool queue depth + gang-wait gauges; pool names are user input, so
   // label values go through the Python-compatible escaper
   std::map<std::string, int> pool_depth;
@@ -443,7 +473,10 @@ Json Master::sched_summary_locked() {
       .set("decisions", sched_.decisions_total)
       .set("considered", sched_.considered_total)
       .set("gangs_admitted", sched_.gangs_admitted_total)
-      .set("gang_wait_ticks", sched_.gang_wait_ticks_total);
+      .set("gang_wait_ticks", sched_.gang_wait_ticks_total)
+      .set("serving_submitted", sched_.serving_submitted_total)
+      .set("serving_running", sched_.serving_running_total)
+      .set("serving_completed", sched_.serving_completed_total);
   Json depth_by_pool = Json::object();
   int64_t queue_depth = 0;
   std::map<std::string, int64_t> pool_depth;
@@ -460,11 +493,22 @@ Json Master::sched_summary_locked() {
     gang_by_pool.set(pool, n);
     gang_waiting += n;
   }
+  int64_t serving_live = 0;
+  for (const auto& [id, a] : allocations_) {
+    if (a.task_type == "serving" &&
+        (a.state == RunState::Running || a.state == RunState::Pulling)) {
+      ++serving_live;
+    }
+  }
+  int64_t serving_desired = 0;
+  for (const auto& [name, f] : fleets_) serving_desired += f.desired;
   Json gauges = Json::object();
   gauges.set("queue_depth", queue_depth)
       .set("queue_depth_by_pool", depth_by_pool)
       .set("gang_waiting", gang_waiting)
-      .set("gang_waiting_by_pool", gang_by_pool);
+      .set("gang_waiting_by_pool", gang_by_pool)
+      .set("serving_replicas_running", serving_live)
+      .set("serving_replicas_desired", serving_desired);
   Json latency = Json::object();
   latency.set("decision_seconds", sched_latency_json(sched_.decision_seconds))
       .set("queue_wait_seconds",
@@ -959,6 +1003,229 @@ HttpResponse Master::tasks_route(const HttpRequest& req,
   return not_found("no such route");
 }
 
+// ---- serving fleets ------------------------------------------------------
+// /api/v1/serving/fleets — N `serving` replica allocations gang-scheduled
+// against a resource pool (docs/serving.md). The replicas ride the exact
+// allocation lifecycle trials and NTSC tasks use: the scheduler grants
+// reservations, the fleet's agent receives idempotent start/kill commands
+// over its heartbeat, and scale-down kills are drain-protected on the
+// agent side (the fleet finishes in-flight decodes before reporting
+// exited, which is when the slots are reclaimed).
+
+Json Master::serving_fleet_json_locked(const ServingFleetRec& fleet) {
+  Json replicas = Json::array();
+  int running = 0, queued = 0;
+  for (const auto& [id, a] : allocations_) {
+    if (a.task_type != "serving" || a.fleet != fleet.name) continue;
+    replicas.push_back(a.to_json());
+    if (a.state == RunState::Running || a.state == RunState::Pulling) {
+      ++running;
+    } else if (a.state == RunState::Queued) {
+      ++queued;
+    }
+  }
+  Json j = fleet.to_json();
+  j.set("replicas", replicas)
+      .set("running", static_cast<int64_t>(running))
+      .set("queued", static_cast<int64_t>(queued));
+  return j;
+}
+
+Allocation& Master::queue_serving_replica_locked(ServingFleetRec& fleet) {
+  Allocation alloc;
+  alloc.id = "serving-" + fleet.name + "-" + std::to_string(fleet.next_seq++);
+  alloc.task_type = "serving";
+  alloc.fleet = fleet.name;
+  alloc.trial_id = 0;
+  alloc.name = alloc.id;
+  alloc.owner = fleet.owner;
+  alloc.state = RunState::Queued;
+  alloc.slots = fleet.slots_per_replica;
+  alloc.priority = fleet.priority;
+  alloc.resource_pool = fleet.resource_pool;
+  alloc.queued_at = now_sec();
+  alloc.submitted_at = alloc.queued_at;
+  alloc.last_activity = alloc.queued_at;
+  alloc.token = crypto::random_token();
+  // the argv a real (exec-style) agent would run; the in-process fleet
+  // agent (serving/fleet.py MasterLink) spawns the replica directly
+  Json argv = Json::array();
+  argv.push_back("python");
+  argv.push_back("-m");
+  argv.push_back("determined_clone_tpu.serving.fleet");
+  argv.push_back("--fleet");
+  argv.push_back(fleet.name);
+  alloc.spec.set("argv", argv);
+  alloc.spec.set("fleet", fleet.name);
+  ++sched_.submitted_total;
+  ++sched_.serving_submitted_total;
+  sched_event_locked("submit", alloc, alloc.submitted_at, alloc.queued_at);
+  std::string id = alloc.id;
+  allocations_[id] = std::move(alloc);
+  dirty_ = true;
+  return allocations_[id];
+}
+
+void Master::shrink_serving_fleet_locked(ServingFleetRec& fleet,
+                                         int target) {
+  // live replicas, newest last (creation order == queued_at, id tiebreak):
+  // scale-down cancels from the top of the sequence so the longest-lived
+  // replicas keep serving
+  std::vector<Allocation*> live;
+  for (auto& [id, a] : allocations_) {
+    if (a.task_type != "serving" || a.fleet != fleet.name) continue;
+    if (a.state == RunState::Completed || a.state == RunState::Errored ||
+        a.state == RunState::Canceled) {
+      continue;
+    }
+    live.push_back(&a);
+  }
+  std::sort(live.begin(), live.end(),
+            [](const Allocation* x, const Allocation* y) {
+              if (x->queued_at != y->queued_at) {
+                return x->queued_at < y->queued_at;
+              }
+              return x->id < y->id;
+            });
+  while (static_cast<int>(live.size()) > target) {
+    Allocation* a = live.back();
+    live.pop_back();
+    if (a->state == RunState::Queued && a->reservations.empty()) {
+      // never scheduled: terminal immediately, no agent involved
+      a->state = RunState::Canceled;
+      a->ended_at = now_sec();
+      ++sched_.completed_total;
+      ++sched_.serving_completed_total;
+      sched_event_locked("end", *a, a->ended_at, a->ended_at);
+    } else {
+      // running replica: Canceled makes the next heartbeat derive a kill;
+      // the fleet agent drains (admission stopped, in-flight decodes
+      // finish, blocks released) and THEN reports exited — on_task_done
+      // is when the slots actually free (drain-protected reclaim)
+      a->state = RunState::Canceled;
+    }
+    dirty_ = true;
+  }
+}
+
+HttpResponse Master::serving_route(const HttpRequest& req) {
+  const auto& parts = req.path_parts;  // {"api","v1","serving","fleets",..}
+  if (parts.size() < 4 || parts[3] != "fleets") {
+    return not_found("no such route");
+  }
+  if (parts.size() == 4 && req.method == "POST") {
+    // rbac: fleets consume cluster slots like experiments do
+    if (!rbac_allows(req, role_rank("Editor"))) {
+      return HttpResponse::json(
+          403, error_json("Editor role required to create fleets").dump());
+    }
+    Json body = Json::parse(req.body);
+    const std::string name = body["name"].as_string();
+    if (name.empty()) return bad_request("fleet name required");
+    for (char c : name) {
+      bool ok = (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+                (c >= '0' && c <= '9') || c == '-' || c == '_';
+      // the name is embedded in allocation ids and prometheus labels
+      if (!ok) return bad_request("fleet name must be [A-Za-z0-9_-]");
+    }
+    if (fleets_.count(name)) {
+      return HttpResponse::json(
+          409, error_json("fleet " + name + " already exists").dump());
+    }
+    ServingFleetRec fleet;
+    fleet.name = name;
+    if (!body["resource_pool"].as_string().empty()) {
+      fleet.resource_pool = body["resource_pool"].as_string();
+    }
+    fleet.slots_per_replica =
+        std::max(0, static_cast<int>(body["slots_per_replica"].as_int(1)));
+    fleet.priority = static_cast<int>(body["priority"].as_int(42));
+    fleet.desired = std::max(0, static_cast<int>(body["replicas"].as_int(1)));
+    if (User* caller = current_user(req)) fleet.owner = caller->username;
+    fleet.created_at = now_sec();
+    ServingFleetRec& rec = fleets_[name] = fleet;
+    for (int i = 0; i < rec.desired; ++i) queue_serving_replica_locked(rec);
+    log_event("info", "serving fleet created: " + name + " (" +
+                          std::to_string(rec.desired) + " replicas x " +
+                          std::to_string(rec.slots_per_replica) +
+                          " slots in " + rec.resource_pool + ")");
+    Json j = Json::object();
+    j.set("fleet", serving_fleet_json_locked(rec));
+    return HttpResponse::json(201, j.dump());
+  }
+  if (parts.size() == 4 && req.method == "GET") {
+    Json arr = Json::array();
+    for (const auto& [name, fleet] : fleets_) {
+      arr.push_back(serving_fleet_json_locked(fleet));
+    }
+    Json j = Json::object();
+    j.set("fleets", arr);
+    return ok_json(j);
+  }
+  if (parts.size() >= 5) {
+    auto it = fleets_.find(parts[4]);
+    if (it == fleets_.end()) return not_found("no fleet " + parts[4]);
+    ServingFleetRec& fleet = it->second;
+    if (parts.size() == 5 && req.method == "GET") {
+      Json j = Json::object();
+      j.set("fleet", serving_fleet_json_locked(fleet));
+      return ok_json(j);
+    }
+    if (parts.size() == 6 && parts[5] == "scale" && req.method == "POST") {
+      if (!rbac_allows(req, role_rank("Editor"))) {
+        return HttpResponse::json(
+            403, error_json("Editor role required to scale fleets").dump());
+      }
+      Json body = Json::parse(req.body);
+      int target =
+          std::max(0, static_cast<int>(body["replicas"].as_int(-1)));
+      if (body["replicas"].as_int(-1) < 0) {
+        return bad_request("scale requires replicas >= 0");
+      }
+      int live = 0;
+      for (const auto& [id, a] : allocations_) {
+        if (a.task_type == "serving" && a.fleet == fleet.name &&
+            a.state != RunState::Completed &&
+            a.state != RunState::Errored &&
+            a.state != RunState::Canceled) {
+          ++live;
+        }
+      }
+      if (target > live) {
+        for (int i = live; i < target; ++i) {
+          queue_serving_replica_locked(fleet);
+        }
+      } else if (target < live) {
+        shrink_serving_fleet_locked(fleet, target);
+      }
+      fleet.desired = target;
+      dirty_ = true;
+      log_event("info", "serving fleet " + fleet.name + " scaled " +
+                            std::to_string(live) + " -> " +
+                            std::to_string(target));
+      Json j = Json::object();
+      j.set("fleet", serving_fleet_json_locked(fleet));
+      return ok_json(j);
+    }
+    if (parts.size() == 6 && parts[5] == "kill" && req.method == "POST") {
+      User* caller = current_user(req);
+      bool own = caller && caller->username == fleet.owner;
+      if (!own && !rbac_allows(req, role_rank("Editor"))) {
+        return HttpResponse::json(
+            403,
+            error_json("Editor role (or fleet ownership) required").dump());
+      }
+      shrink_serving_fleet_locked(fleet, 0);
+      fleet.desired = 0;
+      dirty_ = true;
+      Json j = Json::object();
+      j.set("fleet", serving_fleet_json_locked(fleet));
+      return ok_json(j);
+    }
+  }
+  return not_found("no such route");
+}
+
 HttpResponse Master::route(const HttpRequest& req) {
   const auto& parts = req.path_parts;  // e.g. {"api","v1","experiments","3"}
   if (parts.size() < 2 || parts[0] != "api" || parts[1] != "v1") {
@@ -979,7 +1246,7 @@ HttpResponse Master::route(const HttpRequest& req) {
       "experiments", "tasks",  "users",    "workspaces", "models",
       "templates",   "webhooks", "job-queue", "provisioner", "groups",
       "rbac", "notebooks", "shells", "commands", "tensorboards",
-      "projects", "checkpoints", "cluster"};
+      "projects", "checkpoints", "cluster", "serving"};
   if (config_.auth_required && kAuthRoots.count(root)) {
     bool alloc_readonly = req.method == "GET" &&
                           (root == "experiments" || root == "users") &&
@@ -2023,6 +2290,10 @@ HttpResponse Master::route(const HttpRequest& req) {
   if (root == "tensorboards") {
     return tasks_route(req, "tensorboard", "tensorboard", "tensorboards");
   }
+  // ---- serving fleets: replica gang allocations (docs/serving.md) --------
+  if (root == "serving") {
+    return serving_route(req);
+  }
 
   // ---- agents ------------------------------------------------------------
   // ---- resource pools (≈ GetResourcePools, api_resourcepools.go):
@@ -2216,6 +2487,9 @@ HttpResponse Master::route(const HttpRequest& req) {
             alloc.scheduled_at = alloc.scheduled_at ? alloc.scheduled_at : now;
             alloc.running_at = now;
             ++sched_.running_total;
+            if (alloc.task_type == "serving") {
+              ++sched_.serving_running_total;
+            }
             double sub = alloc.submitted_at > 0 ? alloc.submitted_at
                                                 : alloc.queued_at;
             if (sub > 0 && now >= sub) {
@@ -2262,6 +2536,7 @@ HttpResponse Master::route(const HttpRequest& req) {
           double now = now_sec();
           alloc.running_at = now;
           ++sched_.running_total;
+          if (alloc.task_type == "serving") ++sched_.serving_running_total;
           double sub = alloc.submitted_at > 0 ? alloc.submitted_at
                                               : alloc.queued_at;
           if (sub > 0 && now >= sub) {

@@ -99,6 +99,11 @@ struct SchedTelemetry {
   int64_t reschedules_total = 0;      // requeues + operator queue reshuffles
   int64_t queue_moves_total = 0;      // job-queue move-ahead/behind ops
   int64_t priority_changes_total = 0; // job-queue reprioritize ops
+  // serving-fleet counters (the `serving` allocation type: replica gangs
+  // created through /api/v1/serving/fleets — docs/serving.md)
+  int64_t serving_submitted_total = 0;  // replica allocations created
+  int64_t serving_running_total = 0;    // replicas confirmed serving
+  int64_t serving_completed_total = 0;  // replicas drained/terminated
   // decision-loop counters
   int64_t decisions_total = 0;        // schedule_pool passes
   int64_t considered_total = 0;       // pending allocations examined

@@ -10,7 +10,12 @@ service (docs/serving.md):
 - :mod:`.engine` — the iteration-level continuous-batching scheduler
   (Orca-style): prefill/decode split, admission control on RetryPolicy,
   CAS checkpoint hot-load, per-request telemetry spans;
-- :mod:`.http` — a stdlib HTTP front-end for ``dct serve``.
+- :mod:`.http` — a stdlib HTTP front-end for ``dct serve``;
+- :mod:`.router` — least-loaded dispatch over replicas with 429-aware
+  failover on the shared RetryPolicy;
+- :mod:`.fleet` — replica gangs: drain protocol, blue-green rollout,
+  master integration (the ``serving`` allocation type);
+- :mod:`.autoscale` — queue-driven grow, drain-protected shrink.
 """
 from determined_clone_tpu.serving.bucketing import (  # noqa: F401
     BucketSpec,
@@ -29,4 +34,23 @@ from determined_clone_tpu.serving.engine import (  # noqa: F401
     Request,
     RequestResult,
     ServerOverloaded,
+    make_paged_forward,
+)
+from determined_clone_tpu.serving.router import (  # noqa: F401
+    ROUTER_RETRY,
+    LeastLoadedRouter,
+    NoHealthyReplica,
+    RoutablePort,
+)
+from determined_clone_tpu.serving.fleet import (  # noqa: F401
+    FleetStats,
+    MasterLink,
+    Replica,
+    RolloutReport,
+    ServingFleet,
+)
+from determined_clone_tpu.serving.autoscale import (  # noqa: F401
+    AutoscalePolicy,
+    Autoscaler,
+    AutoscaleSignals,
 )

@@ -61,6 +61,16 @@ ADMISSION_RETRY = RetryPolicy(
     multiplier=2.0, max_delay_s=2.0, retryable=(ServerOverloaded,))
 
 
+def make_paged_forward() -> Any:
+    """The jitted paged forward an engine runs everything through.
+    Replica fleets pass ONE of these to every engine (``fwd=``) so the
+    whole fleet shares a single XLA program cache: replica N>1 warms up
+    for free, and scale-up never pays a compile (all replicas serve the
+    same model config and bucket ladder, so the shapes are identical)."""
+    return jax.jit(gpt.forward_paged, static_argnums=(1,),
+                   donate_argnums=(6, 7))
+
+
 @dataclasses.dataclass(frozen=True)
 class Request:
     """One generation request. Greedy decoding (argmax) — the serving
@@ -159,7 +169,9 @@ class InferenceEngine:
                  buckets: Optional[BucketSpec] = None,
                  cache: Optional[KVCacheConfig] = None,
                  max_queue_depth: int = 64,
-                 telemetry: Any = None) -> None:
+                 telemetry: Any = None,
+                 fwd: Any = None,
+                 iteration_floor_s: float = 0.0) -> None:
         self.model_cfg = model_cfg
         self.buckets = buckets or BucketSpec.build(
             8, min(128, model_cfg.max_seq_len))
@@ -184,8 +196,14 @@ class InferenceEngine:
         # shape never causes a retrace
         self._table_width = max(
             1, math.ceil(model_cfg.max_seq_len / cache.block_size))
-        self._fwd = jax.jit(gpt.forward_paged, static_argnums=(1,),
-                            donate_argnums=(6, 7))
+        self._fwd = fwd if fwd is not None else make_paged_forward()
+        # simulated device-step floor: pad every scheduler iteration that
+        # did device work up to this many seconds. 0.0 (the default) is a
+        # no-op. Fleet benches on a single host set it so per-replica
+        # capacity is bounded by the floor rather than by the one CPU the
+        # replicas share — the same stand-in-for-hardware idiom as
+        # loadgen's simulated agents (see docs/serving.md).
+        self.iteration_floor_s = float(iteration_floor_s)
 
         registry = getattr(telemetry, "registry", telemetry)
         self.registry: MetricsRegistry = (
@@ -239,7 +257,9 @@ class InferenceEngine:
     @classmethod
     def from_serving_config(cls, params: gpt.Params,
                             model_cfg: gpt.GPTConfig, scfg: Any, *,
-                            telemetry: Any = None) -> "InferenceEngine":
+                            telemetry: Any = None, fwd: Any = None,
+                            iteration_floor_s: float = 0.0
+                            ) -> "InferenceEngine":
         """Build an engine from a config/experiment.py ServingConfig
         (the `serving:` block of an experiment YAML)."""
         buckets = BucketSpec.build(
@@ -250,7 +270,8 @@ class InferenceEngine:
                    cache=KVCacheConfig(num_blocks=blocks,
                                        block_size=scfg.kv_block_size),
                    max_queue_depth=scfg.max_queue_depth,
-                   telemetry=telemetry)
+                   telemetry=telemetry, fwd=fwd,
+                   iteration_floor_s=iteration_floor_s)
 
     # -- client surface ----------------------------------------------------
 
@@ -425,6 +446,31 @@ class InferenceEngine:
         if self._queue or self._active:
             raise RuntimeError(f"{what} requires an idle engine")
 
+    def wait_idle(self, timeout: float = 60.0) -> None:
+        """Block until nothing is queued, nothing is active, and the
+        scheduler's in-flight device call (the ``_busy`` window) has
+        finished — i.e. every request accepted so far has fully
+        completed. This is the engine half of the fleet drain protocol:
+        the caller stops routing new work here first, then waits out the
+        in-flight decodes before swapping params or releasing the
+        replica's slots. Raises TimeoutError if traffic never quiesces.
+        """
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._queue or self._active or self._busy:
+                if self._fatal is not None:
+                    raise RuntimeError(
+                        "serving engine died") from self._fatal
+                if self._stop:
+                    raise RuntimeError("serving engine is closed")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"engine not idle after {timeout}s "
+                        f"(queue={len(self._queue)} "
+                        f"active={len(self._active)})")
+                self._cond.wait(remaining)
+
     # -- introspection -----------------------------------------------------
 
     def programs_compiled(self) -> int:
@@ -474,10 +520,19 @@ class InferenceEngine:
                         self._pending_params = None
                     newcomers = self._admit_locked()
                     self._busy = True
+                iter_t0 = time.monotonic()
+                worked = False
                 if newcomers:
                     self._prefill(newcomers)
+                    worked = True
                 if self._active:
                     self._decode_step()
+                    worked = True
+                if worked and self.iteration_floor_s > 0.0:
+                    pad = self.iteration_floor_s \
+                        - (time.monotonic() - iter_t0)
+                    if pad > 0.0:
+                        time.sleep(pad)
         except BaseException as exc:  # noqa: BLE001 — fail every waiter
             with self._cond:
                 self._fatal = exc

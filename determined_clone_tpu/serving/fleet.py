@@ -1,0 +1,595 @@
+"""Serving fleet: replica lifecycle, drain protocol, blue-green rollout.
+
+One :class:`ServingFleet` owns N :class:`InferenceEngine` replicas behind
+a :class:`LeastLoadedRouter` (router.py). The pieces that make N replicas
+a *fleet* rather than N servers:
+
+- **shared XLA program cache** — every replica runs the same jitted
+  forward (``make_paged_forward()``), so the bucket ladder compiles once
+  for the whole fleet and scale-up never pays a compile (the engines'
+  shapes are identical; the donated KV pools differ per call, which jit
+  handles per-invocation);
+- **drain protocol** — a replica is never torn down mid-request: it is
+  marked DRAINING (the router stops selecting it), the engine's
+  ``wait_idle()`` waits out every queued and in-flight sequence, and only
+  then are its slots released. Scale-down and rollout both ride this.
+- **blue-green rollout** — a new parameter version is proven on one
+  drained canary replica (probe request under the new params) before the
+  rest of the fleet is swapped, one drained replica at a time, so every
+  request completes entirely under a single parameter version and the
+  fleet never goes dark. With >= 2 replicas a rollout is invisible to
+  clients; with 1 the router's own backoff (ROUTER_RETRY) bridges the
+  swap window.
+- **master integration** — :class:`MasterLink` speaks the real agent
+  protocol (register / heartbeat / task_event) against the C++ master's
+  ``serving`` allocation type (``POST /api/v1/serving/fleets``), so
+  replicas occupy scheduler slots like any other gang and show up in the
+  ``dct_master_sched_serving_*`` families. Kill commands trigger the
+  drain protocol before the exit report releases the slots.
+
+Telemetry: each replica keeps its own MetricsRegistry (the engine's
+gauges/histograms); ``sample_telemetry()`` stamps a per-replica
+``serving_tokens_per_sec`` gauge and feeds every registry to a
+ClusterMetricsAggregator under ``component=serving_replica_<id>`` so
+``dct metrics`` shows the fleet rollup (docs/serving.md).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from determined_clone_tpu.models import gpt
+from determined_clone_tpu.serving.bucketing import BucketSpec
+from determined_clone_tpu.serving.engine import (
+    InferenceEngine,
+    make_paged_forward,
+)
+from determined_clone_tpu.serving.kv_cache import KVCacheConfig
+from determined_clone_tpu.serving.router import LeastLoadedRouter
+from determined_clone_tpu.telemetry import MetricsRegistry
+
+# Replica lifecycle. STARTING replicas exist but take no traffic (engine
+# warming up); DRAINING replicas finish what they accepted but get
+# nothing new; STOPPED replicas are awaiting removal.
+STARTING = "starting"
+HEALTHY = "healthy"
+DRAINING = "draining"
+STOPPED = "stopped"
+
+
+class Replica:
+    """One engine behind the router: RoutablePort + lifecycle state."""
+
+    def __init__(self, replica_id: str, engine: InferenceEngine) -> None:
+        self.replica_id = replica_id
+        self.engine = engine
+        self.registry: MetricsRegistry = engine.registry
+        self.state = STARTING
+
+    # -- RoutablePort ------------------------------------------------------
+
+    def admitting(self) -> bool:
+        return self.state == HEALTHY
+
+    def load(self) -> Tuple[int, int]:
+        st = self.engine.stats()
+        return (st.queue_depth, -st.free_blocks)
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 16, *,
+               eos_token_id: Optional[int] = None,
+               request_id: Optional[str] = None) -> Any:
+        return self.engine.submit(prompt, max_new_tokens,
+                                  eos_token_id=eos_token_id,
+                                  request_id=request_id)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def drain(self, timeout: float = 60.0) -> float:
+        """Stop admission (the router skips non-HEALTHY replicas) and
+        wait out every queued and in-flight request. Returns the drain
+        wall-time. The replica stays alive — rollout re-admits it."""
+        self.state = DRAINING
+        t0 = time.monotonic()
+        self.engine.wait_idle(timeout)
+        return time.monotonic() - t0
+
+    def readmit(self) -> None:
+        self.state = HEALTHY
+
+    def close(self, timeout: float = 30.0) -> None:
+        self.state = STOPPED
+        self.engine.close(timeout)
+
+
+@dataclasses.dataclass
+class FleetStats:
+    replicas: int
+    healthy: int
+    queue_depth: int          # summed over replicas
+    free_blocks: int          # summed over replicas
+    completed: int            # summed over replicas
+    tokens_generated: int     # summed over replicas
+    rejected: int             # engine-level 429s (absorbed by the router)
+    max_p99_s: float          # worst replica request p99 (NaN when empty)
+
+
+@dataclasses.dataclass
+class RolloutReport:
+    """What a blue-green rollout did (docs/serving.md rollout section)."""
+    order: List[str]          # replica ids in swap order; [0] is the canary
+    probe_output: List[int]   # canary probe tokens under the new params
+    drain_s: Dict[str, float]  # per-replica drain wall-time
+    duration_s: float
+
+
+class ServingFleet:
+    """N engine replicas + router + drain/rollout orchestration.
+
+    ``iteration_floor_s`` is forwarded to every engine; single-host
+    benches set it so per-replica capacity is floor-bound rather than
+    bound by the one CPU all replicas share (docs/serving.md). The first
+    replica's warmup compiles the shared bucket ladder; later replicas
+    warm up against a hot cache for free.
+    """
+
+    def __init__(self, params: gpt.Params, model_cfg: gpt.GPTConfig, *,
+                 name: str = "fleet",
+                 buckets: Optional[BucketSpec] = None,
+                 cache: Optional[KVCacheConfig] = None,
+                 max_queue_depth: int = 256,
+                 iteration_floor_s: float = 0.0,
+                 warmup: bool = True,
+                 registry: Optional[MetricsRegistry] = None,
+                 aggregator: Any = None) -> None:
+        self.name = name
+        self.model_cfg = model_cfg
+        self.buckets = buckets
+        self.cache = cache
+        self.max_queue_depth = int(max_queue_depth)
+        self.iteration_floor_s = float(iteration_floor_s)
+        self.warmup = bool(warmup)
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.aggregator = aggregator
+        self.router = LeastLoadedRouter(self.registry)
+        self._fwd = make_paged_forward()
+        self._params = params
+        self._lock = threading.RLock()   # membership + rollout serialization
+        self._replicas: Dict[str, Replica] = {}
+        self._next_seq = 1
+        self._tps_last: Dict[str, Tuple[float, int]] = {}
+        self._g_replicas = self.registry.gauge(
+            "fleet_replicas", "replicas in the fleet (any state)")
+        self._c_rollouts = self.registry.counter(
+            "fleet_rollouts_total", "blue-green parameter rollouts completed")
+        self._h_drain = self.registry.histogram(
+            "fleet_drain_seconds", "per-replica drain wall-time")
+
+    # -- membership --------------------------------------------------------
+
+    def __enter__(self) -> "ServingFleet":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    def replica_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._replicas)
+
+    def replicas(self) -> List[Replica]:
+        with self._lock:
+            return [self._replicas[r] for r in sorted(self._replicas)]
+
+    def healthy_count(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas.values()
+                       if r.state == HEALTHY)
+
+    def scale_up(self, n: int = 1) -> List[str]:
+        """Add ``n`` replicas; each warms up against the shared program
+        cache (only the fleet's first warmup actually compiles), then
+        joins the router."""
+        added: List[str] = []
+        for _ in range(max(0, int(n))):
+            with self._lock:
+                rid = f"{self.name}-{self._next_seq}"
+                self._next_seq += 1
+            engine = InferenceEngine(
+                self._params, self.model_cfg, buckets=self.buckets,
+                cache=self.cache, max_queue_depth=self.max_queue_depth,
+                telemetry=MetricsRegistry(), fwd=self._fwd,
+                iteration_floor_s=self.iteration_floor_s)
+            rep = Replica(rid, engine)
+            if self.warmup:
+                engine.warmup()
+            rep.state = HEALTHY
+            with self._lock:
+                self._replicas[rid] = rep
+                self._g_replicas.set(len(self._replicas))
+            self.router.add(rep)
+            added.append(rid)
+        return added
+
+    def stop_replica(self, replica_id: str, timeout: float = 60.0) -> float:
+        """Drain-protected removal of one replica: stop admission,
+        finish in-flight work, release its blocks, then tear the engine
+        down. Returns the drain wall-time. This is the only way a
+        replica leaves the fleet — scale-down, autoscaler shrink, and
+        MasterLink kill commands all land here."""
+        with self._lock:
+            rep = self._replicas.get(replica_id)
+        if rep is None:
+            raise KeyError(f"no replica {replica_id!r}")
+        drain_s = rep.drain(timeout)
+        self._h_drain.observe(drain_s)
+        self.router.remove(replica_id)
+        rep.close()
+        with self._lock:
+            self._replicas.pop(replica_id, None)
+            self._tps_last.pop(replica_id, None)
+            self._g_replicas.set(len(self._replicas))
+        return drain_s
+
+    def scale_down(self, n: int = 1, timeout: float = 60.0) -> List[str]:
+        """Remove the ``n`` newest replicas through the drain protocol
+        (newest-first mirrors the master's shrink policy)."""
+        with self._lock:
+            victims = sorted(
+                (r for r in self._replicas.values() if r.state != STOPPED),
+                key=lambda rep: rep.replica_id, reverse=True)[:max(0, int(n))]
+        removed = []
+        for rep in victims:
+            self.stop_replica(rep.replica_id, timeout)
+            removed.append(rep.replica_id)
+        return removed
+
+    def scale_to(self, n: int, timeout: float = 60.0) -> None:
+        cur = len(self.replica_ids())
+        if n > cur:
+            self.scale_up(n - cur)
+        elif n < cur:
+            self.scale_down(cur - n, timeout)
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Tear the fleet down, draining politely first (bounded)."""
+        for rid in sorted(self._replicas, reverse=True):
+            rep = self._replicas.get(rid)
+            if rep is None:
+                continue
+            try:
+                rep.drain(timeout)
+            except (TimeoutError, RuntimeError):
+                pass  # tearing down anyway; close() joins the thread
+            self.router.remove(rid)
+            rep.close()
+        with self._lock:
+            self._replicas.clear()
+            self._g_replicas.set(0)
+
+    # -- traffic -----------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 16, *,
+               eos_token_id: Optional[int] = None,
+               request_id: Optional[str] = None,
+               timeout: Optional[float] = None) -> Any:
+        """Route one request to the least-loaded healthy replica."""
+        return self.router.submit(prompt, max_new_tokens,
+                                  eos_token_id=eos_token_id,
+                                  request_id=request_id, timeout=timeout)
+
+    # -- blue-green rollout ------------------------------------------------
+
+    def rollout(self, new_params: gpt.Params, *,
+                probe_prompt: Sequence[int] = (1, 2, 3),
+                probe_tokens: int = 8,
+                drain_timeout: float = 120.0) -> RolloutReport:
+        """Install ``new_params`` fleet-wide, blue-green style.
+
+        Replica by replica (lowest id first — the canary): stop its
+        admission, drain it, queue the swap, then prove it with a probe
+        request (the probe's prefill crosses the iteration boundary, so
+        it runs — and its output is produced — entirely under the new
+        params). Only after the canary's probe succeeds does the rest of
+        the fleet swap; every later replica's probe must match the
+        canary bit-for-bit (greedy decoding is deterministic, so any
+        divergence means the swap installed different bytes). Because a
+        drained replica has no in-flight sequences, no request ever
+        spans a parameter change: every response is exactly old-version
+        or exactly new-version tokens, which is what lets the rollout
+        tests assert bit-identical outputs under load.
+        """
+        t0 = time.monotonic()
+        with self._lock:
+            order = sorted(self._replicas)
+            reps = [self._replicas[r] for r in order]
+        if not reps:
+            raise RuntimeError("rollout on an empty fleet")
+        probe_output: List[int] = []
+        drain_s: Dict[str, float] = {}
+        for i, rep in enumerate(reps):
+            drain_s[rep.replica_id] = rep.drain(drain_timeout)
+            self._h_drain.observe(drain_s[rep.replica_id])
+            rep.engine.hot_swap(new_params)
+            out = rep.submit(tuple(probe_prompt), probe_tokens).result(
+                drain_timeout).tokens
+            if i == 0:
+                probe_output = out
+            elif out != probe_output:
+                raise RuntimeError(
+                    f"rollout parity violation: replica {rep.replica_id} "
+                    f"probe {out} != canary {probe_output}")
+            rep.readmit()
+        with self._lock:
+            self._params = new_params
+        self._c_rollouts.inc()
+        return RolloutReport(order=order, probe_output=probe_output,
+                             drain_s=drain_s,
+                             duration_s=time.monotonic() - t0)
+
+    def rollout_from_storage(self, storage: Any, storage_id: str, *,
+                             base_tmp: Optional[str] = None,
+                             ckpt_subdir: str = "",
+                             **kw: Any) -> RolloutReport:
+        """Blue-green rollout of a stored checkpoint: the pytree is
+        fetched and deserialized ONCE (CAS managers hit their chunk
+        cache) and the same arrays are hot-swapped into every replica —
+        one fetch for N replicas, unlike per-engine ``hot_load``."""
+        import os
+
+        from determined_clone_tpu.core._serialization import load_pytree
+
+        t0 = time.monotonic()
+        with storage.restore_path(storage_id, base_tmp) as d:
+            src = os.path.join(d, ckpt_subdir) if ckpt_subdir else d
+            new_params = load_pytree(src, like=self._params)
+        self.registry.histogram(
+            "fleet_rollout_load_seconds",
+            "checkpoint fetch + deserialize (once per rollout)"
+        ).observe(time.monotonic() - t0)
+        return self.rollout(new_params, **kw)
+
+    # -- telemetry ---------------------------------------------------------
+
+    def stats(self) -> FleetStats:
+        reps = self.replicas()
+        qd = fb = done = toks = rej = 0
+        healthy = 0
+        max_p99 = float("nan")
+        for rep in reps:
+            st = rep.engine.stats()
+            qd += st.queue_depth
+            fb += st.free_blocks
+            done += st.completed
+            toks += st.tokens_generated
+            rej += st.rejected
+            healthy += 1 if rep.state == HEALTHY else 0
+            p99 = rep.registry.histogram(
+                "serving_request_total_seconds",
+                "submit → last token").percentile(99)
+            if p99 == p99 and not (max_p99 == max_p99 and max_p99 >= p99):
+                max_p99 = p99
+        return FleetStats(replicas=len(reps), healthy=healthy,
+                          queue_depth=qd, free_blocks=fb, completed=done,
+                          tokens_generated=toks, rejected=rej,
+                          max_p99_s=max_p99)
+
+    def sample_telemetry(self) -> None:
+        """Stamp per-replica ``serving_tokens_per_sec`` (from the token
+        counter delta since the last sample) and feed every replica
+        registry to the aggregator as ``component=serving_replica_<id>``
+        — distinct component names, because ingest is latest-wins per
+        component and identical names would clobber each other. The
+        aggregator's serving rollup prefix-matches ``serving_replica``
+        (telemetry/aggregate.py)."""
+        now = time.monotonic()
+        for rep in self.replicas():
+            st = rep.engine.stats()
+            last = self._tps_last.get(rep.replica_id)
+            tps = 0.0
+            if last is not None and now > last[0]:
+                tps = (st.tokens_generated - last[1]) / (now - last[0])
+            self._tps_last[rep.replica_id] = (now, st.tokens_generated)
+            rep.registry.gauge(
+                "serving_tokens_per_sec",
+                "decoded tokens per second since the last sample").set(tps)
+            if self.aggregator is not None:
+                self.aggregator.ingest_component(
+                    f"serving_replica_{rep.replica_id}", rep.registry)
+
+
+# ---------------------------------------------------------------------------
+# Master integration: the agent half of the `serving` allocation type.
+# ---------------------------------------------------------------------------
+
+
+def _master_req(port: int, method: str, path: str,
+                body: Optional[dict] = None, timeout: float = 5.0) -> Any:
+    """Minimal master client, same dialect as tools/loadgen.py (the
+    master runs authless by default; rbac gates pass when auth is off)."""
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        payload = resp.read()
+    return json.loads(payload) if payload else {}
+
+
+class MasterLink:
+    """Runs a ServingFleet as the master's serving gang allocations.
+
+    Registers as an agent (``fleet-<name>``), creates the fleet record
+    via ``POST /api/v1/serving/fleets``, then heartbeats on a ``fleet-
+    link`` thread. The master derives the commands: ``start`` commands
+    (``task_type == "serving"``) spawn a replica and confirm it with a
+    ``running`` task_event; ``kill`` commands (scale-down, fleet kill)
+    run the drain protocol on a ``fleet-drain-<alloc>`` thread and
+    report ``exited`` only once the replica's last request finished and
+    its blocks are freed — the drain-protected slot reclaim the master's
+    shrink comment promises.
+    """
+
+    def __init__(self, fleet: ServingFleet, master_port: int, *,
+                 replicas: int = 1, resource_pool: str = "default",
+                 slots_per_replica: int = 1, agent_slots: int = 16,
+                 poll_s: float = 0.05, drain_timeout: float = 60.0) -> None:
+        self.fleet = fleet
+        self.port = int(master_port)
+        self.poll_s = float(poll_s)
+        self.drain_timeout = float(drain_timeout)
+        self.agent_id = f"fleet-{fleet.name}"
+        self._lock = threading.Lock()
+        self._alloc_replica: Dict[str, str] = {}   # alloc id → replica id
+        self._exited: List[str] = []               # drained, to report
+        self._draining: Dict[str, threading.Thread] = {}
+        self._stop = threading.Event()
+        _master_req(self.port, "POST", "/api/v1/agents/register", {
+            "id": self.agent_id, "slots": int(agent_slots),
+            "topology": f"fleet-{agent_slots}", "address": "127.0.0.1:0",
+            "resource_pool": resource_pool})
+        _master_req(self.port, "POST", "/api/v1/serving/fleets", {
+            "name": fleet.name, "replicas": int(replicas),
+            "resource_pool": resource_pool,
+            "slots_per_replica": int(slots_per_replica)})
+        self._thread = threading.Thread(target=self._run, name="fleet-link",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- master-facing actions --------------------------------------------
+
+    def scale(self, replicas: int) -> None:
+        """Ask the master for a new replica count; the heartbeat loop
+        applies the derived start/kill commands."""
+        _master_req(self.port, "POST",
+                    f"/api/v1/serving/fleets/{self.fleet.name}/scale",
+                    {"replicas": int(replicas)})
+
+    def fleet_status(self) -> Dict[str, Any]:
+        return _master_req(
+            self.port, "GET",
+            f"/api/v1/serving/fleets/{self.fleet.name}")["fleet"]
+
+    # -- agent loop --------------------------------------------------------
+
+    def _heartbeat(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            exited_ids = list(self._exited)
+            # draining allocs still report running — the replica process
+            # is alive until its last request finishes; the master just
+            # re-derives the (idempotently skipped) kill meanwhile
+            running = list(self._alloc_replica)
+        body = {"exited": [{"allocation_id": a, "exit_code": 0}
+                           for a in exited_ids],
+                "running": running}
+        resp = _master_req(
+            self.port, "POST",
+            f"/api/v1/agents/{self.agent_id}/heartbeat", body)
+        with self._lock:
+            # only forget exit reports the master actually received
+            self._exited = [a for a in self._exited if a not in exited_ids]
+        return resp.get("commands", [])
+
+    def _start_replica(self, alloc_id: str) -> None:
+        rid = self.fleet.scale_up(1)[0]
+        with self._lock:
+            self._alloc_replica[alloc_id] = rid
+        _master_req(self.port, "POST",
+                    f"/api/v1/agents/{self.agent_id}/task_event",
+                    {"allocation_id": alloc_id, "event": "running"})
+
+    def _drain_replica(self, alloc_id: str) -> None:
+        """fleet-drain-* thread body: drain protocol, then queue the
+        exit report for the next heartbeat."""
+        with self._lock:
+            rid = self._alloc_replica.get(alloc_id)
+        try:
+            if rid is not None and rid in self.fleet.replica_ids():
+                self.fleet.stop_replica(rid, self.drain_timeout)
+        except (TimeoutError, RuntimeError, KeyError):
+            pass  # report the exit regardless; the engine is going away
+        with self._lock:
+            self._alloc_replica.pop(alloc_id, None)
+            self._exited.append(alloc_id)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                commands = self._heartbeat()
+            except (urllib.error.URLError, OSError, ValueError):
+                if self._stop.wait(self.poll_s * 4):
+                    return
+                continue
+            for cmd in commands:
+                ctype = cmd.get("type")
+                alloc_id = cmd.get("allocation_id", "")
+                if (ctype == "start"
+                        and cmd.get("task_type") == "serving"
+                        and cmd.get("fleet") == self.fleet.name):
+                    try:
+                        self._start_replica(alloc_id)
+                    except (urllib.error.URLError, OSError):
+                        pass  # running event retried via next derive
+                elif ctype == "kill" and alloc_id in self._alloc_replica:
+                    with self._lock:
+                        if alloc_id in self._draining:
+                            continue
+                        t = threading.Thread(
+                            target=self._drain_replica, args=(alloc_id,),
+                            name=f"fleet-drain-{alloc_id}", daemon=True)
+                        self._draining[alloc_id] = t
+                    t.start()
+            with self._lock:
+                done = [a for a, t in self._draining.items()
+                        if not t.is_alive()]
+                for a in done:
+                    self._draining.pop(a)
+            if self._stop.wait(self.poll_s):
+                return
+
+    def wait_replicas(self, n: int, timeout: float = 30.0) -> None:
+        """Block until the local fleet has ``n`` replicas admitted."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.fleet.healthy_count() >= n:
+                return
+            time.sleep(0.02)
+        raise TimeoutError(
+            f"fleet {self.fleet.name!r} has {self.fleet.healthy_count()} "
+            f"healthy replicas after {timeout}s, wanted {n}")
+
+    def close(self, *, kill_fleet: bool = False, timeout: float = 30.0
+              ) -> None:
+        """Stop heartbeating (optionally killing the master-side fleet
+        first so slots free) and join the drain threads."""
+        if kill_fleet:
+            try:
+                _master_req(
+                    self.port, "POST",
+                    f"/api/v1/serving/fleets/{self.fleet.name}/kill", {})
+                deadline = time.monotonic() + timeout
+                while time.monotonic() < deadline:
+                    with self._lock:
+                        idle = not self._alloc_replica and not self._exited
+                    if idle:
+                        break
+                    time.sleep(self.poll_s)
+            except (urllib.error.URLError, OSError):
+                pass
+        self._stop.set()
+        self._thread.join(timeout)
+        with self._lock:
+            drains = list(self._draining.values())
+        for t in drains:
+            t.join(timeout)
+
+
+if __name__ == "__main__":  # pragma: no cover - the master's spec argv
+    raise SystemExit(
+        "determined_clone_tpu.serving.fleet is a library; start a fleet "
+        "with `dct fleet up` (see docs/serving.md)")

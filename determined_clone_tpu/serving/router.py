@@ -1,0 +1,223 @@
+"""Least-loaded request routing over a fleet of serving replicas.
+
+The front door of the serving fleet (fleet.py): every request is
+dispatched to the healthy replica with the smallest admission queue,
+using exactly the gauges each engine already exports (queue depth as the
+primary key, free KV blocks as the tie-break — a replica with a short
+queue but an exhausted pool will stall newcomers in admission, so the
+pool is load too). This is the standard continuous-batching fleet
+policy: iteration-level schedulers keep per-replica latency flat until
+the queue grows, so queue depth is the earliest and cheapest congestion
+signal.
+
+Failover (the 429 story): when a replica rejects with
+:class:`ServerOverloaded` — its HTTP face is a 429 — or a remote replica
+drops the connection, the router *re-dispatches* to the next-least-
+loaded replica and temporarily excludes the failing one from selection.
+The client sees one submit call; the retry storm the naive design
+produces (every client independently hammering the one overloaded
+replica under its own backoff loop) never happens because the exclusion
+is shared router state. Only when EVERY replica is excluded or draining
+does the router itself back off, riding the repo-standard
+:class:`RetryPolicy` (utils/retry.py) with full jitter. Every
+re-dispatch is counted in ``router_redispatch_total{reason=...}``.
+
+Replicas are anything implementing the small :class:`RoutablePort`
+surface; fleet.py's ``Replica`` is the real one, tests use fakes.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from determined_clone_tpu.serving.engine import ServerOverloaded
+from determined_clone_tpu.telemetry import MetricsRegistry
+from determined_clone_tpu.utils.retry import RetryPolicy, retry_call
+
+
+class NoHealthyReplica(RuntimeError):
+    """Every replica is excluded, draining, or gone. Retryable — the
+    router's own dispatch loop backs off on it (ROUTER_RETRY)."""
+
+
+ROUTER_RETRY = RetryPolicy(
+    name="router_dispatch", max_attempts=8, base_delay_s=0.05,
+    multiplier=2.0, max_delay_s=1.0, retryable=(NoHealthyReplica,))
+
+#: Exceptions that mean "this replica, right now" rather than "this
+#: request is malformed": the router excludes the replica and re-
+#: dispatches instead of surfacing them to the client.
+_FAILOVER_ERRORS = (ServerOverloaded, ConnectionError, TimeoutError,
+                    OSError)
+
+
+class RoutablePort:
+    """What the router needs from a replica. fleet.Replica implements
+    this over an in-process engine; a remote replica port would
+    implement it over HTTP (submit → POST /v1/generate, load → the
+    scraped gauges)."""
+
+    replica_id: str
+
+    def admitting(self) -> bool:
+        """False while draining/starting/stopped — never routed to."""
+        raise NotImplementedError
+
+    def load(self) -> tuple:
+        """(queue_depth, -free_blocks): ascending == least loaded."""
+        raise NotImplementedError
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
+               eos_token_id: Optional[int] = None,
+               request_id: Optional[str] = None) -> Any:
+        """Engine-style submit: returns a handle with .result(timeout),
+        raises ServerOverloaded on a full queue."""
+        raise NotImplementedError
+
+
+class LeastLoadedRouter:
+    """Thread-safe least-queue-depth dispatcher with exclusion failover.
+
+    ``exclude_cooldown_s`` bounds how long one 429 keeps a replica out
+    of rotation; the next successful dispatch window re-probes it. The
+    clock is injectable for deterministic tests.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None, *,
+                 exclude_cooldown_s: float = 0.5,
+                 policy: RetryPolicy = ROUTER_RETRY,
+                 clock: Any = time.monotonic) -> None:
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.exclude_cooldown_s = float(exclude_cooldown_s)
+        self.policy = policy
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, RoutablePort] = {}
+        self._excluded_until: Dict[str, float] = {}
+        self._c_dispatch = self.registry.counter(
+            "router_requests_total", "requests dispatched through the router")
+        self._redispatch: Dict[str, Any] = {}
+        self._g_replicas = self.registry.gauge(
+            "router_replicas", "replicas registered with the router")
+        self._g_healthy = self.registry.gauge(
+            "router_healthy_replicas",
+            "replicas admitting and not excluded")
+
+    # -- membership (fleet-managed) ---------------------------------------
+
+    def add(self, replica: RoutablePort) -> None:
+        with self._lock:
+            self._replicas[replica.replica_id] = replica
+            self._g_replicas.set(len(self._replicas))
+
+    def remove(self, replica_id: str) -> None:
+        with self._lock:
+            self._replicas.pop(replica_id, None)
+            self._excluded_until.pop(replica_id, None)
+            self._g_replicas.set(len(self._replicas))
+
+    def replica_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._replicas)
+
+    # -- selection ---------------------------------------------------------
+
+    def _redispatch_counter(self, reason: str) -> Any:
+        c = self._redispatch.get(reason)
+        if c is None:
+            c = self.registry.counter(
+                "router_redispatch_total",
+                "dispatches retried on another replica",
+                labels={"reason": reason})
+            self._redispatch[reason] = c
+        return c
+
+    def excluded(self) -> List[str]:
+        """Replica ids currently in exclusion cooldown (observability)."""
+        now = self._clock()
+        with self._lock:
+            return sorted(r for r, t in self._excluded_until.items()
+                          if t > now)
+
+    def _exclude(self, replica_id: str, reason: str) -> None:
+        with self._lock:
+            self._excluded_until[replica_id] = (
+                self._clock() + self.exclude_cooldown_s)
+        self._redispatch_counter(reason).inc()
+
+    def pick(self, skip: Sequence[str] = ()) -> Optional[RoutablePort]:
+        """Least-loaded healthy replica, or None. Ties break on free
+        blocks (more is better), then replica id (determinism)."""
+        now = self._clock()
+        with self._lock:
+            candidates = []
+            healthy = 0
+            for rid, rep in self._replicas.items():
+                until = self._excluded_until.get(rid, 0.0)
+                if until <= now:
+                    self._excluded_until.pop(rid, None)
+                if not rep.admitting():
+                    continue
+                if until > now:
+                    continue
+                healthy += 1
+                if rid in skip:
+                    continue
+                candidates.append((rep.load(), rid, rep))
+            self._g_healthy.set(healthy)
+        if not candidates:
+            return None
+        candidates.sort(key=lambda c: (c[0], c[1]))
+        return candidates[0][2]
+
+    # -- dispatch ----------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 16, *,
+               eos_token_id: Optional[int] = None,
+               request_id: Optional[str] = None,
+               timeout: Optional[float] = None) -> Any:
+        """Dispatch one request; returns the replica's handle (annotated
+        with ``.replica_id``). One pass over the fleet per attempt:
+        failing replicas are excluded and the next-least-loaded tried
+        immediately (no sleep — that's the no-retry-storm property);
+        only a fully excluded fleet backs off, under ``self.policy``.
+        ``timeout`` bounds the total dispatch wait, mapping to the
+        policy's deadline semantics."""
+        policy = self.policy
+        if timeout is not None:
+            policy = RetryPolicy(
+                name=policy.name, max_attempts=policy.max_attempts,
+                base_delay_s=policy.base_delay_s,
+                multiplier=policy.multiplier,
+                max_delay_s=policy.max_delay_s, jitter=policy.jitter,
+                deadline_s=timeout, retryable=policy.retryable)
+        return retry_call(self._dispatch_once, prompt, max_new_tokens,
+                          eos_token_id=eos_token_id, request_id=request_id,
+                          policy=policy)
+
+    def _dispatch_once(self, prompt: Sequence[int], max_new_tokens: int, *,
+                       eos_token_id: Optional[int],
+                       request_id: Optional[str]) -> Any:
+        tried: List[str] = []
+        while True:
+            target = self.pick(skip=tried)
+            if target is None:
+                raise NoHealthyReplica(
+                    f"no healthy replica (tried {tried or 'none'}, "
+                    f"excluded {self.excluded()})")
+            try:
+                handle = target.submit(
+                    prompt, max_new_tokens, eos_token_id=eos_token_id,
+                    request_id=request_id)
+            except ValueError:
+                raise  # never-servable: not a replica's fault
+            except _FAILOVER_ERRORS as exc:
+                reason = ("overloaded" if isinstance(exc, ServerOverloaded)
+                          else "connection")
+                tried.append(target.replica_id)
+                self._exclude(target.replica_id, reason)
+                continue
+            handle.replica_id = target.replica_id
+            self._c_dispatch.inc()
+            return handle

@@ -348,6 +348,7 @@ class ClusterMetricsAggregator:
                         f"{name}{_label_str(labels)} {_fmt(s['value'])}")
             lines.extend(self._rollup_lines(name, fam))
         lines.extend(self._goodput_lines(fams))
+        lines.extend(self._serving_fleet_lines(fams))
         text = "\n".join(ln for ln in lines if ln)
         return text + ("\n" if text else "")
 
@@ -398,6 +399,67 @@ class ClusterMetricsAggregator:
             "cluster_fraction": (productive_total / wall_total
                                  if wall_total > 0 else None),
         }
+
+    def serving_fleet_rollup(self, fams: Optional[Dict[str, Any]] = None
+                             ) -> Optional[Dict[str, Any]]:
+        """Fleet view over every ``component=serving_replica_*`` snapshot
+        (ServingFleet.sample_telemetry feeds one per replica): aggregate
+        decode throughput and free KV blocks are sums — capacity adds up
+        — but the latency figure is the *max* replica p99, because a
+        fleet is as slow as the replica the router is currently landing
+        you on, and a count-weighted average would let one congested
+        replica hide behind its idle peers. None when no replica has
+        reported (the serving lanes are optional)."""
+        fams = fams if fams is not None else self._families()
+
+        def per_replica(name: str, key: str = "value"
+                        ) -> Dict[str, float]:
+            out: Dict[str, float] = {}
+            for labels, s in fams.get(name, {}).get("children", []):
+                comp = labels.get("component", "")
+                if comp.startswith("serving_replica") and key in s:
+                    out[comp] = float(s[key])
+            return out
+
+        tps = per_replica("serving_tokens_per_sec")
+        free = per_replica("serving_free_kv_blocks")
+        queue = per_replica("serving_queue_depth")
+        p99 = per_replica("serving_request_total_seconds", "p99")
+        completed = per_replica("serving_requests_completed_total")
+        replicas = (set(tps) | set(free) | set(queue) | set(p99)
+                    | set(completed))
+        if not replicas:
+            return None
+        return {
+            "replicas": len(replicas),
+            "tokens_per_sec": sum(tps.values()),
+            "free_kv_blocks": sum(free.values()),
+            "queue_depth": sum(queue.values()),
+            "max_replica_p99_s": max(p99.values()) if p99 else None,
+            "requests_completed": sum(completed.values()),
+        }
+
+    def _serving_fleet_lines(self, fams: Dict[str, Any]) -> List[str]:
+        """``dct_fleet_*`` gauges for ``dump()`` — the scrapeable shape
+        of :meth:`serving_fleet_rollup`."""
+        roll = self.serving_fleet_rollup(fams)
+        if roll is None:
+            return []
+        lines = []
+        for name, key in (("dct_fleet_replicas", "replicas"),
+                          ("dct_fleet_tokens_per_sec", "tokens_per_sec"),
+                          ("dct_fleet_free_kv_blocks", "free_kv_blocks"),
+                          ("dct_fleet_queue_depth", "queue_depth"),
+                          ("dct_fleet_max_replica_p99_seconds",
+                           "max_replica_p99_s"),
+                          ("dct_fleet_requests_completed",
+                           "requests_completed")):
+            v = roll.get(key)
+            if v is None:
+                continue
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {_fmt(v)}")
+        return lines
 
     def _goodput_lines(self, fams: Dict[str, Any]) -> List[str]:
         """``dct_goodput_*`` families: the per-trial fraction under its
@@ -554,6 +616,7 @@ class ClusterMetricsAggregator:
             "mfu_measured_by_trial": mfu_measured,
             "straggler": straggler,
             "goodput": self.goodput_rollup(fams),
+            "serving_fleet": self.serving_fleet_rollup(fams),
             "quantiles": quantiles,
             "counters": dict(sorted(counters.items())),
             "ingest": ingest,
@@ -601,6 +664,17 @@ def format_summary(summary: Dict[str, Any]) -> str:
                 f"{c}={s:.2f}s" for c, s in badput)) if badput else ""
             out.append(f"  trial {tid}: goodput {frac_s} of "
                        f"{acct.get('wall_s', 0.0):.2f}s{bad_s}")
+    fleet = summary.get("serving_fleet")
+    if fleet:
+        p99 = fleet.get("max_replica_p99_s")
+        p99_s = f"{p99:.4f}s" if p99 is not None else "n/a"
+        out.append(
+            f"serving fleet: {fleet['replicas']} replicas, "
+            f"{fleet['tokens_per_sec']:.1f} tokens/sec aggregate, "
+            f"{int(fleet['free_kv_blocks'])} free KV blocks, "
+            f"queue depth {int(fleet['queue_depth'])}, "
+            f"max replica p99 {p99_s}, "
+            f"{int(fleet['requests_completed'])} requests completed")
     if summary["quantiles"]:
         out.append("latency quantiles (cluster, count-weighted):")
         for name, qs in sorted(summary["quantiles"].items()):

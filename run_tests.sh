@@ -40,6 +40,13 @@
 # compile discipline, the HTTP surface, the KV-cached decode FLOPs
 # accounting, and the batch-inference dropped-example counter.
 #
+# `./run_tests.sh --fleet` runs the serving-fleet surface (docs/serving.md
+# "Replica fleets"): the least-loaded router + 429 failover, the drain
+# protocol and drain-protected scale-down, blue-green rollout parity, the
+# queue-driven autoscaler, the fleet HTTP/CLI surface and the aggregator
+# rollup — plus the single-engine suite the fleet builds on. The master
+# integration tests skip cleanly when the C++ build is unavailable.
+#
 # `./run_tests.sh --bench-gate` compares the two newest BENCH_r*.json
 # rounds via tools/bench_gate.py (default -5% samples/sec tolerance; the
 # new round must carry a non-null mfu — docs/observability.md).
@@ -70,6 +77,10 @@ elif [ "$1" = "--control-plane" ]; then
 elif [ "$1" = "--serving" ]; then
     shift
     set -- tests/test_serving.py tests/test_batch_inference.py \
+        -m "not slow" "$@"
+elif [ "$1" = "--fleet" ]; then
+    shift
+    set -- tests/test_serving_fleet.py tests/test_serving.py \
         -m "not slow" "$@"
 elif [ "$1" = "--observability" ]; then
     shift

@@ -38,6 +38,7 @@ _LIBRARY_THREAD_PREFIXES = (
     "train-prefetch", "eval-prefetch", "device-prefetch",
     "profiler-", "ckpt-upload", "tb-sync",
     "serving-engine", "serving-http",
+    "fleet-link", "fleet-drain", "fleet-autoscaler", "fleet-http",
 )
 
 # Deliberately process-lifetime daemon threads: the shared transfer pool's

@@ -81,7 +81,7 @@ def newest_rounds(directory: str = ".") -> Tuple[str, str]:
 # headline throughput/mfu checks below are the contract.
 OPTIONAL_SECTIONS = ("control_plane", "checkpoint_io", "pipeline",
                      "mnist_cnn", "tpu_probe_telemetry", "xla", "goodput",
-                     "serving")
+                     "serving", "serving_fleet")
 
 
 def _section_notes(old_detail: Dict[str, Any], new_detail: Dict[str, Any],
@@ -269,6 +269,62 @@ def _serving_lines(old_detail: Dict[str, Any],
                 f"{p99_old}s → {p99_new}s ({p99_new / p99_old - 1.0:+.1%})")
 
 
+def _serving_fleet_lines(old_detail: Dict[str, Any],
+                         new_detail: Dict[str, Any], report: list) -> None:
+    """Advisory fleet-section reporting (serving/fleet.py measured by
+    bench's replica-scaling ladder): aggregate tokens/sec at 1/2/4
+    replicas plus the mid-burst blue-green rollout. WARNs when the
+    section errored, when throughput stopped scaling monotonically with
+    replica count, when 2 replicas deliver under 1.6x of 1 (the paced
+    engines should land ~2x — below 1.6x the router or the drain path is
+    eating the gain), or when the rollout dropped requests / broke
+    greedy version parity. Advisory-only: the ladder shares the box with
+    the bench itself; the enforced contracts are the tier-1 fleet
+    tests."""
+    sf_new = new_detail.get("serving_fleet")
+    if not isinstance(sf_new, dict):
+        return
+    if sf_new.get("error"):
+        report.append(f"WARN: serving_fleet errored: {sf_new['error']}")
+        return
+    points = [p for p in (sf_new.get("points") or [])
+              if isinstance(p, dict)]
+    if not points:
+        report.append("WARN: serving_fleet section has no points")
+        return
+    ladder = " ".join(
+        f"{p.get('replicas')}x={p.get('tokens_per_sec')}tok/s"
+        f"(p99={p.get('p99_total_s')}s)" for p in points)
+    report.append(
+        f"ok: serving_fleet {ladder}, speedup@2={sf_new.get('speedup_2')} "
+        f"@4={sf_new.get('speedup_4')}")
+    if not sf_new.get("monotonic", False):
+        report.append(
+            "WARN: serving_fleet tokens/sec is not monotonic in replica "
+            "count — adding replicas should add capacity")
+    sp2 = sf_new.get("speedup_2")
+    if isinstance(sp2, (int, float)) and sp2 < 1.6:
+        report.append(
+            f"WARN: serving_fleet 2-replica speedup {sp2} < 1.6x")
+    ro = sf_new.get("rollout")
+    if isinstance(ro, dict):
+        failed = ro.get("failed")
+        if isinstance(failed, (int, float)) and failed > 0:
+            report.append(
+                f"WARN: blue-green rollout dropped {failed} requests "
+                f"(the drain protocol promises zero)")
+        if not ro.get("parity_ok", False):
+            report.append(
+                "WARN: blue-green rollout broke greedy version parity "
+                "(a response mixed old and new params)")
+        else:
+            report.append(
+                f"ok: rollout under load: {ro.get('failed')} failed, "
+                f"{ro.get('old_version_responses')} old / "
+                f"{ro.get('new_version_responses')} new responses, "
+                f"{ro.get('rollout_duration_s')}s")
+
+
 def gate(old: Dict[str, Any], new: Dict[str, Any], *,
          tolerance: float = DEFAULT_TOLERANCE,
          allow_null_mfu: bool = False) -> Tuple[bool, list]:
@@ -320,6 +376,7 @@ def gate(old: Dict[str, Any], new: Dict[str, Any], *,
     _xla_lines(old_detail, new_detail, report)
     _goodput_lines(old_detail, new_detail, report)
     _serving_lines(old_detail, new_detail, report)
+    _serving_fleet_lines(old_detail, new_detail, report)
     return ok, report
 
 

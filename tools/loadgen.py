@@ -310,6 +310,265 @@ def run_load(trials: int = 1000, agents: int = 8, slots_per_agent: int = 8,
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _percentiles(samples: list) -> dict:
+    """p50/p95/p99 with numpy-style linear interpolation (no numpy dep —
+    loadgen must run beside a master with nothing but the stdlib)."""
+    if not samples:
+        return {"p50": None, "p95": None, "p99": None, "count": 0}
+    s = sorted(samples)
+
+    def pct(q: float) -> float:
+        pos = q / 100.0 * (len(s) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(s) - 1)
+        return s[lo] * (1 - (pos - lo)) + s[hi] * (pos - lo)
+
+    return {"p50": round(pct(50), 6), "p95": round(pct(95), 6),
+            "p99": round(pct(99), 6), "count": len(s)}
+
+
+def run_mixed_load(trials: int = 400, agents: int = 4,
+                   slots_per_agent: int = 8, serving_replicas: int = 2,
+                   serving_requests: int = 120,
+                   tokens_per_request: int = 8,
+                   iteration_floor_s: float = 0.01,
+                   budget_s: float = 240.0,
+                   master_port: int | None = None) -> dict:
+    """Trials AND a serving fleet on one simulated cluster.
+
+    The trial half is :func:`run_load`'s machinery (simulated agents in
+    the ``default`` pool, trials minted through the searcher ops route).
+    The serving half is REAL: a ``ServingFleet`` of tiny-GPT engines
+    whose replicas are master ``serving`` gang allocations in their own
+    ``serving`` pool (the standard serving/training pool split), driven
+    through the least-loaded router while the trial storm is in flight.
+    Both sides contend for the master's decision loop and this host's
+    CPU, which is the contention the mixed numbers measure: trial
+    submit→running p95 from the master's own reservoir, serving p99 from
+    client-observed request latencies. Also returns the fleet rollup the
+    aggregator computes from the per-replica registries (what ``dct
+    metrics`` shows) and the master's serving counters (what proves the
+    gang allocations went through the scheduler).
+    """
+    t_total0 = time.monotonic()
+    proc = None
+    tmp = None
+    port = master_port
+    fleet = None
+    link = None
+    try:
+        # serving imports are deliberately lazy: the control_plane lane
+        # must keep working on hosts without jax
+        import jax
+
+        from determined_clone_tpu.models import gpt
+        from determined_clone_tpu.serving import MasterLink, ServingFleet
+        from determined_clone_tpu.serving.bucketing import BucketSpec
+        from determined_clone_tpu.serving.kv_cache import KVCacheConfig
+        from determined_clone_tpu.telemetry.aggregate import (
+            ClusterMetricsAggregator,
+        )
+
+        if port is None:
+            binary = ensure_master_binary()
+            if binary is None:
+                return {"error": "dct-master build unavailable"}
+            tmp = tempfile.mkdtemp(prefix="dct-loadgen-")
+            port = _free_port()
+            proc = subprocess.Popen(
+                [binary, "--port", str(port), "--data-dir",
+                 os.path.join(tmp, "data"), "--db", "sqlite"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            if not _wait_up(port):
+                return {"error": "spawned master did not come up"}
+        elif not _wait_up(port, 5.0):
+            return {"error": f"no master on port {port}"}
+
+        base_c = _counters(_sched(port))
+
+        for i in range(agents):
+            _req(port, "POST", "/api/v1/agents/register",
+                 {"id": f"loadgen-agent-{i}", "slots": slots_per_agent,
+                  "topology": f"fake-{slots_per_agent}",
+                  "address": "127.0.0.1:0", "resource_pool": "default"})
+
+        # -- the serving half: real engines, master-managed ---------------
+        cfg = gpt.GPTConfig(vocab_size=97, n_layers=2, d_model=32,
+                            n_heads=4, d_ff=64, max_seq_len=48,
+                            remat=False, attention_impl="mha")
+        params = gpt.init(jax.random.PRNGKey(0), cfg)
+        aggregator = ClusterMetricsAggregator()
+        fleet = ServingFleet(
+            params, cfg, name="loadgen",
+            buckets=BucketSpec.build(4, 16),
+            cache=KVCacheConfig(num_blocks=24, block_size=8),
+            max_queue_depth=max(64, serving_requests),
+            iteration_floor_s=iteration_floor_s, aggregator=aggregator)
+        link = MasterLink(fleet, port, replicas=serving_replicas,
+                          resource_pool="serving")
+        link.wait_replicas(serving_replicas, timeout=60)
+        fleet.sample_telemetry()  # baseline for the tokens/sec delta
+
+        stop = threading.Event()
+        sims = [_AgentSim(port, f"loadgen-agent-{i}", stop)
+                for i in range(agents)]
+        for s in sims:
+            s.start()
+
+        serving_lat: list = []
+        serving_errors = [0]
+
+        def drive_serving() -> None:
+            handles = []
+            for i in range(serving_requests):
+                if stop.is_set():
+                    break
+                try:
+                    handles.append(fleet.submit(
+                        [1 + (i % 7), 2, 3], tokens_per_request,
+                        timeout=30.0))
+                except Exception:  # noqa: BLE001 — counted, not fatal
+                    serving_errors[0] += 1
+            for h in handles:
+                try:
+                    serving_lat.append(h.result(60.0).total_s)
+                except Exception:  # noqa: BLE001
+                    serving_errors[0] += 1
+
+        serving_thread = threading.Thread(target=drive_serving,
+                                          name="loadgen-serving",
+                                          daemon=True)
+        t_serving0 = time.monotonic()
+        serving_thread.start()
+
+        # -- the trial half, concurrent with the serving traffic ----------
+        exp = _req(port, "POST", "/api/v1/experiments", {"config": {
+            "name": "loadgen-mixed", "entrypoint": "noop:Noop",
+            "searcher": {"name": "custom", "metric": "loss"},
+            "resources": {"slots_per_trial": 1},
+            "hyperparameters": {},
+        }})
+        exp_id = (exp.get("experiment") or exp)["id"]
+        t_sub0 = time.monotonic()
+        submitted = 0
+        rid = 0
+        while submitted < trials:
+            if time.monotonic() - t_total0 > budget_s:
+                break
+            n = min(OPS_PER_BATCH, trials - submitted)
+            ops = []
+            for _ in range(n):
+                ops.append({"type": "create", "request_id": rid,
+                            "hparams": {}})
+                ops.append({"type": "validate_after", "request_id": rid,
+                            "units": 1})
+                rid += 1
+            _req(port, "POST",
+                 f"/api/v1/experiments/{exp_id}/searcher/operations",
+                 {"ops": ops}, timeout=60)
+            submitted += n
+        submit_wall = max(time.monotonic() - t_sub0, 1e-9)
+
+        peak_queue = 0
+        done = 0
+        incomplete = False
+        while True:
+            s = _sched(port)
+            gauges = s.get("gauges") or {}
+            peak_queue = max(peak_queue, int(gauges.get("queue_depth") or 0))
+            done = int(_counters(s).get("completed", 0)
+                       - base_c.get("completed", 0))
+            # completed_total counts every terminal allocation, serving
+            # replicas included — subtract them to see the trial side
+            serving_done = int(_counters(s).get("serving_completed", 0)
+                               - base_c.get("serving_completed", 0))
+            trial_done = (done - serving_done) >= submitted
+            if trial_done and not serving_thread.is_alive():
+                break
+            if time.monotonic() - t_total0 > budget_s:
+                incomplete = True
+                break
+            time.sleep(0.25)
+        serving_thread.join(timeout=60)
+        serving_wall = max(time.monotonic() - t_serving0, 1e-9)
+        stop.set()
+        for s_ in sims:
+            s_.join(timeout=5)
+
+        fleet.sample_telemetry()
+        fleet_roll = aggregator.serving_fleet_rollup()
+        fleet_stats = fleet.stats()
+
+        final = _sched(port)
+        fc, lat = _counters(final), final.get("latency") or {}
+        # the acceptance probe: serving gang allocations visible in the
+        # master's own scheduler families
+        metrics_text = ""
+        try:
+            r = urllib.request.Request(f"http://127.0.0.1:{port}/metrics")
+            with urllib.request.urlopen(r, timeout=10) as resp:
+                metrics_text = resp.read().decode()
+        except (OSError, ValueError):
+            pass
+        serving_families = sorted({
+            line.split("{")[0].split(" ")[0]
+            for line in metrics_text.splitlines()
+            if line.startswith("dct_master_sched_serving")})
+
+        def delta(name: str) -> int:
+            return int(fc.get(name, 0) - base_c.get(name, 0))
+
+        s2r = lat.get("submit_to_running_seconds") or {}
+        return {
+            "trials": {
+                "requested": trials,
+                "submitted": submitted,
+                "completed": done,
+                "submits_per_sec": round(submitted / submit_wall, 2),
+                "peak_queue_depth": peak_queue,
+                "submit_to_running_s": {
+                    "p50": s2r.get("p50"), "p95": s2r.get("p95"),
+                    "p99": s2r.get("p99"), "count": s2r.get("count"),
+                },
+            },
+            "serving": {
+                "replicas": serving_replicas,
+                "requests": serving_requests,
+                "errors": serving_errors[0],
+                "completed": fleet_stats.completed,
+                "tokens_generated": fleet_stats.tokens_generated,
+                "tokens_per_sec": round(
+                    fleet_stats.tokens_generated / serving_wall, 2),
+                "request_total_s": _percentiles(serving_lat),
+                "master_counters": {
+                    "serving_submitted": delta("serving_submitted"),
+                    "serving_running": delta("serving_running"),
+                    "serving_completed": delta("serving_completed"),
+                },
+                "sched_serving_families": serving_families,
+            },
+            "fleet_rollup": fleet_roll,
+            "duration_s": round(time.monotonic() - t_total0, 3),
+            "agent_errors": sum(s_.errors for s_ in sims),
+            "incomplete": incomplete,
+        }
+    except (OSError, ValueError, KeyError, ImportError) as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+    finally:
+        if link is not None:
+            link.close(kill_fleet=True)
+        if fleet is not None:
+            fleet.close()
+        if proc is not None:
+            proc.kill()
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--trials", type=int, default=1000)
@@ -320,10 +579,24 @@ def main(argv=None) -> int:
                         help="total wall-clock budget in seconds")
     parser.add_argument("--master", default=None,
                         help="PORT of a live master (default: spawn one)")
+    parser.add_argument("--mixed", action="store_true",
+                        help="mixed traffic: trials + a real serving "
+                             "fleet on one simulated cluster")
+    parser.add_argument("--serving-replicas", type=int, default=2)
+    parser.add_argument("--serving-requests", type=int, default=120)
     args = parser.parse_args(argv)
-    result = run_load(trials=args.trials, agents=args.agents,
-                      slots_per_agent=args.slots, budget_s=args.budget,
-                      master_port=int(args.master) if args.master else None)
+    if args.mixed:
+        result = run_mixed_load(
+            trials=args.trials, agents=args.agents,
+            slots_per_agent=args.slots,
+            serving_replicas=args.serving_replicas,
+            serving_requests=args.serving_requests, budget_s=args.budget,
+            master_port=int(args.master) if args.master else None)
+    else:
+        result = run_load(trials=args.trials, agents=args.agents,
+                          slots_per_agent=args.slots, budget_s=args.budget,
+                          master_port=int(args.master) if args.master
+                          else None)
     print(json.dumps(result, indent=2))
     return 1 if result.get("error") else 0
 
