@@ -406,6 +406,54 @@ class ObservabilityConfig:
 # ---------------------------------------------------------------------------
 
 @dataclasses.dataclass
+class SpeculativeConfig:
+    """The ``serving.speculative:`` block — draft-model speculative
+    decoding (docs/serving.md). The draft GPT shares the target's
+    tokenizer/vocab and max_seq_len; only its depth/width are chosen
+    here. Greedy output is bit-identical to plain decode regardless of
+    draft quality — a bad draft only costs speed."""
+    enabled: bool = False
+    k: int = 4                      # draft tokens proposed per iteration
+    draft_layers: int = 1
+    draft_d_model: int = 128
+    draft_n_heads: int = 2
+    draft_d_ff: int = 512
+
+    @staticmethod
+    def from_dict(raw: Dict[str, Any]) -> "SpeculativeConfig":
+        if not isinstance(raw, dict):
+            raise ConfigError(
+                f"serving.speculative must be a mapping, got {raw!r}")
+        cfg = SpeculativeConfig(
+            enabled=bool(raw.get("enabled", False)),
+            k=int(raw.get("k", 4)),
+            draft_layers=int(raw.get("draft_layers", 1)),
+            draft_d_model=int(raw.get("draft_d_model", 128)),
+            draft_n_heads=int(raw.get("draft_n_heads", 2)),
+            draft_d_ff=int(raw.get("draft_d_ff", 512)),
+        )
+        cfg.validate()
+        return cfg
+
+    def validate(self) -> None:
+        if not 1 <= self.k <= 16:
+            raise ConfigError(
+                f"serving.speculative.k must be in [1, 16], got {self.k}")
+        for name, v in (("draft_layers", self.draft_layers),
+                        ("draft_d_model", self.draft_d_model),
+                        ("draft_n_heads", self.draft_n_heads),
+                        ("draft_d_ff", self.draft_d_ff)):
+            if v < 1:
+                raise ConfigError(
+                    f"serving.speculative.{name} must be >= 1, got {v}")
+        if self.draft_d_model % self.draft_n_heads:
+            raise ConfigError(
+                f"serving.speculative.draft_d_model "
+                f"{self.draft_d_model} must divide by draft_n_heads "
+                f"{self.draft_n_heads}")
+
+
+@dataclasses.dataclass
 class ServingConfig:
     max_batch: int = 8              # largest (pow2) batch bucket
     max_prefill_len: int = 128      # largest (pow2) prompt-length bucket
@@ -415,6 +463,10 @@ class ServingConfig:
     default_max_new_tokens: int = 64
     host: str = "127.0.0.1"
     port: int = 8191
+    prefix_cache: bool = False      # copy-on-write prompt-prefix sharing
+    chunk_prefill_len: int = 0      # 0 = off; else a prefill bucket size
+    speculative: SpeculativeConfig = dataclasses.field(
+        default_factory=SpeculativeConfig)
 
     @staticmethod
     def from_dict(raw: Dict[str, Any]) -> "ServingConfig":
@@ -429,6 +481,10 @@ class ServingConfig:
             default_max_new_tokens=int(raw.get("default_max_new_tokens", 64)),
             host=str(raw.get("host", "127.0.0.1")),
             port=int(raw.get("port", 8191)),
+            prefix_cache=bool(raw.get("prefix_cache", False)),
+            chunk_prefill_len=int(raw.get("chunk_prefill_len", 0)),
+            speculative=SpeculativeConfig.from_dict(
+                raw.get("speculative", {})),
         )
         cfg.validate()
         return cfg
@@ -455,6 +511,20 @@ class ServingConfig:
         if not 0 < self.port < 65536:
             raise ConfigError(
                 f"serving.port must be in (0, 65536), got {self.port}")
+        if self.chunk_prefill_len < 0:
+            raise ConfigError(
+                f"serving.chunk_prefill_len must be >= 0 (0 = off), "
+                f"got {self.chunk_prefill_len}")
+        if self.chunk_prefill_len:
+            v = self.chunk_prefill_len
+            if (v & (v - 1) or v > self.max_prefill_len
+                    or v < min(8, self.max_prefill_len)):
+                raise ConfigError(
+                    f"serving.chunk_prefill_len must be a power of two "
+                    f"in [{min(8, self.max_prefill_len)}, "
+                    f"{self.max_prefill_len}] (it must land on a prefill "
+                    f"bucket), got {v}")
+        self.speculative.validate()
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

@@ -241,6 +241,21 @@ EXPERIMENT_SCHEMA = {
                 "default_max_new_tokens": {"type": "integer"},
                 "host": {"type": "string"},
                 "port": {"type": "integer"},
+                "prefix_cache": {"type": "boolean"},
+                "chunk_prefill_len": {"type": "integer"},
+                # draft-model speculative decoding (docs/serving.md);
+                # the draft shares the target's tokenizer/vocab
+                "speculative": {
+                    "type": "object", "open": False,
+                    "properties": {
+                        "enabled": {"type": "boolean"},
+                        "k": {"type": "integer"},
+                        "draft_layers": {"type": "integer"},
+                        "draft_d_model": {"type": "integer"},
+                        "draft_n_heads": {"type": "integer"},
+                        "draft_d_ff": {"type": "integer"},
+                    },
+                },
             },
         },
         # deterministic fault injection (seeded FaultPlan;

@@ -398,6 +398,50 @@ def _block_paged(cfg: GPTConfig, block_params: Params, x: jax.Array,
     return x, k_flat.reshape(N, bs, H, hd), v_flat.reshape(N, bs, H, hd)
 
 
+def _paged_backbone(params: Params, cfg: GPTConfig, tokens: jax.Array,
+                    positions: jax.Array, token_mask: jax.Array,
+                    k_pool: jax.Array, v_pool: jax.Array,
+                    block_tables: jax.Array):
+    """Embed → paged transformer stack → final layernorm.
+
+    The shared core of :func:`forward_paged` (last-token readout, the
+    prefill/decode workhorse) and :func:`forward_paged_logits` (all-token
+    readout, the speculative-verify workhorse). Returns
+    ``(x [B, T, D] normed, k_pool, v_pool)``.
+    """
+    B, T = tokens.shape
+    N, bs = k_pool.shape[1], k_pool.shape[2]
+    W = block_tables.shape[1]
+    S = W * bs
+
+    # scatter slots for the new tokens: pool block backing position p is
+    # block_tables[b, p // bs]; padding tokens get an out-of-range slot so
+    # .at[].set(mode="drop") discards them
+    blk = jnp.take_along_axis(block_tables, positions // bs, axis=1)
+    scatter_idx = jnp.where(token_mask, blk * bs + positions % bs,
+                            N * bs).reshape(B * T)
+    gather_idx = (block_tables[:, :, None] * bs
+                  + jnp.arange(bs)[None, None, :]).reshape(B, S)
+    # context slot j == sequence position j: causal = "j <= my position"
+    attn_mask = (jnp.arange(S)[None, None, :] <= positions[:, :, None]
+                 ) & token_mask[:, :, None]
+    attn_mask = attn_mask[:, None]  # [B, 1, T, S] broadcast over heads
+
+    x = jnp.take(params["embed"]["table"], tokens,
+                 axis=0).astype(cfg.compute_dtype)
+
+    def scan_body(x, layer_in):
+        layer_params, k_l, v_l = layer_in
+        x, k_l, v_l = _block_paged(cfg, layer_params, x, positions, k_l,
+                                   v_l, scatter_idx, gather_idx, attn_mask)
+        return x, (k_l, v_l)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        scan_body, x, (params["blocks"], k_pool, v_pool))
+
+    return layernorm(params["final_norm"], x), k_pool, v_pool
+
+
 def forward_paged(params: Params, cfg: GPTConfig, tokens: jax.Array,
                   positions: jax.Array, token_mask: jax.Array,
                   last_index: jax.Array, k_pool: jax.Array,
@@ -434,37 +478,9 @@ def forward_paged(params: Params, cfg: GPTConfig, tokens: jax.Array,
     greedy decode through this path is token-identical to re-running the
     full uncached forward each step — tests/test_serving.py asserts it.
     """
-    B, T = tokens.shape
-    N, bs = k_pool.shape[1], k_pool.shape[2]
-    W = block_tables.shape[1]
-    S = W * bs
-
-    # scatter slots for the new tokens: pool block backing position p is
-    # block_tables[b, p // bs]; padding tokens get an out-of-range slot so
-    # .at[].set(mode="drop") discards them
-    blk = jnp.take_along_axis(block_tables, positions // bs, axis=1)
-    scatter_idx = jnp.where(token_mask, blk * bs + positions % bs,
-                            N * bs).reshape(B * T)
-    gather_idx = (block_tables[:, :, None] * bs
-                  + jnp.arange(bs)[None, None, :]).reshape(B, S)
-    # context slot j == sequence position j: causal = "j <= my position"
-    attn_mask = (jnp.arange(S)[None, None, :] <= positions[:, :, None]
-                 ) & token_mask[:, :, None]
-    attn_mask = attn_mask[:, None]  # [B, 1, T, S] broadcast over heads
-
-    x = jnp.take(params["embed"]["table"], tokens,
-                 axis=0).astype(cfg.compute_dtype)
-
-    def scan_body(x, layer_in):
-        layer_params, k_l, v_l = layer_in
-        x, k_l, v_l = _block_paged(cfg, layer_params, x, positions, k_l,
-                                   v_l, scatter_idx, gather_idx, attn_mask)
-        return x, (k_l, v_l)
-
-    x, (k_pool, v_pool) = jax.lax.scan(
-        scan_body, x, (params["blocks"], k_pool, v_pool))
-
-    x = layernorm(params["final_norm"], x)
+    x, k_pool, v_pool = _paged_backbone(params, cfg, tokens, positions,
+                                        token_mask, k_pool, v_pool,
+                                        block_tables)
     h_last = jnp.take_along_axis(
         x, last_index[:, None, None].astype(jnp.int32), axis=1)[:, 0]
     if cfg.tie_embeddings:
@@ -475,5 +491,93 @@ def forward_paged(params: Params, cfg: GPTConfig, tokens: jax.Array,
     return logits.astype(jnp.float32), k_pool, v_pool
 
 
+def forward_paged_logits(params: Params, cfg: GPTConfig, tokens: jax.Array,
+                         positions: jax.Array, token_mask: jax.Array,
+                         k_pool: jax.Array, v_pool: jax.Array,
+                         block_tables: jax.Array):
+    """Multi-token paged forward returning logits at *every* position.
+
+    The speculative-decoding verify step (docs/serving.md): the target
+    model scores ``[last committed token, draft_1 .. draft_k]`` in one
+    call — ``T == k + 1`` — and the engine accepts the longest draft
+    prefix whose tokens equal the target's own greedy picks. Because the
+    logits at position i condition only on real committed/accepted
+    context (the accept rule stops at the first disagreement), greedy
+    output is bit-identical to one-token-at-a-time decode for any draft.
+
+    Same argument contract as :func:`forward_paged` minus ``last_index``;
+    returns ``(logits [B, T, V] fp32, k_pool, v_pool)``. K/V for all
+    masked-in tokens are written to the pool — rejected drafts leave
+    stale entries past the accepted frontier, which is safe: attention
+    masks slots beyond the query's own position, and the next iteration's
+    scatter overwrites them before they ever become visible.
+    """
+    x, k_pool, v_pool = _paged_backbone(params, cfg, tokens, positions,
+                                        token_mask, k_pool, v_pool,
+                                        block_tables)
+    if cfg.tie_embeddings:
+        logits = (x.astype(jnp.float32)
+                  @ params["embed"]["table"].astype(jnp.float32).T)
+    else:
+        logits = dense(params["lm_head"], x, compute_dtype=jnp.float32)
+    return logits.astype(jnp.float32), k_pool, v_pool
+
+
 def param_count(params: Params) -> int:
     return sum(int(x.size) for x in jax.tree.leaves(params))
+
+
+def extend_with_identity_layers(params: Params, cfg: GPTConfig,
+                                extra_layers: int):
+    """Append ``extra_layers`` exact-identity residual blocks.
+
+    Pre-LN blocks add their output to the residual stream, so a block
+    whose ``attn_out`` and ``mlp_down`` projections (kernel AND bias)
+    are zero contributes exactly zero: the extended model's logits are
+    bit-identical to the original's, while every call pays the deeper
+    model's weight traffic and op count (the QKV/up projections and
+    attention still run — only the final adds vanish). That makes the
+    pair (original, extended) a controlled speculative-decoding
+    testbed: the original IS a perfectly-distilled draft of the
+    extended target, so greedy acceptance is exactly 1.0. bench.py and
+    tests/test_serving_speed.py use it to measure the spec-decode
+    ceiling without training a real draft.
+
+    Returns ``(params, cfg)`` for the deepened model. Stacked-block
+    layout means extension is a leading-axis concat; MoE blocks are not
+    supported (no per-expert identity construction).
+    """
+    if extra_layers <= 0:
+        return params, cfg
+    if cfg.moe_experts > 0:
+        raise ValueError("identity extension supports dense blocks only")
+
+    zero_adds = ("attn_out", "mlp_down")
+
+    def pad(path_top: str, leaf: jax.Array) -> jax.Array:
+        tile = jnp.tile(leaf[:1], (extra_layers,) + (1,) * (leaf.ndim - 1))
+        if path_top in zero_adds:
+            tile = jnp.zeros_like(tile)
+        return jnp.concatenate([leaf, tile], axis=0)
+
+    blocks = {name: {k: pad(name, v) for k, v in sub.items()}
+              for name, sub in params["blocks"].items()}
+    out = dict(params)
+    out["blocks"] = blocks
+    return out, dataclasses.replace(
+        cfg, n_layers=cfg.n_layers + extra_layers)
+
+
+def slice_prefix_layers(params: Params, cfg: GPTConfig, n_layers: int):
+    """Keep only the first ``n_layers`` stacked blocks (embed, final
+    norm and head shared) — the draft half of the identity-extension
+    testbed, and the cheap way to carve a layer-sliced draft out of any
+    stacked-block checkpoint. Returns ``(params, cfg)``."""
+    if not 0 < n_layers <= cfg.n_layers:
+        raise ValueError(f"n_layers must be in [1, {cfg.n_layers}], "
+                         f"got {n_layers}")
+    blocks = {name: {k: v[:n_layers] for k, v in sub.items()}
+              for name, sub in params["blocks"].items()}
+    out = dict(params)
+    out["blocks"] = blocks
+    return out, dataclasses.replace(cfg, n_layers=n_layers)

@@ -25,6 +25,8 @@ from determined_clone_tpu.serving.bucketing import (  # noqa: F401
 from determined_clone_tpu.serving.kv_cache import (  # noqa: F401
     BlockAllocator,
     KVCacheConfig,
+    PrefixCache,
+    PrefixMatch,
     init_kv_pools,
 )
 from determined_clone_tpu.serving.engine import (  # noqa: F401
@@ -34,7 +36,9 @@ from determined_clone_tpu.serving.engine import (  # noqa: F401
     Request,
     RequestResult,
     ServerOverloaded,
+    make_block_copy,
     make_paged_forward,
+    make_paged_verify,
 )
 from determined_clone_tpu.serving.router import (  # noqa: F401
     ROUTER_RETRY,

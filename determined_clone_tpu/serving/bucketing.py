@@ -81,6 +81,38 @@ class BucketSpec:
         return (len(self.batch_buckets) * len(self.prefill_len_buckets)
                 + len(self.batch_buckets))
 
+    def validate_chunk_len(self, chunk_len: int) -> int:
+        """Chunked prefill reuses the prefill ladder: a chunk call pads
+        to ``chunk_len`` exactly, so requiring the chunk length to BE a
+        ladder entry means chunking adds zero new programs."""
+        if chunk_len not in self.prefill_len_buckets:
+            raise ValueError(
+                f"chunk_prefill_len {chunk_len} must be one of the "
+                f"prefill buckets {self.prefill_len_buckets} so chunked "
+                f"prefill stays inside the program budget")
+        return chunk_len
+
+    def extended_budget(self, *, speculative: bool = False,
+                        prefix_cache: bool = False) -> int:
+        """Worst-case jit cache size across ALL the engine's jitted
+        entry points (the number warmup precompiles to and the tier-1
+        probe asserts against):
+
+        - base ladder (target prefill x batch + T=1 decode x batch);
+        - speculative: the draft model runs the same ladder through its
+          own jit (its prefill mirrors every target prefill shape, its
+          k-token proposal loop is T=1 decode), plus one k+1-token
+          verify program per batch bucket on the target;
+        - prefix sharing: one copy-on-write block-copy program per pool
+          pair (target, and draft when speculative).
+        """
+        budget = self.program_budget
+        if speculative:
+            budget += self.program_budget + len(self.batch_buckets)
+        if prefix_cache:
+            budget += 2 if speculative else 1
+        return budget
+
     @staticmethod
     def build(max_batch: int, max_prefill_len: int, *,
               min_batch: int = 1, min_prefill_len: int = 8) -> "BucketSpec":

@@ -24,6 +24,28 @@ repo-standard ``RetryPolicy`` (utils/retry.py) so clients back off with
 full jitter instead of hammering. KV-pool exhaustion is *deferred*
 admission (requests wait in queue until blocks free), never mid-decode
 eviction.
+
+Three raw-speed optimisations ride on the same loop, each individually
+optional and all preserving the bit-identical-greedy-parity pin
+(docs/serving.md has the full protocols):
+
+- **copy-on-write prefix sharing** (``prefix_cache=True``): admission
+  content-hashes the prompt's blocks against the
+  :class:`~determined_clone_tpu.serving.kv_cache.PrefixCache` and
+  aliases resident blocks through the block table, so prefill skips the
+  shared prefix entirely; the one block a new owner could ever write (the
+  block holding the re-scored last prompt token) is COW-forked first.
+- **draft-model speculative decoding** (``speculative_k=k`` plus a tiny
+  draft GPT): the draft proposes k tokens per iteration with T=1 calls,
+  the target scores all of them in ONE k+1-token verify call
+  (``forward_paged_logits``), and the accepted-prefix rule emits exactly
+  the tokens one-at-a-time greedy decode would — a disagreeing draft
+  costs speed, never correctness.
+- **chunked prefill** (``chunk_prefill_len=n``): long prompts prefill n
+  tokens per scheduler iteration, interleaved with decode steps, so one
+  huge prompt can't head-of-line-block every running sequence's next
+  token (and prompts longer than the largest prefill bucket become
+  servable at all).
 """
 from __future__ import annotations
 
@@ -45,6 +67,7 @@ from determined_clone_tpu.serving.bucketing import BucketSpec, bucket_for
 from determined_clone_tpu.serving.kv_cache import (
     BlockAllocator,
     KVCacheConfig,
+    PrefixCache,
     init_kv_pools,
 )
 from determined_clone_tpu.telemetry import MetricsRegistry
@@ -71,6 +94,27 @@ def make_paged_forward() -> Any:
                    donate_argnums=(6, 7))
 
 
+def make_paged_verify() -> Any:
+    """The jitted multi-logit forward the speculative verify step runs
+    through: one [B, k+1] call scores the last committed token plus all
+    k drafts; compiles one program per batch bucket."""
+    return jax.jit(gpt.forward_paged_logits, static_argnums=(1,),
+                   donate_argnums=(5, 6))
+
+
+def _block_copy(k_pool: jax.Array, v_pool: jax.Array,
+                src: jax.Array, dst: jax.Array):
+    """COW fork: duplicate one pool block (all layers) into another."""
+    return (k_pool.at[:, dst].set(k_pool[:, src]),
+            v_pool.at[:, dst].set(v_pool[:, src]))
+
+
+def make_block_copy() -> Any:
+    """Jitted :func:`_block_copy` — src/dst are dynamic scalars, so the
+    whole COW protocol costs exactly one XLA program per pool pair."""
+    return jax.jit(_block_copy, donate_argnums=(0, 1))
+
+
 @dataclasses.dataclass(frozen=True)
 class Request:
     """One generation request. Greedy decoding (argmax) — the serving
@@ -87,11 +131,21 @@ class RequestResult:
     request_id: str
     prompt_len: int
     tokens: List[int]
-    finish_reason: str          # "length" | "eos"
+    finish_reason: str          # "length" | "eos" | "aborted"
     queue_wait_s: float
-    prefill_s: float            # duration of the prefill call it rode
+    prefill_s: float            # total prefill device time it rode
     decode_s: float             # prefill-done → last token
     total_s: float              # submit → last token
+    prefix_hit_blocks: int = 0   # prompt blocks aliased from the cache
+    prefix_miss_blocks: int = 0  # prompt blocks prefilled from scratch
+    spec_proposed: int = 0       # draft tokens offered for this request
+    spec_accepted: int = 0       # draft tokens the target agreed with
+
+    @property
+    def spec_acceptance(self) -> Optional[float]:
+        if self.spec_proposed <= 0:
+            return None
+        return self.spec_accepted / self.spec_proposed
 
 
 @dataclasses.dataclass
@@ -105,6 +159,12 @@ class EngineStats:
     free_blocks: int
     programs_compiled: int
     program_budget: int
+    prefix_hit_blocks: int = 0
+    prefix_miss_blocks: int = 0
+    prefix_cached_entries: int = 0
+    spec_tokens_proposed: int = 0
+    spec_tokens_accepted: int = 0
+    spec_acceptance_rate: Optional[float] = None
 
 
 class _Handle:
@@ -120,6 +180,7 @@ class _Handle:
         self.admit_t = 0.0
         self.prefill_s = 0.0
         self.prefill_done_t = 0.0
+        self.cancelled = False  # set by InferenceEngine.abort
 
     def _finish(self, result: RequestResult) -> None:
         self._result = result
@@ -145,7 +206,9 @@ class _Handle:
 class _Active:
     """Scheduler-private state of one running sequence."""
 
-    __slots__ = ("handle", "blocks", "prompt_len", "out", "last_token")
+    __slots__ = ("handle", "blocks", "prompt_len", "out", "last_token",
+                 "prefill_pos", "pending_copy", "hit_blocks", "miss_blocks",
+                 "spec_proposed", "spec_accepted")
 
     def __init__(self, handle: _Handle, blocks: List[int],
                  prompt_len: int) -> None:
@@ -154,6 +217,16 @@ class _Active:
         self.prompt_len = prompt_len
         self.out: List[int] = []
         self.last_token = -1
+        # next un-prefilled prompt position: 0 for a cold prompt, the
+        # shared-prefix length after a cache hit, prompt_len once done
+        self.prefill_pos = 0
+        # (src, dst) COW fork to execute before this row's first device
+        # call; the src block keeps a caller reference until then
+        self.pending_copy: Optional[Tuple[int, int]] = None
+        self.hit_blocks = 0
+        self.miss_blocks = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
 
 
 class InferenceEngine:
@@ -171,7 +244,12 @@ class InferenceEngine:
                  max_queue_depth: int = 64,
                  telemetry: Any = None,
                  fwd: Any = None,
-                 iteration_floor_s: float = 0.0) -> None:
+                 iteration_floor_s: float = 0.0,
+                 prefix_cache: bool = False,
+                 chunk_prefill_len: int = 0,
+                 speculative_k: int = 0,
+                 draft_params: Optional[gpt.Params] = None,
+                 draft_cfg: Optional[gpt.GPTConfig] = None) -> None:
         self.model_cfg = model_cfg
         self.buckets = buckets or BucketSpec.build(
             8, min(128, model_cfg.max_seq_len))
@@ -197,6 +275,40 @@ class InferenceEngine:
         self._table_width = max(
             1, math.ceil(model_cfg.max_seq_len / cache.block_size))
         self._fwd = fwd if fwd is not None else make_paged_forward()
+
+        # -- optional raw-speed features (module docstring) --------------
+        self.chunk_prefill_len = int(chunk_prefill_len)
+        if self.chunk_prefill_len:
+            self.buckets.validate_chunk_len(self.chunk_prefill_len)
+        self._spec_k = int(speculative_k)
+        if self._spec_k < 0:
+            raise ValueError(f"speculative_k must be >= 0, got {speculative_k}")
+        if self._spec_k:
+            if draft_params is None or draft_cfg is None:
+                raise ValueError(
+                    "speculative_k > 0 needs draft_params and draft_cfg")
+            if draft_cfg.vocab_size != model_cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_cfg.vocab_size} != target vocab "
+                    f"{model_cfg.vocab_size} (the tokenizer is shared)")
+            self._draft_params = draft_params
+            self.draft_cfg = draft_cfg
+            # the draft's pools share block ids (and hence block tables
+            # and the allocator) with the target's — only the per-block
+            # payload shape differs — so prefix sharing and COW cover
+            # the draft KV with zero extra bookkeeping
+            self._dk_pool, self._dv_pool = init_kv_pools(draft_cfg, cache)
+            self._draft_fwd = make_paged_forward()
+            self._verify_fwd = make_paged_verify()
+        else:
+            self._draft_params = None
+            self.draft_cfg = None
+            self._draft_fwd = None
+            self._verify_fwd = None
+        self._prefix = PrefixCache(cache, self._allocator) \
+            if prefix_cache else None
+        self._copy = make_block_copy() if prefix_cache else None
+
         # simulated device-step floor: pad every scheduler iteration that
         # did device work up to this many seconds. 0.0 (the default) is a
         # no-op. Fleet benches on a single host set it so per-replica
@@ -237,10 +349,29 @@ class InferenceEngine:
         self._g_free_blocks = m.gauge(
             "serving_free_kv_blocks", "unallocated KV pool blocks")
         self._g_free_blocks.set(self._allocator.free_blocks())
+        self._c_prefix_hit = m.counter(
+            "prefix_cache_hit_blocks_total",
+            "prompt blocks aliased from the prefix cache (prefill skipped)")
+        self._c_prefix_miss = m.counter(
+            "prefix_cache_miss_blocks_total",
+            "prompt blocks prefilled from scratch")
+        self._c_spec_proposed = m.counter(
+            "serving_spec_tokens_proposed_total",
+            "draft tokens offered to the verify step")
+        self._c_spec_accepted = m.counter(
+            "serving_spec_tokens_accepted_total",
+            "draft tokens the target model agreed with")
+        self._g_spec_rate = m.gauge(
+            "spec_acceptance_rate",
+            "cumulative accepted/proposed draft-token ratio")
+        self._h_spec_accept = m.histogram(
+            "serving_spec_request_acceptance_rate",
+            "per-request draft acceptance rate at retirement")
 
         self._cond = threading.Condition()
         self._queue: collections.deque[_Handle] = collections.deque()
         self._active: List[_Active] = []
+        self._prefilling: List[_Active] = []
         self._stop = False
         self._warming = False
         self._busy = False  # scheduler outside its wait with device work
@@ -258,20 +389,41 @@ class InferenceEngine:
     def from_serving_config(cls, params: gpt.Params,
                             model_cfg: gpt.GPTConfig, scfg: Any, *,
                             telemetry: Any = None, fwd: Any = None,
-                            iteration_floor_s: float = 0.0
+                            iteration_floor_s: float = 0.0,
+                            draft_params: Optional[gpt.Params] = None
                             ) -> "InferenceEngine":
         """Build an engine from a config/experiment.py ServingConfig
-        (the `serving:` block of an experiment YAML)."""
+        (the `serving:` block of an experiment YAML). When the
+        ``speculative:`` block is enabled the draft GPT shares the
+        tokenizer/vocab and max_seq_len with the target; its weights
+        come from ``draft_params`` or, absent one (no distilled draft
+        checkpoint yet), a seeded random init — correct but slow, since
+        the accept rule never trusts the draft."""
         buckets = BucketSpec.build(
             scfg.max_batch, min(scfg.max_prefill_len, model_cfg.max_seq_len))
         blocks = scfg.kv_blocks or scfg.max_batch * max(
             1, math.ceil(model_cfg.max_seq_len / scfg.kv_block_size))
+        spec = getattr(scfg, "speculative", None)
+        spec_k = 0
+        draft_cfg = None
+        if spec is not None and spec.enabled:
+            spec_k = spec.k
+            draft_cfg = dataclasses.replace(
+                model_cfg, n_layers=spec.draft_layers,
+                d_model=spec.draft_d_model, n_heads=spec.draft_n_heads,
+                d_ff=spec.draft_d_ff, remat=False)
+            if draft_params is None:
+                draft_params = gpt.init(jax.random.PRNGKey(0), draft_cfg)
         return cls(params, model_cfg, buckets=buckets,
                    cache=KVCacheConfig(num_blocks=blocks,
                                        block_size=scfg.kv_block_size),
                    max_queue_depth=scfg.max_queue_depth,
                    telemetry=telemetry, fwd=fwd,
-                   iteration_floor_s=iteration_floor_s)
+                   iteration_floor_s=iteration_floor_s,
+                   prefix_cache=getattr(scfg, "prefix_cache", False),
+                   chunk_prefill_len=getattr(scfg, "chunk_prefill_len", 0),
+                   speculative_k=spec_k, draft_params=draft_params,
+                   draft_cfg=draft_cfg)
 
     # -- client surface ----------------------------------------------------
 
@@ -298,7 +450,10 @@ class InferenceEngine:
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, "
                              f"got {max_new_tokens}")
-        if len(prompt) > self.buckets.max_prefill_len:
+        if not self.chunk_prefill_len \
+                and len(prompt) > self.buckets.max_prefill_len:
+            # chunked prefill lifts this limit: any prompt that fits the
+            # model context is served chunk_prefill_len tokens at a time
             raise ValueError(
                 f"prompt length {len(prompt)} exceeds the largest prefill "
                 f"bucket {self.buckets.max_prefill_len}")
@@ -346,6 +501,20 @@ class InferenceEngine:
         return self.submit(prompt, max_new_tokens,
                            eos_token_id=eos_token_id).result(timeout)
 
+    def abort(self, handle: _Handle) -> bool:
+        """Cancel one in-flight request (client disconnect). The
+        scheduler retires it at the next iteration boundary — never
+        mid-step — releasing its pool blocks exactly as a natural finish
+        would (tests pin the allocator accounting). The handle resolves
+        with whatever was generated so far and ``finish_reason ==
+        "aborted"``. Returns False if the request already finished."""
+        with self._cond:
+            if handle.done():
+                return False
+            handle.cancelled = True
+            self._cond.notify_all()
+        return True
+
     # -- model hot-swap ----------------------------------------------------
 
     def hot_swap(self, params: gpt.Params) -> None:
@@ -390,10 +559,14 @@ class InferenceEngine:
 
         The dummy inputs are fully masked (``token_mask`` all False), so
         nothing is written to the KV pools — warmup is invisible to
-        every later request. Requires an idle engine; the scheduler is
-        parked for the duration (racing submits queue up and are served
-        once warmup finishes). Returns :meth:`programs_compiled`, which
-        now equals ``buckets.program_budget``.
+        every later request (the COW copy program is warmed by copying
+        block 0 onto itself: bit-identical values). Requires an idle
+        engine; the scheduler is parked for the duration (racing submits
+        queue up and are served once warmup finishes). Returns
+        :meth:`programs_compiled`, which now equals
+        :meth:`program_budget` — the full ladder includes the draft
+        model's mirror ladder, the k+1-token verify program per batch
+        bucket, and the COW copy when those features are on.
         """
         with self._cond:
             self._await_idle_locked("warmup")
@@ -401,20 +574,43 @@ class InferenceEngine:
         t0 = time.monotonic()
         try:
             with self._span("serving_warmup"):
+                lanes = [(self._fwd, self._params, self.model_cfg)]
+                if self._spec_k:
+                    lanes.append((self._draft_fwd, self._draft_params,
+                                  self.draft_cfg))
                 for b in self.buckets.batch_buckets:
                     tables = jnp.zeros((b, self._table_width), jnp.int32)
-                    for t in (*self.buckets.prefill_len_buckets, 1):
-                        logits, self._k_pool, self._v_pool = self._fwd(
-                            self._params, self.model_cfg,
-                            jnp.zeros((b, t), jnp.int32),
-                            jnp.zeros((b, t), jnp.int32),
-                            jnp.zeros((b, t), bool),
-                            jnp.zeros((b,), jnp.int32),
-                            self._k_pool, self._v_pool, tables)
-                        # the sampling step is its own (tiny) program per
-                        # batch bucket — leave it cold and the first real
-                        # request pays its compile
-                        jnp.argmax(logits, axis=-1).block_until_ready()
+                    for fwd, params, cfg in lanes:
+                        for t in (*self.buckets.prefill_len_buckets, 1):
+                            logits, kp, vp = fwd(
+                                params, cfg,
+                                jnp.zeros((b, t), jnp.int32),
+                                jnp.zeros((b, t), jnp.int32),
+                                jnp.zeros((b, t), bool),
+                                jnp.zeros((b,), jnp.int32),
+                                *self._pools_for(cfg), tables)
+                            self._set_pools_for(cfg, kp, vp)
+                            # the sampling step is its own (tiny) program
+                            # per batch bucket — leave it cold and the
+                            # first real request pays its compile
+                            jnp.argmax(logits, axis=-1).block_until_ready()
+                    if self._spec_k:
+                        t = self._spec_k + 1
+                        logits, self._k_pool, self._v_pool = \
+                            self._verify_fwd(
+                                self._params, self.model_cfg,
+                                jnp.zeros((b, t), jnp.int32),
+                                jnp.zeros((b, t), jnp.int32),
+                                jnp.zeros((b, t), bool),
+                                self._k_pool, self._v_pool, tables)
+                        logits.block_until_ready()
+                if self._copy is not None:
+                    self._k_pool, self._v_pool = self._copy(
+                        self._k_pool, self._v_pool, 0, 0)
+                    if self._spec_k:
+                        self._dk_pool, self._dv_pool = self._copy(
+                            self._dk_pool, self._dv_pool, 0, 0)
+                    jax.block_until_ready(self._k_pool)
         finally:
             with self._cond:
                 self._warming = False
@@ -435,7 +631,7 @@ class InferenceEngine:
             raise RuntimeError("serving engine is closed")
         if self._fatal is not None:
             raise RuntimeError("serving engine died") from self._fatal
-        if self._queue or self._active:
+        if self._queue or self._active or self._prefilling:
             raise RuntimeError(f"{what} requires an idle engine")
         while self._busy and not self._stop and self._fatal is None:
             self._cond.wait()
@@ -443,7 +639,7 @@ class InferenceEngine:
             raise RuntimeError("serving engine is closed")
         if self._fatal is not None:
             raise RuntimeError("serving engine died") from self._fatal
-        if self._queue or self._active:
+        if self._queue or self._active or self._prefilling:
             raise RuntimeError(f"{what} requires an idle engine")
 
     def wait_idle(self, timeout: float = 60.0) -> None:
@@ -457,7 +653,8 @@ class InferenceEngine:
         """
         deadline = time.monotonic() + timeout
         with self._cond:
-            while self._queue or self._active or self._busy:
+            while (self._queue or self._active or self._prefilling
+                   or self._busy):
                 if self._fatal is not None:
                     raise RuntimeError(
                         "serving engine died") from self._fatal
@@ -468,20 +665,49 @@ class InferenceEngine:
                     raise TimeoutError(
                         f"engine not idle after {timeout}s "
                         f"(queue={len(self._queue)} "
-                        f"active={len(self._active)})")
+                        f"active={len(self._active)} "
+                        f"prefilling={len(self._prefilling)})")
                 self._cond.wait(remaining)
 
     # -- introspection -----------------------------------------------------
 
     def programs_compiled(self) -> int:
-        """XLA programs behind the shared jitted forward (the PR 2
-        retrace probe). The tier-1 compile-discipline test asserts this
-        never exceeds ``buckets.program_budget``."""
-        probe = getattr(self._fwd, "_cache_size", None)
-        return int(probe()) if callable(probe) else -1
+        """XLA programs across ALL the engine's jitted entry points —
+        shared forward, draft forward, k+1-token verify, COW copy (the
+        PR 2 retrace probe). The tier-1 compile-discipline test asserts
+        this never exceeds :meth:`program_budget`."""
+        total = 0
+        seen = []
+        for f in (self._fwd, self._draft_fwd, self._verify_fwd,
+                  self._copy):
+            if f is None:
+                continue
+            # jax keys the jit cache on the underlying function: _fwd
+            # and _draft_fwd both wrap gpt.forward_paged, so they SHARE
+            # one cache (that is what lets the draft ladder ride the
+            # fleet-shared forward) — count each distinct cache once or
+            # the draft programs get double-counted
+            wrapped = getattr(f, "__wrapped__", f)
+            if any(wrapped is w for w in seen):
+                continue
+            seen.append(wrapped)
+            probe = getattr(f, "_cache_size", None)
+            if not callable(probe):
+                return -1
+            total += int(probe())
+        return total
+
+    def program_budget(self) -> int:
+        """Worst-case :meth:`programs_compiled` for the feature set this
+        engine was built with; :meth:`warmup` compiles exactly this many."""
+        return self.buckets.extended_budget(
+            speculative=self._spec_k > 0,
+            prefix_cache=self._prefix is not None)
 
     def stats(self) -> EngineStats:
         with self._cond:
+            proposed = int(self._c_spec_proposed.value)
+            accepted = int(self._c_spec_accepted.value)
             return EngineStats(
                 submitted=self._submitted,
                 rejected=int(self._c_rejected.value),
@@ -491,7 +717,15 @@ class InferenceEngine:
                 queue_depth=len(self._queue),
                 free_blocks=self._allocator.free_blocks(),
                 programs_compiled=self.programs_compiled(),
-                program_budget=self.buckets.program_budget)
+                program_budget=self.program_budget(),
+                prefix_hit_blocks=int(self._c_prefix_hit.value),
+                prefix_miss_blocks=int(self._c_prefix_miss.value),
+                prefix_cached_entries=(len(self._prefix)
+                                       if self._prefix is not None else 0),
+                spec_tokens_proposed=proposed,
+                spec_tokens_accepted=accepted,
+                spec_acceptance_rate=(accepted / proposed
+                                      if proposed else None))
 
     # -- scheduler ---------------------------------------------------------
 
@@ -504,29 +738,39 @@ class InferenceEngine:
                     while (not self._stop
                            and (self._warming
                                 or (not self._queue and not self._active
+                                    and not self._prefilling
                                     and self._pending_params is None))):
                         self._cond.wait()
                     if self._stop:
+                        closed = RuntimeError("serving engine closed")
                         for h in self._queue:
-                            h._fail(RuntimeError("serving engine closed"))
+                            h._fail(closed)
                         self._queue.clear()
-                        for a in self._active:
-                            a.handle._fail(
-                                RuntimeError("serving engine closed"))
+                        for a in self._active + self._prefilling:
+                            a.handle._fail(closed)
                         self._active.clear()
+                        self._prefilling.clear()
                         return
                     if self._pending_params is not None:
                         self._params = self._pending_params
                         self._pending_params = None
-                    newcomers = self._admit_locked()
+                        # cached KV is a function of the params
+                        if self._prefix is not None:
+                            self._prefix.flush()
+                            self._g_free_blocks.set(
+                                self._allocator.free_blocks())
+                    self._admit_locked()
                     self._busy = True
                 iter_t0 = time.monotonic()
-                worked = False
-                if newcomers:
-                    self._prefill(newcomers)
+                worked = self._reap_aborted()
+                if self._prefilling:
+                    self._prefill_step()
                     worked = True
                 if self._active:
-                    self._decode_step()
+                    if self._spec_k:
+                        self._spec_step()
+                    else:
+                        self._decode_step()
                     worked = True
                 if worked and self.iteration_floor_s > 0.0:
                     pad = self.iteration_floor_s \
@@ -541,30 +785,136 @@ class InferenceEngine:
                 for h in self._queue:
                     h._fail(exc)
                 self._queue.clear()
-                for a in self._active:
+                for a in self._active + self._prefilling:
                     a.handle._fail(exc)
                 self._active.clear()
+                self._prefilling.clear()
 
-    def _admit_locked(self) -> List[_Active]:
-        """Move queued requests into the batch while slots AND pool
-        blocks allow. FIFO — a head-of-line request the pool can't fit
-        yet blocks later ones (no starvation by bypass)."""
-        newcomers: List[_Active] = []
+    def _admit_locked(self) -> None:
+        """Move queued requests into the prefilling set while slots AND
+        pool blocks allow. FIFO — a head-of-line request the pool can't
+        fit yet blocks later ones (no starvation by bypass). With the
+        prefix cache on, each admission first aliases the longest
+        resident prefix (retaining those blocks) and only allocates
+        fresh blocks for the remainder; under pool pressure LRU cache
+        entries are evicted (dropping the cache's references — blocks
+        shared with running sequences survive) before admission defers.
+        """
         now = time.monotonic()
-        while self._queue and len(self._active) + len(newcomers) \
-                < self.buckets.max_batch:
+        while self._queue and (len(self._active) + len(self._prefilling)
+                               < self.buckets.max_batch):
             head = self._queue[0]
-            total = len(head.req.prompt) + head.req.max_new_tokens
-            if not self._allocator.can_allocate(total):
-                break
+            if head.cancelled:
+                self._queue.popleft()
+                head._finish(RequestResult(
+                    request_id=head.req.request_id,
+                    prompt_len=len(head.req.prompt), tokens=[],
+                    finish_reason="aborted", queue_wait_s=0.0,
+                    prefill_s=0.0, decode_s=0.0,
+                    total_s=now - head.submit_t))
+                continue
+            plen = len(head.req.prompt)
+            total = plen + head.req.max_new_tokens
+            need_total = self.cache.blocks_needed(total)
+            shared: List[int] = []
+            fork_src: Optional[int] = None
+            if self._prefix is not None:
+                match = self._prefix.match(head.req.prompt)
+                # always leave >= 1 prompt token to process: the last
+                # prompt token is re-scored through the model to produce
+                # the first sampled token (its K/V rewrite is what the
+                # COW fork isolates from the shared block)
+                skip = min(match.shared_len, plen - 1)
+                shared = match.blocks
+                if skip < match.shared_len:
+                    # fully-shared prompt: the final shared block holds
+                    # position plen-1 and WILL be written — fork it
+                    fork_src = shared.pop()
+                kept = len(shared)
+                need = need_total - kept
+            else:
+                skip = 0
+                kept = 0
+                need = need_total
+            if self._allocator.free_blocks() < need:
+                if self._prefix is not None:
+                    self._prefix.evict(need)
+                if self._allocator.free_blocks() < need:
+                    # defer admission; hand back the match references
+                    if shared:
+                        self._allocator.release(shared)
+                    if fork_src is not None:
+                        self._allocator.release([fork_src])
+                    break
             self._queue.popleft()
             head.admit_t = now
             self._h_queue_wait.observe(now - head.submit_t)
-            blocks = self._allocator.allocate(total)
-            newcomers.append(_Active(head, blocks, len(head.req.prompt)))
+            fresh = self._allocator.allocate_blocks(need)
+            a = _Active(head, shared + fresh, plen)
+            a.prefill_pos = skip
+            if fork_src is not None:
+                # fresh[0] backs the forked block's position range
+                a.pending_copy = (fork_src, fresh[0])
+            a.hit_blocks = kept + (1 if fork_src is not None else 0)
+            a.miss_blocks = self.cache.blocks_needed(plen) - a.hit_blocks
+            self._c_prefix_hit.inc(a.hit_blocks)
+            self._c_prefix_miss.inc(a.miss_blocks)
+            self._prefilling.append(a)
+            self._peak_active = max(
+                self._peak_active,
+                len(self._active) + len(self._prefilling))
+            self._g_active.set(len(self._active) + len(self._prefilling))
         self._g_queue.set(len(self._queue))
         self._g_free_blocks.set(self._allocator.free_blocks())
-        return newcomers
+
+    def _reap_aborted(self) -> bool:
+        """Retire cancelled rows at the iteration boundary, releasing
+        their blocks (and a pending COW source's extra reference) exactly
+        like a natural finish."""
+        doomed = [a for a in self._active + self._prefilling
+                  if a.handle.cancelled]
+        if not doomed:
+            return False
+        for a in doomed:
+            if a.pending_copy is not None:
+                self._allocator.release([a.pending_copy[0]])
+                a.pending_copy = None
+            self._retire(a, "aborted")
+        with self._cond:
+            self._active = [a for a in self._active if a not in doomed]
+            self._prefilling = [a for a in self._prefilling
+                                if a not in doomed]
+            self._g_active.set(len(self._active) + len(self._prefilling))
+            self._g_free_blocks.set(self._allocator.free_blocks())
+        return True
+
+    def _do_copies(self, rows: Sequence[_Active]) -> None:
+        """Execute pending COW forks before the rows' first device call,
+        then drop the extra reference that kept each source alive."""
+        for a in rows:
+            if a.pending_copy is None:
+                continue
+            src, dst = a.pending_copy
+            self._k_pool, self._v_pool = self._copy(
+                self._k_pool, self._v_pool, src, dst)
+            if self._spec_k:
+                self._dk_pool, self._dv_pool = self._copy(
+                    self._dk_pool, self._dv_pool, src, dst)
+            self._allocator.release([src])
+            a.pending_copy = None
+
+    def _pools_for(self, cfg: gpt.GPTConfig) -> Tuple[jnp.ndarray,
+                                                      jnp.ndarray]:
+        if cfg is self.model_cfg:
+            return self._k_pool, self._v_pool
+        return self._dk_pool, self._dv_pool
+
+    def _set_pools_for(self, cfg: gpt.GPTConfig, k_pool: jnp.ndarray,
+                       v_pool: jnp.ndarray) -> None:
+        if cfg is self.model_cfg:
+            self._k_pool, self._v_pool = k_pool, v_pool
+        else:
+            self._dk_pool, self._dv_pool = k_pool, v_pool
 
     def _tables_for(self, rows: Sequence[_Active], padded_b: int
                     ) -> jnp.ndarray:
@@ -573,44 +923,78 @@ class InferenceEngine:
             tables[i, :len(a.blocks)] = a.blocks
         return jnp.asarray(tables)
 
-    def _prefill(self, rows: List[_Active]) -> None:
-        """One bucketed prefill call for the newcomers; samples each
-        row's first token."""
+    def _prefill_step(self) -> None:
+        """One bucketed prefill call covering every prefilling row's
+        next slice of prompt. Without chunking a row's slice is its
+        whole remaining prompt (one call, as before); with chunking each
+        row advances at most ``chunk_prefill_len`` positions per
+        iteration, so the decode step below never waits behind a long
+        prompt. Rows whose slice reaches the end of the prompt sample
+        their first token from the slice's last logits and graduate to
+        the decode set; prefix-cache rows start at ``prefill_pos > 0``
+        and their completed prompts are registered for future sharing.
+        """
+        rows = list(self._prefilling)
+        self._do_copies(rows)
+        cnt = []
+        for a in rows:
+            remaining = a.prompt_len - a.prefill_pos
+            if self.chunk_prefill_len:
+                remaining = min(remaining, self.chunk_prefill_len)
+            cnt.append(remaining)
         b = bucket_for(len(rows), self.buckets.batch_buckets)
-        t = bucket_for(max(a.prompt_len for a in rows),
-                       self.buckets.prefill_len_buckets)
+        t = bucket_for(max(cnt), self.buckets.prefill_len_buckets)
         tok = np.zeros((b, t), np.int32)
         pos = np.zeros((b, t), np.int32)
         msk = np.zeros((b, t), bool)
         last = np.zeros((b,), np.int32)
         for i, a in enumerate(rows):
-            n = a.prompt_len
-            tok[i, :n] = a.handle.req.prompt
-            pos[i, :n] = np.arange(n)
+            lo, n = a.prefill_pos, cnt[i]
+            tok[i, :n] = a.handle.req.prompt[lo:lo + n]
+            pos[i, :n] = np.arange(lo, lo + n)
             msk[i, :n] = True
             last[i] = n - 1
+        jt = (jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(msk),
+              jnp.asarray(last))
+        tables = self._tables_for(rows, b)
         t0 = time.monotonic()
         with self._span("serving_prefill", batch=b, length=t):
             logits, self._k_pool, self._v_pool = self._fwd(
-                self._params, self.model_cfg, jnp.asarray(tok),
-                jnp.asarray(pos), jnp.asarray(msk), jnp.asarray(last),
-                self._k_pool, self._v_pool, self._tables_for(rows, b))
+                self._params, self.model_cfg, *jt,
+                self._k_pool, self._v_pool, tables)
+            if self._spec_k:
+                # mirror the slice into the draft pools so the proposal
+                # loop sees the same context the target does
+                dl, self._dk_pool, self._dv_pool = self._draft_fwd(
+                    self._draft_params, self.draft_cfg, *jt,
+                    self._dk_pool, self._dv_pool, tables)
+                dl.block_until_ready()
             first = np.asarray(jnp.argmax(logits, axis=-1))
         dt = time.monotonic() - t0
         self._h_prefill.observe(dt)
         done_t = time.monotonic()
-        still_running: List[_Active] = []
+        still_prefilling: List[_Active] = []
+        graduated: List[_Active] = []
         for i, a in enumerate(rows):
-            a.handle.prefill_s = dt
+            a.handle.prefill_s += dt
+            a.prefill_pos += cnt[i]
+            if a.prefill_pos < a.prompt_len:
+                still_prefilling.append(a)
+                continue
             a.handle.prefill_done_t = done_t
+            if self._prefix is not None:
+                self._prefix.register(
+                    a.handle.req.prompt,
+                    a.blocks[:self.cache.blocks_needed(a.prompt_len)])
             a.out.append(int(first[i]))
             a.last_token = int(first[i])
             if not self._maybe_finish(a):
-                still_running.append(a)
+                graduated.append(a)
         with self._cond:
-            self._active.extend(still_running)
-            self._peak_active = max(self._peak_active, len(self._active))
-            self._g_active.set(len(self._active))
+            self._prefilling = still_prefilling
+            self._active.extend(graduated)
+            self._g_active.set(len(self._active) + len(self._prefilling))
+            self._g_free_blocks.set(self._allocator.free_blocks())
 
     def _decode_step(self) -> None:
         """One decode iteration for every active sequence: append each
@@ -641,7 +1025,100 @@ class InferenceEngine:
                 survivors.append(a)
         with self._cond:
             self._active = survivors
-            self._g_active.set(len(self._active))
+            self._g_active.set(len(self._active) + len(self._prefilling))
+            self._g_free_blocks.set(self._allocator.free_blocks())
+
+    def _spec_step(self) -> None:
+        """One speculative iteration for every active sequence: the
+        draft proposes k tokens with k T=1 calls, the target scores
+        [last committed token, draft_1..draft_k] in ONE k+1-token verify
+        call, and each row emits the target's own greedy picks up to and
+        including the first draft disagreement (plus the bonus token on
+        full agreement) — 1..k+1 tokens per iteration, bit-identical to
+        one-at-a-time decode for ANY draft output.
+
+        Per-row ``allow`` masks draft/verify slots past the row's
+        remaining ``max_new_tokens`` allowance, so speculation never
+        writes K/V beyond the row's allocated blocks; rejected drafts
+        leave stale pool entries past the accepted frontier, which
+        position-masked attention never reads and the next iteration's
+        scatter overwrites (models/gpt.py:forward_paged_logits).
+        """
+        rows = list(self._active)
+        k = self._spec_k
+        b = bucket_for(len(rows), self.buckets.batch_buckets)
+        tables = self._tables_for(rows, b)
+        n0 = np.array([a.prompt_len + len(a.out) for a in rows])
+        allow = np.array([min(k + 1,
+                              a.handle.req.max_new_tokens - len(a.out))
+                          for a in rows])
+        t0 = time.monotonic()
+        with self._span("serving_spec_step", batch=b, rows=len(rows),
+                        k=k):
+            drafts = np.zeros((len(rows), k), np.int64)
+            cur = np.array([a.last_token for a in rows])
+            zero_last = jnp.zeros((b,), jnp.int32)
+            for j in range(k):
+                tok = np.zeros((b, 1), np.int32)
+                pos = np.zeros((b, 1), np.int32)
+                msk = np.zeros((b, 1), bool)
+                tok[:len(rows), 0] = cur
+                pos[:len(rows), 0] = n0 - 1 + j
+                msk[:len(rows), 0] = j < allow
+                dl, self._dk_pool, self._dv_pool = self._draft_fwd(
+                    self._draft_params, self.draft_cfg, jnp.asarray(tok),
+                    jnp.asarray(pos), jnp.asarray(msk), zero_last,
+                    self._dk_pool, self._dv_pool, tables)
+                cur = np.asarray(jnp.argmax(dl, axis=-1))[:len(rows)]
+                drafts[:, j] = cur
+            tok = np.zeros((b, k + 1), np.int32)
+            pos = np.zeros((b, k + 1), np.int32)
+            msk = np.zeros((b, k + 1), bool)
+            for i, a in enumerate(rows):
+                tok[i, 0] = a.last_token
+                tok[i, 1:] = drafts[i]
+                pos[i] = np.arange(n0[i] - 1, n0[i] + k)
+                msk[i] = np.arange(k + 1) < allow[i]
+            logits, self._k_pool, self._v_pool = self._verify_fwd(
+                self._params, self.model_cfg, jnp.asarray(tok),
+                jnp.asarray(pos), jnp.asarray(msk),
+                self._k_pool, self._v_pool, tables)
+            target = np.asarray(jnp.argmax(logits, axis=-1))
+        self._h_decode.observe(time.monotonic() - t0)
+        survivors: List[_Active] = []
+        step_proposed = step_accepted = 0
+        for i, a in enumerate(rows):
+            # accept while the draft echoes the target's own greedy pick;
+            # target[i, j] is trustworthy for j < allow[i] because all of
+            # its conditioning tokens are committed-or-accepted by then
+            emitted = [int(target[i, 0])]
+            j = 0
+            while (j < allow[i] - 1 and j < k
+                   and int(drafts[i, j]) == int(target[i, j])):
+                j += 1
+                emitted.append(int(target[i, j]))
+            usable = int(min(k, allow[i] - 1))
+            a.spec_proposed += usable
+            a.spec_accepted += len(emitted) - 1
+            step_proposed += usable
+            step_accepted += len(emitted) - 1
+            for tk in emitted:
+                a.out.append(tk)
+                a.last_token = tk
+                if (a.handle.req.eos_token_id is not None
+                        and tk == a.handle.req.eos_token_id):
+                    break
+            if not self._maybe_finish(a):
+                survivors.append(a)
+        self._c_spec_proposed.inc(step_proposed)
+        self._c_spec_accepted.inc(step_accepted)
+        proposed = self._c_spec_proposed.value
+        if proposed:
+            self._g_spec_rate.set(
+                self._c_spec_accepted.value / proposed)
+        with self._cond:
+            self._active = survivors
+            self._g_active.set(len(self._active) + len(self._prefilling))
             self._g_free_blocks.set(self._allocator.free_blocks())
 
     def _maybe_finish(self, a: _Active) -> bool:
@@ -653,25 +1130,35 @@ class InferenceEngine:
             reason = "length"
         if reason is None:
             return False
+        self._retire(a, reason)
+        return True
+
+    def _retire(self, a: _Active, reason: str) -> None:
         now = time.monotonic()
         self._allocator.release(a.blocks)
+        h = a.handle
         result = RequestResult(
-            request_id=req.request_id,
+            request_id=h.req.request_id,
             prompt_len=a.prompt_len,
             tokens=list(a.out),
             finish_reason=reason,
-            queue_wait_s=a.handle.admit_t - a.handle.submit_t,
-            prefill_s=a.handle.prefill_s,
-            decode_s=now - a.handle.prefill_done_t,
-            total_s=now - a.handle.submit_t)
+            queue_wait_s=max(0.0, h.admit_t - h.submit_t),
+            prefill_s=h.prefill_s,
+            decode_s=(now - h.prefill_done_t if h.prefill_done_t else 0.0),
+            total_s=now - h.submit_t,
+            prefix_hit_blocks=a.hit_blocks,
+            prefix_miss_blocks=a.miss_blocks,
+            spec_proposed=a.spec_proposed,
+            spec_accepted=a.spec_accepted)
         self._h_total.observe(result.total_s)
         self._c_completed.inc()
         self._c_tokens.inc(len(a.out))
+        if a.spec_proposed:
+            self._h_spec_accept.observe(a.spec_accepted / a.spec_proposed)
         with self._cond:
             self._completed += 1
             self._total_tokens += len(a.out)
-        a.handle._finish(result)
-        return True
+        h._finish(result)
 
     # -- static (run-to-completion) baseline -------------------------------
 
@@ -742,25 +1229,41 @@ class InferenceEngine:
         slot — the static-batching cost the continuous scheduler
         eliminates."""
         b = bucket_for(len(rows), self.buckets.batch_buckets)
-        t = bucket_for(max(a.prompt_len for a in rows),
-                       self.buckets.prefill_len_buckets)
-        tok = np.zeros((b, t), np.int32)
-        pos = np.zeros((b, t), np.int32)
-        msk = np.zeros((b, t), bool)
-        last = np.zeros((b,), np.int32)
-        for i, a in enumerate(rows):
-            n = a.prompt_len
-            tok[i, :n] = a.handle.req.prompt
-            pos[i, :n] = np.arange(n)
-            msk[i, :n] = True
-            last[i] = n - 1
+        # chunked prefill applies to the static path too (same programs;
+        # without it a chunked-engine workload could not be replayed) —
+        # run-to-completion means chunks of ONE group interleave with
+        # nothing, so the whole prompt still lands before any decode
+        chunk = self.chunk_prefill_len or self.buckets.prefill_len_buckets[-1]
         tables = self._tables_for(rows, b)
         t0 = time.monotonic()
-        logits, self._k_pool, self._v_pool = self._fwd(
-            self._params, self.model_cfg, jnp.asarray(tok), jnp.asarray(pos),
-            jnp.asarray(msk), jnp.asarray(last),
-            self._k_pool, self._v_pool, tables)
-        first = np.asarray(jnp.argmax(logits, axis=-1))
+        offs = [0] * len(rows)
+        first = np.zeros((b,), np.int64)
+        while True:
+            cnts = [min(chunk, a.prompt_len - offs[i])
+                    for i, a in enumerate(rows)]
+            t = bucket_for(max(cnts), self.buckets.prefill_len_buckets)
+            tok = np.zeros((b, t), np.int32)
+            pos = np.zeros((b, t), np.int32)
+            msk = np.zeros((b, t), bool)
+            last = np.zeros((b,), np.int32)
+            for i, a in enumerate(rows):
+                n = cnts[i]
+                if n > 0:
+                    tok[i, :n] = a.handle.req.prompt[offs[i]:offs[i] + n]
+                    pos[i, :n] = np.arange(offs[i], offs[i] + n)
+                    msk[i, :n] = True
+                    last[i] = n - 1
+            logits, self._k_pool, self._v_pool = self._fwd(
+                self._params, self.model_cfg, jnp.asarray(tok),
+                jnp.asarray(pos), jnp.asarray(msk), jnp.asarray(last),
+                self._k_pool, self._v_pool, tables)
+            picks = np.asarray(jnp.argmax(logits, axis=-1))
+            for i, a in enumerate(rows):
+                offs[i] += cnts[i]
+                if cnts[i] > 0 and offs[i] >= a.prompt_len:
+                    first[i] = picks[i]
+            if all(offs[i] >= a.prompt_len for i, a in enumerate(rows)):
+                break
         dt = time.monotonic() - t0
         done_t = time.monotonic()
         for i, a in enumerate(rows):

@@ -145,13 +145,18 @@ class ServingFleet:
                  iteration_floor_s: float = 0.0,
                  warmup: bool = True,
                  registry: Optional[MetricsRegistry] = None,
-                 aggregator: Any = None) -> None:
+                 aggregator: Any = None,
+                 prefix_cache: bool = False) -> None:
         self.name = name
         self.model_cfg = model_cfg
         self.buckets = buckets
         self.cache = cache
         self.max_queue_depth = int(max_queue_depth)
         self.iteration_floor_s = float(iteration_floor_s)
+        # per-replica COW prefix sharing (each replica owns its pool, so
+        # each keeps its own prefix index; the router's least-loaded
+        # spread means a hot shared prefix ends up cached everywhere)
+        self.prefix_cache = bool(prefix_cache)
         self.warmup = bool(warmup)
         self.registry = registry if registry is not None else MetricsRegistry()
         self.aggregator = aggregator
@@ -203,7 +208,8 @@ class ServingFleet:
                 self._params, self.model_cfg, buckets=self.buckets,
                 cache=self.cache, max_queue_depth=self.max_queue_depth,
                 telemetry=MetricsRegistry(), fwd=self._fwd,
-                iteration_floor_s=self.iteration_floor_s)
+                iteration_floor_s=self.iteration_floor_s,
+                prefix_cache=self.prefix_cache)
             rep = Replica(rid, engine)
             if self.warmup:
                 engine.warmup()

@@ -112,6 +112,13 @@ def _make_handler(engine: InferenceEngine):
                     "prefill_s": round(result.prefill_s, 6),
                     "decode_s": round(result.decode_s, 6),
                     "total_s": round(result.total_s, 6),
+                    # raw-speed breakdown: how much prefill the prefix
+                    # cache skipped and how well the draft model did
+                    "prefix_hit_blocks": result.prefix_hit_blocks,
+                    "prefix_miss_blocks": result.prefix_miss_blocks,
+                    "spec_proposed": result.spec_proposed,
+                    "spec_accepted": result.spec_accepted,
+                    "spec_acceptance": result.spec_acceptance,
                 },
             })
 

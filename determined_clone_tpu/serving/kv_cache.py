@@ -6,21 +6,32 @@ device arrays (compute dtype — the exact values ``mha`` would see, which
 is what makes paged decode token-identical to the uncached forward), and
 each admitted sequence owns a list of block ids covering
 ``ceil((prompt_len + max_new_tokens) / block_size)`` slots. The
-:class:`BlockAllocator` is plain host-side bookkeeping — a free list —
-because block assignment happens at admission time, outside jit; the
-device side only ever sees dense int32 block tables.
+:class:`BlockAllocator` is plain host-side bookkeeping — per-block
+refcounts over a free list — because block assignment happens at
+admission time, outside jit; the device side only ever sees dense int32
+block tables.
 
 Allocation is all-upfront per sequence (reservation = worst case decode
 length) rather than on-demand per step: simpler, and it converts pool
 exhaustion into *admission-time* backpressure (ServerOverloaded → client
 retry/backoff) instead of a mid-decode eviction story.
+
+Prefix sharing (docs/serving.md) rides on the refcounts: the
+:class:`PrefixCache` content-hashes the prompt's blocks and lets a new
+sequence alias already-resident block ids through its block table, so
+the "millions of users, one system prompt" workload stores each prefix
+once and skips its prefill entirely. A shared block is immutable from
+the allocator's point of view; the engine copy-on-write forks the one
+block a new owner would ever need to write (see docs for the proof that
+full shared blocks are never written).
 """
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import math
 import threading
-from typing import Any, List, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 
@@ -62,17 +73,23 @@ def init_kv_pools(cfg: Any, cache: KVCacheConfig) -> Tuple[jnp.ndarray,
 
 
 class BlockAllocator:
-    """Thread-safe free-list over the pool's block ids.
+    """Thread-safe per-block refcounts over the pool's block ids.
 
     The engine's scheduler thread allocates at admission and frees at
     retirement; the HTTP threads only observe :meth:`free_blocks` for
-    backpressure headroom, hence the lock.
+    backpressure headroom, hence the lock. A block is free iff its
+    refcount is zero; :meth:`allocate` hands out blocks at refcount 1,
+    prefix sharing adds owners via :meth:`retain`, and :meth:`release`
+    decrements — the block returns to the free list only when the last
+    owner (sequence or prefix-cache entry) lets go, which is the
+    never-freed-while-referenced invariant the COW protocol leans on.
     """
 
     def __init__(self, cache: KVCacheConfig) -> None:
         self._cache = cache
         self._lock = threading.Lock()
         self._free: List[int] = list(range(cache.num_blocks - 1, -1, -1))
+        self._ref: List[int] = [0] * cache.num_blocks
 
     @property
     def num_blocks(self) -> int:
@@ -82,6 +99,10 @@ class BlockAllocator:
         with self._lock:
             return len(self._free)
 
+    def refcount(self, block: int) -> int:
+        with self._lock:
+            return self._ref[block]
+
     def can_allocate(self, total_len: int) -> bool:
         return self.free_blocks() >= self._cache.blocks_needed(total_len)
 
@@ -89,18 +110,175 @@ class BlockAllocator:
         """Reserve blocks covering ``total_len`` positions; raises
         MemoryError when the pool can't — the engine maps that to
         ServerOverloaded (admission backpressure)."""
-        need = self._cache.blocks_needed(total_len)
+        return self.allocate_blocks(self._cache.blocks_needed(total_len))
+
+    def allocate_blocks(self, need: int) -> List[int]:
         with self._lock:
             if need > len(self._free):
                 raise MemoryError(
                     f"KV pool exhausted: need {need} blocks, "
                     f"{len(self._free)}/{self._cache.num_blocks} free")
             got = [self._free.pop() for _ in range(need)]
+            for b in got:
+                self._ref[b] = 1
         return got
 
-    def release(self, blocks: List[int]) -> None:
+    def retain(self, blocks: Sequence[int]) -> None:
+        """Add one owner to each block; only live blocks can be shared."""
         with self._lock:
             for b in blocks:
-                if not 0 <= b < self._cache.num_blocks or b in self._free:
+                if not 0 <= b < self._cache.num_blocks or self._ref[b] < 1:
+                    raise ValueError(f"retain of free/bogus block {b}")
+                self._ref[b] += 1
+
+    def release(self, blocks: Sequence[int]) -> None:
+        with self._lock:
+            for b in blocks:
+                if not 0 <= b < self._cache.num_blocks or self._ref[b] < 1:
                     raise ValueError(f"double/bogus free of block {b}")
-                self._free.append(b)
+                self._ref[b] -= 1
+                if self._ref[b] == 0:
+                    self._free.append(b)
+
+
+@dataclasses.dataclass
+class PrefixMatch:
+    """What :meth:`PrefixCache.match` found for one prompt.
+
+    ``blocks`` are resident block ids covering prompt positions
+    ``[0, shared_len)`` in order, already retained on behalf of the
+    caller (who must release them, or hand them to a sequence that
+    will). ``shared_len`` counts whole shared *positions*; it is a
+    multiple of the block size except when the final entry was an exact
+    partial-tail hit, in which case ``shared_len == len(prompt)``.
+    """
+    blocks: List[int]
+    shared_len: int
+
+
+class PrefixCache:
+    """Content-addressed index of resident prompt blocks.
+
+    Keys are chained hashes — ``h_i = sha256(h_{i-1} || tokens of block
+    i)`` with ``h_{-1}`` empty — so a key identifies both a block's
+    tokens *and* its absolute position, which is what lets a block table
+    alias it verbatim (paged attention positions are absolute). Full
+    prompt blocks are keyed by their chain hash; the prompt's partial
+    tail block (when ``prompt_len % block_size != 0``) is keyed by the
+    chain hash of the full prefix plus the exact tail tokens, so only a
+    byte-identical prompt can alias it.
+
+    The cache holds one allocator reference per indexed block; sequences
+    sharing a block add their own. Eviction (LRU, deepest-first so a
+    chain never strands unreachable descendants) merely drops the
+    cache's reference — blocks stay alive until their last sequence
+    retires, which is the never-freed-while-referenced invariant.
+
+    Single-writer: all mutation happens on the engine's scheduler
+    thread; the lock only guards the counters HTTP threads read.
+    """
+
+    def __init__(self, cache: KVCacheConfig,
+                 allocator: BlockAllocator) -> None:
+        self._cfg = cache
+        self._alloc = allocator
+        # key -> (block id, depth, last-used tick); depth = block index
+        # within the prompt, used to evict leaves before their parents.
+        self._entries: Dict[bytes, Tuple[int, int, int]] = {}
+        self._tick = 0
+
+    # -- hashing -----------------------------------------------------------
+
+    @staticmethod
+    def _chain(prev: bytes, tokens: Sequence[int]) -> bytes:
+        h = hashlib.sha256(prev)
+        h.update(b"|" + ",".join(str(int(t)) for t in tokens).encode())
+        return h.digest()
+
+    @staticmethod
+    def _tail_key(prev: bytes, tokens: Sequence[int]) -> bytes:
+        return PrefixCache._chain(prev + b"#tail", tokens)
+
+    # -- lookup / registration --------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def match(self, prompt: Sequence[int]) -> PrefixMatch:
+        """Longest resident prefix of ``prompt``, caller-retained."""
+        bs = self._cfg.block_size
+        blocks: List[int] = []
+        shared = 0
+        prev = b""
+        self._tick += 1
+        n_full = len(prompt) // bs
+        for i in range(n_full):
+            key = self._chain(prev, prompt[i * bs:(i + 1) * bs])
+            ent = self._entries.get(key)
+            if ent is None:
+                break
+            self._entries[key] = (ent[0], ent[1], self._tick)
+            blocks.append(ent[0])
+            shared += bs
+            prev = key
+        else:
+            tail = prompt[n_full * bs:]
+            if tail:
+                key = self._tail_key(prev, tail)
+                ent = self._entries.get(key)
+                if ent is not None:
+                    self._entries[key] = (ent[0], ent[1], self._tick)
+                    blocks.append(ent[0])
+                    shared += len(tail)
+        if blocks:
+            self._alloc.retain(blocks)
+        return PrefixMatch(blocks, shared)
+
+    def register(self, prompt: Sequence[int], blocks: Sequence[int]) -> None:
+        """Index a just-prefilled prompt's blocks. ``blocks`` is the
+        sequence's block table prefix (one id per prompt block, in
+        order). Already-indexed keys are left alone — first writer wins,
+        and colliding later sequences simply hold private copies."""
+        bs = self._cfg.block_size
+        self._tick += 1
+        prev = b""
+        n_full = len(prompt) // bs
+        for i in range(n_full):
+            key = self._chain(prev, prompt[i * bs:(i + 1) * bs])
+            if key not in self._entries:
+                self._alloc.retain([blocks[i]])
+                self._entries[key] = (blocks[i], i, self._tick)
+            prev = key
+        tail = prompt[n_full * bs:]
+        if tail:
+            key = self._tail_key(prev, tail)
+            if key not in self._entries:
+                self._alloc.retain([blocks[n_full]])
+                self._entries[key] = (blocks[n_full], n_full, self._tick)
+
+    # -- pressure ----------------------------------------------------------
+
+    def evict(self, want_free: int) -> int:
+        """Drop LRU entries until the allocator has ``want_free`` free
+        blocks or the cache is empty. Oldest tick first, deepest block
+        first on ties, so a chain's leaves go before its root and no
+        entry is ever left unreachable. Returns entries dropped."""
+        dropped = 0
+        while (self._entries
+               and self._alloc.free_blocks() < want_free):
+            key = min(self._entries,
+                      key=lambda k: (self._entries[k][2],
+                                     -self._entries[k][1]))
+            block, _, _ = self._entries.pop(key)
+            self._alloc.release([block])
+            dropped += 1
+        return dropped
+
+    def flush(self) -> int:
+        """Drop everything — cached KV is a function of the params, so
+        hot-swap invalidates the whole index."""
+        n = len(self._entries)
+        for block, _, _ in self._entries.values():
+            self._alloc.release([block])
+        self._entries.clear()
+        return n

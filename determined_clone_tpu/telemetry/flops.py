@@ -215,19 +215,63 @@ def gpt_decode_flops_per_token(cfg: Any, context_len: int) -> Dict[str, float]:
     return out
 
 
-def gpt_generation_flops(cfg: Any, prompt_len: int,
-                         new_tokens: int) -> float:
+def gpt_generation_flops(cfg: Any, prompt_len: int, new_tokens: int, *,
+                         prefill_from: int = 0) -> float:
     """Total forward FLOPs to serve one request: one prefill of
     ``prompt_len`` plus ``new_tokens - 1`` incremental decode steps (the
     first generated token falls out of the prefill logits; decode step j
     runs at context ``prompt_len + j``). The serving bench divides the
     sum of this over all completed requests by wall-clock for a real
-    tokens-level MFU."""
+    tokens-level MFU.
+
+    ``prefill_from`` accounts for prefix sharing: positions before it
+    were aliased from the prefix cache, so only the suffix tokens pay
+    prefill FLOPs (each still at full sequence length ``prompt_len`` —
+    the same accounting convention as :func:`gpt_prefill_flops`). The
+    re-scored last prompt token keeps the suffix count >= 1.
+    """
     p, n = int(prompt_len), int(new_tokens)
-    total = gpt_prefill_flops(cfg, p)["total"]
+    skip = min(max(0, int(prefill_from)), p - 1)
+    per_tok = gpt_forward_flops_per_token(cfg, p)
+    total = sum(per_tok.values()) * float(p - skip)
     for j in range(1, n):
         total += gpt_decode_flops_per_token(cfg, p + j)["total"]
     return total
+
+
+def gpt_verify_flops(cfg: Any, context_len: int, k: int) -> Dict[str, float]:
+    """Forward FLOPs of ONE speculative verify call: the target scores
+    ``k + 1`` tokens (last committed token + k drafts) starting at
+    context ``context_len``. Each scored token pays the full projections
+    + MLP + embedding of a decode step, and its attention mix is linear
+    in its OWN context — token i of the call sees ``context_len + i``
+    cached positions — so the call total is the sum of k+1 consecutive
+    decode-step counts. This is why acceptance rate is the whole game:
+    the verify call costs what k+1 sequential decode steps cost, but
+    only ``accepted + 1`` of its tokens are emitted.
+    """
+    out: Dict[str, float] = {}
+    for i in range(int(k) + 1):
+        step = gpt_decode_flops_per_token(cfg, int(context_len) + i)
+        for key, v in step.items():
+            out[key] = out.get(key, 0.0) + v
+    return out
+
+
+def gpt_speculative_step_flops(cfg: Any, draft_cfg: Any, context_len: int,
+                               k: int) -> Dict[str, float]:
+    """Forward FLOPs of one whole speculative iteration for one
+    sequence: k single-token draft proposals (each an incremental decode
+    step of the draft model at its growing context) plus the target's
+    k+1-token verify call. Returns ``{"draft", "verify", "total"}`` —
+    the per-emitted-token cost is ``total / (accepted + 1)``, which is
+    the quantity the acceptance-rate gate in tools/bench_gate.py guards.
+    """
+    c = int(context_len)
+    draft = sum(gpt_decode_flops_per_token(draft_cfg, c + i)["total"]
+                for i in range(int(k)))
+    verify = gpt_verify_flops(cfg, c, k)["total"]
+    return {"draft": draft, "verify": verify, "total": draft + verify}
 
 
 def dense_train_flops_per_token(n_params: int) -> float:

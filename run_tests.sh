@@ -37,8 +37,10 @@
 #
 # `./run_tests.sh --serving` runs the online-inference surface
 # (docs/serving.md): the continuous-batching engine, paged-KV parity and
-# compile discipline, the HTTP surface, the KV-cached decode FLOPs
-# accounting, and the batch-inference dropped-example counter.
+# compile discipline, the raw-speed features (COW prefix sharing,
+# speculative decoding, chunked prefill), the HTTP surface, the
+# KV-cached decode FLOPs accounting, and the batch-inference
+# dropped-example counter.
 #
 # `./run_tests.sh --fleet` runs the serving-fleet surface (docs/serving.md
 # "Replica fleets"): the least-loaded router + 429 failover, the drain
@@ -76,7 +78,8 @@ elif [ "$1" = "--control-plane" ]; then
         -m "not slow" "$@"
 elif [ "$1" = "--serving" ]; then
     shift
-    set -- tests/test_serving.py tests/test_batch_inference.py \
+    set -- tests/test_serving.py tests/test_serving_speed.py \
+        tests/test_batch_inference.py \
         -m "not slow" "$@"
 elif [ "$1" = "--fleet" ]; then
     shift

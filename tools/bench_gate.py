@@ -244,6 +244,9 @@ def _serving_lines(old_detail: Dict[str, Any],
             f"run-to-completion (continuous_over_static={ratio})")
     sv_old = old_detail.get("serving")
     if not isinstance(sv_old, dict) or sv_old.get("error"):
+        sv_old = {}
+    _serving_optimized_lines(sv_old, sv_new, report)
+    if not sv_old:
         return
     old_by_rate = {p.get("offered_rps"): p
                    for p in (sv_old.get("load_points") or [])
@@ -267,6 +270,72 @@ def _serving_lines(old_detail: Dict[str, Any],
             report.append(
                 f"WARN: serving p99 at {rate} req/s "
                 f"{p99_old}s → {p99_new}s ({p99_new / p99_old - 1.0:+.1%})")
+
+
+def _serving_optimized_lines(sv_old: Dict[str, Any],
+                             sv_new: Dict[str, Any], report: list) -> None:
+    """The raw-speed lane (prefix sharing + speculative decoding +
+    chunked prefill, docs/serving.md): report the optimized engine's
+    top-load tokens/sec and its ratio over the features-off baseline
+    measured in the SAME round, and WARN when
+
+    - the speculative acceptance rate is null or below 0.3 (the draft
+      is wasting more verify work than it saves — time to retrain or
+      shrink it),
+    - the prefix-cache hit rate regressed vs the previous round (the
+      hashing/eviction path stopped matching what it used to), or
+    - p99 at the top offered load grew more than 2x vs the previous
+      round (chunked prefill exists precisely to keep tail latency flat
+      under load — a 2x jump means long prompts are blocking decode
+      again).
+
+    Old rounds without the optimized section skip the cross-round
+    checks (the section landed with the raw-speed PR)."""
+    opt_new = sv_new.get("optimized")
+    if not isinstance(opt_new, dict):
+        return
+    pts = [p for p in (opt_new.get("load_points") or [])
+           if isinstance(p, dict)]
+    top = pts[-1] if pts else {}
+    acc = opt_new.get("acceptance_rate")
+    hit_rate = opt_new.get("prefix_hit_rate")
+    report.append(
+        f"ok: serving-optimized top {top.get('offered_rps')} req/s: "
+        f"{top.get('tokens_per_sec')} tok/s "
+        f"({sv_new.get('optimized_over_baseline')}x baseline), "
+        f"acceptance={acc}, prefix_hit_rate={hit_rate}, programs "
+        f"{opt_new.get('programs_compiled')}/"
+        f"{opt_new.get('program_budget')}")
+    if not isinstance(acc, (int, float)):
+        report.append(
+            "WARN: speculative acceptance rate is null with speculation "
+            "enabled — the verify path banked no decisions")
+    elif acc < 0.3:
+        report.append(
+            f"WARN: speculative acceptance rate {acc} < 0.3 — the draft "
+            f"wastes more verify work than it saves")
+    opt_old = sv_old.get("optimized")
+    if not isinstance(opt_old, dict):
+        return
+    hit_old = opt_old.get("prefix_hit_rate")
+    if (isinstance(hit_old, (int, float))
+            and isinstance(hit_rate, (int, float))
+            and hit_rate < hit_old - 0.05):
+        report.append(
+            f"WARN: prefix-cache hit rate {hit_old} → {hit_rate} "
+            f"(regressed — hashing or eviction path changed behavior)")
+    old_pts = [p for p in (opt_old.get("load_points") or [])
+               if isinstance(p, dict)]
+    if old_pts and pts:
+        p99_old = old_pts[-1].get("p99_total_s")
+        p99_new = top.get("p99_total_s")
+        if (isinstance(p99_old, (int, float)) and p99_old > 0
+                and isinstance(p99_new, (int, float))
+                and p99_new / p99_old > 2.0):
+            report.append(
+                f"WARN: optimized p99 at top load {p99_old}s → {p99_new}s "
+                f"(more than 2x — chunked prefill is no longer keeping "
+                f"tail latency flat)")
 
 
 def _serving_fleet_lines(old_detail: Dict[str, Any],

@@ -333,8 +333,16 @@ def run_mixed_load(trials: int = 400, agents: int = 4,
                    tokens_per_request: int = 8,
                    iteration_floor_s: float = 0.01,
                    budget_s: float = 240.0,
-                   master_port: int | None = None) -> dict:
+                   master_port: int | None = None,
+                   shared_prefix: bool = False) -> dict:
     """Trials AND a serving fleet on one simulated cluster.
+
+    ``shared_prefix`` switches the serving traffic to the "millions of
+    users, one system prompt" shape: every request opens with the same
+    system prefix (a whole KV block) followed by a varied tail, and the
+    fleet's engines run with the COW prefix cache on — the serving
+    numbers then report the aggregate block hit-rate next to the p99,
+    which is the pair the prefix cache is supposed to move.
 
     The trial half is :func:`run_load`'s machinery (simulated agents in
     the ``default`` pool, trials minted through the searcher ops route).
@@ -403,7 +411,8 @@ def run_mixed_load(trials: int = 400, agents: int = 4,
             buckets=BucketSpec.build(4, 16),
             cache=KVCacheConfig(num_blocks=24, block_size=8),
             max_queue_depth=max(64, serving_requests),
-            iteration_floor_s=iteration_floor_s, aggregator=aggregator)
+            iteration_floor_s=iteration_floor_s, aggregator=aggregator,
+            prefix_cache=shared_prefix)
         link = MasterLink(fleet, port, replicas=serving_replicas,
                           resource_pool="serving")
         link.wait_replicas(serving_replicas, timeout=60)
@@ -418,15 +427,20 @@ def run_mixed_load(trials: int = 400, agents: int = 4,
         serving_lat: list = []
         serving_errors = [0]
 
+        # one KV block (block_size=8) of common system prompt; tails vary
+        system_prefix = [7, 3, 5, 2, 9, 4, 6, 8]
+
         def drive_serving() -> None:
             handles = []
             for i in range(serving_requests):
                 if stop.is_set():
                     break
+                prompt = [1 + (i % 7), 2, 3]
+                if shared_prefix:
+                    prompt = system_prefix + prompt
                 try:
                     handles.append(fleet.submit(
-                        [1 + (i % 7), 2, 3], tokens_per_request,
-                        timeout=30.0))
+                        prompt, tokens_per_request, timeout=30.0))
                 except Exception:  # noqa: BLE001 — counted, not fatal
                     serving_errors[0] += 1
             for h in handles:
@@ -498,6 +512,16 @@ def run_mixed_load(trials: int = 400, agents: int = 4,
         fleet.sample_telemetry()
         fleet_roll = aggregator.serving_fleet_rollup()
         fleet_stats = fleet.stats()
+        # prefix-cache effectiveness, summed over the replicas' engines —
+        # the hit-rate to read next to the serving p99 below
+        prefix_hits = prefix_misses = 0
+        for r in fleet.replicas():
+            st = r.engine.stats()
+            prefix_hits += st.prefix_hit_blocks
+            prefix_misses += st.prefix_miss_blocks
+        prefix_total = prefix_hits + prefix_misses
+        prefix_hit_rate = (round(prefix_hits / prefix_total, 4)
+                           if prefix_total else None)
 
         final = _sched(port)
         fc, lat = _counters(final), final.get("latency") or {}
@@ -540,6 +564,10 @@ def run_mixed_load(trials: int = 400, agents: int = 4,
                 "tokens_per_sec": round(
                     fleet_stats.tokens_generated / serving_wall, 2),
                 "request_total_s": _percentiles(serving_lat),
+                "shared_prefix": shared_prefix,
+                "prefix_hit_blocks": prefix_hits,
+                "prefix_miss_blocks": prefix_misses,
+                "prefix_hit_rate": prefix_hit_rate,
                 "master_counters": {
                     "serving_submitted": delta("serving_submitted"),
                     "serving_running": delta("serving_running"),
@@ -584,6 +612,10 @@ def main(argv=None) -> int:
                              "fleet on one simulated cluster")
     parser.add_argument("--serving-replicas", type=int, default=2)
     parser.add_argument("--serving-requests", type=int, default=120)
+    parser.add_argument("--shared-prefix", action="store_true",
+                        help="serving traffic shares a common system "
+                             "prompt (exercises the COW prefix cache; "
+                             "reports block hit-rate beside p99)")
     args = parser.parse_args(argv)
     if args.mixed:
         result = run_mixed_load(
@@ -591,7 +623,8 @@ def main(argv=None) -> int:
             slots_per_agent=args.slots,
             serving_replicas=args.serving_replicas,
             serving_requests=args.serving_requests, budget_s=args.budget,
-            master_port=int(args.master) if args.master else None)
+            master_port=int(args.master) if args.master else None,
+            shared_prefix=args.shared_prefix)
     else:
         result = run_load(trials=args.trials, agents=args.agents,
                           slots_per_agent=args.slots, budget_s=args.budget,
