@@ -126,6 +126,11 @@ class InProcessMaster:
                 ["api", "v1"] and parts[2] == "cluster"
                 and parts[3] == "goodput"):
             return 200, self.aggregator.goodput_rollup(), "application/json"
+        if (method == "GET" and len(parts) == 4 and parts[:2] ==
+                ["api", "v1"] and parts[2] == "cluster"
+                and parts[3] == "slo"):
+            return 200, {"slo": self.aggregator.slo_rollup()}, \
+                "application/json"
         if (method == "GET" and len(parts) == 5 and parts[:2] ==
                 ["api", "v1"] and parts[2] == "experiments"
                 and parts[4] == "trace"):

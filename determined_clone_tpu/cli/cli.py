@@ -522,6 +522,81 @@ def cmd_trace_export(args) -> int:
     return 0
 
 
+def cmd_trace_request(args) -> int:
+    """Pull one request's stitched multi-process trace (front door →
+    router → replica legs) out of a fleet's request archive
+    (docs/observability.md "Request tracing & SLOs"). The archive's live
+    ring survives kill -9, so partial legs of a request a replica died
+    on are still retrievable."""
+    from determined_clone_tpu.telemetry.chrome_trace import (
+        validate_chrome_trace,
+    )
+    from determined_clone_tpu.telemetry.flight import (
+        request_archive_summary,
+        request_chrome_trace,
+    )
+
+    directory = args.archive_dir or os.environ.get(
+        "DCT_REQUEST_ARCHIVE_DIR")
+    if not directory:
+        print("error: give --archive-dir (or set DCT_REQUEST_ARCHIVE_DIR)",
+              file=sys.stderr)
+        return 2
+    try:
+        trace = request_chrome_trace(directory, args.request_id)
+    except KeyError:
+        print(f"no spans for request {args.request_id!r} under "
+              f"{directory}", file=sys.stderr)
+        summary = request_archive_summary(directory)
+        known = sorted(summary.get("live_request_ids") or [])
+        if known:
+            preview = ", ".join(known[:10])
+            more = f" (+{len(known) - 10} more)" if len(known) > 10 else ""
+            print(f"archived requests: {preview}{more}", file=sys.stderr)
+        return 1
+    problems = validate_chrome_trace(trace)
+    if problems:  # only malformed records on disk can cause this
+        print("warning: trace has structural problems:\n  " +
+              "\n  ".join(problems), file=sys.stderr)
+    with open(args.output, "w") as f:
+        json.dump(trace, f)
+    other = trace.get("otherData", {})
+    trace_ids = other.get("trace_ids") or []
+    tid_note = f" trace_id {trace_ids[0]}" if trace_ids else ""
+    print(f"wrote {len(trace.get('traceEvents', []))} trace events for "
+          f"request {args.request_id}{tid_note} to {args.output} "
+          f"(load at ui.perfetto.dev or chrome://tracing)")
+    return 0
+
+
+def cmd_slo(args) -> int:
+    """Multi-window burn-rate SLO readout (docs/observability.md
+    "Request tracing & SLOs"): availability and latency objectives over
+    the serving fleet, fast (5m/1h) and slow (6h/3d) windows. Reads the
+    master's ``GET /api/v1/cluster/slo`` or, with ``--url``, a fleet
+    front door's ``GET /v1/slo``."""
+    from determined_clone_tpu.telemetry.slo import format_slo
+
+    if args.url:
+        import urllib.request
+
+        with urllib.request.urlopen(f"{args.url.rstrip('/')}/v1/slo",
+                                    timeout=10) as resp:
+            payload = json.loads(resp.read().decode("utf-8"))
+    else:
+        payload = make_session(args).get("/api/v1/cluster/slo")
+    evaluation = payload.get("slo")
+    if evaluation is None:
+        print("no SLO engine attached (serving fleets attach one when "
+              "tracing is enabled)", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(evaluation, indent=2, default=str))
+    else:
+        print(format_slo(evaluation))
+    return 0
+
+
 def cmd_debug_flight(args) -> int:
     """Post-mortem dump of a flight-recorder ring (docs/observability.md):
     merge the surviving segments — including the ones a kill -9 left
@@ -1564,6 +1639,16 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--limit", type=int, default=100000,
                    help="max profiler samples to pull from the master")
     c.set_defaults(func=cmd_trace_export)
+    c = str_.add_parser("request",
+                        help="pull one request's stitched trace (front "
+                             "door → router → replica) from a fleet's "
+                             "request archive")
+    c.add_argument("request_id", help="the request_id to look up")
+    c.add_argument("--archive-dir", default=None,
+                   help="the fleet's request archive directory "
+                        "(DCT_REQUEST_ARCHIVE_DIR)")
+    c.add_argument("-o", "--output", default="request-trace.json")
+    c.set_defaults(func=cmd_trace_request)
 
     # debug (post-mortem tooling — docs/observability.md)
     p_dbg = sub.add_parser("debug", help="post-mortem debugging tools")
@@ -1600,6 +1685,17 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--json", action="store_true",
                    help="print the accounts as JSON")
     c.set_defaults(func=cmd_goodput)
+
+    # slo (multi-window burn-rate objectives — docs/observability.md)
+    c = sub.add_parser("slo",
+                       help="serving SLO readout: availability + latency "
+                            "burn rates over fast/slow windows")
+    c.add_argument("--url", default=None,
+                   help="ask a fleet front door (http://host:port) "
+                        "instead of the master")
+    c.add_argument("--json", action="store_true",
+                   help="print the evaluation as JSON")
+    c.set_defaults(func=cmd_slo)
 
     # serve (online inference: continuous batching + paged KV cache —
     # docs/serving.md)

@@ -124,6 +124,9 @@ class Request:
     max_new_tokens: int
     eos_token_id: Optional[int] = None
     request_id: str = ""
+    # cross-process trace identity minted at the front door; rides every
+    # per-request span so the stitched trace shows one request end to end
+    trace_id: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -140,6 +143,7 @@ class RequestResult:
     prefix_miss_blocks: int = 0  # prompt blocks prefilled from scratch
     spec_proposed: int = 0       # draft tokens offered for this request
     spec_accepted: int = 0       # draft tokens the target agreed with
+    trace_id: Optional[str] = None  # front-door trace identity, if minted
 
     @property
     def spec_acceptance(self) -> Optional[float]:
@@ -324,6 +328,12 @@ class InferenceEngine:
         tracer = getattr(telemetry, "tracer", None)
         self._span = (tracer.span if tracer is not None
                       else lambda name, **kw: contextlib.nullcontext())
+        # per-request event recording (queue admission, prefill chunks,
+        # speculative rounds, COW forks, retirement): None when telemetry
+        # is off, so the disabled path pays one `is not None` per step and
+        # nothing per request
+        self._tracer = (tracer if tracer is not None
+                        and getattr(tracer, "enabled", False) else None)
         m = self.registry
         self._h_queue_wait = m.histogram(
             "serving_queue_wait_seconds", "submit → admitted into the batch")
@@ -439,9 +449,26 @@ class InferenceEngine:
             self._cond.notify_all()
         self._thread.join(timeout)
 
+    @staticmethod
+    def _req_args(req: Request, **extra: Any) -> Dict[str, Any]:
+        """Span args identifying one request (per-request tracing)."""
+        args: Dict[str, Any] = {"request_id": req.request_id, **extra}
+        if req.trace_id:
+            args["trace_id"] = req.trace_id
+        return args
+
+    def attach_tracer(self, tracer: Any) -> None:
+        """Late-bind (or detach, with None) the per-request event tracer.
+        A plain attribute swap is atomic, so flipping it while the
+        scheduler runs is safe — the bench uses this to measure the same
+        warm engine traced vs untraced (tracing_overhead)."""
+        self._tracer = (tracer if tracer is not None
+                        and getattr(tracer, "enabled", False) else None)
+
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 16, *,
                eos_token_id: Optional[int] = None,
-               request_id: Optional[str] = None) -> _Handle:
+               request_id: Optional[str] = None,
+               trace_id: Optional[str] = None) -> _Handle:
         """Enqueue one request. Raises ValueError for never-servable
         requests and ServerOverloaded when the queue is full."""
         prompt = tuple(int(t) for t in prompt)
@@ -474,7 +501,7 @@ class InferenceEngine:
             self._req_seq += 1
             rid = request_id or f"req-{self._req_seq}"
             handle = _Handle(Request(prompt, int(max_new_tokens),
-                                     eos_token_id, rid))
+                                     eos_token_id, rid, trace_id))
             handle.submit_t = time.monotonic()
             self._queue.append(handle)
             self._submitted += 1
@@ -487,13 +514,14 @@ class InferenceEngine:
                             max_new_tokens: int = 16, *,
                             eos_token_id: Optional[int] = None,
                             request_id: Optional[str] = None,
+                            trace_id: Optional[str] = None,
                             policy: RetryPolicy = ADMISSION_RETRY) -> _Handle:
         """submit() under the repo-standard retry/backoff policy: full-
         jitter exponential backoff on ServerOverloaded, re-raised on
         exhaustion. The client half of admission control."""
         return retry_call(self.submit, prompt, max_new_tokens,
                           eos_token_id=eos_token_id, request_id=request_id,
-                          policy=policy)
+                          trace_id=trace_id, policy=policy)
 
     def generate(self, prompt: Sequence[int], max_new_tokens: int = 16, *,
                  eos_token_id: Optional[int] = None,
@@ -848,7 +876,16 @@ class InferenceEngine:
                     break
             self._queue.popleft()
             head.admit_t = now
-            self._h_queue_wait.observe(now - head.submit_t)
+            if self._tracer is not None:
+                self._h_queue_wait.observe(now - head.submit_t,
+                                           exemplar=head.req.request_id)
+                self._tracer.instant(
+                    "request_admitted", **self._req_args(
+                        head.req,
+                        queue_wait_s=round(now - head.submit_t, 6),
+                        prompt_len=plen))
+            else:
+                self._h_queue_wait.observe(now - head.submit_t)
             fresh = self._allocator.allocate_blocks(need)
             a = _Active(head, shared + fresh, plen)
             a.prefill_pos = skip
@@ -902,6 +939,10 @@ class InferenceEngine:
                     self._dk_pool, self._dv_pool, src, dst)
             self._allocator.release([src])
             a.pending_copy = None
+            if self._tracer is not None:
+                self._tracer.instant(
+                    "request_cow_fork", **self._req_args(
+                        a.handle.req, src_block=src, dst_block=dst))
 
     def _pools_for(self, cfg: gpt.GPTConfig) -> Tuple[jnp.ndarray,
                                                       jnp.ndarray]:
@@ -958,6 +999,7 @@ class InferenceEngine:
               jnp.asarray(last))
         tables = self._tables_for(rows, b)
         t0 = time.monotonic()
+        pt0 = time.perf_counter() if self._tracer is not None else 0.0
         with self._span("serving_prefill", batch=b, length=t):
             logits, self._k_pool, self._v_pool = self._fwd(
                 self._params, self.model_cfg, *jt,
@@ -972,6 +1014,11 @@ class InferenceEngine:
             first = np.asarray(jnp.argmax(logits, axis=-1))
         dt = time.monotonic() - t0
         self._h_prefill.observe(dt)
+        if self._tracer is not None:
+            for i, a in enumerate(rows):
+                self._tracer.record_span(
+                    "request_prefill_chunk", pt0, dt, **self._req_args(
+                        a.handle.req, pos=a.prefill_pos, tokens=cnt[i]))
         done_t = time.monotonic()
         still_prefilling: List[_Active] = []
         graduated: List[_Active] = []
@@ -1053,6 +1100,7 @@ class InferenceEngine:
                               a.handle.req.max_new_tokens - len(a.out))
                           for a in rows])
         t0 = time.monotonic()
+        pt0 = time.perf_counter() if self._tracer is not None else 0.0
         with self._span("serving_spec_step", batch=b, rows=len(rows),
                         k=k):
             drafts = np.zeros((len(rows), k), np.int64)
@@ -1084,7 +1132,8 @@ class InferenceEngine:
                 jnp.asarray(pos), jnp.asarray(msk),
                 self._k_pool, self._v_pool, tables)
             target = np.asarray(jnp.argmax(logits, axis=-1))
-        self._h_decode.observe(time.monotonic() - t0)
+        step_dt = time.monotonic() - t0
+        self._h_decode.observe(step_dt)
         survivors: List[_Active] = []
         step_proposed = step_accepted = 0
         for i, a in enumerate(rows):
@@ -1102,6 +1151,11 @@ class InferenceEngine:
             a.spec_accepted += len(emitted) - 1
             step_proposed += usable
             step_accepted += len(emitted) - 1
+            if self._tracer is not None:
+                self._tracer.record_span(
+                    "request_spec_round", pt0, step_dt, **self._req_args(
+                        a.handle.req, proposed=usable,
+                        accepted=len(emitted) - 1, emitted=len(emitted)))
             for tk in emitted:
                 a.out.append(tk)
                 a.last_token = tk
@@ -1149,8 +1203,21 @@ class InferenceEngine:
             prefix_hit_blocks=a.hit_blocks,
             prefix_miss_blocks=a.miss_blocks,
             spec_proposed=a.spec_proposed,
-            spec_accepted=a.spec_accepted)
-        self._h_total.observe(result.total_s)
+            spec_accepted=a.spec_accepted,
+            trace_id=h.req.trace_id)
+        if self._tracer is not None:
+            self._h_total.observe(result.total_s,
+                                  exemplar=h.req.request_id)
+            self._tracer.instant(
+                "request_retired", **self._req_args(
+                    h.req, finish_reason=reason, tokens=len(a.out),
+                    total_s=round(result.total_s, 6),
+                    queue_wait_s=round(result.queue_wait_s, 6),
+                    prefix_hit_blocks=a.hit_blocks,
+                    spec_proposed=a.spec_proposed,
+                    spec_accepted=a.spec_accepted))
+        else:
+            self._h_total.observe(result.total_s)
         self._c_completed.inc()
         self._c_tokens.inc(len(a.out))
         if a.spec_proposed:

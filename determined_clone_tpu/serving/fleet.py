@@ -32,15 +32,30 @@ gauges/histograms); ``sample_telemetry()`` stamps a per-replica
 ``serving_tokens_per_sec`` gauge and feeds every registry to a
 ClusterMetricsAggregator under ``component=serving_replica_<id>`` so
 ``dct metrics`` shows the fleet rollup (docs/serving.md).
+
+Request tracing (docs/observability.md "Request tracing & SLOs"): when
+tracing is on (the default; ``DCT_TELEMETRY_DISABLED=1`` turns the whole
+plane off), the fleet keeps three tracer lanes — ``frontdoor`` (one span
+per request, submit → result), ``router`` (dispatch + every failover
+hop), and one ``serving_replica_<id>`` lane per engine (admission,
+prefill chunks, speculative rounds, COW forks, retirement). Every lane
+shares the per-request ``trace_id`` minted at the front door, so
+``stitch_chrome_trace`` renders one request as one multi-process trace.
+``archive_dir`` adds a :class:`RequestArchive`: a crash-durable live
+ring of every request-tagged span plus a tail-sampled retained store
+(errors + slowest-N always kept) that ``dct trace request <id>`` reads.
+A fleet-level :class:`SLOEngine` accounts every front-door completion.
 """
 from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import threading
 import time
 import urllib.error
 import urllib.request
+import uuid
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from determined_clone_tpu.models import gpt
@@ -51,7 +66,12 @@ from determined_clone_tpu.serving.engine import (
 )
 from determined_clone_tpu.serving.kv_cache import KVCacheConfig
 from determined_clone_tpu.serving.router import LeastLoadedRouter
-from determined_clone_tpu.telemetry import MetricsRegistry
+from determined_clone_tpu.telemetry import (
+    MetricsRegistry,
+    RequestArchive,
+    SLOEngine,
+    Tracer,
+)
 
 # Replica lifecycle. STARTING replicas exist but take no traffic (engine
 # warming up); DRAINING replicas finish what they accepted but get
@@ -61,14 +81,29 @@ HEALTHY = "healthy"
 DRAINING = "draining"
 STOPPED = "stopped"
 
+# ring size for each serving tracer lane; archive sinks see every record
+# regardless, so the ring only bounds what the aggregator can drain
+_TRACE_EVENTS = 32_768
+
+
+class _EngineTelemetry:
+    """Minimal telemetry facade for an engine: the engine reads exactly
+    ``.registry`` and ``.tracer`` off whatever it is handed."""
+
+    def __init__(self, registry: MetricsRegistry, tracer: Tracer) -> None:
+        self.registry = registry
+        self.tracer = tracer
+
 
 class Replica:
     """One engine behind the router: RoutablePort + lifecycle state."""
 
-    def __init__(self, replica_id: str, engine: InferenceEngine) -> None:
+    def __init__(self, replica_id: str, engine: InferenceEngine, *,
+                 tracer: Optional[Tracer] = None) -> None:
         self.replica_id = replica_id
         self.engine = engine
         self.registry: MetricsRegistry = engine.registry
+        self.tracer = tracer
         self.state = STARTING
 
     # -- RoutablePort ------------------------------------------------------
@@ -82,10 +117,12 @@ class Replica:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 16, *,
                eos_token_id: Optional[int] = None,
-               request_id: Optional[str] = None) -> Any:
+               request_id: Optional[str] = None,
+               trace_id: Optional[str] = None) -> Any:
         return self.engine.submit(prompt, max_new_tokens,
                                   eos_token_id=eos_token_id,
-                                  request_id=request_id)
+                                  request_id=request_id,
+                                  trace_id=trace_id)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -146,7 +183,10 @@ class ServingFleet:
                  warmup: bool = True,
                  registry: Optional[MetricsRegistry] = None,
                  aggregator: Any = None,
-                 prefix_cache: bool = False) -> None:
+                 prefix_cache: bool = False,
+                 tracing: Optional[bool] = None,
+                 archive_dir: Optional[str] = None,
+                 slo: Any = None) -> None:
         self.name = name
         self.model_cfg = model_cfg
         self.buckets = buckets
@@ -160,19 +200,53 @@ class ServingFleet:
         self.warmup = bool(warmup)
         self.registry = registry if registry is not None else MetricsRegistry()
         self.aggregator = aggregator
-        self.router = LeastLoadedRouter(self.registry)
+        # per-request tracing: on by default, DCT_TELEMETRY_DISABLED=1 is
+        # the plane-wide off switch (same contract as telemetry_from_config)
+        self.tracing = (bool(tracing) if tracing is not None
+                        else os.environ.get("DCT_TELEMETRY_DISABLED") != "1")
+        archive_dir = archive_dir or (
+            os.environ.get("DCT_REQUEST_ARCHIVE_DIR") or None)
+        self.archive: Optional[RequestArchive] = None
+        if self.tracing and archive_dir:
+            self.archive = RequestArchive(archive_dir,
+                                          registry=self.registry)
+        if isinstance(slo, SLOEngine):
+            self.slo: Optional[SLOEngine] = slo
+        elif slo is not None:
+            self.slo = SLOEngine.from_dict(slo)
+        else:
+            self.slo = SLOEngine() if self.tracing else None
+        self.frontdoor_tracer = self._make_tracer("frontdoor")
+        self._router_tracer = self._make_tracer("router")
+        self.router = LeastLoadedRouter(self.registry,
+                                        tracer=self._router_tracer)
         self._fwd = make_paged_forward()
         self._params = params
         self._lock = threading.RLock()   # membership + rollout serialization
         self._replicas: Dict[str, Replica] = {}
         self._next_seq = 1
         self._tps_last: Dict[str, Tuple[float, int]] = {}
+        self._span_cursor: Dict[str, int] = {}
         self._g_replicas = self.registry.gauge(
             "fleet_replicas", "replicas in the fleet (any state)")
         self._c_rollouts = self.registry.counter(
             "fleet_rollouts_total", "blue-green parameter rollouts completed")
         self._h_drain = self.registry.histogram(
             "fleet_drain_seconds", "per-replica drain wall-time")
+        self._h_frontdoor = self.registry.histogram(
+            "fleet_frontdoor_seconds",
+            "front-door request wall-time (submit → result, incl. routing)")
+
+    def _make_tracer(self, process_name: str) -> Optional[Tracer]:
+        """One tracer lane of the stitched request trace; None (and zero
+        per-request work anywhere downstream) when tracing is off."""
+        if not self.tracing:
+            return None
+        t = Tracer(enabled=True, max_events=_TRACE_EVENTS,
+                   process_name=process_name)
+        if self.archive is not None:
+            t.add_sink(self.archive.sink_for(t))
+        return t
 
     # -- membership --------------------------------------------------------
 
@@ -204,13 +278,17 @@ class ServingFleet:
             with self._lock:
                 rid = f"{self.name}-{self._next_seq}"
                 self._next_seq += 1
+            tracer = self._make_tracer(f"serving_replica_{rid}")
+            telemetry: Any = MetricsRegistry()
+            if tracer is not None:
+                telemetry = _EngineTelemetry(telemetry, tracer)
             engine = InferenceEngine(
                 self._params, self.model_cfg, buckets=self.buckets,
                 cache=self.cache, max_queue_depth=self.max_queue_depth,
-                telemetry=MetricsRegistry(), fwd=self._fwd,
+                telemetry=telemetry, fwd=self._fwd,
                 iteration_floor_s=self.iteration_floor_s,
                 prefix_cache=self.prefix_cache)
-            rep = Replica(rid, engine)
+            rep = Replica(rid, engine, tracer=tracer)
             if self.warmup:
                 engine.warmup()
             rep.state = HEALTHY
@@ -238,6 +316,7 @@ class ServingFleet:
         with self._lock:
             self._replicas.pop(replica_id, None)
             self._tps_last.pop(replica_id, None)
+            self._span_cursor.pop(f"serving_replica_{replica_id}", None)
             self._g_replicas.set(len(self._replicas))
         return drain_s
 
@@ -276,17 +355,89 @@ class ServingFleet:
         with self._lock:
             self._replicas.clear()
             self._g_replicas.set(0)
+        if self.archive is not None:
+            self.archive.close()
 
     # -- traffic -----------------------------------------------------------
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 16, *,
                eos_token_id: Optional[int] = None,
                request_id: Optional[str] = None,
+               trace_id: Optional[str] = None,
                timeout: Optional[float] = None) -> Any:
         """Route one request to the least-loaded healthy replica."""
         return self.router.submit(prompt, max_new_tokens,
                                   eos_token_id=eos_token_id,
-                                  request_id=request_id, timeout=timeout)
+                                  request_id=request_id, trace_id=trace_id,
+                                  timeout=timeout)
+
+    def mint_ids(self, request_id: Optional[str] = None,
+                 trace_id: Optional[str] = None
+                 ) -> Tuple[Optional[str], Optional[str]]:
+        """Front-door identity: keep caller-supplied ids, mint the rest.
+        With tracing off both stay as given (possibly None) — the engine
+        falls back to its cheap ``req-<seq>`` ids and no uuid is paid."""
+        if not self.tracing:
+            return request_id, trace_id
+        rid = request_id or f"req-{uuid.uuid4().hex[:12]}"
+        tid = trace_id or f"trace-{uuid.uuid4().hex[:16]}"
+        return rid, tid
+
+    def handle_request(self, prompt: Sequence[int],
+                       max_new_tokens: int = 16, *,
+                       eos_token_id: Optional[int] = None,
+                       request_id: Optional[str] = None,
+                       trace_id: Optional[str] = None,
+                       timeout: float = 120.0) -> Tuple[Any, Any]:
+        """Full front-door lifecycle for one request: mint the trace
+        identity, dispatch through the router, block for the result, and
+        account the outcome (front-door span, SLO ingest, archive
+        retention decision). Returns ``(result, handle)``; raises exactly
+        what :meth:`submit` / ``handle.result`` raise, after accounting
+        the failure. The HTTP front door and in-process callers share
+        this path so traces look identical either way."""
+        rid, tid = self.mint_ids(request_id, trace_id)
+        ft = self.frontdoor_tracer
+        t0 = time.perf_counter()
+        try:
+            handle = self.submit(prompt, max_new_tokens,
+                                 eos_token_id=eos_token_id,
+                                 request_id=rid, trace_id=tid,
+                                 timeout=timeout)
+            result = handle.result(timeout=timeout)
+        except Exception as exc:
+            dt = time.perf_counter() - t0
+            if ft is not None:
+                ft.record_span("frontdoor_request", t0, dt,
+                               request_id=rid, trace_id=tid,
+                               error=type(exc).__name__)
+            self.note_request(rid, ok=False, latency_s=None,
+                              error=str(exc))
+            raise
+        dt = time.perf_counter() - t0
+        if ft is not None:
+            ft.record_span(
+                "frontdoor_request", t0, dt, request_id=rid, trace_id=tid,
+                replica=getattr(handle, "replica_id", ""),
+                tokens=len(result.tokens))
+            self._h_frontdoor.observe(dt, exemplar=rid)
+        else:
+            self._h_frontdoor.observe(dt)
+        self.note_request(rid, ok=True, latency_s=dt)
+        return result, handle
+
+    def note_request(self, request_id: Optional[str], *, ok: bool = True,
+                     latency_s: Optional[float] = None,
+                     error: Optional[str] = None) -> Optional[str]:
+        """Account one finished front-door request: SLO ingest plus the
+        archive's keep/drop decision for its span bundle. Returns the
+        archive retention reason (None = dropped or no archive)."""
+        if self.slo is not None:
+            self.slo.record_request(ok=ok, latency_s=latency_s)
+        if self.archive is not None and request_id:
+            return self.archive.note_result(
+                request_id, ok=ok, latency_s=latency_s, error=error)
+        return None
 
     # -- blue-green rollout ------------------------------------------------
 
@@ -391,7 +542,10 @@ class ServingFleet:
         — distinct component names, because ingest is latest-wins per
         component and identical names would clobber each other. The
         aggregator's serving rollup prefix-matches ``serving_replica``
-        (telemetry/aggregate.py)."""
+        (telemetry/aggregate.py). With tracing on, also drains every
+        tracer lane's new span records into the aggregator (so ``dct
+        trace export`` stitches the fleet) and lands the SLO evaluation
+        as ``dct_slo_*`` gauges in the fleet registry."""
         now = time.monotonic()
         for rep in self.replicas():
             st = rep.engine.stats()
@@ -406,6 +560,34 @@ class ServingFleet:
             if self.aggregator is not None:
                 self.aggregator.ingest_component(
                     f"serving_replica_{rep.replica_id}", rep.registry)
+                self._ship_spans(
+                    f"serving_replica_{rep.replica_id}", rep.tracer)
+        if self.aggregator is not None:
+            self._ship_spans("frontdoor", self.frontdoor_tracer)
+            self._ship_spans("router", self._router_tracer)
+        if self.slo is not None:
+            self.slo.publish(self.registry)
+
+    def _ship_spans(self, component: str,
+                    tracer: Optional[Tracer]) -> None:
+        """Drain one tracer lane's finished spans since the last sample
+        into the aggregator, annotated with the clock anchor + process
+        name ``stitch_chrome_trace`` needs (same identity contract as
+        Telemetry.publish)."""
+        if tracer is None or self.aggregator is None:
+            return
+        ship = getattr(self.aggregator, "ingest_component_spans", None)
+        if ship is None:
+            return
+        with self._lock:
+            cursor = self._span_cursor.get(component, 0)
+        new, cursor = tracer.drain_since(cursor)
+        with self._lock:
+            self._span_cursor[component] = cursor
+        if new:
+            ident = {"wall_epoch": tracer.wall_epoch,
+                     "process": tracer.process_name or component}
+            ship(component, [{**ident, **rec} for rec in new])
 
 
 # ---------------------------------------------------------------------------

@@ -193,13 +193,22 @@ def _make_fleet_handler(fleet: Any, aggregator: Any):
                 self._send(200, {"ok": st.healthy > 0,
                                  "stats": dataclasses.asdict(st)})
             elif self.path == "/v1/fleet":
+                slo = getattr(fleet, "slo", None)
                 self._send(200, {
                     "name": fleet.name,
                     "stats": dataclasses.asdict(fleet.stats()),
                     "replicas": [{"id": r.replica_id, "state": r.state}
                                  for r in fleet.replicas()],
                     "excluded": fleet.router.excluded(),
+                    "slo_verdict": (slo.evaluate()["verdict"]
+                                    if slo is not None else None),
                 })
+            elif self.path == "/v1/slo":
+                slo = getattr(fleet, "slo", None)
+                if slo is None:
+                    self._send(404, {"error": "no SLO engine configured"})
+                else:
+                    self._send(200, {"slo": slo.evaluate()})
             elif self.path == "/metrics":
                 fleet.sample_telemetry()
                 text = fleet.registry.dump() + aggregator.dump()
@@ -216,15 +225,28 @@ def _make_fleet_handler(fleet: Any, aggregator: Any):
                     if not isinstance(prompt, list):
                         raise ValueError(
                             "'prompt' must be a list of token ids")
-                    handle = fleet.submit(
-                        prompt, int(req.get("max_new_tokens", 16)),
-                        eos_token_id=req.get("eos_token_id"),
-                        request_id=req.get("request_id"),
-                        timeout=float(req.get("timeout_s", 120.0)))
-                    result = handle.result(
-                        timeout=float(req.get("timeout_s", 120.0)))
+                    timeout = float(req.get("timeout_s", 120.0))
+                    handler = getattr(fleet, "handle_request", None)
+                    if handler is not None:
+                        # the front door proper: mints request_id/trace_id,
+                        # records the frontdoor span, accounts SLO+archive
+                        result, handle = handler(
+                            prompt, int(req.get("max_new_tokens", 16)),
+                            eos_token_id=req.get("eos_token_id"),
+                            request_id=req.get("request_id"),
+                            trace_id=req.get("trace_id"),
+                            timeout=timeout)
+                    else:  # minimal fleet fakes in tests
+                        handle = fleet.submit(
+                            prompt, int(req.get("max_new_tokens", 16)),
+                            eos_token_id=req.get("eos_token_id"),
+                            request_id=req.get("request_id"),
+                            timeout=timeout)
+                        result = handle.result(timeout=timeout)
                     self._send(200, {
                         "request_id": result.request_id,
+                        "trace_id": getattr(result, "trace_id", None)
+                        or req.get("trace_id"),
                         "replica_id": getattr(handle, "replica_id", ""),
                         "tokens": result.tokens,
                         "finish_reason": result.finish_reason,
@@ -287,6 +309,10 @@ class FleetHTTPServer:
             )
 
             fleet.aggregator = ClusterMetricsAggregator()
+        slo = getattr(fleet, "slo", None)
+        attach = getattr(fleet.aggregator, "attach_slo", None)
+        if slo is not None and attach is not None:
+            attach(slo)
         self._server = ThreadingHTTPServer(
             (host, port), _make_fleet_handler(fleet, fleet.aggregator))
         self._server.daemon_threads = True

@@ -70,9 +70,11 @@ class RoutablePort:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
                eos_token_id: Optional[int] = None,
-               request_id: Optional[str] = None) -> Any:
+               request_id: Optional[str] = None, **kwargs: Any) -> Any:
         """Engine-style submit: returns a handle with .result(timeout),
-        raises ServerOverloaded on a full queue."""
+        raises ServerOverloaded on a full queue. A ``trace_id`` kwarg is
+        forwarded only when the caller minted one, so minimal ports need
+        not accept it."""
         raise NotImplementedError
 
 
@@ -87,22 +89,31 @@ class LeastLoadedRouter:
     def __init__(self, registry: Optional[MetricsRegistry] = None, *,
                  exclude_cooldown_s: float = 0.5,
                  policy: RetryPolicy = ROUTER_RETRY,
-                 clock: Any = time.monotonic) -> None:
+                 clock: Any = time.monotonic,
+                 tracer: Any = None) -> None:
         self.registry = registry if registry is not None else MetricsRegistry()
         self.exclude_cooldown_s = float(exclude_cooldown_s)
         self.policy = policy
         self._clock = clock
+        # per-request tracing lane ("router" process in the stitched
+        # trace): dispatch decisions + every failover hop; None = off
+        self._tracer = (tracer if tracer is not None
+                        and getattr(tracer, "enabled", False) else None)
         self._lock = threading.Lock()
         self._replicas: Dict[str, RoutablePort] = {}
         self._excluded_until: Dict[str, float] = {}
         self._c_dispatch = self.registry.counter(
             "router_requests_total", "requests dispatched through the router")
         self._redispatch: Dict[str, Any] = {}
+        self._dispatch_by_replica: Dict[str, Any] = {}
         self._g_replicas = self.registry.gauge(
             "router_replicas", "replicas registered with the router")
         self._g_healthy = self.registry.gauge(
             "router_healthy_replicas",
             "replicas admitting and not excluded")
+        self._g_excluded = self.registry.gauge(
+            "router_excluded_replicas",
+            "replicas currently in exclusion cooldown")
 
     # -- membership (fleet-managed) ---------------------------------------
 
@@ -133,17 +144,35 @@ class LeastLoadedRouter:
             self._redispatch[reason] = c
         return c
 
+    def _dispatch_counter(self, replica_id: str) -> Any:
+        c = self._dispatch_by_replica.get(replica_id)
+        if c is None:
+            c = self.registry.counter(
+                "router_dispatch_total",
+                "successful dispatches per replica",
+                labels={"replica": replica_id})
+            self._dispatch_by_replica[replica_id] = c
+        return c
+
+    def _set_excluded_locked(self, now: float) -> None:
+        self._g_excluded.set(
+            sum(1 for t in self._excluded_until.values() if t > now))
+
     def excluded(self) -> List[str]:
         """Replica ids currently in exclusion cooldown (observability)."""
         now = self._clock()
         with self._lock:
-            return sorted(r for r, t in self._excluded_until.items()
-                          if t > now)
+            out = sorted(r for r, t in self._excluded_until.items()
+                         if t > now)
+            self._set_excluded_locked(now)
+        return out
 
     def _exclude(self, replica_id: str, reason: str) -> None:
         with self._lock:
+            now = self._clock()
             self._excluded_until[replica_id] = (
-                self._clock() + self.exclude_cooldown_s)
+                now + self.exclude_cooldown_s)
+            self._set_excluded_locked(now)
         self._redispatch_counter(reason).inc()
 
     def pick(self, skip: Sequence[str] = ()) -> Optional[RoutablePort]:
@@ -166,6 +195,7 @@ class LeastLoadedRouter:
                     continue
                 candidates.append((rep.load(), rid, rep))
             self._g_healthy.set(healthy)
+            self._set_excluded_locked(now)
         if not candidates:
             return None
         candidates.sort(key=lambda c: (c[0], c[1]))
@@ -176,6 +206,7 @@ class LeastLoadedRouter:
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 16, *,
                eos_token_id: Optional[int] = None,
                request_id: Optional[str] = None,
+               trace_id: Optional[str] = None,
                timeout: Optional[float] = None) -> Any:
         """Dispatch one request; returns the replica's handle (annotated
         with ``.replica_id``). One pass over the fleet per attempt:
@@ -183,7 +214,8 @@ class LeastLoadedRouter:
         immediately (no sleep — that's the no-retry-storm property);
         only a fully excluded fleet backs off, under ``self.policy``.
         ``timeout`` bounds the total dispatch wait, mapping to the
-        policy's deadline semantics."""
+        policy's deadline semantics. ``trace_id`` (minted at the front
+        door) rides every failover hop into the chosen replica."""
         policy = self.policy
         if timeout is not None:
             policy = RetryPolicy(
@@ -194,12 +226,24 @@ class LeastLoadedRouter:
                 deadline_s=timeout, retryable=policy.retryable)
         return retry_call(self._dispatch_once, prompt, max_new_tokens,
                           eos_token_id=eos_token_id, request_id=request_id,
-                          policy=policy)
+                          trace_id=trace_id, policy=policy)
+
+    def _trace_args(self, request_id: Optional[str],
+                    trace_id: Optional[str],
+                    **extra: Any) -> Dict[str, Any]:
+        args: Dict[str, Any] = dict(extra)
+        if request_id is not None:
+            args["request_id"] = request_id
+        if trace_id is not None:
+            args["trace_id"] = trace_id
+        return args
 
     def _dispatch_once(self, prompt: Sequence[int], max_new_tokens: int, *,
                        eos_token_id: Optional[int],
-                       request_id: Optional[str]) -> Any:
+                       request_id: Optional[str],
+                       trace_id: Optional[str] = None) -> Any:
         tried: List[str] = []
+        pt0 = time.perf_counter() if self._tracer is not None else 0.0
         while True:
             target = self.pick(skip=tried)
             if target is None:
@@ -207,9 +251,13 @@ class LeastLoadedRouter:
                     f"no healthy replica (tried {tried or 'none'}, "
                     f"excluded {self.excluded()})")
             try:
-                handle = target.submit(
-                    prompt, max_new_tokens, eos_token_id=eos_token_id,
-                    request_id=request_id)
+                kw: Dict[str, Any] = {"eos_token_id": eos_token_id,
+                                      "request_id": request_id}
+                if trace_id is not None:
+                    # only when minted, so minimal RoutablePort fakes
+                    # (tests) need not grow the kwarg
+                    kw["trace_id"] = trace_id
+                handle = target.submit(prompt, max_new_tokens, **kw)
             except ValueError:
                 raise  # never-servable: not a replica's fault
             except _FAILOVER_ERRORS as exc:
@@ -217,7 +265,19 @@ class LeastLoadedRouter:
                           else "connection")
                 tried.append(target.replica_id)
                 self._exclude(target.replica_id, reason)
+                if self._tracer is not None:
+                    self._tracer.instant(
+                        "router_redispatch", **self._trace_args(
+                            request_id, trace_id,
+                            replica=target.replica_id, reason=reason))
                 continue
             handle.replica_id = target.replica_id
             self._c_dispatch.inc()
+            self._dispatch_counter(target.replica_id).inc()
+            if self._tracer is not None:
+                self._tracer.record_span(
+                    "router_dispatch", pt0, time.perf_counter() - pt0,
+                    **self._trace_args(
+                        request_id, trace_id, replica=target.replica_id,
+                        attempts=len(tried) + 1))
             return handle

@@ -34,9 +34,14 @@ from determined_clone_tpu.telemetry.chrome_trace import (
 )
 from determined_clone_tpu.telemetry.flight import (
     FlightRecorder,
+    RequestArchive,
     flight_summary,
     flight_to_chrome_trace,
     read_flight,
+    read_request_archive,
+    request_archive_summary,
+    request_chrome_trace,
+    request_records,
 )
 from determined_clone_tpu.telemetry.goodput import (
     CATEGORIES as GOODPUT_CATEGORIES,
@@ -54,6 +59,10 @@ from determined_clone_tpu.telemetry.metrics import (
     MetricsRegistry,
     parse_prometheus_text,
 )
+from determined_clone_tpu.telemetry.slo import (
+    SLOEngine,
+    format_slo,
+)
 from determined_clone_tpu.telemetry.spans import (
     NULL_SPAN,
     Span,
@@ -64,13 +73,15 @@ from determined_clone_tpu.telemetry.spans import (
 __all__ = [
     "Counter", "FlightRecorder", "GOODPUT_CATEGORIES", "Gauge",
     "GoodputJournal", "GoodputLedger", "Histogram", "MetricsRegistry",
-    "NULL_SPAN", "Span", "Telemetry", "Tracer",
-    "check_conservation", "chrome_trace_events",
+    "NULL_SPAN", "RequestArchive", "SLOEngine", "Span", "Telemetry",
+    "Tracer", "check_conservation", "chrome_trace_events",
     "flight_summary", "flight_to_chrome_trace", "format_goodput",
-    "merge_goodput", "null_span", "parse_prometheus_text",
-    "read_flight", "read_goodput", "spans_from_profiler_samples",
-    "stitch_chrome_trace", "telemetry_from_config", "to_chrome_trace",
-    "validate_chrome_trace", "write_chrome_trace",
+    "format_slo", "merge_goodput", "null_span", "parse_prometheus_text",
+    "read_flight", "read_goodput", "read_request_archive",
+    "request_archive_summary", "request_chrome_trace", "request_records",
+    "spans_from_profiler_samples", "stitch_chrome_trace",
+    "telemetry_from_config", "to_chrome_trace", "validate_chrome_trace",
+    "write_chrome_trace",
 ]
 
 

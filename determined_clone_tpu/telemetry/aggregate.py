@@ -97,6 +97,23 @@ class ClusterMetricsAggregator:
         self._duplicates = self.registry.counter(
             "dct_master_ingest_duplicates_total",
             "batches dropped as idempotency-key duplicates")
+        # fleet-level SLO engine (telemetry/slo.py), attached by whoever
+        # owns the request stream (FleetHTTPServer); evaluated on demand
+        self._slo: Any = None
+
+    def attach_slo(self, slo: Any) -> None:
+        """Attach the fleet's SLOEngine so ``slo_rollup()`` (and the
+        master's ``/api/v1/cluster/slo`` route) can evaluate it."""
+        self._slo = slo
+
+    def slo_rollup(self) -> Optional[Dict[str, Any]]:
+        """Multi-window burn-rate evaluation of the attached SLO engine,
+        landing ``dct_slo_*`` gauges in the master registry as a side
+        effect (so ``dump()`` exports them). None when no engine is
+        attached — serving (and its SLOs) are optional lanes."""
+        if self._slo is None:
+            return None
+        return self._slo.publish(self.registry)
 
     # -- ingestion ---------------------------------------------------------
 
@@ -430,6 +447,27 @@ class ClusterMetricsAggregator:
                     | set(completed))
         if not replicas:
             return None
+        # raw-speed ratios are fleet-wide sums over sums (a per-replica
+        # average would let an idle replica's 0/0 skew the ratio)
+        proposed = sum(per_replica(
+            "serving_spec_tokens_proposed_total").values())
+        accepted = sum(per_replica(
+            "serving_spec_tokens_accepted_total").values())
+        hits = sum(per_replica("prefix_cache_hit_blocks_total").values())
+        misses = sum(per_replica("prefix_cache_miss_blocks_total").values())
+        # slowest request across the fleet: the latency histogram's
+        # max exemplar carries the request_id (telemetry/metrics.py)
+        slowest: Optional[Dict[str, Any]] = None
+        for labels, s in fams.get("serving_request_total_seconds",
+                                  {}).get("children", []):
+            comp = labels.get("component", "")
+            ex = s.get("max_exemplar")
+            if (comp.startswith("serving_replica")
+                    and isinstance(ex, dict) and ex.get("id")):
+                v = float(ex.get("value", 0.0))
+                if slowest is None or v > slowest["latency_s"]:
+                    slowest = {"request_id": str(ex["id"]),
+                               "latency_s": v, "replica": comp}
         return {
             "replicas": len(replicas),
             "tokens_per_sec": sum(tps.values()),
@@ -437,6 +475,11 @@ class ClusterMetricsAggregator:
             "queue_depth": sum(queue.values()),
             "max_replica_p99_s": max(p99.values()) if p99 else None,
             "requests_completed": sum(completed.values()),
+            "spec_acceptance_rate": (accepted / proposed
+                                     if proposed else None),
+            "prefix_hit_rate": (hits / (hits + misses)
+                                if hits + misses else None),
+            "slowest_request": slowest,
         }
 
     def _serving_fleet_lines(self, fams: Dict[str, Any]) -> List[str]:
@@ -453,12 +496,22 @@ class ClusterMetricsAggregator:
                           ("dct_fleet_max_replica_p99_seconds",
                            "max_replica_p99_s"),
                           ("dct_fleet_requests_completed",
-                           "requests_completed")):
+                           "requests_completed"),
+                          ("dct_fleet_spec_acceptance_rate",
+                           "spec_acceptance_rate"),
+                          ("dct_fleet_prefix_hit_rate",
+                           "prefix_hit_rate")):
             v = roll.get(key)
             if v is None:
                 continue
             lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name} {_fmt(v)}")
+        slowest = roll.get("slowest_request")
+        if slowest:
+            lines.append(
+                '# EXEMPLAR dct_fleet_slowest_request'
+                f'{{request_id="{slowest["request_id"]}"}} '
+                f'{_fmt(slowest["latency_s"])}')
         return lines
 
     def _goodput_lines(self, fams: Dict[str, Any]) -> List[str]:
@@ -617,6 +670,7 @@ class ClusterMetricsAggregator:
             "straggler": straggler,
             "goodput": self.goodput_rollup(fams),
             "serving_fleet": self.serving_fleet_rollup(fams),
+            "slo": self.slo_rollup(),
             "quantiles": quantiles,
             "counters": dict(sorted(counters.items())),
             "ingest": ingest,
@@ -675,6 +729,28 @@ def format_summary(summary: Dict[str, Any]) -> str:
             f"queue depth {int(fleet['queue_depth'])}, "
             f"max replica p99 {p99_s}, "
             f"{int(fleet['requests_completed'])} requests completed")
+        rates = []
+        spec = fleet.get("spec_acceptance_rate")
+        if spec is not None:
+            rates.append(f"spec acceptance {spec:.1%}")
+        hit = fleet.get("prefix_hit_rate")
+        if hit is not None:
+            rates.append(f"prefix hit-rate {hit:.1%}")
+        slowest = fleet.get("slowest_request")
+        if slowest:
+            rates.append(
+                f"slowest request {slowest['request_id']} "
+                f"({slowest['latency_s']:.4f}s on {slowest['replica']})")
+        if rates:
+            out.append("  " + ", ".join(rates))
+    slo = summary.get("slo")
+    if slo:
+        parts = []
+        for name, obj in sorted(slo.get("objectives", {}).items()):
+            burn = obj["windows"]["5m"].get("burn_rate")
+            burn_s = f"{burn:.2f}x" if burn is not None else "n/a"
+            parts.append(f"{name} {obj['verdict']} (5m burn {burn_s})")
+        out.append(f"slo: verdict {slo['verdict']} — " + ", ".join(parts))
     if summary["quantiles"]:
         out.append("latency quantiles (cluster, count-weighted):")
         for name, qs in sorted(summary["quantiles"].items()):

@@ -32,12 +32,14 @@ and moves on. The recorder observes training; it must never take it down.
 """
 from __future__ import annotations
 
+import collections
 import json
 import os
+import random
 import re
 import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from determined_clone_tpu import faults
 
@@ -265,9 +267,239 @@ def flight_to_chrome_trace(directory: str) -> Dict[str, Any]:
     return to_chrome_trace(spans, other_data=other)
 
 
+# -- per-request trace archive ----------------------------------------------
+
+
+class RequestArchive:
+    """Flight-recorder-durable, tail-sampled archive of per-request spans.
+
+    Two stores under one directory (docs/observability.md "Request tracing
+    & SLOs"):
+
+    - ``live/`` — a write-through :class:`FlightRecorder` ring. Every
+      request-tagged span hits disk the moment it finishes, so a replica
+      killed mid-request leaves its partial leg readable (the chaos
+      property). Bounded like any flight ring: the oldest segments age
+      out.
+    - ``retained/`` — the curated archive, written once per *finished*
+      request by the tail-sampling policy: errors are always kept, the
+      slowest-N by latency are always kept, and everything else is kept
+      with probability ``sample_rate``. Retained entries bundle the
+      request's full span list, so they survive after the live ring has
+      rotated past them.
+
+    Span records arrive via :meth:`sink_for` hooks on each component
+    tracer (front door, router, replicas); only records whose args carry a
+    ``request_id`` are archived. Identity (process, wall_epoch, the
+    request's trace_id) is attached per record at write time, so
+    :func:`request_chrome_trace` can stitch one request's multi-process
+    lanes without segment-order bookkeeping.
+    """
+
+    def __init__(self, directory: str, *,
+                 segment_events: int = 512,
+                 max_segments: int = 8,
+                 slowest_n: int = 8,
+                 sample_rate: float = 0.0,
+                 max_open_requests: int = 512,
+                 registry: Optional[Any] = None,
+                 seed: int = 0) -> None:
+        self.directory = directory
+        self.slowest_n = max(0, int(slowest_n))
+        self.sample_rate = float(sample_rate)
+        self.max_open_requests = max(1, int(max_open_requests))
+        self._rng = random.Random(seed)
+        self._live = FlightRecorder(
+            os.path.join(directory, "live"),
+            segment_events=segment_events, max_segments=max_segments,
+            registry=registry)
+        self._retained = FlightRecorder(
+            os.path.join(directory, "retained"),
+            segment_events=segment_events, max_segments=max_segments)
+        # per-request span buffers (completion writes the retained bundle
+        # from here; a crash leaves only the live ring, by design)
+        self._open: "collections.OrderedDict[str, List[Dict[str, Any]]]" = \
+            collections.OrderedDict()
+        # (latency_s, request_id) floor for the slowest-N policy
+        self._slowest: List[Tuple[float, str]] = []
+        self._lock = threading.Lock()
+        self._retained_count = 0
+
+    # -- ingest -------------------------------------------------------------
+
+    def sink_for(self, tracer: Any) -> Any:
+        """A tracer sink that archives request-tagged records with this
+        tracer's identity attached."""
+        def sink(rec: Dict[str, Any]) -> None:
+            args = rec.get("args") or {}
+            rid = args.get("request_id")
+            if rid is None:
+                return
+            entry = {"wall_epoch": tracer.wall_epoch, **rec}
+            process = getattr(tracer, "process_name", None)
+            if process:
+                entry["process"] = process
+            trace_id = args.get("trace_id") or tracer.trace_id
+            if trace_id:
+                entry["trace_id"] = trace_id
+            self.observe_span(str(rid), entry)
+        return sink
+
+    def observe_span(self, request_id: str,
+                     rec: Dict[str, Any]) -> None:
+        """One finished request-tagged span: durable immediately, and
+        buffered for the completion-time sampling decision."""
+        self._live.record_span(rec)
+        with self._lock:
+            buf = self._open.get(request_id)
+            if buf is None:
+                buf = self._open[request_id] = []
+                while len(self._open) > self.max_open_requests:
+                    # evict the oldest open request (its spans stay in the
+                    # live ring; it just can't be retained as a bundle)
+                    self._open.popitem(last=False)
+            buf.append(rec)
+
+    def note_result(self, request_id: str, *, ok: bool = True,
+                    latency_s: Optional[float] = None,
+                    error: Optional[str] = None) -> Optional[str]:
+        """Completion hook: apply the tail-sampling policy.
+
+        Returns the retention reason (``"error"``, ``"slowest"``,
+        ``"sampled"``) or None when the request was let go.
+        """
+        with self._lock:
+            spans = self._open.pop(request_id, [])
+            reason: Optional[str] = None
+            if not ok:
+                reason = "error"
+            elif latency_s is not None and self.slowest_n > 0:
+                floor = (self._slowest[0][0]
+                         if len(self._slowest) >= self.slowest_n else None)
+                if floor is None or latency_s > floor:
+                    self._slowest.append((float(latency_s), request_id))
+                    self._slowest.sort()
+                    del self._slowest[:-self.slowest_n]
+                    reason = "slowest"
+            if reason is None and self._rng.random() < self.sample_rate:
+                reason = "sampled"
+            if reason is None:
+                return None
+            self._retained_count += 1
+        trace_id = next((s["trace_id"] for s in spans
+                         if s.get("trace_id")), None)
+        entry: Dict[str, Any] = {
+            "kind": "request", "request_id": request_id, "ok": bool(ok),
+            "reason": reason, "time": time.time(), "spans": spans,
+        }
+        if latency_s is not None:
+            entry["latency_s"] = round(float(latency_s), 6)
+        if error is not None:
+            entry["error"] = str(error)[:500]
+        if trace_id is not None:
+            entry["trace_id"] = trace_id
+        self._retained._write(entry)
+        return reason
+
+    @property
+    def retained_count(self) -> int:
+        return self._retained_count
+
+    def close(self) -> None:
+        self._live.close()
+        self._retained.close()
+
+
+def read_request_archive(directory: str) -> Iterator[Dict[str, Any]]:
+    """Yield every record from both archive stores: live-ring span
+    records first, then retained request bundles."""
+    for rec in read_flight(os.path.join(directory, "live")):
+        if rec.get("kind") == "span":
+            yield rec
+    for rec in read_flight(os.path.join(directory, "retained")):
+        if rec.get("kind") == "request":
+            yield rec
+
+
+def request_archive_summary(directory: str) -> Dict[str, Any]:
+    """Counts + retained-request digest for the CLI."""
+    live_spans = 0
+    live_requests = set()
+    retained: List[Dict[str, Any]] = []
+    for rec in read_request_archive(directory):
+        if rec.get("kind") == "span":
+            live_spans += 1
+            rid = (rec.get("args") or {}).get("request_id")
+            if rid:
+                live_requests.add(str(rid))
+        else:
+            retained.append({
+                "request_id": rec.get("request_id"),
+                "ok": rec.get("ok"),
+                "reason": rec.get("reason"),
+                "latency_s": rec.get("latency_s"),
+                "spans": len(rec.get("spans") or []),
+            })
+    return {
+        "live_spans": live_spans,
+        "live_request_ids": sorted(live_requests),
+        "retained": retained,
+    }
+
+
+def request_records(directory: str,
+                    request_id: str) -> List[Dict[str, Any]]:
+    """All span records for one request, merged across the live ring and
+    any retained bundle, deduplicated."""
+    out: List[Dict[str, Any]] = []
+    seen = set()
+
+    def _add(rec: Dict[str, Any]) -> None:
+        key = (rec.get("process"), rec.get("tid"), rec.get("name"),
+               rec.get("ts_us"), rec.get("ph"))
+        if key in seen:
+            return
+        seen.add(key)
+        out.append(rec)
+
+    for rec in read_request_archive(directory):
+        if rec.get("kind") == "span":
+            if str((rec.get("args") or {}).get("request_id")) == request_id:
+                _add(rec)
+        elif str(rec.get("request_id")) == request_id:
+            for span in rec.get("spans") or []:
+                if isinstance(span, dict):
+                    _add(span)
+    return out
+
+
+def request_chrome_trace(directory: str,
+                         request_id: str) -> Dict[str, Any]:
+    """Stitch one request's spans (front door, router, every replica leg)
+    into a single multi-process Chrome trace. Raises KeyError when the
+    archive has no spans for the id."""
+    from determined_clone_tpu.telemetry.chrome_trace import (
+        stitch_chrome_trace,
+    )
+
+    records = request_records(directory, request_id)
+    if not records:
+        raise KeyError(
+            f"request {request_id!r} not found in archive {directory!r}")
+    return stitch_chrome_trace(
+        records,
+        other_data={"source": "request_archive", "directory": directory,
+                    "request_id": request_id})
+
+
 __all__ = [
     "FlightRecorder",
+    "RequestArchive",
     "flight_summary",
     "flight_to_chrome_trace",
     "read_flight",
+    "read_request_archive",
+    "request_archive_summary",
+    "request_chrome_trace",
+    "request_records",
 ]

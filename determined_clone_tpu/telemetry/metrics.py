@@ -19,9 +19,10 @@ telemetry rides the profiler's existing flush thread for shipping.
 from __future__ import annotations
 
 import bisect
+import collections
 import random
 import threading
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 
 def _valid_name(name: str) -> str:
@@ -140,9 +141,19 @@ class Histogram:
     Exposed as a Prometheus *summary* (quantile labels + _sum/_count): the
     trial side wants p50/p95/p99 directly, not cumulative buckets that need
     a server-side quantile estimator.
+
+    Observations may carry an *exemplar* — a short identity string (a
+    request_id) naming the thing that produced the value. The histogram
+    keeps a small ring of recent exemplars plus the exemplar of the
+    all-time max, so an aggregate like "p99 doubled" can be traded for a
+    concrete trace id (``dct metrics`` → ``dct trace request <id>``).
+    Exemplars ride :meth:`sample` snapshots and a ``# EXEMPLAR`` comment
+    line in the exposition text (comments, so every existing scraper
+    still parses the family).
     """
 
     QUANTILES = (0.5, 0.95, 0.99)
+    EXEMPLAR_RING = 8
 
     prom_type = "summary"
 
@@ -159,15 +170,23 @@ class Histogram:
         self._sum = 0.0
         self._min = float("inf")
         self._max = float("-inf")
+        self._exemplars: collections.deque = collections.deque(
+            maxlen=self.EXEMPLAR_RING)
+        self._max_exemplar: Optional[Tuple[float, str]] = None
         self._lock = threading.Lock()
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         v = float(v)
         with self._lock:
             self._count += 1
             self._sum += v
             self._min = min(self._min, v)
             self._max = max(self._max, v)
+            if exemplar is not None:
+                self._exemplars.append((v, str(exemplar)))
+                if (self._max_exemplar is None
+                        or v >= self._max_exemplar[0]):
+                    self._max_exemplar = (v, str(exemplar))
             if len(self._sample) < self.reservoir_size:
                 bisect.insort(self._sample, v)
             else:
@@ -188,6 +207,17 @@ class Histogram:
     def sum(self) -> float:
         with self._lock:
             return self._sum
+
+    def exemplars(self) -> List[Tuple[float, str]]:
+        """Recent (value, id) bucket occupants, oldest first."""
+        with self._lock:
+            return list(self._exemplars)
+
+    def max_exemplar(self) -> Optional[Tuple[float, str]]:
+        """(value, id) of the all-time max observation, if any carried an
+        exemplar."""
+        with self._lock:
+            return self._max_exemplar
 
     def percentile(self, q: float) -> float:
         """q in [0, 100]; numpy-default linear interpolation over the
@@ -214,6 +244,12 @@ class Histogram:
                 f"{_fmt(self.percentile(100 * q))}")
         lines.append(f"{self.name}_sum{base} {_fmt(self.sum)}")
         lines.append(f"{self.name}_count{base} {self.count}")
+        ex = self.max_exemplar()
+        if ex is not None:
+            lines.append(
+                f"# EXEMPLAR {self.name}"
+                f"{_label_str(self.labels, {'request_id': ex[1]})} "
+                f"{_fmt(ex[0])}")
         return lines
 
     def expose(self) -> List[str]:
@@ -234,6 +270,11 @@ class Histogram:
                 p95=round(self.percentile(95), 6),
                 p99=round(self.percentile(99), 6),
             )
+        ex = self.max_exemplar()
+        if ex is not None:
+            out["max_exemplar"] = {"value": round(ex[0], 6), "id": ex[1]}
+            out["exemplars"] = [
+                {"value": round(v, 6), "id": i} for v, i in self.exemplars()]
         return out
 
 
@@ -318,13 +359,16 @@ def parse_prometheus_text(text: str) -> Dict[str, Any]:
     """Parse the text exposition format back into structured samples.
 
     Returns ``{"samples": [(name, labels_dict, value)], "types": {name:
-    type}, "help": {name: help}}``. Understands the escaping rules
-    :meth:`MetricsRegistry.dump` applies, so tests (and ``dct metrics``)
-    can round-trip the ``/metrics`` endpoint output.
+    type}, "help": {name: help}, "exemplars": [(name, labels_dict,
+    value)]}``. Understands the escaping rules :meth:`MetricsRegistry.dump`
+    applies, so tests (and ``dct metrics``) can round-trip the ``/metrics``
+    endpoint output; ``# EXEMPLAR`` comment lines (histogram exemplars)
+    are collected separately rather than skipped.
     """
     samples: List[Any] = []
     types: Dict[str, str] = {}
     helps: Dict[str, str] = {}
+    exemplars: List[Any] = []
     for line in text.splitlines():
         line = line.strip()
         if not line:
@@ -340,6 +384,14 @@ def parse_prometheus_text(text: str) -> Dict[str, Any]:
                 raw = parts[3] if len(parts) == 4 else ""
                 helps[parts[2]] = (raw.replace("\\n", "\n")
                                    .replace("\\\\", "\\"))
+            continue
+        if line.startswith("# EXEMPLAR "):
+            body = line[len("# EXEMPLAR "):].strip()
+            try:
+                sub = parse_prometheus_text(body)
+                exemplars.extend(sub["samples"])
+            except (ValueError, IndexError):
+                pass
             continue
         if line.startswith("#"):
             continue
@@ -376,7 +428,8 @@ def parse_prometheus_text(text: str) -> Dict[str, Any]:
             value_str = value_str.strip()
         value = float(value_str)
         samples.append((name.strip(), labels, value))
-    return {"samples": samples, "types": types, "help": helps}
+    return {"samples": samples, "types": types, "help": helps,
+            "exemplars": exemplars}
 
 
 def _fmt(v: float) -> str:

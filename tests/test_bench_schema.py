@@ -131,6 +131,16 @@ def test_serving_section_schema(bench_result):
     assert 0 < sv["programs_compiled"] <= sv["program_budget"]
     assert sv["serving_mfu"] > 0
     assert ":" in sv["mfu_peak_assumed"]
+    # tracing A/B at top load: the overhead estimate must be measured
+    # (non-null) and sane; the <2% budget itself is the gate's advisory
+    assert isinstance(sv["tracing_overhead"], float)
+    assert sv["tracing_overhead"] < 0.5
+    assert sv["traced_tokens_per_sec"] > 0
+    # the simulated-clock SLO replay of the measured latency distribution
+    slo = sv["slo"]
+    assert slo["verdict"] in ("ok", "slow_burn", "fast_burn", "no_data")
+    assert slo["latency_threshold_s"] > 0
+    assert isinstance(slo["burning_fast"], bool)
 
 
 def test_gate_accepts_fresh_round(bench_result):
@@ -144,7 +154,8 @@ def test_gate_accepts_fresh_round(bench_result):
     assert any(line.startswith("ok: xla compile=") for line in report)
     assert any(line.startswith("ok: goodput fraction=") for line in report)
     assert any(line.startswith("ok: serving ") for line in report)
-    assert not any(line.startswith("WARN:") for line in report)
+    warns = [line for line in report if line.startswith("WARN:")]
+    assert not warns, warns
 
 
 def test_gate_enforces_bench_history():

@@ -1,0 +1,476 @@
+"""Per-request distributed tracing (docs/observability.md "Request
+tracing & SLOs"): the request archive's tail-sampling + kill -9
+durability, the router's exclusion/dispatch observability, and the
+acceptance path — one request through the fleet HTTP front door with an
+injected replica failure stitches into a single valid Chrome trace
+(front door → router dispatch + redispatch → both replica legs) under
+one trace_id, retrievable via ``dct trace request <id>``. The slow
+chaos test hard-kills a replica process mid-request and proves the
+archive recovers the partial leg."""
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import jax
+import pytest
+
+from determined_clone_tpu.models import gpt
+from determined_clone_tpu.serving import (
+    BucketSpec,
+    KVCacheConfig,
+    LeastLoadedRouter,
+    ServerOverloaded,
+    ServingFleet,
+)
+from determined_clone_tpu.serving.http import FleetHTTPServer
+from determined_clone_tpu.telemetry import (
+    MetricsRegistry,
+    RequestArchive,
+    Tracer,
+    request_archive_summary,
+    request_chrome_trace,
+    request_records,
+    validate_chrome_trace,
+)
+from determined_clone_tpu.telemetry.aggregate import ClusterMetricsAggregator
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = gpt.GPTConfig(vocab_size=97, n_layers=2, d_model=32, n_heads=4,
+                    d_ff=64, max_seq_len=48, remat=False,
+                    attention_impl="mha")
+BUCKETS = BucketSpec.build(2, 8)
+CACHE = KVCacheConfig(num_blocks=16, block_size=8)
+PROMPT = [1, 2, 3]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt.init(jax.random.PRNGKey(0), CFG)
+
+
+def make_fleet(params, **kw):
+    kw.setdefault("buckets", BUCKETS)
+    kw.setdefault("cache", CACHE)
+    kw.setdefault("warmup", False)
+    return ServingFleet(params, CFG, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Request archive: tail sampling + durability (no engines)
+# ---------------------------------------------------------------------------
+
+
+def archive_span(archive, tracer, request_id, name="request_admitted",
+                 **extra):
+    tracer.record_span(name, time.perf_counter(), 0.001,
+                       request_id=request_id, **extra)
+    return archive
+
+
+def test_archive_keeps_errors_slowest_and_samples(tmp_path):
+    archive = RequestArchive(str(tmp_path), slowest_n=2, sample_rate=0.0)
+    tracer = Tracer(enabled=True, process_name="frontdoor")
+    tracer.add_sink(archive.sink_for(tracer))
+    for rid, lat in (("r-big", 0.5), ("r-mid", 0.2), ("r-small", 0.1)):
+        archive_span(archive, tracer, rid, trace_id=f"t-{rid}")
+    # spans without a request_id never reach the archive
+    tracer.record_span("warmup", time.perf_counter(), 0.001)
+
+    assert archive.note_result("r-big", ok=True, latency_s=0.5) == "slowest"
+    assert archive.note_result("r-mid", ok=True, latency_s=0.2) == "slowest"
+    # under the slowest-N floor and not sampled → let go
+    assert archive.note_result("r-small", ok=True, latency_s=0.1) is None
+    # errors are always retained, latency or not
+    archive_span(archive, tracer, "r-err")
+    assert archive.note_result("r-err", ok=False,
+                               error="ServerOverloaded") == "error"
+    assert archive.retained_count == 3
+
+    summary = request_archive_summary(str(tmp_path))
+    assert summary["live_spans"] == 4  # every request-tagged span, kept or not
+    assert "r-small" in summary["live_request_ids"]
+    reasons = {r["request_id"]: r["reason"] for r in summary["retained"]}
+    assert reasons == {"r-big": "slowest", "r-mid": "slowest",
+                       "r-err": "error"}
+    archive.close()
+
+
+def test_archive_sample_rate_keeps_the_rest(tmp_path):
+    archive = RequestArchive(str(tmp_path), slowest_n=0, sample_rate=1.0)
+    tracer = Tracer(enabled=True, process_name="frontdoor")
+    tracer.add_sink(archive.sink_for(tracer))
+    archive_span(archive, tracer, "r-fast")
+    assert archive.note_result("r-fast", ok=True,
+                               latency_s=0.001) == "sampled"
+    archive.close()
+
+
+def test_archive_live_ring_is_durable_before_close(tmp_path):
+    """Write-through property: the span is on disk the moment the tracer
+    finishes it — no close(), no flush — so a kill -9 mid-request leaves
+    the partial leg readable (the chaos contract, proven cross-process
+    by the slow test below)."""
+    archive = RequestArchive(str(tmp_path))
+    tracer = Tracer(enabled=True, process_name="serving_replica_r1")
+    tracer.add_sink(archive.sink_for(tracer))
+    archive_span(archive, tracer, "r-crash", trace_id="t-crash")
+    recs = request_records(str(tmp_path), "r-crash")
+    assert len(recs) == 1
+    assert recs[0]["process"] == "serving_replica_r1"
+    assert recs[0]["trace_id"] == "t-crash"
+    archive.close()
+
+
+def test_request_records_dedup_and_chrome_trace(tmp_path):
+    archive = RequestArchive(str(tmp_path), slowest_n=4)
+    fd = Tracer(enabled=True, process_name="frontdoor")
+    fd.add_sink(archive.sink_for(fd))
+    rep = Tracer(enabled=True, process_name="serving_replica_r1")
+    rep.add_sink(archive.sink_for(rep))
+    archive_span(archive, rep, "r-1", name="request_admitted",
+                 trace_id="t-1")
+    archive_span(archive, fd, "r-1", name="frontdoor_request",
+                 trace_id="t-1")
+    archive.note_result("r-1", ok=True, latency_s=0.2)  # retained bundle
+    archive.close()
+    # each span now exists in the live ring AND the retained bundle;
+    # request_records must not double-count
+    recs = request_records(str(tmp_path), "r-1")
+    assert len(recs) == 2
+    trace = request_chrome_trace(str(tmp_path), "r-1")
+    assert validate_chrome_trace(trace) == []
+    assert trace["otherData"]["trace_ids"] == ["t-1"]
+    assert set(trace["otherData"]["processes"]) == {
+        "frontdoor", "serving_replica_r1"}
+    with pytest.raises(KeyError):
+        request_chrome_trace(str(tmp_path), "r-unknown")
+
+
+# ---------------------------------------------------------------------------
+# Router observability (fake ports)
+# ---------------------------------------------------------------------------
+
+
+class FakePort:
+    def __init__(self, rid, fail=None):
+        self.replica_id = rid
+        self.fail = fail
+        self.kwargs = None
+
+    def admitting(self):
+        return True
+
+    def load(self):
+        return (0, -16)
+
+    def submit(self, prompt, max_new_tokens, **kwargs):
+        if self.fail is not None:
+            raise self.fail
+        self.kwargs = kwargs
+
+        class Handle:
+            def result(self, timeout=None):
+                return None
+
+        return Handle()
+
+
+def _gauge(reg, name):
+    return reg.gauge(name, "").value
+
+
+def test_router_exclusion_gauge_and_per_replica_dispatch():
+    clock = [0.0]
+    reg = MetricsRegistry()
+    router = LeastLoadedRouter(reg, exclude_cooldown_s=1.0,
+                               clock=lambda: clock[0])
+    bad = FakePort("a", fail=ServerOverloaded("queue full"))
+    good = FakePort("b")
+    router.add(bad)
+    router.add(good)
+    handle = router.submit(PROMPT, 4, request_id="r-1", trace_id="t-1")
+    assert handle.replica_id == "b"
+    # the failing replica sits in cooldown, visible as a gauge
+    assert router.excluded() == ["a"]
+    assert _gauge(reg, "router_excluded_replicas") == 1.0
+    # per-replica dispatch counter: only the replica that served it
+    text = reg.dump()
+    assert 'router_dispatch_total{replica="b"} 1' in text
+    assert 'router_dispatch_total{replica="a"}' not in text
+    # the minted trace identity rode the failover hop into the replica
+    assert good.kwargs["trace_id"] == "t-1"
+    assert good.kwargs["request_id"] == "r-1"
+    # cooldown expiry clears the gauge
+    clock[0] += 2.0
+    assert router.excluded() == []
+    assert _gauge(reg, "router_excluded_replicas") == 0.0
+
+
+def test_router_records_dispatch_and_redispatch_spans():
+    tracer = Tracer(enabled=True, process_name="router")
+    router = LeastLoadedRouter(MetricsRegistry(), tracer=tracer)
+    router.add(FakePort("a", fail=ConnectionError("replica died")))
+    router.add(FakePort("b"))
+    router.submit(PROMPT, 4, request_id="r-1", trace_id="t-1")
+    names = [e["name"] for e in tracer.events()]
+    assert "router_redispatch" in names
+    assert "router_dispatch" in names
+    dispatch = next(e for e in tracer.events()
+                    if e["name"] == "router_dispatch")
+    assert dispatch["args"]["replica"] == "b"
+    assert dispatch["args"]["attempts"] == 2
+    assert dispatch["args"]["trace_id"] == "t-1"
+
+
+def test_router_without_trace_id_spares_minimal_ports():
+    """Fakes that predate tracing (no trace_id kwarg) keep working: the
+    kwarg is only forwarded when the front door minted one."""
+
+    class LegacyPort:
+        replica_id = "legacy"
+
+        def admitting(self):
+            return True
+
+        def load(self):
+            return (0, 0)
+
+        def submit(self, prompt, max_new_tokens, *, eos_token_id=None,
+                   request_id=None):
+            class Handle:
+                def result(self, timeout=None):
+                    return None
+
+            return Handle()
+
+    router = LeastLoadedRouter()
+    router.add(LegacyPort())
+    assert router.submit(PROMPT, 4) is not None
+
+
+# ---------------------------------------------------------------------------
+# The acceptance path: HTTP front door, injected failure, one trace
+# ---------------------------------------------------------------------------
+
+
+def test_traced_request_with_failover_stitches_one_trace(params, tmp_path):
+    archive_dir = str(tmp_path / "archive")
+    agg = ClusterMetricsAggregator()
+    fleet = make_fleet(params, aggregator=agg, tracing=True,
+                       archive_dir=archive_dir)
+    try:
+        fleet.scale_up(2)
+        rep_a, rep_b = fleet.replicas()
+
+        # inject: replica A accepts the work, then the connection "drops"
+        # — the router must fail over while A's partial leg keeps tracing
+        orig_submit = rep_a.submit
+
+        def flaky_submit(prompt, max_new_tokens=16, **kw):
+            orig_submit(prompt, max_new_tokens, **kw)
+            raise ConnectionError("link dropped after enqueue")
+
+        rep_a.submit = flaky_submit
+        with FleetHTTPServer(fleet) as server:
+            body = json.dumps({"prompt": PROMPT,
+                               "max_new_tokens": 6}).encode()
+            req = urllib.request.Request(
+                f"{server.url}/v1/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                out = json.loads(resp.read().decode())
+            rep_a.submit = orig_submit
+            rid, tid = out["request_id"], out["trace_id"]
+            assert rid.startswith("req-") and tid.startswith("trace-")
+            assert out["replica_id"] == rep_b.replica_id
+            assert len(out["tokens"]) == 6
+
+            # both engines saw the request (A kept the enqueued copy);
+            # let them go idle so every span of both legs is recorded
+            for rep in fleet.replicas():
+                rep.engine.wait_idle(60.0)
+            fleet.sample_telemetry()
+
+            # the SLO surface saw the request
+            with urllib.request.urlopen(f"{server.url}/v1/slo",
+                                        timeout=10) as resp:
+                slo = json.loads(resp.read().decode())["slo"]
+            assert slo["verdict"] in ("ok", "slow_burn", "fast_burn")
+            with urllib.request.urlopen(f"{server.url}/v1/fleet",
+                                        timeout=10) as resp:
+                assert json.loads(
+                    resp.read().decode())["slo_verdict"] is not None
+
+        # ONE stitched trace: front door + router decision (incl. the
+        # redispatch) + both replica legs, all under a single trace_id
+        trace = request_chrome_trace(archive_dir, rid)
+        assert validate_chrome_trace(trace) == []
+        assert trace["otherData"]["trace_ids"] == [tid]
+        processes = set(trace["otherData"]["processes"])
+        assert {"frontdoor", "router",
+                f"serving_replica_{rep_a.replica_id}",
+                f"serving_replica_{rep_b.replica_id}"} <= processes
+        names = {e["name"] for e in trace["traceEvents"]
+                 if e["ph"] in ("X", "i")}
+        assert {"frontdoor_request", "router_dispatch",
+                "router_redispatch", "request_admitted",
+                "request_retired"} <= names
+
+        # the aggregator got the same lanes via sample_telemetry
+        agg_procs = {s.get("process") for s in agg.spans()}
+        assert {"frontdoor", "router"} <= agg_procs
+
+        # a completed request is the fleet's slowest so far → exemplar
+        roll = agg.serving_fleet_rollup()
+        assert roll["slowest_request"]["request_id"] == rid
+
+        # the operator path: dct trace request <id>
+        from determined_clone_tpu.cli.cli import main as cli_main
+        out_path = tmp_path / "request-trace.json"
+        rc = cli_main(["trace", "request", rid,
+                       "--archive-dir", archive_dir, "-o", str(out_path)])
+        assert rc == 0
+        written = json.loads(out_path.read_text())
+        assert validate_chrome_trace(written) == []
+        assert written["otherData"]["trace_ids"] == [tid]
+        # and an unknown id fails with the archive's inventory, not a stack
+        assert cli_main(["trace", "request", "req-nope",
+                         "--archive-dir", archive_dir,
+                         "-o", str(out_path)]) == 1
+    finally:
+        fleet.close()
+
+
+def test_disabled_telemetry_means_zero_tracing_work(params, tmp_path,
+                                                    monkeypatch):
+    monkeypatch.setenv("DCT_TELEMETRY_DISABLED", "1")
+    fleet = make_fleet(params, archive_dir=str(tmp_path / "archive"))
+    try:
+        assert fleet.tracing is False
+        assert fleet.frontdoor_tracer is None
+        assert fleet.archive is None
+        assert fleet.slo is None
+        fleet.scale_up(1)
+        rep = fleet.replicas()[0]
+        assert rep.tracer is None
+        assert rep.engine._tracer is None
+        # ids pass through unminted: no uuid cost on the disabled path
+        assert fleet.mint_ids(None, None) == (None, None)
+        result, _ = fleet.handle_request(PROMPT, 4)
+        assert len(result.tokens) == 4
+        assert result.trace_id is None
+        # nothing was archived and no request events were recorded
+        assert not os.path.isdir(str(tmp_path / "archive"))
+    finally:
+        fleet.close()
+
+
+def test_tracing_on_by_default_and_attach_tracer_swap(params, monkeypatch):
+    monkeypatch.delenv("DCT_TELEMETRY_DISABLED", raising=False)
+    fleet = make_fleet(params)
+    try:
+        assert fleet.tracing is True
+        assert fleet.frontdoor_tracer is not None
+        assert fleet.slo is not None
+        fleet.scale_up(1)
+        engine = fleet.replicas()[0].engine
+        assert engine._tracer is not None
+        # the bench's traced/untraced A/B rides this atomic swap
+        engine.attach_tracer(None)
+        assert engine._tracer is None
+        t = Tracer(enabled=True, process_name="bench_serving")
+        engine.attach_tracer(t)
+        assert engine._tracer is t
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# Chaos: replica hard-killed mid-request (the flight-recorder property)
+# ---------------------------------------------------------------------------
+
+CHAOS_LEG1 = '''
+import os, signal, sys, time
+sys.path.insert(0, {repo!r})
+import jax
+from determined_clone_tpu.models import gpt
+from determined_clone_tpu.serving import (
+    BucketSpec, KVCacheConfig, ServingFleet)
+from determined_clone_tpu.telemetry.flight import request_records
+
+archive_dir = sys.argv[1]
+cfg = gpt.GPTConfig(vocab_size=97, n_layers=2, d_model=32, n_heads=4,
+                    d_ff=64, max_seq_len=48, remat=False,
+                    attention_impl="mha")
+params = gpt.init(jax.random.PRNGKey(0), cfg)
+fleet = ServingFleet(params, cfg, name="leg1",
+                     buckets=BucketSpec.build(2, 8),
+                     cache=KVCacheConfig(num_blocks=16, block_size=8),
+                     warmup=False, tracing=True, archive_dir=archive_dir,
+                     iteration_floor_s=0.05)
+fleet.scale_up(1)
+fleet.submit([1, 2, 3], 40, request_id="req-chaos", trace_id="trace-chaos")
+deadline = time.monotonic() + 90
+while time.monotonic() < deadline:
+    if any(r.get("name") == "request_admitted"
+           for r in request_records(archive_dir, "req-chaos")):
+        # the partial leg is on disk; die like a machine failure —
+        # no drain, no close, no atexit
+        os.kill(os.getpid(), signal.SIGKILL)
+    time.sleep(0.02)
+print("ADMISSION NEVER ARCHIVED", file=sys.stderr)
+sys.exit(3)
+'''
+
+
+@pytest.mark.slow
+def test_kill9_replica_leaves_partial_leg_and_failover_completes(
+        params, tmp_path):
+    """Satellite chaos property: leg 1 (a subprocess fleet) is SIGKILLed
+    mid-request after admission; the archive's live ring keeps its
+    partial spans. Leg 2 (this process) re-runs the same request_id /
+    trace_id to completion — the failed-over retry — and the stitched
+    trace shows BOTH legs under the one trace_id."""
+    archive_dir = str(tmp_path / "archive")
+    script = tmp_path / "chaos_leg1.py"
+    script.write_text(CHAOS_LEG1.format(repo=REPO))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("DCT_TELEMETRY_DISABLED", None)
+    proc = subprocess.run([sys.executable, str(script), archive_dir],
+                          env=env, capture_output=True, text=True,
+                          timeout=300)
+    assert proc.returncode == -9, proc.stdout + proc.stderr
+
+    # the partial leg survived the kill
+    leg1 = request_records(archive_dir, "req-chaos")
+    assert any(r.get("name") == "request_admitted" for r in leg1)
+    assert all(r.get("trace_id") == "trace-chaos" for r in leg1)
+
+    # leg 2: a fresh fleet over the same archive completes the request
+    fleet = make_fleet(params, name="leg2", tracing=True,
+                       archive_dir=archive_dir)
+    try:
+        fleet.scale_up(1)
+        result, _ = fleet.handle_request(
+            PROMPT, 6, request_id="req-chaos", trace_id="trace-chaos")
+        assert len(result.tokens) == 6
+        for rep in fleet.replicas():
+            rep.engine.wait_idle(60.0)
+    finally:
+        fleet.close()
+
+    trace = request_chrome_trace(archive_dir, "req-chaos")
+    assert validate_chrome_trace(trace) == []
+    assert trace["otherData"]["trace_ids"] == ["trace-chaos"]
+    processes = set(trace["otherData"]["processes"])
+    assert "serving_replica_leg1-1" in processes   # the killed leg
+    assert "serving_replica_leg2-1" in processes   # the completed leg
+    assert "frontdoor" in processes
+    names = {e["name"] for e in trace["traceEvents"]
+             if e["ph"] in ("X", "i")}
+    assert "request_admitted" in names
+    assert "request_retired" in names              # only leg 2 got here

@@ -1,0 +1,238 @@
+"""SLO burn-rate engine + latency exemplars (docs/observability.md
+"Request tracing & SLOs"): multi-window burn-rate math on simulated
+clocks, verdict ordering, gauge export, the aggregator/inprocess-master
+SLO surface, and the histogram exemplar ring that trades an aggregate
+percentile for a concrete request id."""
+import math
+
+import pytest
+
+from determined_clone_tpu.api.inprocess import InProcessMaster
+from determined_clone_tpu.telemetry import MetricsRegistry
+from determined_clone_tpu.telemetry.aggregate import (
+    ClusterMetricsAggregator,
+    format_summary,
+)
+from determined_clone_tpu.telemetry.metrics import parse_prometheus_text
+from determined_clone_tpu.telemetry.slo import (
+    FAST_BURN_THRESHOLD,
+    WINDOWS,
+    SLOEngine,
+    format_slo,
+)
+
+T0 = 1_000_000.0  # simulated wall-clock origin; nothing reads time.time
+
+
+def make_engine(**kw):
+    kw.setdefault("clock", lambda: T0)
+    return SLOEngine(**kw)
+
+
+# -- engine math -------------------------------------------------------------
+
+
+def test_no_traffic_is_no_data():
+    ev = make_engine().evaluate(now=T0)
+    assert ev["verdict"] == "no_data"
+    for obj in ev["objectives"].values():
+        assert obj["verdict"] == "no_data"
+        assert all(w["burn_rate"] is None for w in obj["windows"].values())
+
+
+def test_healthy_traffic_is_ok():
+    slo = make_engine()
+    for tick in range(72):  # 3 days of hourly traffic
+        slo.record_request(ok=True, latency_s=0.01, n=50,
+                           t=T0 - tick * 3600.0)
+    ev = slo.evaluate(now=T0)
+    assert ev["verdict"] == "ok"
+    av = ev["objectives"]["availability"]
+    assert av["windows"]["3d"]["total"] == 72 * 50
+    assert av["windows"]["3d"]["burn_rate"] == 0.0
+
+
+def test_burn_rate_is_bad_fraction_over_budget():
+    slo = make_engine(availability_objective=0.999)
+    # 2 errors in 100 requests → bad_fraction 0.02, budget 0.001 → 20x
+    slo.record_request(ok=True, n=98, t=T0)
+    slo.record_request(ok=False, n=2, t=T0)
+    w = slo.evaluate(now=T0)["objectives"]["availability"]["windows"]["5m"]
+    assert w["bad_fraction"] == pytest.approx(0.02)
+    assert w["burn_rate"] == pytest.approx(20.0)
+
+
+def test_fast_burn_needs_both_fast_windows():
+    # errors only in the last 5 minutes: the 5m window burns hot but the
+    # 1h window dilutes under 14.4x → not a fast burn (transient spike)
+    slo = make_engine(availability_objective=0.999)
+    slo.record_request(ok=False, n=20, t=T0)
+    slo.record_request(ok=True, n=980, t=T0)
+    slo.record_request(ok=True, n=100_000, t=T0 - 1800.0)
+    av = slo.evaluate(now=T0)["objectives"]["availability"]
+    assert av["windows"]["5m"]["burn_rate"] >= FAST_BURN_THRESHOLD
+    assert av["windows"]["1h"]["burn_rate"] < FAST_BURN_THRESHOLD
+    assert not av["burning_fast"]
+    # sustain the error rate across the full hour → both windows burn
+    for tick in range(12):
+        slo.record_request(ok=False, n=5000, t=T0 - tick * 300.0)
+    av = slo.evaluate(now=T0)["objectives"]["availability"]
+    assert av["burning_fast"]
+    assert av["verdict"] == "fast_burn"
+
+
+def test_slow_burn_tickets_without_paging():
+    # a steady 2x burn: over 1.0 on the slow pair (ticket) but nowhere
+    # near 14.4 on the fast pair (no page)
+    slo = make_engine(availability_objective=0.999)
+    for tick in range(72):
+        slo.record_request(ok=True, n=998, t=T0 - tick * 3600.0)
+        slo.record_request(ok=False, n=2, t=T0 - tick * 3600.0)
+    av = slo.evaluate(now=T0)["objectives"]["availability"]
+    assert not av["burning_fast"]
+    assert av["burning_slow"]
+    assert av["verdict"] == "slow_burn"
+    # overall verdict is the worst objective; latency saw no samples with
+    # latency_s=None → but totals exist only for availability
+    assert slo.evaluate(now=T0)["verdict"] == "slow_burn"
+
+
+def test_latency_objective_judges_threshold():
+    slo = make_engine(latency_objective=0.99, latency_threshold_s=0.5)
+    for tick in range(72):
+        slo.record_request(ok=True, latency_s=2.0, n=30,
+                           t=T0 - tick * 3600.0)
+        slo.record_request(ok=True, latency_s=0.05, n=70,
+                           t=T0 - tick * 3600.0)
+    lat = slo.evaluate(now=T0)["objectives"]["latency"]
+    assert lat["threshold_s"] == 0.5
+    # 30% slow against a 1% budget = 30x on every window → fast burn
+    assert lat["verdict"] == "fast_burn"
+    # availability is clean; overall takes the worst
+    assert slo.evaluate(now=T0)["verdict"] == "fast_burn"
+
+
+def test_buckets_outside_window_are_ignored():
+    slo = make_engine()
+    slo.record_request(ok=False, n=10, t=T0 - WINDOWS["3d"] - 7200.0)
+    ev = slo.evaluate(now=T0)
+    assert ev["verdict"] == "no_data"
+
+
+def test_from_dict_and_validation():
+    slo = SLOEngine.from_dict(
+        {"availability_objective": 0.99, "latency_threshold_s": 1.5,
+         "unknown_key": "ignored"}, clock=lambda: T0)
+    assert slo.availability_objective == 0.99
+    assert slo.latency_threshold_s == 1.5
+    with pytest.raises(ValueError):
+        SLOEngine(availability_objective=1.5)
+    with pytest.raises(ValueError):
+        SLOEngine(latency_threshold_s=0.0)
+
+
+def test_publish_exports_gauges_and_format_renders():
+    slo = make_engine()
+    slo.record_request(ok=False, n=5, t=T0)
+    reg = MetricsRegistry()
+    ev = slo.publish(reg)
+    text = reg.dump()
+    assert 'dct_slo_objective{objective="availability"}' in text
+    assert 'dct_slo_burn_rate{objective="availability",window="5m"}' in text
+    assert "dct_slo_burning" in text
+    # windows with no traffic export NaN, not 0 (absence, not health):
+    # only availability saw requests, so latency burn rates are NaN
+    parsed = parse_prometheus_text(text)
+    lat_burns = [v for n, lab, v in parsed["samples"]
+                 if n == "dct_slo_burn_rate"
+                 and lab.get("objective") == "latency"]
+    assert lat_burns and all(math.isnan(v) for v in lat_burns)
+    rendered = format_slo(ev)
+    assert "slo verdict:" in rendered
+    assert "availability" in rendered and "latency" in rendered
+
+
+def test_aggregator_slo_rollup_and_summary():
+    agg = ClusterMetricsAggregator()
+    assert agg.slo_rollup() is None
+    slo = make_engine()
+    slo.record_request(ok=True, latency_s=0.01, n=100, t=T0)
+    agg.attach_slo(slo)
+    roll = agg.slo_rollup()
+    assert roll["verdict"] == "ok"
+    # the rollup publishes into the aggregator registry → dump carries it
+    assert "dct_slo_burn_rate" in agg.dump()
+    summary = agg.summary()
+    assert summary["slo"]["verdict"] == "ok"
+    assert "slo: verdict ok" in format_summary(summary)
+
+
+def test_inprocess_master_serves_cluster_slo():
+    master = InProcessMaster()
+    status, payload, _ = master.handle("GET", "/api/v1/cluster/slo")
+    assert status == 200 and payload["slo"] is None
+    slo = make_engine()
+    slo.record_request(ok=False, n=3, t=T0)
+    master.aggregator.attach_slo(slo)
+    status, payload, _ = master.handle("GET", "/api/v1/cluster/slo")
+    assert status == 200
+    assert payload["slo"]["objectives"]["availability"]["verdict"] in (
+        "fast_burn", "slow_burn", "ok")
+
+
+# -- histogram exemplars -----------------------------------------------------
+
+
+def test_histogram_exemplar_tracks_max_and_ring():
+    reg = MetricsRegistry()
+    h = reg.histogram("serving_request_total_seconds", "test")
+    h.observe(0.2, exemplar="req-a")
+    h.observe(0.9, exemplar="req-slow")
+    h.observe(0.4, exemplar="req-b")
+    h.observe(0.1)  # exemplar-less observations don't disturb the ring
+    assert h.max_exemplar() == (0.9, "req-slow")
+    assert [i for _, i in h.exemplars()] == ["req-a", "req-slow", "req-b"]
+    # the ring is bounded: oldest exemplars age out, the max survives
+    for k in range(20):
+        h.observe(0.01, exemplar=f"req-{k}")
+    assert len(h.exemplars()) == h.EXEMPLAR_RING
+    assert h.max_exemplar() == (0.9, "req-slow")
+
+
+def test_exemplar_rides_exposition_and_samples():
+    reg = MetricsRegistry()
+    h = reg.histogram("serving_request_total_seconds", "test",
+                      labels={"component": "serving_replica_r1"})
+    h.observe(1.25, exemplar="req-deadbeef")
+    text = reg.dump()
+    assert "# EXEMPLAR serving_request_total_seconds" in text
+    assert 'request_id="req-deadbeef"' in text
+    parsed = parse_prometheus_text(text)
+    assert any(lab.get("request_id") == "req-deadbeef"
+               for _, lab, _ in parsed["exemplars"])
+    snap = h.sample()
+    assert snap["max_exemplar"] == {"value": 1.25, "id": "req-deadbeef"}
+    assert snap["exemplars"][0]["id"] == "req-deadbeef"
+
+
+def test_fleet_rollup_names_slowest_request():
+    agg = ClusterMetricsAggregator()
+    reg = MetricsRegistry()
+    reg.histogram("serving_request_total_seconds", "t").observe(
+        0.8, exemplar="req-slowest")
+    reg.counter("serving_spec_tokens_proposed_total", "t").inc(100)
+    reg.counter("serving_spec_tokens_accepted_total", "t").inc(60)
+    reg.counter("prefix_cache_hit_blocks_total", "t").inc(30)
+    reg.counter("prefix_cache_miss_blocks_total", "t").inc(10)
+    agg.ingest_component("serving_replica_r1", reg)
+    roll = agg.serving_fleet_rollup()
+    assert roll["spec_acceptance_rate"] == pytest.approx(0.6)
+    assert roll["prefix_hit_rate"] == pytest.approx(0.75)
+    assert roll["slowest_request"]["request_id"] == "req-slowest"
+    assert roll["slowest_request"]["replica"] == "serving_replica_r1"
+    text = format_summary(agg.summary())
+    assert "spec acceptance" in text
+    assert "req-slowest" in text
+    dump = agg.dump()
+    assert "dct_fleet_spec_acceptance_rate" in dump
+    assert "dct_fleet_slowest_request" in dump

@@ -242,6 +242,33 @@ def _serving_lines(old_detail: Dict[str, Any],
         report.append(
             f"WARN: continuous batching no longer beats static "
             f"run-to-completion (continuous_over_static={ratio})")
+    # observability lane (docs/observability.md "Request tracing & SLOs"):
+    # per-request tracing must stay near-free at top load, and the round's
+    # simulated-clock SLO verdict must not be burning its fast windows
+    overhead = sv_new.get("tracing_overhead")
+    if not isinstance(overhead, (int, float)):
+        report.append("WARN: tracing_overhead is null — the traced/"
+                      "untraced A/B did not run")
+    elif overhead > 0.02:
+        report.append(
+            f"WARN: tracing overhead {overhead:.1%} > 2% at top load "
+            f"({top.get('tokens_per_sec')} → "
+            f"{sv_new.get('traced_tokens_per_sec')} tok/s traced)")
+    else:
+        report.append(f"ok: tracing overhead {overhead:.1%} at top load")
+    slo = sv_new.get("slo")
+    if not isinstance(slo, dict) or slo.get("verdict") is None:
+        report.append("WARN: serving SLO verdict is null")
+    elif slo.get("burning_fast"):
+        report.append(
+            f"WARN: serving SLO fast windows burning "
+            f"(verdict={slo.get('verdict')}, 5m latency burn "
+            f"{slo.get('latency_burn_5m')}x over threshold "
+            f"{slo.get('latency_threshold_s')}s)")
+    else:
+        report.append(
+            f"ok: serving SLO verdict {slo.get('verdict')} "
+            f"(latency threshold {slo.get('latency_threshold_s')}s)")
     sv_old = old_detail.get("serving")
     if not isinstance(sv_old, dict) or sv_old.get("error"):
         sv_old = {}

@@ -424,7 +424,7 @@ def run_mixed_load(trials: int = 400, agents: int = 4,
         for s in sims:
             s.start()
 
-        serving_lat: list = []
+        serving_lat: list = []          # (total_s, request_id) pairs
         serving_errors = [0]
 
         # one KV block (block_size=8) of common system prompt; tails vary
@@ -445,7 +445,8 @@ def run_mixed_load(trials: int = 400, agents: int = 4,
                     serving_errors[0] += 1
             for h in handles:
                 try:
-                    serving_lat.append(h.result(60.0).total_s)
+                    res = h.result(60.0)
+                    serving_lat.append((res.total_s, res.request_id))
                 except Exception:  # noqa: BLE001
                     serving_errors[0] += 1
 
@@ -523,6 +524,16 @@ def run_mixed_load(trials: int = 400, agents: int = 4,
         prefix_hit_rate = (round(prefix_hits / prefix_total, 4)
                            if prefix_total else None)
 
+        # the observed p99-slowest request, by id — paste it straight into
+        # ``dct trace request <id>`` to pull the stitched per-request trace
+        lat_pcts = _percentiles([t for t, _ in serving_lat])
+        p99_slowest = None
+        if serving_lat and lat_pcts["p99"] is not None:
+            at_or_above = [(t, r) for t, r in serving_lat
+                           if t >= lat_pcts["p99"]]
+            pool = at_or_above or serving_lat
+            p99_slowest = max(pool, key=lambda p: p[0])[1]
+
         final = _sched(port)
         fc, lat = _counters(final), final.get("latency") or {}
         # the acceptance probe: serving gang allocations visible in the
@@ -563,7 +574,8 @@ def run_mixed_load(trials: int = 400, agents: int = 4,
                 "tokens_generated": fleet_stats.tokens_generated,
                 "tokens_per_sec": round(
                     fleet_stats.tokens_generated / serving_wall, 2),
-                "request_total_s": _percentiles(serving_lat),
+                "request_total_s": lat_pcts,
+                "p99_slowest_request_id": p99_slowest,
                 "shared_prefix": shared_prefix,
                 "prefix_hit_blocks": prefix_hits,
                 "prefix_miss_blocks": prefix_misses,
