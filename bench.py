@@ -887,6 +887,60 @@ def _run_child() -> None:
         finally:
             fleet.close()
 
+    def time_multichip(device_counts=(8, 16)) -> dict:
+        """Measured multichip scaling lane (docs/parallelism.md): one
+        ``parallel/scaling_bench.py`` subprocess per simulated mesh size —
+        device count is fixed at backend init, so each size needs its own
+        process; they run concurrently because the virtual devices
+        timeshare the host either way. Each child steers itself to a
+        forced-device-count CPU mesh before backend init and prints one
+        MULTICHIP schema artifact as its last JSON line."""
+        from determined_clone_tpu.telemetry.mesh import validate_multichip
+
+        deadline = time.monotonic() + max(60.0, min(remaining() - 15.0,
+                                                    300.0))
+        env = dict(os.environ)
+        # the child picks its own platform/device-count (host steering);
+        # scrub the parent's TPU knobs so a live tunnel can't leak in
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("XLA_FLAGS", None)
+        procs = {}
+        for n in device_counts:
+            procs[str(n)] = subprocess.Popen(
+                [sys.executable, "-m",
+                 "determined_clone_tpu.parallel.scaling_bench",
+                 "--devices", str(n), "--steps", "2", "--warmup", "1",
+                 "--json"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, cwd=REPO_ROOT, env=env)
+        runs = {}
+        for key, proc in procs.items():
+            try:
+                out, _ = proc.communicate(
+                    timeout=max(10.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.communicate()
+                runs[key] = {"error": "timeout"}
+                continue
+            artifact = None
+            for line in (out or "").splitlines():
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        artifact = json.loads(line)
+                    except ValueError:
+                        continue
+            if proc.returncode != 0 or not isinstance(artifact, dict):
+                runs[key] = {"error": f"rc={proc.returncode}, "
+                                      f"no artifact line"}
+                continue
+            problems = validate_multichip(artifact)
+            if problems:
+                artifact["schema_errors"] = problems[:5]
+            runs[key] = artifact
+        return {"runs": runs}
+
     def gpt_cfg(n_layers: int, d_model: int, n_heads: int, seq: int,
                 attention_impl: str, vocab: int = 50304,
                 remat: bool = True) -> gpt.GPTConfig:
@@ -938,6 +992,7 @@ def _run_child() -> None:
     goodput_section = None
     serving_section = None
     serving_fleet_section = None
+    multichip_section = None
     if not on_tpu:
         # cheap on CPU, and computing it before the ladder means the very
         # first banked result line already carries a non-null
@@ -1069,6 +1124,10 @@ def _run_child() -> None:
                     # 1/2/4 replicas under the same burst, plus a mid-burst
                     # blue-green rollout (zero failures, version parity)
                     "serving_fleet": serving_fleet_section,
+                    # measured multichip scaling (parallel/scaling_bench):
+                    # per-axis efficiency, measured-vs-analytic MFU, and
+                    # collective structure on 8/16-device simulated meshes
+                    "multichip": multichip_section,
                     "init_s": round(t_init, 1),
                 },
             }
@@ -1125,6 +1184,15 @@ def _run_child() -> None:
                 serving_fleet_section = time_serving_fleet()
             except Exception as exc:  # noqa: BLE001
                 serving_fleet_section = {"error": repr(exc)[:200]}
+        if multichip_section is None and remaining() > 100:
+            # post-bank on BOTH lanes: the two scaling-bench subprocesses
+            # run concurrently (~75 s on this box) and never delay the
+            # first banked rung line; absence under a squeezed budget is
+            # an OPTIONAL_SECTION note in the gate, not a failure
+            try:
+                multichip_section = time_multichip()
+            except Exception as exc:  # noqa: BLE001
+                multichip_section = {"error": repr(exc)[:200]}
 
         # Re-emit enriched with the extras; the parent keeps the last line.
         _emit(result_line())

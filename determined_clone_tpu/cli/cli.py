@@ -767,6 +767,106 @@ def cmd_goodput(args) -> int:
     return 0
 
 
+def cmd_mesh(args) -> int:
+    """Mesh observability readout (docs/parallelism.md): collective
+    op/byte counts per (kind, axis), straggler events, and the worst
+    comm-vs-compute fraction from the cluster metrics plane; or a
+    MULTICHIP scaling artifact rendered from a file (``--file``) or
+    measured fresh on a simulated mesh (``--run N``)."""
+    from determined_clone_tpu.telemetry.mesh import (
+        format_multichip,
+        validate_multichip,
+    )
+
+    if args.run is not None:
+        # device count is fixed at backend init — measure in a subprocess
+        # that steers itself to a forced-device-count CPU mesh
+        import subprocess
+        proc = subprocess.run(
+            [sys.executable, "-m",
+             "determined_clone_tpu.parallel.scaling_bench",
+             "--devices", str(args.run), "--json"],
+            capture_output=True, text=True, timeout=600)
+        artifact = None
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    artifact = json.loads(line)
+                except ValueError:
+                    continue
+        if proc.returncode != 0 or not isinstance(artifact, dict):
+            print(f"scaling bench failed (rc={proc.returncode}): "
+                  f"{proc.stderr.strip()[-400:]}", file=sys.stderr)
+            return 1
+    elif args.file:
+        with open(args.file) as f:
+            obj = json.load(f)
+        artifact = obj
+        if isinstance(obj, dict) and "tail" in obj and "meshes" not in obj:
+            # driver MULTICHIP_rN.json wrapper: the artifact is the last
+            # JSON line of the round's stdout tail
+            artifact = None
+            for line in str(obj["tail"]).splitlines():
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        artifact = json.loads(line)
+                    except ValueError:
+                        continue
+            if artifact is None:
+                print(f"{args.file}: no artifact line in wrapper tail",
+                      file=sys.stderr)
+                return 1
+    else:
+        # cluster plane: fold the master's /metrics exposition through the
+        # aggregator and print the mesh rollup
+        from determined_clone_tpu.telemetry.aggregate import (
+            ClusterMetricsAggregator,
+        )
+        import urllib.request
+
+        session = make_session(args)
+        url = f"http://{session.host}:{session.port}/metrics"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            text = resp.read().decode("utf-8")
+        agg = ClusterMetricsAggregator()
+        agg.ingest_prometheus_text("master", text)
+        roll = agg.mesh_rollup()
+        if roll is None:
+            print("no mesh metrics reported (no sharded program has "
+                  "exported collective accounting yet)", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(roll, indent=2, default=str))
+            return 0
+        for kind, axes in sorted((roll.get("collective_ops") or {}).items()):
+            for ax, cnt in sorted(axes.items()):
+                b = (roll.get("collective_bytes") or {}).get(
+                    kind, {}).get(ax)
+                b_s = f", {b:.0f} B/exec" if isinstance(b, (int, float)) \
+                    else ""
+                print(f"collective {kind}[{ax}]: {cnt:.0f} ops{b_s}")
+        for dev, cnt in sorted((roll.get("straggler_events") or {}).items()):
+            print(f"straggler events {dev}: {cnt:.0f}")
+        worst = roll.get("worst_comm_fraction")
+        if isinstance(worst, dict):
+            print(f"worst comm/compute fraction: "
+                  f"{worst.get('fraction'):.1%} ({worst.get('program')})")
+        return 0
+
+    problems = validate_multichip(artifact)
+    if args.json:
+        print(json.dumps(artifact, indent=2, default=str))
+    else:
+        print(format_multichip(artifact))
+    if problems:
+        print("schema problems: " + "; ".join(problems[:5]),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def cmd_serve(args) -> int:
     """Serve a GPT checkpoint over HTTP with continuous batching over a
     paged KV cache (docs/serving.md). `--selftest` binds an ephemeral
@@ -1696,6 +1796,23 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--json", action="store_true",
                    help="print the evaluation as JSON")
     c.set_defaults(func=cmd_slo)
+
+    # mesh (collective accounting + straggler + scaling readout —
+    # docs/parallelism.md)
+    c = sub.add_parser("mesh",
+                       help="mesh observability: collective op/byte "
+                            "counts, straggler events, multichip scaling "
+                            "artifacts")
+    c.add_argument("--file", default=None,
+                   help="render a MULTICHIP artifact (raw or driver "
+                        "MULTICHIP_rN.json wrapper) instead of asking "
+                        "the master")
+    c.add_argument("--run", type=int, default=None, metavar="N",
+                   help="measure fresh on an N-device simulated mesh "
+                        "(runs parallel/scaling_bench in a subprocess)")
+    c.add_argument("--json", action="store_true",
+                   help="print the artifact/rollup as JSON")
+    c.set_defaults(func=cmd_mesh)
 
     # serve (online inference: continuous batching + paged KV cache —
     # docs/serving.md)

@@ -32,6 +32,12 @@ from determined_clone_tpu.telemetry.chrome_trace import (
     validate_chrome_trace,
     write_chrome_trace,
 )
+from determined_clone_tpu.telemetry.collectives import (
+    CollectiveSummary,
+    comm_compute_fraction,
+    export_collectives,
+    parse_hlo_collectives,
+)
 from determined_clone_tpu.telemetry.flight import (
     FlightRecorder,
     RequestArchive,
@@ -52,6 +58,14 @@ from determined_clone_tpu.telemetry.goodput import (
     merge_goodput,
     read_goodput,
 )
+from determined_clone_tpu.telemetry.mesh import (
+    MULTICHIP_SCHEMA_VERSION,
+    MeshStragglerDetector,
+    device_lane_records,
+    format_multichip,
+    per_device_completion_seconds,
+    validate_multichip,
+)
 from determined_clone_tpu.telemetry.metrics import (
     Counter,
     Gauge,
@@ -71,17 +85,20 @@ from determined_clone_tpu.telemetry.spans import (
 )
 
 __all__ = [
-    "Counter", "FlightRecorder", "GOODPUT_CATEGORIES", "Gauge",
-    "GoodputJournal", "GoodputLedger", "Histogram", "MetricsRegistry",
-    "NULL_SPAN", "RequestArchive", "SLOEngine", "Span", "Telemetry",
-    "Tracer", "check_conservation", "chrome_trace_events",
+    "CollectiveSummary", "Counter", "FlightRecorder",
+    "GOODPUT_CATEGORIES", "Gauge", "GoodputJournal", "GoodputLedger",
+    "Histogram", "MULTICHIP_SCHEMA_VERSION", "MeshStragglerDetector",
+    "MetricsRegistry", "NULL_SPAN", "RequestArchive", "SLOEngine", "Span",
+    "Telemetry", "Tracer", "check_conservation", "chrome_trace_events",
+    "comm_compute_fraction", "device_lane_records", "export_collectives",
     "flight_summary", "flight_to_chrome_trace", "format_goodput",
-    "format_slo", "merge_goodput", "null_span", "parse_prometheus_text",
+    "format_multichip", "format_slo", "merge_goodput", "null_span", "parse_hlo_collectives",
+    "parse_prometheus_text", "per_device_completion_seconds",
     "read_flight", "read_goodput", "read_request_archive",
     "request_archive_summary", "request_chrome_trace", "request_records",
     "spans_from_profiler_samples", "stitch_chrome_trace",
     "telemetry_from_config", "to_chrome_trace", "validate_chrome_trace",
-    "write_chrome_trace",
+    "validate_multichip", "write_chrome_trace",
 ]
 
 

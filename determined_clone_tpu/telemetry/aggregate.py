@@ -366,6 +366,7 @@ class ClusterMetricsAggregator:
             lines.extend(self._rollup_lines(name, fam))
         lines.extend(self._goodput_lines(fams))
         lines.extend(self._serving_fleet_lines(fams))
+        lines.extend(self._mesh_lines(fams))
         text = "\n".join(ln for ln in lines if ln)
         return text + ("\n" if text else "")
 
@@ -481,6 +482,81 @@ class ClusterMetricsAggregator:
                                 if hits + misses else None),
             "slowest_request": slowest,
         }
+
+    def mesh_rollup(self, fams: Optional[Dict[str, Any]] = None
+                    ) -> Optional[Dict[str, Any]]:
+        """Mesh view over the collective-accounting and straggler families
+        (telemetry/collectives.py + telemetry/mesh.py): collective op/byte
+        totals by (kind, axis) summed across reporters — structure adds up
+        when several programs are captured — straggler events by device,
+        and the worst comm-vs-compute fraction across captured programs
+        (worst, not average: the program closest to communication-bound is
+        the one a topology change hurts first). None when nothing
+        mesh-related has reported (single-device runs)."""
+        fams = fams if fams is not None else self._families()
+        ops: Dict[str, Dict[str, float]] = {}
+        byts: Dict[str, Dict[str, float]] = {}
+        for fam_name, dest in (("xla_collective_ops_total", ops),
+                               ("xla_collective_bytes", byts)):
+            for labels, s in fams.get(fam_name, {}).get("children", []):
+                kind, axis = labels.get("kind"), labels.get("axis")
+                if kind and axis:
+                    by_axis = dest.setdefault(kind, {})
+                    by_axis[axis] = by_axis.get(axis, 0.0) + float(
+                        s.get("value", 0))
+        stragglers: Dict[str, float] = {}
+        for labels, s in fams.get("mesh_straggler_events_total",
+                                  {}).get("children", []):
+            dev = labels.get("device")
+            if dev:
+                stragglers[dev] = stragglers.get(dev, 0.0) + float(
+                    s.get("value", 0))
+        worst_frac: Optional[Tuple[str, float]] = None
+        for labels, s in fams.get("xla_comm_compute_fraction",
+                                  {}).get("children", []):
+            v = float(s.get("value", 0))
+            if worst_frac is None or v > worst_frac[1]:
+                worst_frac = (labels.get("program", "?"), v)
+        if not ops and not stragglers and worst_frac is None:
+            return None
+        return {
+            "collective_ops": {k: dict(sorted(v.items()))
+                               for k, v in sorted(ops.items())},
+            "collective_bytes": {k: dict(sorted(v.items()))
+                                 for k, v in sorted(byts.items())},
+            "straggler_events": dict(sorted(stragglers.items())),
+            "straggler_events_total": sum(stragglers.values()),
+            "worst_comm_fraction": (
+                {"program": worst_frac[0], "fraction": worst_frac[1]}
+                if worst_frac is not None else None),
+        }
+
+    def _mesh_lines(self, fams: Dict[str, Any]) -> List[str]:
+        """``dct_mesh_*`` rollup gauges for ``dump()`` — the scrapeable
+        shape of :meth:`mesh_rollup` (the per-reporter series already
+        export under their own names with trial/component labels)."""
+        roll = self.mesh_rollup(fams)
+        if roll is None:
+            return []
+        lines = []
+        total_ops = sum(sum(v.values())
+                        for v in roll["collective_ops"].values())
+        total_bytes = sum(sum(v.values())
+                          for v in roll["collective_bytes"].values())
+        for name, v in (("dct_mesh_collective_ops", total_ops),
+                        ("dct_mesh_collective_bytes", total_bytes),
+                        ("dct_mesh_straggler_events",
+                         roll["straggler_events_total"])):
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {_fmt(v)}")
+        worst = roll.get("worst_comm_fraction")
+        if worst is not None:
+            lines.append("# TYPE dct_mesh_worst_comm_fraction gauge")
+            lines.append(
+                "dct_mesh_worst_comm_fraction"
+                f"{_label_str({'program': worst['program']})} "
+                f"{_fmt(worst['fraction'])}")
+        return lines
 
     def _serving_fleet_lines(self, fams: Dict[str, Any]) -> List[str]:
         """``dct_fleet_*`` gauges for ``dump()`` — the scrapeable shape
@@ -624,7 +700,7 @@ class ClusterMetricsAggregator:
                            or "restart" in name or "fallback" in name
                            or "dropped" in name or "failures" in name
                            or "compiles" in name or "anomalies" in name
-                           or "divergence" in name)
+                           or "divergence" in name or "straggler" in name)
             if interesting:
                 counters[name] = sum(float(s.get("value", 0))
                                      for _, s in fam["children"])
@@ -670,6 +746,7 @@ class ClusterMetricsAggregator:
             "straggler": straggler,
             "goodput": self.goodput_rollup(fams),
             "serving_fleet": self.serving_fleet_rollup(fams),
+            "mesh": self.mesh_rollup(fams),
             "slo": self.slo_rollup(),
             "quantiles": quantiles,
             "counters": dict(sorted(counters.items())),
@@ -743,6 +820,24 @@ def format_summary(summary: Dict[str, Any]) -> str:
                 f"({slowest['latency_s']:.4f}s on {slowest['replica']})")
         if rates:
             out.append("  " + ", ".join(rates))
+    mesh = summary.get("mesh")
+    if mesh:
+        ops = mesh.get("collective_ops") or {}
+        op_parts = []
+        for kind in sorted(ops):
+            for axis, n in sorted(ops[kind].items()):
+                op_parts.append(f"{kind}[{axis}]={int(n)}")
+        if op_parts:
+            out.append("mesh collectives: " + ", ".join(op_parts))
+        ev = mesh.get("straggler_events") or {}
+        if ev:
+            out.append("mesh stragglers: " + ", ".join(
+                f"{dev}={int(n)}" for dev, n in sorted(ev.items())))
+        worst = mesh.get("worst_comm_fraction")
+        if worst is not None:
+            out.append(
+                f"mesh comm fraction (worst program): "
+                f"{worst['fraction']:.1%} ({worst['program']})")
     slo = summary.get("slo")
     if slo:
         parts = []

@@ -97,7 +97,9 @@ def stitch_chrome_trace(samples: Iterable[Dict[str, Any]], *,
     output, possibly from many trials plus the runner): each record may
     carry ``process`` (lane name), ``trace_id``, and ``wall_epoch``.
     Records group into one Chrome *process* per ``process`` label (falling
-    back to ``trial-{trial_id}``), each announced with a ``process_name``
+    back to ``device:{device}`` for per-device lane records from
+    telemetry/mesh.py — every simulated mesh device gets its own lane —
+    then ``trial-{trial_id}``), each announced with a ``process_name``
     metadata event; per-process thread lanes keep their names. Timestamps
     are re-based onto a shared axis using each tracer's ``wall_epoch``
     anchor (``ts_us`` alone is relative to a private perf_counter epoch),
@@ -108,6 +110,8 @@ def stitch_chrome_trace(samples: Iterable[Dict[str, Any]], *,
         if rec.get("group") not in (None, "span"):
             continue
         proc = rec.get("process")
+        if not proc and rec.get("device"):
+            proc = f"device:{rec['device']}"
         if not proc:
             tid = rec.get("trial_id")
             proc = f"trial-{tid}" if tid is not None else "unknown"

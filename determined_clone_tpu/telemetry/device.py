@@ -63,14 +63,49 @@ def host_rss_bytes() -> Optional[int]:
     return None
 
 
+def live_buffer_bytes_by_device() -> Dict[str, float]:
+    """Real per-device buffer residency from ``jax.live_arrays()``.
+
+    Walks every live array's addressable shards and sums
+    ``shard.data.nbytes`` per device — the one per-device signal a
+    backend without ``memory_stats()`` can still give honestly. On a
+    simulated ``--xla_force_host_platform_device_count`` mesh this is
+    exactly the sharded footprint: a dp=8-sharded batch shows 1/8 of its
+    bytes on each virtual device, an unbalanced sharding shows the skew.
+    Misses XLA temp buffers (only *live array* storage is visible), so it
+    is a residency floor, not a capacity gauge.
+    """
+    try:
+        import jax
+
+        arrays = jax.live_arrays()
+    except Exception:
+        return {}
+    out: Dict[str, float] = {}
+    for arr in arrays:
+        try:
+            for shard in arr.addressable_shards:
+                dev = shard.device
+                key = f"{dev.platform}:{dev.id}"
+                out[key] = out.get(key, 0.0) + float(shard.data.nbytes)
+        except Exception:
+            continue  # deleted/donated between enumeration and read
+    return out
+
+
 def device_memory_snapshot() -> List[Dict[str, Any]]:
-    """One record per local device.
+    """One record per local device (plus one host record on fallback).
 
     Each record: ``{"device": "cpu:0", "platform", "bytes_in_use",
     "bytes_limit", "peak_bytes_in_use", "source"}``. ``source`` is
-    ``"memory_stats"`` on backends that report real per-device stats and
-    ``"rss"`` for the CPU fallback (where the *process* RSS is attributed
-    to device 0 once, not multiplied across the virtual device count).
+    ``"memory_stats"`` on backends that report real per-device stats.
+    Devices without stats (CPU, including the simulated
+    ``--xla_force_host_platform_device_count`` mesh) each get a
+    ``"live_buffers"`` record with their real sharded-array residency —
+    previously all virtual devices collapsed into one RSS sum and
+    per-device skew was invisible — plus ONE ``"rss"`` record labeled
+    ``device="host"`` (the shared address space, attributed once) that
+    keeps the process-level magnitude in the sums and the watermark.
     """
     try:
         import jax
@@ -79,39 +114,53 @@ def device_memory_snapshot() -> List[Dict[str, Any]]:
     except Exception:
         return []
     records: List[Dict[str, Any]] = []
-    rss_attributed = False
+    no_stats: List[Any] = []
     for d in devices:
         try:
             stats = d.memory_stats()
         except Exception:
             stats = None
-        rec: Dict[str, Any] = {
-            "device": f"{d.platform}:{d.id}",
-            "platform": str(d.platform),
-        }
         if stats:
-            rec.update(
-                bytes_in_use=float(stats.get("bytes_in_use", 0)),
-                bytes_limit=float(stats.get("bytes_limit", 0)),
-                peak_bytes_in_use=float(
+            records.append({
+                "device": f"{d.platform}:{d.id}",
+                "platform": str(d.platform),
+                "bytes_in_use": float(stats.get("bytes_in_use", 0)),
+                "bytes_limit": float(stats.get("bytes_limit", 0)),
+                "peak_bytes_in_use": float(
                     stats.get("peak_bytes_in_use",
                               stats.get("bytes_in_use", 0))),
-                source="memory_stats",
-            )
-            records.append(rec)
-        elif not rss_attributed:
-            # CPU (or a backend without memory introspection): host RSS
-            # stands in, attributed once — the virtual 8-device CPU mesh
-            # shares one address space
-            rss = host_rss_bytes()
-            if rss is None:
-                continue
-            rss_attributed = True
-            rec.update(bytes_in_use=float(rss), bytes_limit=0.0,
-                       peak_bytes_in_use=float(rss), source="rss")
-            records.append(rec)
+                "source": "memory_stats",
+            })
+        else:
+            no_stats.append(d)
+    if no_stats:
+        live = live_buffer_bytes_by_device()
+        for d in no_stats:
+            key = f"{d.platform}:{d.id}"
+            in_use = float(live.get(key, 0.0))
+            records.append({
+                "device": key,
+                "platform": str(d.platform),
+                "bytes_in_use": in_use,
+                "bytes_limit": 0.0,
+                "peak_bytes_in_use": in_use,
+                "source": "live_buffers",
+            })
+        rss = host_rss_bytes()
+        if rss is not None:
+            records.append({
+                "device": "host",
+                "platform": str(no_stats[0].platform),
+                "bytes_in_use": float(rss),
+                "bytes_limit": 0.0,
+                "peak_bytes_in_use": float(rss),
+                "source": "rss",
+            })
     if records:
-        _raise_watermark(sum(r["bytes_in_use"] for r in records))
+        # the rss record already contains the live buffers (same address
+        # space), so the watermark counts real stats + rss only
+        _raise_watermark(sum(r["bytes_in_use"] for r in records
+                             if r["source"] != "live_buffers"))
     return records
 
 
@@ -126,12 +175,17 @@ def device_memory_stats() -> Dict[str, float]:
     records = device_memory_snapshot()
     if not records:
         return {}
+    # live_buffers bytes already live inside the host rss record (one
+    # address space) — summing both would double-count, so the flat sums
+    # keep their historical magnitude from real stats + rss only
+    summed = [r for r in records if r["source"] != "live_buffers"]
     out = {
-        "device_bytes_in_use": sum(r["bytes_in_use"] for r in records),
-        "device_bytes_limit": sum(r["bytes_limit"] for r in records),
-        "device_count": float(len(records)),
+        "device_bytes_in_use": sum(r["bytes_in_use"] for r in summed),
+        "device_bytes_limit": sum(r["bytes_limit"] for r in summed),
+        "device_count": float(
+            len([r for r in records if r["device"] != "host"])),
     }
-    peak = sum(r["peak_bytes_in_use"] for r in records)
+    peak = sum(r["peak_bytes_in_use"] for r in summed)
     if peak:
         out["device_peak_bytes_in_use"] = peak
     return out
@@ -155,7 +209,8 @@ class DeviceMemoryMonitor:
 
     def sample(self) -> Dict[str, float]:
         records = device_memory_snapshot()
-        total_in_use = sum(r["bytes_in_use"] for r in records)
+        total_in_use = sum(r["bytes_in_use"] for r in records
+                           if r["source"] != "live_buffers")
         with self._lock:
             self._peak = max(self._peak, total_in_use)
         reg = self._registry

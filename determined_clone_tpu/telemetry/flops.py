@@ -316,6 +316,49 @@ def peak_flops_estimate(platform: Optional[str] = None,
     return CPU_PEAK_EST_FLOPS, "cpu:est"
 
 
+# Per-device interconnect bandwidth, bytes/s. TPU ICI numbers are
+# published per-link aggregates; the CPU number stands in for "shared
+# memory on one host" (a simulated --xla_force_host_platform_device_count
+# mesh moves shards through RAM) — like CPU_PEAK_EST_FLOPS it exists to
+# make the comm-vs-compute fraction non-null and comparable across rounds,
+# not to be absolutely accurate, and it carries a provenance label.
+TPU_ICI_BYTES_PER_S: Dict[str, float] = {
+    "v4": 300e9,
+    "v5e": 200e9,
+    "v5p": 600e9,
+    "v6e": 450e9,
+}
+CPU_INTERCONNECT_EST_BYTES_PER_S = 10e9
+
+
+def interconnect_bandwidth_estimate(platform: Optional[str] = None,
+                                    tpu_generation: Optional[str] = None,
+                                    ) -> Tuple[float, str]:
+    """Best-available per-device interconnect bandwidth (bytes/s).
+
+    Returns ``(bytes_per_s, provenance)`` with the same provenance-label
+    contract as :func:`peak_flops_estimate`; the analytic comm-vs-compute
+    fraction (telemetry/collectives.py) divides collective payload bytes
+    by this to turn the compiled program's structure into seconds.
+    """
+    plat = (platform or "").lower()
+    if not plat:
+        try:
+            import jax
+            plat = jax.default_backend()
+        except Exception:
+            plat = "cpu"
+    if plat == "tpu":
+        gen = (tpu_generation or os.environ.get("DCT_TPU_GENERATION")
+               or "").lower().lstrip("tpu").strip("-_ ")
+        if gen in TPU_ICI_BYTES_PER_S:
+            return TPU_ICI_BYTES_PER_S[gen], f"tpu:{gen}"
+        return TPU_ICI_BYTES_PER_S["v5e"], "tpu:v5e:assumed"
+    if plat == "gpu":
+        return 600e9, "gpu:nvlink:assumed"
+    return CPU_INTERCONNECT_EST_BYTES_PER_S, "cpu:est"
+
+
 def mfu(flops_per_sec: float, peak_flops: float,
         n_devices: int = 1) -> float:
     """Model FLOPs utilization against ``n_devices`` chips of peak."""

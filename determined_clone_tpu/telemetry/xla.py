@@ -66,10 +66,17 @@ class CompileRecord:
     argument_bytes: Optional[float] = None
     output_bytes: Optional[float] = None
     temp_bytes: Optional[float] = None
+    # post-SPMD collective accounting (telemetry/collectives.py); None
+    # when the compiled HLO text was unavailable or mesh-less
+    collectives: Optional[Any] = None
+    comm_fraction: Optional[float] = None
 
     def as_dict(self) -> Dict[str, Any]:
-        return {k: v for k, v in dataclasses.asdict(self).items()
-                if v is not None}
+        out = {k: v for k, v in dataclasses.asdict(self).items()
+               if v is not None and k != "collectives"}
+        if self.collectives is not None:
+            out["collectives"] = self.collectives.as_dict()
+        return out
 
 
 def _cost_analysis(compiled: Any) -> Tuple[Optional[float], Optional[float]]:
@@ -122,6 +129,7 @@ def aot_compile(
     program: str = "train_step",
     registry: Optional[Any] = None,
     tracer: Optional[Any] = None,
+    mesh: Optional[Any] = None,
 ) -> Tuple[Callable[..., Any], Optional[CompileRecord]]:
     """Explicitly lower + compile a jitted callable, capturing telemetry.
 
@@ -137,6 +145,14 @@ def aot_compile(
 
     ``example_args`` only contribute shapes/dtypes/shardings; nothing
     executes during lowering.
+
+    With ``mesh`` (a ``jax.sharding.Mesh`` or an ``{axis: size}`` mapping)
+    the *compiled* — post-SPMD-partitioner — HLO text is additionally
+    parsed for collectives (telemetry/collectives.py): op counts and byte
+    volumes per mesh axis land on the record and, with a registry, as
+    ``xla_collective_*`` gauges plus an analytic comm-vs-compute fraction.
+    The lowered StableHLO has none of this (collectives are *inserted* by
+    partitioning), which is why the capture reads ``compiled.as_text()``.
     """
     try:
         t0 = time.perf_counter()
@@ -159,6 +175,42 @@ def aot_compile(
         logger.debug("aot compile capture unavailable for %s: %r",
                      program, exc)
         return fn, None
+
+    if mesh is not None:
+        try:
+            from determined_clone_tpu.telemetry import (
+                collectives as coll_mod,
+            )
+            from determined_clone_tpu.telemetry import flops as flops_mod
+
+            summary = coll_mod.parse_hlo_collectives(
+                compiled.as_text(), mesh=mesh)
+            record.collectives = summary
+            platform = None
+            try:
+                import jax
+
+                platform = jax.devices()[0].platform
+            except Exception:
+                platform = "cpu"
+            bw, _bw_label = flops_mod.interconnect_bandwidth_estimate(
+                platform)
+            peak, _peak_label = flops_mod.peak_flops_estimate(platform)
+            # cost_analysis() describes the per-device partitioned module
+            # and the parser's byte volumes are per-shard payloads, so
+            # both sides of the fraction are per-device quantities
+            record.comm_fraction = coll_mod.comm_compute_fraction(
+                summary, record.flops,
+                interconnect_bytes_per_s=bw,
+                peak_flops_per_s=peak)
+            if registry is not None:
+                coll_mod.export_collectives(
+                    summary, registry, program=program,
+                    fingerprint=record.fingerprint[:16],
+                    comm_fraction=record.comm_fraction)
+        except Exception as exc:  # noqa: BLE001 - observer, never a dependency
+            logger.debug("collective accounting unavailable for %s: %r",
+                         program, exc)
 
     export_compile_record(record, registry=registry, tracer=tracer,
                           start=t0)

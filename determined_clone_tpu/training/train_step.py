@@ -176,24 +176,27 @@ def capture_compile(
     program: str = "train_step",
     registry: Optional[Any] = None,
     tracer: Optional[Any] = None,
+    mesh: Optional[Mesh] = None,
 ) -> Tuple[Callable[..., Any], Optional[Any]]:
     """Explicit ``lower()``/``compile()`` capture for a built step.
 
     Replaces the implicit first-call compile with a measured one: compile
     wall time, a sha256 fingerprint of the lowered StableHLO, and the
     compiled program's cost/memory analysis land in the registry/tracer
-    (telemetry/xla.py has the mechanics). The returned callable runs the
-    AOT executable — the program that was measured is the program that
-    executes — and falls back to ``step``'s jit cache on a shape mismatch
-    (remainder batches). ``example_args`` contribute shapes only; nothing
-    runs during lowering. On any failure the original ``step`` comes back
-    with a ``None`` record.
+    (telemetry/xla.py has the mechanics). With ``mesh``, the compiled
+    (post-SPMD) HLO is additionally parsed for collectives — op counts
+    and byte volumes per mesh axis (telemetry/collectives.py). The
+    returned callable runs the AOT executable — the program that was
+    measured is the program that executes — and falls back to ``step``'s
+    jit cache on a shape mismatch (remainder batches). ``example_args``
+    contribute shapes only; nothing runs during lowering. On any failure
+    the original ``step`` comes back with a ``None`` record.
     """
     from determined_clone_tpu.telemetry import xla as xla_telemetry
 
     return xla_telemetry.aot_compile(
         step, example_args, program=program,
-        registry=registry, tracer=tracer)
+        registry=registry, tracer=tracer, mesh=mesh)
 
 
 def param_count(tree: Any) -> int:

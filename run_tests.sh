@@ -49,6 +49,13 @@
 # rollup — plus the single-engine suite the fleet builds on. The master
 # integration tests skip cleanly when the C++ build is unavailable.
 #
+# `./run_tests.sh --multichip` runs the mesh-observability surface
+# (docs/parallelism.md) on the simulated 8-device mesh: collective
+# accounting, straggler detection, per-device lanes, the MULTICHIP
+# artifact schema, plus the sharding/mesh suites the lane builds on.
+# The live-mesh tests skip cleanly when device forcing is unavailable
+# (they check len(jax.devices()) themselves).
+#
 # `./run_tests.sh --bench-gate` compares the two newest BENCH_r*.json
 # rounds via tools/bench_gate.py (default -5% samples/sec tolerance; the
 # new round must carry a non-null mfu — docs/observability.md).
@@ -84,6 +91,11 @@ elif [ "$1" = "--serving" ]; then
 elif [ "$1" = "--fleet" ]; then
     shift
     set -- tests/test_serving_fleet.py tests/test_serving.py \
+        -m "not slow" "$@"
+elif [ "$1" = "--multichip" ]; then
+    shift
+    set -- tests/test_mesh_observability.py tests/test_mesh_sharding.py \
+        tests/test_xla_telemetry.py tests/test_device_telemetry.py \
         -m "not slow" "$@"
 elif [ "$1" = "--observability" ]; then
     shift

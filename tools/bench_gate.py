@@ -81,7 +81,7 @@ def newest_rounds(directory: str = ".") -> Tuple[str, str]:
 # headline throughput/mfu checks below are the contract.
 OPTIONAL_SECTIONS = ("control_plane", "checkpoint_io", "pipeline",
                      "mnist_cnn", "tpu_probe_telemetry", "xla", "goodput",
-                     "serving", "serving_fleet")
+                     "serving", "serving_fleet", "multichip")
 
 
 def _section_notes(old_detail: Dict[str, Any], new_detail: Dict[str, Any],
@@ -421,6 +421,81 @@ def _serving_fleet_lines(old_detail: Dict[str, Any],
                 f"{ro.get('rollout_duration_s')}s")
 
 
+def _multichip_lines(old_detail: Dict[str, Any],
+                     new_detail: Dict[str, Any], report: list) -> bool:
+    """Multichip scaling-lane gate (parallel/scaling_bench.py via bench's
+    ``multichip`` section, one artifact per simulated mesh size). Unlike
+    the advisory sections this one ENFORCES: per-axis scaling efficiency
+    dropping more than 5 points against the previous round on the same
+    mesh size fails the gate — the simulated mesh timeshares one host, so
+    the absolute numbers are pessimistic but *stable*, and a 5-point drop
+    means the sharded program itself got worse (more collective volume,
+    lost overlap), which real ICI will amplify. Collective-structure
+    drift on an unchanged program fingerprint stays an advisory WARN: new
+    collectives can be a legitimate partitioner change, but it is exactly
+    what to look at first when the efficiency line fails.
+
+    Returns False when the gate should fail, True otherwise."""
+    mc_new = new_detail.get("multichip")
+    if not isinstance(mc_new, dict):
+        return True
+    if mc_new.get("error"):
+        report.append(f"WARN: multichip errored: {mc_new['error']}")
+        return True
+    ok = True
+    mc_old = old_detail.get("multichip")
+    old_runs = (mc_old.get("runs") or {}) if isinstance(mc_old, dict) else {}
+    for size, run in sorted((mc_new.get("runs") or {}).items(),
+                            key=lambda kv: int(kv[0])
+                            if str(kv[0]).isdigit() else 0):
+        if not isinstance(run, dict):
+            continue
+        if run.get("error"):
+            report.append(f"WARN: multichip[{size}] errored: {run['error']}")
+            continue
+        if run.get("schema_errors"):
+            report.append(f"WARN: multichip[{size}] artifact failed schema "
+                          f"validation: {run['schema_errors']}")
+        meshes = run.get("meshes") or {}
+        effs = " ".join(
+            f"{ax}={m.get('scaling_efficiency'):.3f}"
+            if isinstance(m.get("scaling_efficiency"), (int, float))
+            else f"{ax}=null"
+            for ax, m in sorted(meshes.items()) if isinstance(m, dict))
+        report.append(f"ok: multichip {size} devices: {effs}")
+        old_run = old_runs.get(size)
+        old_meshes = (old_run.get("meshes") or {}) \
+            if isinstance(old_run, dict) else {}
+        for axis, m in sorted(meshes.items()):
+            if not isinstance(m, dict):
+                continue
+            eff = m.get("scaling_efficiency")
+            old_m = old_meshes.get(axis)
+            if not isinstance(old_m, dict):
+                continue
+            old_eff = old_m.get("scaling_efficiency")
+            if (isinstance(old_eff, (int, float))
+                    and isinstance(eff, (int, float))
+                    and eff < old_eff - 0.05):
+                ok = False
+                report.append(
+                    f"FAIL: multichip {size}-device {axis} scaling "
+                    f"efficiency {old_eff:.3f} → {eff:.3f} (dropped more "
+                    f"than 5 points — the sharded program regressed)")
+            fp_new, fp_old = m.get("program_fingerprint"), \
+                old_m.get("program_fingerprint")
+            coll_new = (m.get("collectives") or {}).get("fingerprint")
+            coll_old = (old_m.get("collectives") or {}).get("fingerprint")
+            if (fp_new and fp_new == fp_old
+                    and coll_new and coll_old and coll_new != coll_old):
+                report.append(
+                    f"WARN: multichip {size}-device {axis} collective "
+                    f"structure drifted on an unchanged program "
+                    f"({coll_old} → {coll_new}) — the partitioner is "
+                    f"emitting different collectives for the same trace")
+    return ok
+
+
 def gate(old: Dict[str, Any], new: Dict[str, Any], *,
          tolerance: float = DEFAULT_TOLERANCE,
          allow_null_mfu: bool = False) -> Tuple[bool, list]:
@@ -473,6 +548,7 @@ def gate(old: Dict[str, Any], new: Dict[str, Any], *,
     _goodput_lines(old_detail, new_detail, report)
     _serving_lines(old_detail, new_detail, report)
     _serving_fleet_lines(old_detail, new_detail, report)
+    ok = _multichip_lines(old_detail, new_detail, report) and ok
     return ok, report
 
 
