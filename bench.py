@@ -887,6 +887,79 @@ def _run_child() -> None:
         finally:
             fleet.close()
 
+    def time_exec_cache() -> dict:
+        """Persistent executable cache A/B (storage/exec_cache.py,
+        docs/checkpoint_storage.md): bring up a one-replica fleet twice
+        against the SAME on-disk cache. Leg A (cold) compiles the full
+        warmup ladder and publishes each executable to ``cas/exec/``;
+        ``jax.clear_caches()`` then empties the in-memory jit cache so
+        leg B (warm) can only be fast by deserializing from the store.
+        The bar the gate reads: every warm program is a cache hit with
+        zero fallback compiles, ``compile_time_saved_s`` is non-null,
+        and the warm replica start beats the cold one."""
+        import shutil
+        import tempfile
+
+        from determined_clone_tpu.serving import (
+            BucketSpec,
+            KVCacheConfig,
+            ServingFleet,
+        )
+        from determined_clone_tpu.storage import exec_cache as exec_mod
+        from determined_clone_tpu.storage.base import SharedFSStorageManager
+
+        cfg = gpt_cfg(2, 32, 4, 48, "mha", vocab=97, remat=False)
+        params = gpt.init(jax.random.PRNGKey(0), cfg)
+        cache_dir = tempfile.mkdtemp(prefix="bench-exec-cache-")
+
+        def leg(tokens_ref: list) -> tuple:
+            cache = exec_mod.ExecutableCache(
+                SharedFSStorageManager(cache_dir))
+            fleet = ServingFleet(
+                params, cfg, name="exec-ab",
+                buckets=BucketSpec.build(4, 16),
+                cache=KVCacheConfig(num_blocks=24, block_size=8),
+                exec_cache=cache)
+            try:
+                t0 = time.monotonic()
+                fleet.scale_up(1)
+                start_s = (fleet.scale_up_latencies_s or
+                           [time.monotonic() - t0])[0]
+                tokens = fleet.submit([1, 2, 3], 8,
+                                      timeout=120.0).result(120.0).tokens
+                tokens_ref.append(list(tokens))
+                return start_s, fleet.exec_cache_summary() or {}
+            finally:
+                fleet.close()
+
+        try:
+            tokens_ab: list = []
+            cold_s, cold = leg(tokens_ab)
+            # drop the in-memory jit cache: leg B must go through the
+            # persistent store or pay the compile again
+            jax.clear_caches()
+            warm_s, warm = leg(tokens_ab)
+            warm_hits = warm.get("exec_cache_hits", 0)
+            warm_misses = warm.get("exec_cache_misses", 0)
+            return {
+                "programs": warm.get("programs"),
+                "cold_replica_start_s": round(cold_s, 3),
+                "warm_replica_start_s": round(warm_s, 3),
+                "speedup": round(cold_s / max(warm_s, 1e-9), 2),
+                "cold_hits": cold.get("exec_cache_hits", 0),
+                "cold_misses": cold.get("exec_cache_misses", 0),
+                "exec_cache_hits": warm_hits,
+                "exec_cache_misses": warm_misses,
+                "warm_hit_rate": round(
+                    warm_hits / max(warm_hits + warm_misses, 1), 3),
+                "fallback_compiles": warm.get("fallback_compiles", 0),
+                "compile_time_saved_s": warm.get("compile_time_saved_s"),
+                "warm_compile_seconds": warm.get("compile_seconds"),
+                "tokens_match": tokens_ab[0] == tokens_ab[1],
+            }
+        finally:
+            shutil.rmtree(cache_dir, ignore_errors=True)
+
     def time_multichip(device_counts=(8, 16)) -> dict:
         """Measured multichip scaling lane (docs/parallelism.md): one
         ``parallel/scaling_bench.py`` subprocess per simulated mesh size —
@@ -992,6 +1065,7 @@ def _run_child() -> None:
     goodput_section = None
     serving_section = None
     serving_fleet_section = None
+    exec_cache_section = None
     multichip_section = None
     if not on_tpu:
         # cheap on CPU, and computing it before the ladder means the very
@@ -1016,6 +1090,13 @@ def _run_child() -> None:
             serving_fleet_section = time_serving_fleet()
         except Exception as exc:  # noqa: BLE001
             serving_fleet_section = {"error": repr(exc)[:200]}
+        # cold/warm replica-start A/B through the persistent executable
+        # cache — pre-ladder so the first banked line already answers
+        # "did the restart leg's compile cost collapse" (ROADMAP item 4)
+        try:
+            exec_cache_section = time_exec_cache()
+        except Exception as exc:  # noqa: BLE001
+            exec_cache_section = {"error": repr(exc)[:200]}
     for i, rung in enumerate(ladder):
         if remaining() < rung["min_s"]:
             _emit({"skipped_rung": rung["name"],
@@ -1124,6 +1205,10 @@ def _run_child() -> None:
                     # 1/2/4 replicas under the same burst, plus a mid-burst
                     # blue-green rollout (zero failures, version parity)
                     "serving_fleet": serving_fleet_section,
+                    # persistent executable cache: cold vs warm replica
+                    # start on the same on-disk cas/exec/ store —
+                    # compile_time_saved_s is the tentpole's receipt
+                    "exec_cache": exec_cache_section,
                     # measured multichip scaling (parallel/scaling_bench):
                     # per-axis efficiency, measured-vs-analytic MFU, and
                     # collective structure on 8/16-device simulated meshes
@@ -1184,6 +1269,13 @@ def _run_child() -> None:
                 serving_fleet_section = time_serving_fleet()
             except Exception as exc:  # noqa: BLE001
                 serving_fleet_section = {"error": repr(exc)[:200]}
+        if exec_cache_section is None and remaining() > 45:
+            # TPU lane: the cold leg pays the ladder compile once; the
+            # warm leg is mostly deserialize, so the pair fits the slot
+            try:
+                exec_cache_section = time_exec_cache()
+            except Exception as exc:  # noqa: BLE001
+                exec_cache_section = {"error": repr(exc)[:200]}
         if multichip_section is None and remaining() > 100:
             # post-bank on BOTH lanes: the two scaling-bench subprocesses
             # run concurrently (~75 s on this box) and never delay the

@@ -297,7 +297,55 @@ def cmd_checkpoint_stats(args) -> int:
         print(f"checkpoint_storage type {raw.get('type')!r} is not "
               "content-addressed; stats need `type: cas`", file=sys.stderr)
         return 2
+    # storage_stats() includes the per-namespace split (checkpoint
+    # chunks vs cached executables) under "namespaces"
     print_json(manager.storage_stats())
+    return 0
+
+
+def cmd_exec_cache_stats(args) -> int:
+    """Persistent executable cache readout: entries, bytes, per-program
+    breakdown, session hit rate (docs/checkpoint_storage.md, "Executable
+    cache").
+
+    Accepts the same storage addressing as `checkpoint stats` (--config /
+    --host-path with a cas block) or --dir, a bare shared_fs root — the
+    DCT_EXEC_CACHE_DIR convention the serving warm-start harness uses.
+    """
+    from determined_clone_tpu.config.experiment import (
+        CheckpointStorageConfig,
+    )
+    from determined_clone_tpu.storage import (
+        CASStorageManager,
+        ExecutableCache,
+        SharedFSStorageManager,
+        build,
+    )
+
+    if args.dir:
+        cache = ExecutableCache(SharedFSStorageManager(args.dir))
+    else:
+        if args.config:
+            import yaml
+
+            with open(args.config) as f:
+                doc = yaml.safe_load(f) or {}
+            raw = doc.get("checkpoint_storage") or doc
+        elif args.host_path:
+            raw = {"type": "cas", "inner": {
+                "type": "shared_fs", "host_path": args.host_path}}
+        else:
+            print("exec-cache stats needs --config, --host-path or --dir",
+                  file=sys.stderr)
+            return 2
+        manager = build(CheckpointStorageConfig.from_dict(raw))
+        if not isinstance(manager, CASStorageManager):
+            print(f"checkpoint_storage type {raw.get('type')!r} is not "
+                  "content-addressed; the executable cache lives on "
+                  "`type: cas`", file=sys.stderr)
+            return 2
+        cache = manager.exec_cache()
+    print_json(cache.stats())
     return 0
 
 
@@ -1520,6 +1568,24 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--cache-path", default=None,
                    help="local chunk-cache dir (with --host-path)")
     c.set_defaults(func=cmd_checkpoint_stats)
+
+    # exec-cache (persistent compiled-executable cache on the CAS store)
+    p_exec = sub.add_parser(
+        "exec-cache",
+        help="persistent AOT executable cache on the CAS blob store")
+    se = p_exec.add_subparsers(dest="subcommand", required=True)
+    c = se.add_parser("stats",
+                      help="entries, bytes, per-program breakdown, "
+                           "session hit rate")
+    c.add_argument("--config", default=None,
+                   help="experiment config yaml with a checkpoint_storage "
+                        "cas block")
+    c.add_argument("--host-path", default=None,
+                   help="shared_fs storage root (shortcut for a config)")
+    c.add_argument("--dir", default=None,
+                   help="bare exec-cache root (the DCT_EXEC_CACHE_DIR "
+                        "convention)")
+    c.set_defaults(func=cmd_exec_cache_stats)
 
     # task (generic) + NTSC types
     p_task = sub.add_parser("task", help="NTSC tasks")

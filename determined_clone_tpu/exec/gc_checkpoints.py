@@ -32,10 +32,13 @@ def sweep_uncommitted(manager) -> int:
     swept = failed = 0
     for sid in storage_ids:
         if sid == "cas":
-            # the content-addressed chunk namespace (storage/cas.py) is not
-            # a checkpoint and never has a COMMIT marker; a CAS manager
-            # already hides it, but guard here too for legacy GC configs
-            # pointing directly at the inner store
+            # the content-addressed namespace (storage/cas.py) is not a
+            # checkpoint and never has a COMMIT marker: it holds the chunk
+            # store AND the persistent executable cache (cas/exec/ blobs +
+            # index, storage/exec_cache.py), neither of which may ever be
+            # swept as "uncommitted". A CAS manager already hides it, but
+            # guard here too for legacy GC configs pointing directly at
+            # the inner store
             continue
         try:
             if manager.is_committed(sid):
@@ -64,7 +67,9 @@ def main() -> int:
         return 0
     # when DCT_GC_STORAGE is a `type: cas` block, delete() below also runs
     # the ref-counted chunk GC: chunks still referenced by any surviving
-    # checkpoint are kept (storage/cas.py, docs/checkpoint_storage.md)
+    # checkpoint are kept, and the exec/ executable-cache namespace is
+    # outside the chunk walk entirely — cached executables are never
+    # reclaimed here (storage/cas.py, docs/checkpoint_storage.md)
     manager = build(CheckpointStorageConfig.from_dict(json.loads(storage_raw)))
     uuids = [u for u in uuids_raw.split(",") if u]
     failed = 0

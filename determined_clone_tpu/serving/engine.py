@@ -84,22 +84,80 @@ ADMISSION_RETRY = RetryPolicy(
     multiplier=2.0, max_delay_s=2.0, retryable=(ServerOverloaded,))
 
 
-def make_paged_forward() -> Any:
+def _ambient_exec_cache() -> Any:
+    """The process-default persistent executable cache (storage/
+    exec_cache.py), or None. Resolution must never fail engine
+    construction."""
+    try:
+        from determined_clone_tpu.storage import exec_cache as exec_mod
+
+        return exec_mod.default_cache()
+    except Exception:  # pragma: no cover - defensive
+        return None
+
+
+def _maybe_dispatch(fn: Any, exec_cache: Any, program: str) -> Any:
+    """Wrap a jitted entry point in an AotDispatcher when a persistent
+    executable cache is in play (explicit ``exec_cache``, or the ambient
+    default). ``exec_cache=False`` forces the plain jit wrapper; with no
+    cache anywhere the jit wrapper comes back unchanged — the seed
+    behavior, byte-for-byte."""
+    if exec_cache is False:
+        return fn
+    cache = exec_cache if exec_cache is not None else _ambient_exec_cache()
+    if cache is None:
+        return fn
+    from determined_clone_tpu.telemetry.xla import AotDispatcher
+
+    return AotDispatcher(fn, program=program, exec_cache=cache)
+
+
+def _sum_cache_summaries(dispatchers: Sequence[Any]) -> Optional[
+        Dict[str, Any]]:
+    """Merge ``AotDispatcher.cache_summary()`` dicts (None with no
+    dispatchers — plain jit everywhere, nothing to report).
+    ``compile_time_saved_s`` stays None until at least one hit so "no
+    cache traffic" and "cache saved 0s" read differently downstream."""
+    totals: Optional[Dict[str, Any]] = None
+    for d in dispatchers:
+        s = d.cache_summary()
+        if totals is None:
+            totals = dict(s)
+            continue
+        for k, v in s.items():
+            if v is None:
+                continue
+            totals[k] = (totals.get(k) or 0) + v
+    if totals is not None and not totals.get("exec_cache_hits"):
+        totals["compile_time_saved_s"] = None
+    return totals
+
+
+def make_paged_forward(exec_cache: Any = None) -> Any:
     """The jitted paged forward an engine runs everything through.
     Replica fleets pass ONE of these to every engine (``fwd=``) so the
     whole fleet shares a single XLA program cache: replica N>1 warms up
     for free, and scale-up never pays a compile (all replicas serve the
-    same model config and bucket ladder, so the shapes are identical)."""
-    return jax.jit(gpt.forward_paged, static_argnums=(1,),
-                   donate_argnums=(6, 7))
+    same model config and bucket ladder, so the shapes are identical).
+
+    With a persistent executable cache (``exec_cache=``, or the ambient
+    default from storage/exec_cache.py) the wrapper is an
+    :class:`~determined_clone_tpu.telemetry.xla.AotDispatcher`: warmup
+    loads previously-compiled programs from the CAS ``cas/exec/``
+    namespace instead of compiling, so even the FIRST process of a
+    restart leg starts warm. ``exec_cache=False`` opts out."""
+    fwd = jax.jit(gpt.forward_paged, static_argnums=(1,),
+                  donate_argnums=(6, 7))
+    return _maybe_dispatch(fwd, exec_cache, "serving_forward_paged")
 
 
-def make_paged_verify() -> Any:
+def make_paged_verify(exec_cache: Any = None) -> Any:
     """The jitted multi-logit forward the speculative verify step runs
     through: one [B, k+1] call scores the last committed token plus all
     k drafts; compiles one program per batch bucket."""
-    return jax.jit(gpt.forward_paged_logits, static_argnums=(1,),
-                   donate_argnums=(5, 6))
+    fwd = jax.jit(gpt.forward_paged_logits, static_argnums=(1,),
+                  donate_argnums=(5, 6))
+    return _maybe_dispatch(fwd, exec_cache, "serving_verify")
 
 
 def _block_copy(k_pool: jax.Array, v_pool: jax.Array,
@@ -109,10 +167,11 @@ def _block_copy(k_pool: jax.Array, v_pool: jax.Array,
             v_pool.at[:, dst].set(v_pool[:, src]))
 
 
-def make_block_copy() -> Any:
+def make_block_copy(exec_cache: Any = None) -> Any:
     """Jitted :func:`_block_copy` — src/dst are dynamic scalars, so the
     whole COW protocol costs exactly one XLA program per pool pair."""
-    return jax.jit(_block_copy, donate_argnums=(0, 1))
+    fwd = jax.jit(_block_copy, donate_argnums=(0, 1))
+    return _maybe_dispatch(fwd, exec_cache, "serving_block_copy")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -302,7 +361,12 @@ class InferenceEngine:
             # payload shape differs — so prefix sharing and COW cover
             # the draft KV with zero extra bookkeeping
             self._dk_pool, self._dv_pool = init_kv_pools(draft_cfg, cache)
-            self._draft_fwd = make_paged_forward()
+            # an AotDispatcher keys on (cfg, shapes), so target and draft
+            # lanes SHARE one dispatcher — their executables land in one
+            # table and programs_compiled() counts them once, exactly as
+            # the shared jit cache always did
+            self._draft_fwd = (self._fwd if hasattr(self._fwd, "warm")
+                               else make_paged_forward(exec_cache=False))
             self._verify_fwd = make_paged_verify()
         else:
             self._draft_params = None
@@ -334,6 +398,15 @@ class InferenceEngine:
         # nothing per request
         self._tracer = (tracer if tracer is not None
                         and getattr(tracer, "enabled", False) else None)
+        # exec-cache-backed dispatchers export their compile records
+        # (xla_compile spans, xla_exec_cache_* counters) through this
+        # replica's registry/tracer; a fleet-shared dispatcher rebinds to
+        # whichever replica is currently warming
+        for entry in (self._fwd, self._draft_fwd, self._verify_fwd,
+                      self._copy):
+            bind = getattr(entry, "bind_telemetry", None)
+            if callable(bind):
+                bind(self.registry, self._tracer)
         m = self.registry
         self._h_queue_wait = m.histogram(
             "serving_queue_wait_seconds", "submit → admitted into the batch")
@@ -600,6 +673,14 @@ class InferenceEngine:
             self._await_idle_locked("warmup")
             self._warming = True
         t0 = time.monotonic()
+
+        def call(f: Any, *args: Any) -> Any:
+            # exec-cache-backed dispatchers take the cache-first AOT path
+            # (load the serialized executable, compile only on a miss);
+            # plain jit wrappers compile implicitly as they always did
+            warm = getattr(f, "warm", None)
+            return warm(*args) if callable(warm) else f(*args)
+
         try:
             with self._span("serving_warmup"):
                 lanes = [(self._fwd, self._params, self.model_cfg)]
@@ -610,8 +691,8 @@ class InferenceEngine:
                     tables = jnp.zeros((b, self._table_width), jnp.int32)
                     for fwd, params, cfg in lanes:
                         for t in (*self.buckets.prefill_len_buckets, 1):
-                            logits, kp, vp = fwd(
-                                params, cfg,
+                            logits, kp, vp = call(
+                                fwd, params, cfg,
                                 jnp.zeros((b, t), jnp.int32),
                                 jnp.zeros((b, t), jnp.int32),
                                 jnp.zeros((b, t), bool),
@@ -624,20 +705,20 @@ class InferenceEngine:
                             jnp.argmax(logits, axis=-1).block_until_ready()
                     if self._spec_k:
                         t = self._spec_k + 1
-                        logits, self._k_pool, self._v_pool = \
-                            self._verify_fwd(
-                                self._params, self.model_cfg,
-                                jnp.zeros((b, t), jnp.int32),
-                                jnp.zeros((b, t), jnp.int32),
-                                jnp.zeros((b, t), bool),
-                                self._k_pool, self._v_pool, tables)
+                        logits, self._k_pool, self._v_pool = call(
+                            self._verify_fwd,
+                            self._params, self.model_cfg,
+                            jnp.zeros((b, t), jnp.int32),
+                            jnp.zeros((b, t), jnp.int32),
+                            jnp.zeros((b, t), bool),
+                            self._k_pool, self._v_pool, tables)
                         logits.block_until_ready()
                 if self._copy is not None:
-                    self._k_pool, self._v_pool = self._copy(
-                        self._k_pool, self._v_pool, 0, 0)
+                    self._k_pool, self._v_pool = call(
+                        self._copy, self._k_pool, self._v_pool, 0, 0)
                     if self._spec_k:
-                        self._dk_pool, self._dv_pool = self._copy(
-                            self._dk_pool, self._dv_pool, 0, 0)
+                        self._dk_pool, self._dv_pool = call(
+                            self._copy, self._dk_pool, self._dv_pool, 0, 0)
                     jax.block_until_ready(self._k_pool)
         finally:
             with self._cond:
@@ -731,6 +812,26 @@ class InferenceEngine:
         return self.buckets.extended_budget(
             speculative=self._spec_k > 0,
             prefix_cache=self._prefix is not None)
+
+    def exec_dispatchers(self) -> List[Any]:
+        """The engine's distinct AOT dispatchers (empty when the engine
+        runs plain jit — the persistent executable cache is not in play).
+        The fleet dedups these across replicas: the shared forward is ONE
+        dispatcher no matter how many engines run through it."""
+        out: List[Any] = []
+        for f in (self._fwd, self._draft_fwd, self._verify_fwd,
+                  self._copy):
+            if callable(getattr(f, "cache_summary", None)) and not any(
+                    f is s for s in out):
+                out.append(f)
+        return out
+
+    def exec_cache_summary(self) -> Optional[Dict[str, Any]]:
+        """Aggregated persistent-executable-cache accounting across the
+        engine's dispatchers (None when the engine runs plain jit — the
+        cache is not in play). ``fallback_compiles`` > 0 on a supposedly
+        warm engine means some program was compiled instead of loaded."""
+        return _sum_cache_summaries(self.exec_dispatchers())
 
     def stats(self) -> EngineStats:
         with self._cond:

@@ -186,7 +186,8 @@ class ServingFleet:
                  prefix_cache: bool = False,
                  tracing: Optional[bool] = None,
                  archive_dir: Optional[str] = None,
-                 slo: Any = None) -> None:
+                 slo: Any = None,
+                 exec_cache: Any = None) -> None:
         self.name = name
         self.model_cfg = model_cfg
         self.buckets = buckets
@@ -220,7 +221,12 @@ class ServingFleet:
         self._router_tracer = self._make_tracer("router")
         self.router = LeastLoadedRouter(self.registry,
                                         tracer=self._router_tracer)
-        self._fwd = make_paged_forward()
+        # the fleet-shared forward: one jit cache — and, with a persistent
+        # executable cache (``exec_cache=`` or the ambient default), one
+        # AOT dispatcher whose ladder loads from the CAS ``exec/``
+        # namespace instead of compiling, so even replica 1 of a restart
+        # leg warms in milliseconds (``exec_cache=False`` opts out)
+        self._fwd = make_paged_forward(exec_cache)
         self._params = params
         self._lock = threading.RLock()   # membership + rollout serialization
         self._replicas: Dict[str, Replica] = {}
@@ -236,6 +242,12 @@ class ServingFleet:
         self._h_frontdoor = self.registry.histogram(
             "fleet_frontdoor_seconds",
             "front-door request wall-time (submit → result, incl. routing)")
+        self._h_scale_up = self.registry.histogram(
+            "fleet_scale_up_seconds",
+            "per-replica scale-up wall-time (engine build + warmup)")
+        # per-replica scale-up latencies in arrival order — the bench's
+        # cold-vs-warm replica-start A/B reads this directly
+        self.scale_up_latencies_s: List[float] = []
 
     def _make_tracer(self, process_name: str) -> Optional[Tracer]:
         """One tracer lane of the stitched request trace; None (and zero
@@ -275,6 +287,7 @@ class ServingFleet:
         joins the router."""
         added: List[str] = []
         for _ in range(max(0, int(n))):
+            t0 = time.monotonic()
             with self._lock:
                 rid = f"{self.name}-{self._next_seq}"
                 self._next_seq += 1
@@ -297,6 +310,10 @@ class ServingFleet:
                 self._g_replicas.set(len(self._replicas))
             self.router.add(rep)
             added.append(rid)
+            dt = time.monotonic() - t0
+            self._h_scale_up.observe(dt)
+            with self._lock:
+                self.scale_up_latencies_s.append(dt)
         return added
 
     def stop_replica(self, replica_id: str, timeout: float = 60.0) -> float:
@@ -511,6 +528,26 @@ class ServingFleet:
         return self.rollout(new_params, **kw)
 
     # -- telemetry ---------------------------------------------------------
+
+    def exec_cache_summary(self) -> Optional[Dict[str, Any]]:
+        """Fleet-wide persistent-executable-cache accounting (None when
+        every entry point runs plain jit). Dispatchers are deduped by
+        identity across replicas — the fleet-shared forward is ONE
+        dispatcher no matter how many engines run through it, so its
+        hits/misses count once."""
+        from determined_clone_tpu.serving.engine import _sum_cache_summaries
+
+        seen: List[Any] = []
+        if callable(getattr(self._fwd, "cache_summary", None)):
+            seen.append(self._fwd)
+        for rep in self.replicas():
+            lister = getattr(rep.engine, "exec_dispatchers", None)
+            if not callable(lister):
+                continue
+            for d in lister():
+                if not any(d is s for s in seen):
+                    seen.append(d)
+        return _sum_cache_summaries(seen)
 
     def stats(self) -> FleetStats:
         reps = self.replicas()

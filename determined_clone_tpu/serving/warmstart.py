@@ -1,0 +1,139 @@
+"""Warm-start measurement harness: one process, one JSON report.
+
+``python -m determined_clone_tpu.serving.warmstart --exec-cache-dir D``
+builds a deterministic tiny engine against the persistent executable
+cache rooted at ``D``, warms the full bucket ladder, decodes a fixed
+greedy prompt, and prints one JSON object. Run it twice against the same
+directory and the pair IS the tentpole's proof:
+
+- leg 1 (cold) compiles every ladder program and publishes each to
+  ``cas/exec/`` — ``exec_cache.misses == program_budget``;
+- leg 2 (warm, a FRESH process: nothing survives in jax's in-memory jit
+  cache) loads every program instead — ``exec_cache.hits ==
+  program_budget``, ``fallback_compiles == 0``, the goodput ``compile``
+  category collapses to the deserialize residual, and ``tokens`` is
+  bit-identical to leg 1 (greedy decode through a deserialized
+  executable is the same program, so the same bits).
+
+tests/test_exec_cache.py drives exactly that subprocess pair; bench.py's
+serving exec-cache section reuses :func:`run` in-process. ``--no-cache``
+measures the plain-jit baseline for the same ladder (the A in the A/B).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+# deterministic harness constants: both legs (and every future leg) must
+# build the exact same ladder or the hit/miss accounting means nothing
+SEED = 0
+VOCAB = 64
+MAX_SEQ = 64
+PROMPT = [1, 2, 3, 4, 5, 6, 7, 8]
+MAX_NEW_TOKENS = 8
+
+
+def _model_cfg() -> Any:
+    from determined_clone_tpu.models import gpt
+
+    return gpt.GPTConfig(vocab_size=VOCAB, max_seq_len=MAX_SEQ,
+                         n_layers=2, d_model=32, n_heads=2, d_ff=64)
+
+
+class _Telemetry:
+    """The minimal facade the engine reads: ``.registry`` + ``.tracer``."""
+
+    def __init__(self, registry: Any, tracer: Any) -> None:
+        self.registry = registry
+        self.tracer = tracer
+
+
+def run(exec_cache_dir: Optional[str], *,
+        max_new_tokens: int = MAX_NEW_TOKENS) -> Dict[str, Any]:
+    """Build → warm → decode → account. Returns the report dict."""
+    import jax
+
+    from determined_clone_tpu.models import gpt
+    from determined_clone_tpu.serving.bucketing import BucketSpec
+    from determined_clone_tpu.serving.engine import InferenceEngine
+    from determined_clone_tpu.storage import exec_cache as exec_mod
+    from determined_clone_tpu.storage.base import SharedFSStorageManager
+    from determined_clone_tpu.telemetry import MetricsRegistry
+    from determined_clone_tpu.telemetry.goodput import GoodputLedger
+    from determined_clone_tpu.telemetry.spans import Tracer
+
+    cache = None
+    if exec_cache_dir:
+        cache = exec_mod.ExecutableCache(
+            SharedFSStorageManager(exec_cache_dir))
+        exec_mod.set_default_cache(cache)
+
+    registry = MetricsRegistry()
+    tracer = Tracer(enabled=True, process_name="warmstart")
+    ledger = GoodputLedger(registry=registry)
+    tracer.add_sink(ledger.observe_span)
+
+    cfg = _model_cfg()
+    params = gpt.init(jax.random.PRNGKey(SEED), cfg)
+    buckets = BucketSpec.build(2, 16)
+
+    t0 = time.monotonic()
+    engine = InferenceEngine(params, cfg, buckets=buckets,
+                             prefix_cache=True,
+                             telemetry=_Telemetry(registry, tracer))
+    programs = engine.warmup()
+    warmup_s = time.monotonic() - t0
+    result = engine.generate(PROMPT, max_new_tokens)
+    summary = engine.exec_cache_summary()
+    budget = engine.program_budget()
+    engine.close()
+
+    goodput = ledger.snapshot()
+    counters: Dict[str, float] = {}
+    for name, sample in registry.snapshot().items():
+        if name.startswith("xla_exec_cache"):
+            counters[name] = float(
+                sample.get("value", sample.get("sum", 0.0)) or 0.0)
+
+    report: Dict[str, Any] = {
+        "warmup_s": round(warmup_s, 4),
+        "programs_compiled": programs,
+        "program_budget": budget,
+        "tokens": list(result.tokens),
+        "goodput_compile_s": round(
+            goodput["categories"].get("compile", 0.0), 4),
+        "exec_cache": summary,          # None when running plain jit
+        "exec_cache_metrics": counters,
+        "cache_stats": cache.stats() if cache is not None else None,
+    }
+    if cache is not None:
+        exec_mod.set_default_cache(None)
+    return report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m determined_clone_tpu.serving.warmstart",
+        description="deterministic warm-start measurement leg "
+                    "(see module docstring)")
+    ap.add_argument("--exec-cache-dir", default=None,
+                    help="persistent executable cache root (shared_fs); "
+                         "required unless --no-cache")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="plain-jit baseline leg (no executable cache)")
+    ap.add_argument("--max-tokens", type=int, default=MAX_NEW_TOKENS)
+    args = ap.parse_args(argv)
+    if not args.no_cache and not args.exec_cache_dir:
+        ap.error("--exec-cache-dir is required (or pass --no-cache)")
+    report = run(None if args.no_cache else args.exec_cache_dir,
+                 max_new_tokens=args.max_tokens)
+    json.dump(report, sys.stdout, indent=2, sort_keys=True)
+    sys.stdout.write("\n")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via subprocess
+    sys.exit(main())

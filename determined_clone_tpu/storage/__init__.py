@@ -9,8 +9,14 @@ from determined_clone_tpu.storage.base import (
     build,
 )
 from determined_clone_tpu.storage.cas import (
+    BlobIntegrityError,
+    BlobService,
     CASStorageManager,
     ChunkCache,
+)
+from determined_clone_tpu.storage.exec_cache import (
+    ExecKey,
+    ExecutableCache,
 )
 from determined_clone_tpu.storage.transfer import (
     TransferPool,
@@ -20,9 +26,13 @@ from determined_clone_tpu.storage.transfer import (
 
 __all__ = [
     "AzureStorageManager",
+    "BlobIntegrityError",
+    "BlobService",
     "CASStorageManager",
     "ChunkCache",
     "DirectoryStorageManager",
+    "ExecKey",
+    "ExecutableCache",
     "GCSStorageManager",
     "S3StorageManager",
     "SharedFSStorageManager",

@@ -1,14 +1,28 @@
-"""Content-addressed checkpoint storage (CAS): chunk-level dedup + cache.
+"""Content-addressed blob storage (CAS): chunk-level dedup + cache.
 
-``CASStorageManager`` sits between ``CheckpointContext`` and any concrete
-:class:`~determined_clone_tpu.storage.base.StorageManager` backend. It
-splits checkpoint payload files into fixed-size chunks keyed by their
-sha256, stores each chunk once in a shared ``cas/`` namespace in the
-backend, and writes a per-checkpoint **chunk manifest** alongside PR 4's
-``manifest.json``/``COMMIT`` protocol files. Successive checkpoints (and
-different trials sharing a storage root) re-upload only the chunks that
-actually changed — the incremental-checkpoint result of Check-N-Run
-(NSDI '22) / CheckFreq (FAST '21), see docs/checkpoint_storage.md.
+The reserved ``cas/`` storage_id is a generic **content-addressed blob
+store** with two clients:
+
+- **checkpoint chunks** (``cas/chunks/``): ``CASStorageManager`` sits
+  between ``CheckpointContext`` and any concrete
+  :class:`~determined_clone_tpu.storage.base.StorageManager` backend. It
+  splits checkpoint payload files into fixed-size chunks keyed by their
+  sha256, stores each chunk once, and writes a per-checkpoint **chunk
+  manifest** alongside PR 4's ``manifest.json``/``COMMIT`` protocol
+  files. Successive checkpoints (and different trials sharing a storage
+  root) re-upload only the chunks that actually changed — the
+  incremental-checkpoint result of Check-N-Run (NSDI '22) / CheckFreq
+  (FAST '21), see docs/checkpoint_storage.md.
+- **compiled executables** (``cas/exec/``): the persistent AOT
+  executable cache (storage/exec_cache.py) stores serialized XLA
+  executables as content-addressed blobs plus a key index, so replica
+  fleets and restart legs skip recompiling programs another process
+  already built.
+
+Both ride the same :class:`BlobService` transport — digest-keyed object
+paths, sha256 verification on every read, local :class:`ChunkCache`
+read-through, fault-point injection — so the integrity and chaos
+machinery proven on checkpoints applies to executables unchanged.
 
 Protocol extension: a checkpoint is restorable iff its COMMIT marker
 exists (unchanged from PR 4) AND every chunk its manifests reference
@@ -51,9 +65,17 @@ from determined_clone_tpu.storage.base import (
 
 logger = logging.getLogger(__name__)
 
-# Reserved storage_id holding the shared chunk objects; never a checkpoint.
-# GC sweeps and list_storage_ids() must skip it.
+# Reserved storage_id holding the shared blob objects (checkpoint chunks
+# AND cached executables); never a checkpoint. GC sweeps and
+# list_storage_ids() must skip it.
 CHUNK_NAMESPACE = "cas"
+
+# Blob namespaces inside the reserved storage_id. Chunk GC only ever
+# deletes ``chunks/...`` rels (structurally — see BlobService.rel), so
+# ``exec/...`` entries can never be swept as orphan chunks.
+CHUNK_PREFIX = "chunks"
+EXEC_BLOB_PREFIX = "exec/blobs"
+EXEC_INDEX_PREFIX = "exec/index"
 
 # Per-upload-call chunk manifest written into the checkpoint's namespace.
 # One file per upload() call (so sharded ranks never collide); restore
@@ -252,6 +274,148 @@ class ChunkCache:
             }
 
 
+class BlobIntegrityError(Exception):
+    """A blob is missing from the store or fails digest verification."""
+
+    def __init__(self, digest: str, reason: str, *,
+                 missing: bool = False) -> None:
+        super().__init__(f"blob {digest[:12]}…: {reason}")
+        self.digest = digest
+        self.reason = reason
+        self.missing = missing
+
+
+class BlobService:
+    """Digest-keyed blob transport over the reserved ``cas`` storage_id.
+
+    One instance per namespace — checkpoint chunks under ``chunks/``,
+    serialized executables under ``exec/blobs/`` — each with its own
+    fault-point names so chaos tests can tear or drop either object kind
+    independently. Shared guarantees:
+
+    - objects live at ``<prefix>/<digest[:2]>/<digest>`` (fanned out so
+      shared_fs directories stay enumerable);
+    - every read is sha256-verified against its key before it is served
+      (:class:`BlobIntegrityError` on mismatch — a torn object can never
+      launder bad bytes into a restore or a deserialized executable);
+    - an optional local :class:`ChunkCache` serves repeat reads without
+      touching the backend (itself digest-verified per hit);
+    - ``fault_store`` / ``fault_drop`` / ``fault_load`` name the
+      injection points (faults/core.py) for torn writes, lost objects,
+      and failed reads.
+
+    The ``counter`` hook receives ``(key, n)`` accounting events
+    (``cache_hits`` / ``cache_misses`` / ``bytes_downloaded``) so the
+    owning manager can fold them into its session stats and metrics.
+    """
+
+    def __init__(self, inner: StorageManager, prefix: str = CHUNK_PREFIX, *,
+                 cache: Optional[ChunkCache] = None,
+                 fault_store: Optional[str] = None,
+                 fault_drop: Optional[str] = None,
+                 fault_load: Optional[str] = None,
+                 counter: Optional[Any] = None) -> None:
+        self._inner = inner
+        self.prefix = prefix
+        self._cache = cache
+        self._fault_store = fault_store
+        self._fault_drop = fault_drop
+        self._fault_load = fault_load
+        self._count = counter if counter is not None else (lambda k, n: None)
+
+    def rel(self, digest: str) -> str:
+        """Backend-relative object path of a blob."""
+        return f"{self.prefix}/{digest[:2]}/{digest}"
+
+    def digest_of_rel(self, rel: str) -> Optional[str]:
+        """Inverse of :meth:`rel`; None for anything outside this
+        namespace (another namespace's blobs, index files, strays)."""
+        head = self.prefix + "/"
+        if not rel.startswith(head):
+            return None
+        parts = rel[len(head):].split("/")
+        if (len(parts) == 2 and len(parts[1]) == 64
+                and parts[0] == parts[1][:2]):
+            return parts[1]
+        return None
+
+    def list_blobs(self) -> Dict[str, int]:
+        """digest -> size for every blob in this namespace RIGHT NOW
+        (fresh backend listing, no memo)."""
+        listing = self._inner.list_files(CHUNK_NAMESPACE)
+        out: Dict[str, int] = {}
+        for rel, size in listing.items():
+            d = self.digest_of_rel(rel)
+            if d is not None:
+                out[d] = int(size)
+        return out
+
+    def put(self, data: bytes, *, digest: Optional[str] = None
+            ) -> Optional[str]:
+        """Store bytes under their sha256 (or a caller-supplied digest —
+        the chunk path already hashed during scan). Returns the digest,
+        or None when an injected drop swallowed the object (the caller
+        decides whether that is fatal)."""
+        if digest is None:
+            digest = _sha256_bytes(data)
+        if self._fault_store is not None:
+            faults.point(self._fault_store)
+        if (self._fault_drop is not None
+                and faults.truncate_bytes(self._fault_drop) is not None):
+            return None
+        rel = self.rel(digest)
+        with tempfile.TemporaryDirectory(prefix="dct-blob-up-") as stage:
+            staged = os.path.join(stage, rel)
+            os.makedirs(os.path.dirname(staged), exist_ok=True)
+            with open(staged, "wb") as f:
+                f.write(data)
+            if self._fault_store is not None:
+                keep = faults.truncate_bytes(self._fault_store)
+                if keep is not None:
+                    # injected torn object: truncated bytes land under the
+                    # full digest's key — read-side digest-verify convicts
+                    with open(staged, "r+b") as f:
+                        f.truncate(keep)
+            self._inner.upload(stage, CHUNK_NAMESPACE, paths=[rel])
+        if self._cache is not None:
+            self._cache.put(digest, data)
+        return digest
+
+    def get(self, digest: str) -> bytes:
+        """Fetch + digest-verify one blob (cache first, then backend).
+        Raises :class:`BlobIntegrityError` when missing or torn."""
+        if self._fault_load is not None:
+            faults.point(self._fault_load)
+        if self._cache is not None:
+            hit = self._cache.get(digest)
+            if hit is not None:
+                self._count("cache_hits", 1)
+                with open(hit, "rb") as f:
+                    return f.read()
+            self._count("cache_misses", 1)
+        rel = self.rel(digest)
+        with tempfile.TemporaryDirectory(prefix="dct-blob-dl-") as tmp:
+            try:
+                self._inner.download(CHUNK_NAMESPACE, tmp, paths=[rel])
+                with open(os.path.join(tmp, rel), "rb") as f:
+                    data = f.read()
+            except (FileNotFoundError, KeyError):
+                raise BlobIntegrityError(
+                    digest, "missing from the blob store",
+                    missing=True) from None
+        if _sha256_bytes(data) != digest:
+            raise BlobIntegrityError(
+                digest, "content digest mismatch (torn blob)")
+        self._count("bytes_downloaded", len(data))
+        if self._cache is not None:
+            self._cache.put(digest, data)
+        return data
+
+    def delete(self, digests: Iterable[str]) -> None:
+        self._inner.delete_files(
+            CHUNK_NAMESPACE, [self.rel(d) for d in sorted(digests)])
+
+
 class CASStorageManager(StorageManager):
     """Content-addressed wrapper around a concrete storage backend.
 
@@ -290,6 +454,13 @@ class CASStorageManager(StorageManager):
             "chunks_uploaded": 0, "chunks_deduped": 0, "chunks_dropped": 0,
             "cache_hits": 0, "cache_misses": 0,
         }
+        # chunk-namespace client of the shared blob transport; the
+        # executable cache (exec_cache()) is the second client
+        self._chunks = BlobService(
+            inner, CHUNK_PREFIX, cache=cache,
+            fault_store="cas.chunk_upload", fault_drop="cas.chunk_drop",
+            fault_load="cas.chunk_download", counter=self._count)
+        self._exec_cache: Optional[Any] = None
 
     # -- telemetry ----------------------------------------------------------
 
@@ -332,9 +503,10 @@ class CASStorageManager(StorageManager):
 
     def _list_backend_chunks(self) -> Set[str]:
         """Digests present in the chunk namespace RIGHT NOW (fresh listing,
-        no session memo) — what dedup re-verification checks against."""
-        listing = self._inner.list_files(CHUNK_NAMESPACE)
-        return {d for d in map(_digest_of_rel, listing) if d}
+        no session memo) — what dedup re-verification checks against.
+        Executable-cache blobs (``exec/...``) are a different namespace
+        and never appear here."""
+        return set(self._chunks.list_blobs())
 
     def _refresh_known_chunks(self) -> Set[str]:
         digests = self._list_backend_chunks()
@@ -459,42 +631,26 @@ class CASStorageManager(StorageManager):
 
     def _upload_chunks(
             self, to_send: List[Tuple[str, str, Dict[str, Any]]]) -> None:
-        with tempfile.TemporaryDirectory(prefix="dct-cas-up-") as stage:
+        def send(src: str, chunk: Dict[str, Any]) -> None:
+            digest, size, offset = (chunk["sha256"], chunk["size"],
+                                    chunk["offset"])
+            with open(src, "rb") as f:
+                f.seek(offset)
+                data = f.read(size)
+            if self._chunks.put(data, digest=digest) is None:
+                # injected lost object (cas.chunk_drop): the save
+                # "succeeds" but this chunk never reaches the backend —
+                # restore must refuse
+                self._count("chunks_dropped", 1)
+                return
+            self._count("bytes_uploaded", size)
+            self._count("chunks_uploaded", 1)
 
-            def send(src: str, chunk: Dict[str, Any]) -> None:
-                digest, size, offset = (chunk["sha256"], chunk["size"],
-                                        chunk["offset"])
-                faults.point("cas.chunk_upload")
-                if faults.truncate_bytes("cas.chunk_drop") is not None:
-                    # injected lost object: the save "succeeds" but this
-                    # chunk never reaches the backend — restore must refuse
-                    self._count("chunks_dropped", 1)
-                    return
-                with open(src, "rb") as f:
-                    f.seek(offset)
-                    data = f.read(size)
-                rel = chunk_rel(digest)
-                staged = os.path.join(stage, rel)
-                os.makedirs(os.path.dirname(staged), exist_ok=True)
-                with open(staged, "wb") as f:
-                    f.write(data)
-                keep = faults.truncate_bytes("cas.chunk_upload")
-                if keep is not None:
-                    # injected torn chunk: a truncated object lands under
-                    # the full digest's key — restore digest-verify convicts
-                    with open(staged, "r+b") as f:
-                        f.truncate(keep)
-                self._inner.upload(stage, CHUNK_NAMESPACE, paths=[rel])
-                if self._cache is not None:
-                    self._cache.put(digest, data)
-                self._count("bytes_uploaded", size)
-                self._count("chunks_uploaded", 1)
-
-            tasks = [
-                (lambda src=src, chunk=c: send(src, chunk))
-                for src, _, c in to_send
-            ]
-            self._get_pool().run(tasks)
+        tasks = [
+            (lambda src=src, chunk=c: send(src, chunk))
+            for src, _, c in to_send
+        ]
+        self._get_pool().run(tasks)
 
     def _write_chunk_manifest(self, storage_id: str,
                               entries: Dict[str, Any]) -> None:
@@ -554,32 +710,16 @@ class CASStorageManager(StorageManager):
                 f"manifest says {entry['size']}")
 
     def _fetch_chunk(self, storage_id: str, digest: str, size: int) -> bytes:
-        faults.point("cas.chunk_download")
-        if self._cache is not None:
-            hit = self._cache.get(digest)
-            if hit is not None:
-                self._count("cache_hits", 1)
-                with open(hit, "rb") as f:
-                    return f.read()
-            self._count("cache_misses", 1)
-        rel = chunk_rel(digest)
-        with tempfile.TemporaryDirectory(prefix="dct-cas-dl-") as tmp:
-            try:
-                self._inner.download(CHUNK_NAMESPACE, tmp, paths=[rel])
-                with open(os.path.join(tmp, rel), "rb") as f:
-                    data = f.read()
-            except (FileNotFoundError, KeyError):
+        try:
+            return self._chunks.get(digest)
+        except BlobIntegrityError as e:
+            if e.missing:
                 raise _corrupt(
                     storage_id, f"chunk {digest[:12]}… missing from the "
                     "chunk store (lost object or over-eager GC)") from None
-        if _sha256_bytes(data) != digest:
             raise _corrupt(
                 storage_id, f"chunk {digest[:12]}… content digest mismatch "
-                "(torn chunk)")
-        self._count("bytes_downloaded", len(data))
-        if self._cache is not None:
-            self._cache.put(digest, data)
-        return data
+                "(torn chunk)") from None
 
     # -- logical listing / commit -------------------------------------------
 
@@ -688,8 +828,11 @@ class CASStorageManager(StorageManager):
         if not garbage:
             return
         try:
-            self._inner.delete_files(
-                CHUNK_NAMESPACE, [chunk_rel(d) for d in sorted(garbage)])
+            # only ever the chunk namespace: executable-cache entries
+            # (cas/exec/...) are referenced via their own index, live in a
+            # different BlobService prefix, and are structurally invisible
+            # to this ref-count walk — never swept as orphan chunks
+            self._chunks.delete(garbage)
         except NotImplementedError:
             logger.info("chunk GC skipped: %s has no per-object delete",
                         type(self._inner).__name__)
@@ -703,15 +846,46 @@ class CASStorageManager(StorageManager):
 
     # -- stats (dct checkpoint stats) ----------------------------------------
 
+    def exec_cache(self) -> Any:
+        """The executable cache sharing this manager's backend: cached
+        XLA programs land in ``cas/exec/`` next to (but namespaced away
+        from) the checkpoint chunks. Built lazily — a trainer that never
+        AOT-compiles pays nothing. When the manager has a local chunk
+        cache, the executable blobs get their own LRU sibling under
+        ``<cache_path>/exec``."""
+        from determined_clone_tpu.storage import exec_cache as exec_mod
+
+        with self._lock:
+            if self._exec_cache is None:
+                local = None
+                if self._cache is not None:
+                    local = ChunkCache(os.path.join(self._cache.path, "exec"),
+                                       max_bytes=self._cache.max_bytes)
+                self._exec_cache = exec_mod.ExecutableCache(
+                    self._inner, cache=local)
+            return self._exec_cache
+
     def storage_stats(self) -> Dict[str, Any]:
-        """Durable store-wide dedup accounting + cache hit rate.
+        """Durable store-wide dedup accounting + cache hit rate, broken
+        out per blob namespace (checkpoint chunks vs cached executables
+        — one aggregate would let a growing executable cache masquerade
+        as checkpoint growth).
 
         dedup_ratio = logical chunked bytes across every checkpoint's
         manifests / physical bytes in the chunk namespace — >1 means
         chunk-level dedup is saving space (and saved the matching upload
         bandwidth when the chunks were first written).
         """
-        physical = self._inner.list_files(CHUNK_NAMESPACE)
+        listing = self._inner.list_files(CHUNK_NAMESPACE)
+        physical = {rel: size for rel, size in listing.items()
+                    if self._chunks.digest_of_rel(rel) is not None}
+        exec_blob_bytes = sum(
+            size for rel, size in listing.items()
+            if rel.startswith(EXEC_BLOB_PREFIX + "/"))
+        exec_blob_count = sum(
+            1 for rel in listing if rel.startswith(EXEC_BLOB_PREFIX + "/"))
+        exec_index_count = sum(
+            1 for rel in listing if rel.startswith(EXEC_INDEX_PREFIX + "/"))
         chunk_bytes = sum(physical.values())
         logical = 0
         checkpoints = 0
@@ -741,6 +915,13 @@ class CASStorageManager(StorageManager):
             "logical_bytes": logical,
             "dedup_ratio": (round(logical / chunk_bytes, 4)
                             if chunk_bytes else None),
+            "namespaces": {
+                "chunks": {"objects": len(physical),
+                           "bytes": chunk_bytes},
+                "exec": {"objects": exec_blob_count,
+                         "bytes": exec_blob_bytes,
+                         "executables": exec_index_count},
+            },
             "session": dict(self.session_stats),
         }
         if self._cache is not None:

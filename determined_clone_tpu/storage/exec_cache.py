@@ -1,0 +1,423 @@
+"""Persistent AOT executable cache on the CAS blob store.
+
+Every replica cold-start, blue-green rollout, and restart leg used to
+recompile the full serving bucket ladder (plus the trainer's AOT-captured
+steps) from scratch — the jit cache only lives as long as one process.
+This module makes compiled XLA executables durable: ``jax.experimental.
+serialize_executable`` turns a ``Compiled`` into bytes, and the reserved
+``cas/exec/`` namespace (storage/cas.py :class:`BlobService`) stores them
+content-addressed, so a *second* process — another replica, a restarted
+trainer, the next bench leg — loads in milliseconds what the first one
+spent seconds compiling. The same pattern as JAX's persistent compilation
+cache and vLLM-style engine snapshotting (docs/serving.md), but
+fleet-wide and riding the repo's own digest-verified blob transport.
+
+Layout inside the reserved ``cas`` storage_id::
+
+    exec/blobs/<aa>/<sha256>     pickled (payload, in_tree, out_tree) —
+                                 content-addressed, digest-verified reads
+    exec/index/<keydigest>.json  ExecKey -> blob digest + meta (program
+                                 label, original compile seconds, sizes)
+
+The index is what makes blobs *referenced*: checkpoint chunk GC walks
+only ``chunks/...`` rels, so executable entries are structurally immune
+to the ref-count sweep (and gc_checkpoints.py skips the ``cas`` namespace
+wholesale).
+
+Keying — :class:`ExecKey` — is ``(stablehlo_fingerprint, mesh/sharding,
+jaxlib version, platform)``:
+
+- the **fingerprint** (telemetry/xla.py:fingerprint_stablehlo) pins the
+  exact lowered program: any model-config, shape, dtype, or donation
+  change changes the StableHLO text;
+- the **mesh** key pins device topology and axis layout (a 2x4 executable
+  must never load on a 1x8 mesh);
+- **jaxlib version + platform** pin the runtime ABI: serialized
+  executables are not portable across compiler versions or backends.
+
+A stale or foreign key therefore *misses* — it can never deserialize the
+wrong executable — and any load failure (torn blob, version skew,
+injected fault) degrades to a plain compile, never a crash. Fault points
+``exec_cache.load`` / ``exec_cache.store`` make both directions
+injectable (docs/fault_tolerance.md).
+
+Process wiring: the compile path (telemetry/xla.py:aot_compile) consults
+:func:`default_cache` when no cache is passed explicitly. It resolves
+from the ``DCT_EXEC_CACHE_DIR`` environment variable (a shared_fs root —
+what the warm-start subprocess test and bench A/B use) or an explicit
+:func:`set_default_cache` (e.g. the trainer publishing its CAS storage
+manager's :meth:`~determined_clone_tpu.storage.cas.CASStorageManager.
+exec_cache`). No default means no caching — the seed behavior.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+import pickle
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from determined_clone_tpu import faults
+from determined_clone_tpu.storage.cas import (
+    CHUNK_NAMESPACE,
+    EXEC_BLOB_PREFIX,
+    EXEC_INDEX_PREFIX,
+    BlobService,
+    ChunkCache,
+)
+
+logger = logging.getLogger(__name__)
+
+_FORMAT = 1
+
+
+def mesh_fingerprint(mesh: Any) -> str:
+    """Canonical mesh/sharding key: axis names x sizes + device kinds.
+
+    Accepts a ``jax.sharding.Mesh``, an ``{axis: size}`` mapping (the
+    collective-accounting convention in telemetry/xla.py), or None
+    (single-device / fully replicated)."""
+    if mesh is None:
+        return "none"
+    try:
+        from jax.sharding import Mesh
+
+        if isinstance(mesh, Mesh):
+            axes = ",".join(
+                f"{name}={size}"
+                for name, size in zip(mesh.axis_names, mesh.devices.shape))
+            kinds = sorted({d.device_kind for d in mesh.devices.flat})
+            return f"mesh({axes})[{'/'.join(kinds)}]"
+    except Exception:  # pragma: no cover - jax always importable here
+        pass
+    if isinstance(mesh, dict):
+        inner = ",".join(f"{k}={v}" for k, v in sorted(mesh.items()))
+        return f"mesh({inner})"
+    return repr(mesh)
+
+
+def runtime_fingerprint() -> Tuple[str, str]:
+    """(jaxlib-version, platform) of THIS process — serialized
+    executables are ABI-bound to both."""
+    versions = "unknown"
+    platform = "unknown"
+    try:
+        import jax
+
+        jl = None
+        try:
+            import jaxlib.version
+
+            jl = jaxlib.version.__version__
+        except Exception:
+            jl = getattr(jax, "__version_info__", None)
+        versions = f"jax-{jax.__version__}/jaxlib-{jl}"
+        platform = jax.devices()[0].platform
+    except Exception:  # pragma: no cover - headless import failures
+        pass
+    return versions, platform
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecKey:
+    """Identity of one cached executable. All four fields participate in
+    the digest; changing any of them is a MISS by construction."""
+
+    fingerprint: str   # sha256 of the lowered StableHLO text
+    mesh: str          # mesh_fingerprint()
+    jaxlib: str        # runtime version pair
+    platform: str      # cpu / tpu / gpu
+
+    def digest(self) -> str:
+        blob = json.dumps(dataclasses.asdict(self), sort_keys=True)
+        return hashlib.sha256(blob.encode("utf-8")).hexdigest()
+
+
+class ExecutableCache:
+    """Load/store serialized XLA executables on a storage backend.
+
+    ``load`` and ``store`` are *observers* of the compile path: every
+    failure mode — missing entry, torn blob, pickle or deserialization
+    error, version skew, injected fault — is caught, counted, and
+    reported as a miss, so the caller's fallback is always a plain
+    compile. Session counters feed ``xla_exec_cache_*`` metrics (against
+    the registry bound via :meth:`set_telemetry` or passed per call) and
+    ``stats()`` (the ``dct exec-cache stats`` readout).
+    """
+
+    def __init__(self, inner: Any, *,
+                 cache: Optional[ChunkCache] = None) -> None:
+        self._inner = inner
+        self._blobs = BlobService(inner, EXEC_BLOB_PREFIX, cache=cache)
+        self._lock = threading.Lock()
+        self._registry: Optional[Any] = None
+        self.session: Dict[str, Any] = {
+            "hits": 0, "misses": 0, "stores": 0, "errors": 0,
+            "load_seconds": 0.0, "store_seconds": 0.0,
+            "compile_seconds_saved": 0.0, "bytes_loaded": 0,
+            "bytes_stored": 0,
+        }
+
+    # -- telemetry ---------------------------------------------------------
+
+    def set_telemetry(self, registry: Optional[Any]) -> None:
+        self._registry = registry
+
+    def _export(self, registry: Optional[Any], outcome: str,
+                load_seconds: Optional[float] = None) -> None:
+        reg = registry if registry is not None else self._registry
+        if reg is None:
+            return
+        try:
+            if outcome == "hit":
+                reg.counter(
+                    "xla_exec_cache_hits_total",
+                    "compiles skipped: executable loaded from the "
+                    "persistent cache").inc()
+            else:
+                reg.counter(
+                    "xla_exec_cache_misses_total",
+                    "compiles that found no (usable) cached executable"
+                ).inc()
+            if load_seconds is not None:
+                reg.histogram(
+                    "xla_exec_cache_load_seconds",
+                    "fetch + deserialize of one cached executable"
+                ).observe(load_seconds)
+        except Exception:  # pragma: no cover - metrics must never fail a load
+            pass
+
+    def _note(self, key: str, n: Any) -> None:
+        with self._lock:
+            self.session[key] += n
+
+    # -- keys --------------------------------------------------------------
+
+    def key_for(self, fingerprint: str, mesh: Any = None) -> ExecKey:
+        jaxlib, platform = runtime_fingerprint()
+        return ExecKey(fingerprint=fingerprint,
+                       mesh=mesh_fingerprint(mesh),
+                       jaxlib=jaxlib, platform=platform)
+
+    @staticmethod
+    def _index_rel(key_digest: str) -> str:
+        return f"{EXEC_INDEX_PREFIX}/{key_digest}.json"
+
+    # -- load / store ------------------------------------------------------
+
+    def _read_index(self, key_digest: str) -> Optional[Dict[str, Any]]:
+        rel = self._index_rel(key_digest)
+        with tempfile.TemporaryDirectory(prefix="dct-exec-idx-") as tmp:
+            try:
+                self._inner.download(CHUNK_NAMESPACE, tmp, paths=[rel])
+                with open(os.path.join(tmp, rel)) as f:
+                    return json.load(f)
+            except (FileNotFoundError, KeyError):
+                return None
+
+    def load(self, key: ExecKey, *, registry: Optional[Any] = None
+             ) -> Optional[Tuple[Any, Dict[str, Any]]]:
+        """``(compiled, meta)`` for a cached executable, or None (a miss
+        — including every failure mode; the caller compiles)."""
+        t0 = time.perf_counter()
+        try:
+            faults.point("exec_cache.load")
+            entry = self._read_index(key.digest())
+            if entry is None or entry.get("key") != dataclasses.asdict(key):
+                self._note("misses", 1)
+                self._export(registry, "miss")
+                return None
+            data = self._blobs.get(entry["blob"])  # digest-verified
+            doc = pickle.loads(data)
+            if doc.get("key") != dataclasses.asdict(key):
+                # an index pointing at a foreign blob can only serve a
+                # WRONG executable — refuse and recompile
+                raise ValueError("executable blob key mismatch")
+            from jax.experimental import serialize_executable
+
+            compiled = serialize_executable.deserialize_and_load(
+                doc["payload"], doc["in_tree"], doc["out_tree"])
+        except Exception as exc:  # noqa: BLE001 - degrade to compile, never crash
+            logger.debug("exec cache load failed for %s: %r",
+                         key.fingerprint[:12], exc)
+            self._note("misses", 1)
+            self._note("errors", 1)
+            self._export(registry, "miss")
+            return None
+        dt = time.perf_counter() - t0
+        self._note("hits", 1)
+        self._note("load_seconds", dt)
+        self._note("bytes_loaded", len(data))
+        saved = entry.get("compile_seconds")
+        if saved:
+            self._note("compile_seconds_saved", float(saved))
+        self._export(registry, "hit", load_seconds=dt)
+        meta = {"program": entry.get("program"),
+                "compile_seconds": saved,
+                "load_seconds": dt,
+                "size": len(data)}
+        return compiled, meta
+
+    def store(self, key: ExecKey, compiled: Any, *, program: str,
+              compile_seconds: Optional[float] = None,
+              registry: Optional[Any] = None) -> bool:
+        """Serialize + publish one executable. Best-effort: False (and a
+        counted error) on any failure — publishing is an optimization for
+        the NEXT process, never a dependency of this one."""
+        t0 = time.perf_counter()
+        try:
+            faults.point("exec_cache.store")
+            from jax.experimental import serialize_executable
+
+            payload, in_tree, out_tree = serialize_executable.serialize(
+                compiled)
+            doc = pickle.dumps(
+                {"format": _FORMAT, "key": dataclasses.asdict(key),
+                 "payload": payload, "in_tree": in_tree,
+                 "out_tree": out_tree},
+                protocol=pickle.HIGHEST_PROTOCOL)
+            blob_digest = self._blobs.put(doc)
+            if blob_digest is None:  # injected drop
+                raise IOError("executable blob dropped")
+            index = {
+                "format": _FORMAT,
+                "key": dataclasses.asdict(key),
+                "blob": blob_digest,
+                "size": len(doc),
+                "program": program,
+                "compile_seconds": compile_seconds,
+                "created": time.time(),
+            }
+            rel = self._index_rel(key.digest())
+            with tempfile.TemporaryDirectory(prefix="dct-exec-idx-") as tmp:
+                path = os.path.join(tmp, rel)
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                with open(path, "w") as f:
+                    json.dump(index, f, indent=1)
+                self._inner.upload(tmp, CHUNK_NAMESPACE, paths=[rel])
+        except Exception as exc:  # noqa: BLE001 - observer, never a dependency
+            logger.debug("exec cache store failed for %s/%s: %r",
+                         program, key.fingerprint[:12], exc)
+            self._note("errors", 1)
+            return False
+        self._note("stores", 1)
+        self._note("store_seconds", time.perf_counter() - t0)
+        self._note("bytes_stored", len(doc))
+        return True
+
+    # -- stats (dct exec-cache stats) --------------------------------------
+
+    def _list_index(self) -> List[Dict[str, Any]]:
+        try:
+            listing = self._inner.list_files(CHUNK_NAMESPACE)
+        except (FileNotFoundError, KeyError):
+            return []
+        rels = sorted(r for r in listing
+                      if r.startswith(EXEC_INDEX_PREFIX + "/")
+                      and r.endswith(".json"))
+        if not rels:
+            return []
+        out: List[Dict[str, Any]] = []
+        with tempfile.TemporaryDirectory(prefix="dct-exec-ls-") as tmp:
+            self._inner.download(CHUNK_NAMESPACE, tmp, paths=rels)
+            for rel in rels:
+                try:
+                    with open(os.path.join(tmp, rel)) as f:
+                        out.append(json.load(f))
+                except (ValueError, OSError):
+                    continue  # unreadable index entry: skip, not fatal
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        """Durable + session view: entry/byte totals, per-program-label
+        breakdown, session hit rate."""
+        try:
+            blobs = self._blobs.list_blobs()
+        except (FileNotFoundError, KeyError):
+            blobs = {}
+        entries = self._list_index()
+        by_program: Dict[str, Dict[str, Any]] = {}
+        for e in entries:
+            label = str(e.get("program") or "?")
+            slot = by_program.setdefault(
+                label, {"entries": 0, "bytes": 0, "compile_seconds": 0.0})
+            slot["entries"] += 1
+            slot["bytes"] += int(e.get("size") or 0)
+            if e.get("compile_seconds"):
+                slot["compile_seconds"] = round(
+                    slot["compile_seconds"] + float(e["compile_seconds"]), 4)
+        with self._lock:
+            session = dict(self.session)
+        looked = session["hits"] + session["misses"]
+        return {
+            "entries": len(entries),
+            "blob_count": len(blobs),
+            "bytes": sum(blobs.values()),
+            "by_program": by_program,
+            "hit_rate": (round(session["hits"] / looked, 4)
+                         if looked else None),
+            "session": session,
+        }
+
+
+# -- process-default cache ---------------------------------------------------
+
+_DEFAULT_LOCK = threading.Lock()
+_DEFAULT: Dict[str, Any] = {"cache": None, "source": None}
+
+ENV_DIR = "DCT_EXEC_CACHE_DIR"
+
+
+def set_default_cache(cache: Optional[ExecutableCache]) -> None:
+    """Install (or with None, clear) the process-wide default cache the
+    compile path falls back to. An explicit set wins over the
+    environment; clearing re-enables environment resolution."""
+    with _DEFAULT_LOCK:
+        _DEFAULT["cache"] = cache
+        _DEFAULT["source"] = "explicit" if cache is not None else None
+
+
+def default_cache() -> Optional[ExecutableCache]:
+    """The ambient executable cache: an explicit :func:`set_default_cache`
+    value, else one rooted at ``$DCT_EXEC_CACHE_DIR`` (a shared_fs
+    directory — memoized per path), else None (caching off)."""
+    with _DEFAULT_LOCK:
+        if _DEFAULT["source"] == "explicit":
+            return _DEFAULT["cache"]
+        directory = os.environ.get(ENV_DIR)
+        if not directory:
+            if _DEFAULT["source"] is not None:
+                _DEFAULT["cache"] = None
+                _DEFAULT["source"] = None
+            return None
+        if _DEFAULT["source"] != directory:
+            try:
+                from determined_clone_tpu.storage.base import (
+                    SharedFSStorageManager,
+                )
+
+                _DEFAULT["cache"] = ExecutableCache(
+                    SharedFSStorageManager(directory))
+                _DEFAULT["source"] = directory
+            except Exception as exc:  # pragma: no cover - bad env value
+                logger.warning("exec cache disabled: cannot open %s=%s (%r)",
+                               ENV_DIR, directory, exc)
+                _DEFAULT["cache"] = None
+                _DEFAULT["source"] = directory
+        return _DEFAULT["cache"]
+
+
+__all__ = [
+    "ENV_DIR",
+    "ExecKey",
+    "ExecutableCache",
+    "default_cache",
+    "mesh_fingerprint",
+    "runtime_fingerprint",
+    "set_default_cache",
+]

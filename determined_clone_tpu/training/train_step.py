@@ -177,6 +177,7 @@ def capture_compile(
     registry: Optional[Any] = None,
     tracer: Optional[Any] = None,
     mesh: Optional[Mesh] = None,
+    exec_cache: Optional[Any] = None,
 ) -> Tuple[Callable[..., Any], Optional[Any]]:
     """Explicit ``lower()``/``compile()`` capture for a built step.
 
@@ -191,12 +192,20 @@ def capture_compile(
     jit cache on a shape mismatch (remainder batches). ``example_args``
     contribute shapes only; nothing runs during lowering. On any failure
     the original ``step`` comes back with a ``None`` record.
+
+    With a persistent executable cache — explicit ``exec_cache``, or the
+    ambient default a ``DCT_EXEC_CACHE=1`` CAS-backed run installs
+    (core/_context.py) — the capture is cache-first: a restart leg loads
+    the serialized train-step executable from ``cas/exec/`` instead of
+    recompiling, and the goodput ``compile`` category collapses to the
+    load time (``record.cache_hit``/``compile_time_saved_s`` say so).
     """
     from determined_clone_tpu.telemetry import xla as xla_telemetry
 
     return xla_telemetry.aot_compile(
         step, example_args, program=program,
-        registry=registry, tracer=tracer, mesh=mesh)
+        registry=registry, tracer=tracer, mesh=mesh,
+        exec_cache=exec_cache)
 
 
 def param_count(tree: Any) -> int:

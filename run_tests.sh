@@ -27,7 +27,8 @@
 #
 # `./run_tests.sh --storage` runs the checkpoint-storage surface
 # (docs/checkpoint_storage.md): backends, the content-addressed store +
-# transfer pool, and the storage-facing fault-tolerance paths.
+# transfer pool, the persistent executable cache, and the storage-facing
+# fault-tolerance paths.
 #
 # `./run_tests.sh --control-plane` runs the control-plane observability
 # surface (docs/observability.md): scheduler lifecycle telemetry,
@@ -77,6 +78,7 @@ elif [ "$1" = "--chaos" ]; then
 elif [ "$1" = "--storage" ]; then
     shift
     set -- tests/test_storage_backends.py tests/test_cas_store.py \
+        tests/test_exec_cache.py \
         tests/test_fault_tolerance.py -m "not slow" "$@"
 elif [ "$1" = "--control-plane" ]; then
     shift

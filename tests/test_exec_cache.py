@@ -1,0 +1,450 @@
+"""Persistent AOT executable cache (docs/checkpoint_storage.md,
+"Executable cache"): ExecKey invalidation, store/load roundtrips on the
+CAS blob service, torn-blob and fault-injection degradation, GC safety
+of the ``cas/exec/`` namespace, the per-namespace storage stats split,
+and the warm-start contract — a second process (or a cleared-cache
+second engine) loads every ladder program instead of compiling, with
+bit-identical greedy output."""
+import dataclasses
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import determined_clone_tpu
+from determined_clone_tpu import faults
+from determined_clone_tpu.storage import (
+    CASStorageManager,
+    ExecutableCache,
+    SharedFSStorageManager,
+    TransferPool,
+)
+from determined_clone_tpu.storage import exec_cache as exec_mod
+from determined_clone_tpu.storage.cas import (
+    EXEC_BLOB_PREFIX,
+    EXEC_INDEX_PREFIX,
+)
+from determined_clone_tpu.telemetry import MetricsRegistry
+from determined_clone_tpu.telemetry.xla import AotDispatcher, aot_compile
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.abspath(determined_clone_tpu.__file__)))
+
+
+@pytest.fixture(autouse=True)
+def clean_state(monkeypatch):
+    """No fault plan, no ambient default cache, no env leakage."""
+    monkeypatch.delenv("DCT_FAULT_PLAN", raising=False)
+    monkeypatch.delenv(exec_mod.ENV_DIR, raising=False)
+    faults.reset()
+    exec_mod.set_default_cache(None)
+    yield
+    faults.reset()
+    exec_mod.set_default_cache(None)
+
+
+def make_cache(tmp_path, name="exec-store"):
+    return ExecutableCache(SharedFSStorageManager(str(tmp_path / name)))
+
+
+def compile_one(scale=2.0):
+    """A fresh jitted program + compiled executable + example arg."""
+    jitted = jax.jit(lambda x: x * scale + 1.0)
+    x = jnp.arange(8.0)
+    compiled = jitted.lower(x).compile()
+    return jitted, compiled, x
+
+
+# ---------------------------------------------------------------------------
+# keying / invalidation
+# ---------------------------------------------------------------------------
+
+def test_exec_key_digest_is_canonical_and_field_sensitive(tmp_path):
+    cache = make_cache(tmp_path)
+    k1 = cache.key_for("ab" * 32)
+    assert k1 == cache.key_for("ab" * 32)
+    assert k1.digest() == cache.key_for("ab" * 32).digest()
+    # every field participates: jaxlib skew, platform skew, mesh skew,
+    # and program changes each produce a different digest
+    for field, value in [("fingerprint", "cd" * 32),
+                         ("mesh", "mesh(data=8)"),
+                         ("jaxlib", "jax-9.9/jaxlib-9.9"),
+                         ("platform", "tpu")]:
+        assert dataclasses.replace(k1, **{field: value}).digest() \
+            != k1.digest()
+
+
+def test_mesh_fingerprint_forms():
+    assert exec_mod.mesh_fingerprint(None) == "none"
+    assert exec_mod.mesh_fingerprint({"model": 2, "data": 4}) == \
+        "mesh(data=4,model=2)"
+    import numpy as np
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    fp = exec_mod.mesh_fingerprint(mesh)
+    assert fp.startswith("mesh(data=1)")
+
+
+def test_stale_key_misses_never_serves_wrong_executable(tmp_path):
+    cache = make_cache(tmp_path)
+    _, compiled, x = compile_one()
+    key = cache.key_for("ab" * 32)
+    assert cache.store(key, compiled, program="p")
+    # a second runtime with a different jaxlib/platform computes a
+    # different digest — there is no entry to find, hence a miss (the
+    # wrong executable is unreachable by construction)
+    stale = dataclasses.replace(key, jaxlib="jax-0.0/jaxlib-0.0")
+    assert cache.load(stale) is None
+    assert cache.session["misses"] == 1
+    # the real key still loads
+    assert cache.load(key) is not None
+
+
+def test_index_blob_key_cross_check(tmp_path):
+    # an index entry pointing at a blob serialized under a DIFFERENT key
+    # must refuse to load (never deserialize a foreign executable)
+    cache = make_cache(tmp_path)
+    _, compiled, x = compile_one()
+    k1 = cache.key_for("ab" * 32)
+    k2 = cache.key_for("cd" * 32)
+    assert cache.store(k1, compiled, program="p")
+    assert cache.store(k2, compiled, program="p")
+    store_root = str(tmp_path / "exec-store" / "cas")
+    idx1 = os.path.join(store_root, cache._index_rel(k1.digest()))
+    idx2 = os.path.join(store_root, cache._index_rel(k2.digest()))
+    with open(idx1) as f:
+        entry1 = json.load(f)
+    with open(idx2) as f:
+        entry2 = json.load(f)
+    entry1["blob"] = entry2["blob"]  # k1's index now points at k2's blob
+    with open(idx1, "w") as f:
+        json.dump(entry1, f)
+    fresh = make_cache(tmp_path)
+    assert fresh.load(k1) is None
+    assert fresh.session["errors"] == 1
+    assert fresh.load(k2) is not None
+
+
+# ---------------------------------------------------------------------------
+# roundtrip / degradation
+# ---------------------------------------------------------------------------
+
+def test_store_load_roundtrip_executes_identically(tmp_path):
+    cache = make_cache(tmp_path)
+    registry = MetricsRegistry()
+    jitted, compiled, x = compile_one()
+    key = cache.key_for("ab" * 32)
+    assert cache.store(key, compiled, program="roundtrip",
+                       compile_seconds=1.25, registry=registry)
+
+    fresh = make_cache(tmp_path)  # same backend, empty session
+    loaded = fresh.load(key, registry=registry)
+    assert loaded is not None
+    compiled2, meta = loaded
+    assert meta["program"] == "roundtrip"
+    assert meta["compile_seconds"] == 1.25
+    assert meta["load_seconds"] > 0
+    assert jnp.array_equal(compiled2(x), jitted(x))
+    assert fresh.session == dict(fresh.session, hits=1, misses=0)
+    snap = registry.snapshot()
+    assert snap["xla_exec_cache_hits_total"]["value"] == 1.0
+    assert snap["xla_exec_cache_load_seconds"]["count"] == 1
+
+
+def test_missing_entry_is_a_plain_miss(tmp_path):
+    cache = make_cache(tmp_path)
+    registry = MetricsRegistry()
+    assert cache.load(cache.key_for("ab" * 32), registry=registry) is None
+    assert cache.session["misses"] == 1
+    assert cache.session["errors"] == 0  # absence is not an error
+    assert registry.snapshot()[
+        "xla_exec_cache_misses_total"]["value"] == 1.0
+
+
+def test_torn_blob_degrades_to_miss(tmp_path):
+    cache = make_cache(tmp_path)
+    _, compiled, x = compile_one()
+    key = cache.key_for("ab" * 32)
+    assert cache.store(key, compiled, program="p")
+    [blob_path] = glob.glob(str(
+        tmp_path / "exec-store" / "cas" / EXEC_BLOB_PREFIX / "*" / "*"))
+    size = os.path.getsize(blob_path)
+    with open(blob_path, "r+b") as f:
+        f.truncate(size // 2)
+    fresh = make_cache(tmp_path)  # no local cache: must hit the torn blob
+    assert fresh.load(key) is None
+    assert fresh.session["errors"] == 1
+    assert fresh.session["misses"] == 1
+
+
+def test_fault_points_cover_both_directions(tmp_path):
+    cache = make_cache(tmp_path)
+    _, compiled, x = compile_one()
+    key = cache.key_for("ab" * 32)
+    plan = faults.activate(faults.plan_from_dict({"rules": [
+        {"point": "exec_cache.store", "exc": "io"}]}))
+    assert cache.store(key, compiled, program="p") is False
+    assert cache.session["errors"] == 1
+    faults.deactivate(plan)
+    assert cache.store(key, compiled, program="p") is True
+
+    plan = faults.activate(faults.plan_from_dict({"rules": [
+        {"point": "exec_cache.load", "exc": "io"}]}))
+    assert cache.load(key) is None  # injected: degrades to a miss
+    assert cache.session["misses"] == 1
+    faults.deactivate(plan)
+    assert cache.load(key) is not None
+
+
+# ---------------------------------------------------------------------------
+# compile-path integration (aot_compile / AotDispatcher)
+# ---------------------------------------------------------------------------
+
+def test_aot_compile_is_cache_first(tmp_path):
+    cache = make_cache(tmp_path)
+    registry = MetricsRegistry()
+    x = jnp.arange(8.0)
+
+    fn1 = jax.jit(lambda v: v * 3.0 - 1.0)
+    call1, rec1 = aot_compile(fn1, (x,), program="p", registry=registry,
+                              exec_cache=cache)
+    assert rec1 is not None and not rec1.cache_hit
+    out1 = call1(x)
+
+    jax.clear_caches()  # nothing in-memory survives into "process 2"
+    fn2 = jax.jit(lambda v: v * 3.0 - 1.0)
+    call2, rec2 = aot_compile(fn2, (x,), program="p", registry=registry,
+                              exec_cache=cache)
+    assert rec2 is not None and rec2.cache_hit
+    assert rec2.compile_time_saved_s and rec2.compile_time_saved_s > 0
+    assert rec2.cache_load_seconds and rec2.cache_load_seconds > 0
+    assert jnp.array_equal(call2(x), out1)
+    snap = registry.snapshot()
+    assert snap["xla_exec_cache_hits_total"]["value"] == 1.0
+    assert snap["xla_exec_cache_misses_total"]["value"] == 1.0
+    assert snap["xla_exec_cache_saved_seconds_total"]["value"] > 0
+
+
+def test_aot_compile_with_statics_prunes_for_the_executable(tmp_path):
+    # jit statics are burned into the program: the AOT wrapper must call
+    # the executable with dynamic args only, NOT fall back to the jit
+    # cache (the fallback would silently re-compile every program and the
+    # warm-start contract would be a lie)
+    cache = make_cache(tmp_path)
+    x = jnp.arange(8.0)
+    fn = jax.jit(lambda v, flavor: v + len(flavor), static_argnums=(1,))
+    call, rec = aot_compile(fn, (x, "abc"), program="p", exec_cache=cache)
+    assert rec is not None
+    out = call(x, "abc")
+    assert jnp.array_equal(out, x + 3)
+    assert fn._cache_size() == 0  # the executable ran, not the jit cache
+
+
+def test_dispatcher_warm_then_dispatch_without_jit(tmp_path):
+    cache = make_cache(tmp_path)
+    fn = jax.jit(lambda v: v * 2.0)
+    disp = AotDispatcher(fn, program="p", exec_cache=cache)
+    x = jnp.arange(8.0)
+    disp.warm(x)
+    assert disp._cache_size() == 1
+    assert disp.fallback_compiles() == 0
+    out = disp(x)  # same signature: served by the resident executable
+    assert jnp.array_equal(out, x * 2.0)
+    assert disp.fallback_compiles() == 0
+    # an unwarmed signature falls back to the jit cache (counted)
+    y = jnp.arange(4.0)
+    assert jnp.array_equal(disp(y), y * 2.0)
+    assert disp.fallback_compiles() == 1
+    summary = disp.cache_summary()
+    assert summary["programs"] == 1
+    assert summary["exec_cache_misses"] == 1
+    assert summary["fallback_compiles"] == 1
+
+
+def test_default_cache_resolution(tmp_path, monkeypatch):
+    assert exec_mod.default_cache() is None
+    monkeypatch.setenv(exec_mod.ENV_DIR, str(tmp_path / "ambient"))
+    c1 = exec_mod.default_cache()
+    assert c1 is not None
+    assert exec_mod.default_cache() is c1  # memoized per path
+    explicit = make_cache(tmp_path)
+    exec_mod.set_default_cache(explicit)  # explicit beats environment
+    assert exec_mod.default_cache() is explicit
+    exec_mod.set_default_cache(None)  # clearing re-enables env resolution
+    assert exec_mod.default_cache() is not None
+    monkeypatch.delenv(exec_mod.ENV_DIR)
+    assert exec_mod.default_cache() is None
+
+
+# ---------------------------------------------------------------------------
+# GC safety + stats split
+# ---------------------------------------------------------------------------
+
+def make_cas(tmp_path):
+    inner = SharedFSStorageManager(str(tmp_path / "store"))
+    mgr = CASStorageManager(inner, chunk_size=1024,
+                            pool=TransferPool(workers=0))
+    return mgr, inner
+
+
+def write_payload(src, blob):
+    os.makedirs(src, exist_ok=True)
+    with open(os.path.join(src, "weights.bin"), "wb") as f:
+        f.write(blob)
+
+
+def exec_rels(inner):
+    return {r for r in inner.list_files("cas")
+            if r.startswith((EXEC_BLOB_PREFIX + "/",
+                             EXEC_INDEX_PREFIX + "/"))}
+
+
+def test_chunk_gc_never_sweeps_exec_entries(tmp_path):
+    mgr, inner = make_cas(tmp_path)
+    _, compiled, x = compile_one()
+    ec = mgr.exec_cache()
+    assert ec.store(ec.key_for("ab" * 32), compiled, program="p")
+    before = exec_rels(inner)
+    assert len(before) == 2  # one blob + one index entry
+
+    src = str(tmp_path / "src")
+    write_payload(src, os.urandom(3 * 1024))
+    mgr.upload(src, "ck-1")
+    write_payload(src, os.urandom(3 * 1024))
+    mgr.upload(src, "ck-2")
+    # ref-count GC runs on every delete; exec entries are structurally
+    # outside the chunk namespace it walks
+    mgr.delete("ck-2")
+    assert exec_rels(inner) == before
+    mgr.delete("ck-1")  # last checkpoint gone: chunks empty, exec intact
+    assert exec_rels(inner) == before
+    assert ec.load(ec.key_for("ab" * 32)) is not None
+
+
+def test_uncommitted_sweep_skips_the_cas_namespace(tmp_path, monkeypatch):
+    from determined_clone_tpu.exec.gc_checkpoints import sweep_uncommitted
+
+    mgr, inner = make_cas(tmp_path)
+    _, compiled, x = compile_one()
+    ec = mgr.exec_cache()
+    assert ec.store(ec.key_for("ab" * 32), compiled, program="p")
+    before = exec_rels(inner)
+    # age floor 0: everything uncommitted is sweepable — including the
+    # "cas" storage_id (never committed, no COMMIT marker) if the sweep
+    # failed to skip it
+    monkeypatch.setenv("DCT_GC_UNCOMMITTED_AGE_S", "0")
+    swept = sweep_uncommitted(inner)
+    assert swept == 0
+    assert exec_rels(inner) == before
+
+
+def test_storage_stats_splits_namespaces(tmp_path):
+    mgr, inner = make_cas(tmp_path)
+    src = str(tmp_path / "src")
+    write_payload(src, os.urandom(4 * 1024))
+    mgr.upload(src, "ck-1")
+    _, compiled, x = compile_one()
+    ec = mgr.exec_cache()
+    assert ec.store(ec.key_for("ab" * 32), compiled, program="p")
+
+    stats = mgr.storage_stats()
+    ns = stats["namespaces"]
+    assert ns["chunks"]["objects"] == 4
+    assert ns["chunks"]["bytes"] == 4 * 1024
+    assert ns["exec"]["executables"] == 1
+    assert ns["exec"]["objects"] == 1          # content blobs
+    assert ns["exec"]["bytes"] > 0
+    # the top-level chunk accounting ignores exec blobs entirely
+    assert stats["chunk_count"] == 4
+    assert stats["chunk_bytes"] == 4 * 1024
+
+
+def test_exec_cache_stats_by_program(tmp_path):
+    cache = make_cache(tmp_path)
+    _, compiled, x = compile_one()
+    assert cache.store(cache.key_for("ab" * 32), compiled,
+                       program="serving_forward", compile_seconds=2.0)
+    assert cache.store(cache.key_for("cd" * 32), compiled,
+                       program="serving_forward", compile_seconds=3.0)
+    assert cache.store(cache.key_for("ef" * 32), compiled,
+                       program="train_step", compile_seconds=5.0)
+    assert cache.load(cache.key_for("ab" * 32)) is not None
+    assert cache.load(cache.key_for("11" * 32)) is None
+
+    stats = cache.stats()
+    assert stats["entries"] == 3
+    assert stats["blob_count"] >= 1  # identical executables dedup
+    assert stats["hit_rate"] == 0.5
+    fwd = stats["by_program"]["serving_forward"]
+    assert fwd["entries"] == 2 and fwd["compile_seconds"] == 5.0
+    assert stats["by_program"]["train_step"]["entries"] == 1
+    assert stats["session"]["stores"] == 3
+
+
+# ---------------------------------------------------------------------------
+# warm-start contract
+# ---------------------------------------------------------------------------
+
+def test_warm_restart_loads_full_ladder_in_process(tmp_path):
+    """Two warmstart legs in one process: the second builds every
+    entry point fresh (new jit wrappers, empty jit caches) and must load
+    the whole ladder — zero compiles, zero fallbacks, identical greedy
+    output, goodput compile collapsed to the deserialize residual."""
+    from determined_clone_tpu.serving import warmstart
+
+    d = str(tmp_path / "exec-cache")
+    leg1 = warmstart.run(d)
+    assert leg1["programs_compiled"] == leg1["program_budget"]
+    assert leg1["exec_cache"]["exec_cache_misses"] == \
+        leg1["program_budget"]
+    assert leg1["exec_cache"]["exec_cache_hits"] == 0
+
+    jax.clear_caches()  # drop tracing caches too: a true cold process
+    leg2 = warmstart.run(d)
+    assert leg2["programs_compiled"] == leg2["program_budget"]
+    assert leg2["exec_cache"]["exec_cache_hits"] == leg2["program_budget"]
+    assert leg2["exec_cache"]["exec_cache_misses"] == 0
+    assert leg2["exec_cache"]["fallback_compiles"] == 0
+    assert leg2["exec_cache"]["compile_time_saved_s"] > 0
+    assert leg2["tokens"] == leg1["tokens"]
+    assert leg2["goodput_compile_s"] < leg1["goodput_compile_s"]
+    assert leg2["exec_cache_metrics"][
+        "xla_exec_cache_hits_total"] == leg2["program_budget"]
+
+
+def run_warmstart_subprocess(cache_dir):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DCT_FAULT_PLAN", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "determined_clone_tpu.serving.warmstart",
+         "--exec-cache-dir", cache_dir],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+        timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout)
+
+
+def test_warm_start_subprocess_zero_recompiles(tmp_path):
+    """The tentpole's acceptance pin: a genuinely separate second
+    process compiles nothing — every ladder program loads from the
+    persistent cache — and its greedy decode is bit-identical."""
+    d = str(tmp_path / "exec-cache")
+    leg1 = run_warmstart_subprocess(d)
+    assert leg1["exec_cache"]["exec_cache_misses"] == \
+        leg1["program_budget"]
+
+    leg2 = run_warmstart_subprocess(d)
+    assert leg2["exec_cache"]["exec_cache_hits"] == leg2["program_budget"]
+    assert leg2["exec_cache"]["exec_cache_misses"] == 0
+    assert leg2["exec_cache"]["fallback_compiles"] == 0  # jit-cache probe
+    assert leg2["programs_compiled"] == leg2["program_budget"]
+    assert leg2["tokens"] == leg1["tokens"]
+    # the goodput compile category collapses on the warm leg
+    assert leg2["goodput_compile_s"] < leg1["goodput_compile_s"]
+    assert leg2["warmup_s"] < leg1["warmup_s"]

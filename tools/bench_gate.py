@@ -81,7 +81,7 @@ def newest_rounds(directory: str = ".") -> Tuple[str, str]:
 # headline throughput/mfu checks below are the contract.
 OPTIONAL_SECTIONS = ("control_plane", "checkpoint_io", "pipeline",
                      "mnist_cnn", "tpu_probe_telemetry", "xla", "goodput",
-                     "serving", "serving_fleet", "multichip")
+                     "serving", "serving_fleet", "exec_cache", "multichip")
 
 
 def _section_notes(old_detail: Dict[str, Any], new_detail: Dict[str, Any],
@@ -421,6 +421,58 @@ def _serving_fleet_lines(old_detail: Dict[str, Any],
                 f"{ro.get('rollout_duration_s')}s")
 
 
+def _exec_cache_lines(old_detail: Dict[str, Any],
+                      new_detail: Dict[str, Any], report: list) -> None:
+    """Advisory executable-cache reporting (storage/exec_cache.py via
+    bench's cold/warm replica-start A/B): WARNs when the section
+    errored, when the warm leg hit rate is zero (every program
+    recompiled — the persistent cache did nothing), when any warm
+    program fell back to a plain compile, when the warm leg's greedy
+    tokens diverged from the cold leg's (a deserialized executable must
+    be the same program, so the same bits), or when the warm replica
+    start regressed more than 2x against the previous round. Advisory
+    only: wall-times share the box with the bench; the enforced
+    contracts are the tier-1 exec-cache tests."""
+    ec_new = new_detail.get("exec_cache")
+    if not isinstance(ec_new, dict):
+        return
+    if ec_new.get("error"):
+        report.append(f"WARN: exec_cache errored: {ec_new['error']}")
+        return
+    report.append(
+        f"ok: exec_cache cold {ec_new.get('cold_replica_start_s')}s → warm "
+        f"{ec_new.get('warm_replica_start_s')}s "
+        f"({ec_new.get('speedup')}x), {ec_new.get('exec_cache_hits')} hits/"
+        f"{ec_new.get('exec_cache_misses')} misses, saved "
+        f"{ec_new.get('compile_time_saved_s')}s of compile")
+    rate = ec_new.get("warm_hit_rate")
+    if isinstance(rate, (int, float)) and rate <= 0:
+        report.append(
+            "WARN: exec_cache warm leg hit rate is 0 — every program "
+            "recompiled; the persistent cache is not being consulted")
+    fallbacks = ec_new.get("fallback_compiles")
+    if isinstance(fallbacks, (int, float)) and fallbacks > 0:
+        report.append(
+            f"WARN: exec_cache warm leg fell back to plain compile "
+            f"{fallbacks} time(s) — a cached executable failed to "
+            f"load or dispatch")
+    if ec_new.get("tokens_match") is False:
+        report.append(
+            "WARN: exec_cache warm-leg greedy tokens diverged from the "
+            "cold leg — a deserialized executable produced different bits")
+    ec_old = old_detail.get("exec_cache")
+    warm_new = ec_new.get("warm_replica_start_s")
+    warm_old = (ec_old.get("warm_replica_start_s")
+                if isinstance(ec_old, dict) else None)
+    if (isinstance(warm_old, (int, float)) and warm_old > 0
+            and isinstance(warm_new, (int, float))
+            and warm_new > 2.0 * warm_old):
+        report.append(
+            f"WARN: exec_cache warm replica start regressed "
+            f"{warm_old}s → {warm_new}s (>2x) — deserialization or "
+            f"blob-store reads got slower")
+
+
 def _multichip_lines(old_detail: Dict[str, Any],
                      new_detail: Dict[str, Any], report: list) -> bool:
     """Multichip scaling-lane gate (parallel/scaling_bench.py via bench's
@@ -548,6 +600,7 @@ def gate(old: Dict[str, Any], new: Dict[str, Any], *,
     _goodput_lines(old_detail, new_detail, report)
     _serving_lines(old_detail, new_detail, report)
     _serving_fleet_lines(old_detail, new_detail, report)
+    _exec_cache_lines(old_detail, new_detail, report)
     ok = _multichip_lines(old_detail, new_detail, report) and ok
     return ok, report
 
