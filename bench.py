@@ -1014,6 +1014,91 @@ def _run_child() -> None:
             runs[key] = artifact
         return {"runs": runs}
 
+    def time_tsdb() -> dict:
+        """Scrape+store overhead of the embedded time-series layer
+        (telemetry/tsdb.py): a synthetic aggregator shaped like a busy
+        cluster — 8 trials' worth of counter/gauge families plus 4
+        serving replicas — is scraped repeatedly into a TSDB with the
+        stock SLO burn rules evaluating each tick. The number the gate
+        reads is duty_fraction: scrape+evaluate wall time over the 5 s
+        scrape period, advisory-bounded at 2% so the loop can never
+        crowd the master it observes."""
+        from determined_clone_tpu.telemetry.aggregate import (
+            ClusterMetricsAggregator,
+        )
+        from determined_clone_tpu.telemetry.metrics import MetricsRegistry
+        from determined_clone_tpu.telemetry.rules import (
+            RuleEngine,
+            stock_slo_rules,
+        )
+        from determined_clone_tpu.telemetry.tsdb import TimeSeriesDB
+
+        sim = {"t": 1_000_000.0}
+
+        def clock() -> float:
+            return sim["t"]
+
+        agg = ClusterMetricsAggregator(clock=clock)
+        tsdb = TimeSeriesDB(clock=clock)
+        engine = RuleEngine(stock_slo_rules(), clock=clock)
+        registry = MetricsRegistry()
+
+        def feed(tick: int) -> None:
+            for r in range(4):
+                agg.ingest_prometheus_text(
+                    f"serving_replica_r{r}",
+                    "# TYPE serving_queue_depth gauge\n"
+                    f"serving_queue_depth {tick % 7}\n"
+                    "# TYPE serving_tokens_per_sec gauge\n"
+                    f"serving_tokens_per_sec {90 + r}\n"
+                    "# TYPE serving_tokens_total counter\n"
+                    f"serving_tokens_total {1000 * r + 50 * tick}\n"
+                    "# TYPE serving_requests_completed_total counter\n"
+                    f"serving_requests_completed_total {10 * tick}\n")
+            for n in range(8):
+                lines = [f"# TYPE bench_worker_gauge_{g} gauge\n"
+                         f"bench_worker_gauge_{g} {g + tick}\n"
+                         for g in range(8)]
+                lines += [f"# TYPE bench_worker_steps_{c}_total counter\n"
+                          f"bench_worker_steps_{c}_total {c + 3 * tick}\n"
+                          for c in range(4)]
+                agg.ingest_prometheus_text(f"bench_worker_{n}",
+                                           "".join(lines))
+
+        ticks = 60
+        feed(0)
+        t0 = time.perf_counter()
+        for _ in range(ticks):
+            agg.dump()
+        dump_s = (time.perf_counter() - t0) / ticks
+        scrape_s = 0.0
+        for tick in range(1, ticks + 1):
+            feed(tick)
+            sim["t"] += 5.0
+            t0 = time.perf_counter()
+            tsdb.scrape(agg)
+            engine.evaluate(tsdb)
+            engine.publish(registry)
+            scrape_s += time.perf_counter() - t0
+        scrape_s /= ticks
+        stats = tsdb.stats()
+        period_s = 5.0
+        return {
+            "series": stats["series"],
+            "samples_per_scrape": round(
+                stats["samples_stored_total"] / max(1,
+                                                    stats["scrapes_total"])),
+            "dump_ms": round(dump_s * 1e3, 3),
+            "scrape_ms": round(scrape_s * 1e3, 3),
+            "scrape_period_s": period_s,
+            # the fraction of the scrape period the loop spends working;
+            # the gate's advisory bar is 2%
+            "duty_fraction": round(scrape_s / period_s, 6),
+            "bytes_estimate": stats["bytes_estimate"],
+            "memory_budget_bytes": stats["memory_budget_bytes"],
+            "within_budget": stats["within_budget"],
+        }
+
     def gpt_cfg(n_layers: int, d_model: int, n_heads: int, seq: int,
                 attention_impl: str, vocab: int = 50304,
                 remat: bool = True) -> gpt.GPTConfig:
@@ -1067,6 +1152,7 @@ def _run_child() -> None:
     serving_fleet_section = None
     exec_cache_section = None
     multichip_section = None
+    tsdb_section = None
     if not on_tpu:
         # cheap on CPU, and computing it before the ladder means the very
         # first banked result line already carries a non-null
@@ -1097,6 +1183,12 @@ def _run_child() -> None:
             exec_cache_section = time_exec_cache()
         except Exception as exc:  # noqa: BLE001
             exec_cache_section = {"error": repr(exc)[:200]}
+        # host-only and cheap (~1 s): the scrape/store duty cycle of the
+        # time-series layer, pre-ladder so the first banked line has it
+        try:
+            tsdb_section = time_tsdb()
+        except Exception as exc:  # noqa: BLE001
+            tsdb_section = {"error": repr(exc)[:200]}
     for i, rung in enumerate(ladder):
         if remaining() < rung["min_s"]:
             _emit({"skipped_rung": rung["name"],
@@ -1213,6 +1305,9 @@ def _run_child() -> None:
                     # per-axis efficiency, measured-vs-analytic MFU, and
                     # collective structure on 8/16-device simulated meshes
                     "multichip": multichip_section,
+                    # time-series layer duty cycle: scrape+store+rule
+                    # evaluation wall time over the 5 s scrape period
+                    "tsdb": tsdb_section,
                     "init_s": round(t_init, 1),
                 },
             }
@@ -1276,6 +1371,12 @@ def _run_child() -> None:
                 exec_cache_section = time_exec_cache()
             except Exception as exc:  # noqa: BLE001
                 exec_cache_section = {"error": repr(exc)[:200]}
+        if tsdb_section is None and remaining() > 10:
+            # TPU lane: host-only, ~1 s; rides in any leftover budget
+            try:
+                tsdb_section = time_tsdb()
+            except Exception as exc:  # noqa: BLE001
+                tsdb_section = {"error": repr(exc)[:200]}
         if multichip_section is None and remaining() > 100:
             # post-bank on BOTH lanes: the two scaling-bench subprocesses
             # run concurrently (~75 s on this box) and never delay the

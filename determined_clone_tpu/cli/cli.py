@@ -55,6 +55,36 @@ def make_session(args: argparse.Namespace) -> MasterSession:
     return session
 
 
+def fetch_cluster_view(args: argparse.Namespace, path: str, *,
+                       fold_fallback: bool = True):
+    """Shared master-fetch plumbing for the observability subcommands
+    (metrics, goodput, slo, query, alerts, top): ``GET path`` on the
+    configured master. With ``fold_fallback`` a 404 — a master (e.g.
+    the C++ one) that exposes ``/metrics`` but not this JSON route —
+    fetches the exposition text instead and folds it through a fresh
+    aggregator so the caller can re-derive its view. Returns
+    ``(session, payload, agg)``; exactly one of payload/agg is
+    non-None.
+    """
+    session = make_session(args)
+    try:
+        return session, session.get(path), None
+    except MasterError as e:
+        if e.status != 404 or not fold_fallback:
+            raise
+        from determined_clone_tpu.telemetry.aggregate import (
+            ClusterMetricsAggregator,
+        )
+        import urllib.request
+
+        url = f"http://{session.host}:{session.port}/metrics"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            text = resp.read().decode("utf-8")
+        agg = ClusterMetricsAggregator()
+        agg.ingest_prometheus_text("master", text)
+        return session, None, agg
+
+
 # ---------------------------------------------------------------------------
 # output helpers
 # ---------------------------------------------------------------------------
@@ -632,7 +662,8 @@ def cmd_slo(args) -> int:
                                     timeout=10) as resp:
             payload = json.loads(resp.read().decode("utf-8"))
     else:
-        payload = make_session(args).get("/api/v1/cluster/slo")
+        _, payload, _ = fetch_cluster_view(args, "/api/v1/cluster/slo",
+                                           fold_fallback=False)
     evaluation = payload.get("slo")
     if evaluation is None:
         print("no SLO engine attached (serving fleets attach one when "
@@ -643,6 +674,196 @@ def cmd_slo(args) -> int:
     else:
         print(format_slo(evaluation))
     return 0
+
+
+def _timeseries_path(name: Optional[str], *, labels: Optional[str] = None,
+                     window: float = 300.0, reduce: str = "raw",
+                     q: float = 0.95) -> str:
+    """Build the ``/api/v1/timeseries`` request path for one query."""
+    from urllib.parse import urlencode
+
+    if not name:
+        return "/api/v1/timeseries"
+    params = {"name": name, "window": f"{window:g}", "reduce": reduce,
+              "q": f"{q:g}"}
+    if labels:
+        params["labels"] = labels
+    return "/api/v1/timeseries?" + urlencode(params)
+
+
+def _format_series_labels(labels: Dict[str, Any]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def cmd_query(args) -> int:
+    """Windowed reductions over the master's embedded TSDB
+    (docs/observability.md "Time series, queries & alert rules").
+    Without a series name, lists what the TSDB holds; with one, runs
+    ``GET /api/v1/timeseries`` and prints per-series reductions
+    (``--reduce rate`` over a counter gives per-second throughput the
+    aggregator's latest-wins gauges cannot)."""
+    path = _timeseries_path(args.name, labels=args.labels,
+                            window=args.window, reduce=args.reduce,
+                            q=args.q)
+    _, payload, _ = fetch_cluster_view(args, path, fold_fallback=False)
+    if args.json:
+        print(json.dumps(payload, indent=2, default=str))
+        return 0
+    if not args.name:
+        stats = payload.get("stats") or {}
+        budget = stats.get("memory_budget_bytes") or 0
+        print(f"{stats.get('series', 0)} series, "
+              f"{stats.get('samples', 0)} samples, "
+              f"{stats.get('bytes_estimate', 0) / 1024.0:.0f} KiB of "
+              f"{budget / 1024.0:.0f} KiB budget "
+              f"({stats.get('scrapes_total', 0)} scrapes)")
+        for name in payload.get("series") or []:
+            print(f"  {name}")
+        return 0
+    series = payload.get("series") or []
+    if not series:
+        print(f"no series named {args.name!r} in the window",
+              file=sys.stderr)
+        return 1
+    for s in series:
+        label_s = _format_series_labels(s.get("labels") or {})
+        head = (f"{args.name}{label_s} [{s.get('kind', 'gauge')}] "
+                f"{args.reduce} over {args.window:g}s")
+        if args.reduce == "raw":
+            print(f"{head}: {s.get('n', 0)} samples")
+            for t, v in s.get("samples") or []:
+                print(f"  {t:.3f} {v:g}")
+        else:
+            v = s.get("value")
+            v_s = f"{v:g}" if v is not None else "n/a (need ≥2 samples)"
+            print(f"{head}: {v_s}")
+    return 0
+
+
+def cmd_alerts(args) -> int:
+    """Alert-rule readout (docs/observability.md "Time series, queries
+    & alert rules"): every configured rule with its state machine
+    position (inactive/pending/firing/resolved), measured value, and
+    hold-down. Reads the master's ``GET /api/v1/alerts``."""
+    from determined_clone_tpu.telemetry.rules import format_alerts
+
+    _, payload, _ = fetch_cluster_view(args, "/api/v1/alerts",
+                                       fold_fallback=False)
+    if args.json:
+        print(json.dumps(payload, indent=2, default=str))
+    else:
+        print(format_alerts(payload))
+    return 0
+
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: List[float], width: int = 32) -> str:
+    vals = [v for v in values if v == v][-width:]
+    if not vals:
+        return "(no data)"
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK_BLOCKS[0] * len(vals)
+    top = len(_SPARK_BLOCKS) - 1
+    return "".join(_SPARK_BLOCKS[int((v - lo) / span * top)]
+                   for v in vals)
+
+
+def _top_frame(args, session) -> str:
+    """One rendering of the ``dct top`` dashboard, built entirely from
+    the master's query API so it shows exactly what the TSDB stored."""
+    def query(name: str, reduce: str = "last",
+              labels: Optional[str] = None) -> List[Dict[str, Any]]:
+        path = _timeseries_path(name, labels=labels, window=args.window,
+                                reduce=reduce)
+        try:
+            return session.get(path).get("series") or []
+        except MasterError:
+            return []
+
+    def one(name: str, reduce: str = "last",
+            labels: Optional[str] = None) -> Optional[float]:
+        for s in query(name, reduce, labels):
+            if s.get("value") is not None:
+                return float(s["value"])
+        return None
+
+    def fmt(v: Optional[float], spec: str = "g") -> str:
+        return format(v, spec) if v is not None else "n/a"
+
+    def fmt_s(v: Optional[float]) -> str:
+        return f"{v:.3f}s" if v is not None else "n/a"
+
+    lines = [f"dct top — window {args.window:g}s"]
+    replicas = one("dct_fleet_replicas")
+    tps_now = one("dct_fleet_tokens_per_sec")
+    lines.append(f"fleet: {fmt(replicas, '.0f')} replicas, "
+                 f"{fmt(tps_now, '.1f')} tokens/s, "
+                 f"queue {fmt(one('dct_fleet_queue_depth'), '.0f')}, "
+                 f"p99 {fmt_s(one('dct_fleet_max_replica_p99_seconds'))}")
+    tps_series = query("dct_fleet_tokens_per_sec", reduce="raw")
+    tps_points = [v for s in tps_series
+                  for _, v in s.get("samples") or []]
+    lines.append(f"tokens/s  {_sparkline(tps_points)}")
+    goodput = one("dct_goodput_cluster_fraction")
+    hit = one("dct_exec_cache_hit_rate")
+    lines.append(f"goodput {fmt(goodput, '.1%')}   "
+                 f"exec-cache hit {fmt(hit, '.1%')}")
+    queues = {(s.get("labels") or {}).get("component"): s.get("value")
+              for s in query("serving_queue_depth")
+              if (s.get("labels") or {}).get("component")}
+    p99s = {(s.get("labels") or {}).get("component"): s.get("value")
+            for s in query("serving_request_total_seconds",
+                           labels="quantile=0.99")
+            if (s.get("labels") or {}).get("component")}
+    if queues or p99s:
+        lines.append("replicas:")
+        for comp in sorted(set(queues) | set(p99s)):
+            lines.append(f"  {comp:<24} queue {fmt(queues.get(comp), '.0f'):>5}"
+                         f"   p99 {fmt_s(p99s.get(comp))}")
+    try:
+        alerts = session.get("/api/v1/alerts")
+    except MasterError:
+        alerts = None
+    if alerts is not None:
+        firing = alerts.get("firing") or []
+        if firing:
+            lines.append(f"ALERTS FIRING: {', '.join(firing)}")
+        else:
+            lines.append(f"alerts: {len(alerts.get('rules') or [])} rules, "
+                         "none firing")
+    return "\n".join(lines) + "\n"
+
+
+def cmd_top(args) -> int:
+    """Live terminal dashboard over the master's time-series query API
+    (docs/observability.md "Time series, queries & alert rules"):
+    fleet throughput sparkline, per-replica queue/p99, goodput, exec
+    cache hit rate, firing alerts. ``--once`` prints a single frame
+    (tests and scripts); otherwise redraws every ``--interval``
+    seconds until interrupted."""
+    import time as _time
+
+    session, _, _ = fetch_cluster_view(args, "/api/v1/timeseries",
+                                       fold_fallback=False)
+    if args.once:
+        sys.stdout.write(_top_frame(args, session))
+        return 0
+    try:
+        while True:
+            frame = _top_frame(args, session)
+            sys.stdout.write("\x1b[2J\x1b[H" + frame)
+            sys.stdout.flush()
+            _time.sleep(max(0.2, args.interval))
+    except KeyboardInterrupt:
+        print()
+        return 0
 
 
 def cmd_debug_flight(args) -> int:
@@ -693,10 +914,7 @@ def cmd_metrics(args) -> int:
     """Cluster-wide metrics view (`GET /metrics` + the master's summary
     endpoint): top trials by throughput, cluster quantiles, restart/
     fallback/retry counters — docs/observability.md."""
-    from determined_clone_tpu.telemetry.aggregate import (
-        ClusterMetricsAggregator,
-        format_summary,
-    )
+    from determined_clone_tpu.telemetry.aggregate import format_summary
 
     if args.raw:
         master = args.master or os.environ.get("DCT_MASTER",
@@ -707,22 +925,12 @@ def cmd_metrics(args) -> int:
         with urllib.request.urlopen(url, timeout=10) as resp:
             sys.stdout.write(resp.read().decode("utf-8"))
         return 0
-    session = make_session(args)
-    try:
-        summary = session.get("/api/v1/cluster/metrics")
-    except MasterError as e:
-        if e.status != 404:
-            raise
-        # C++ masters have /metrics but no JSON summary route: fold the
-        # exposition text through the aggregator so the scheduler's
-        # dct_master_sched_* families land in the same summary view
-        import urllib.request
-
-        url = f"http://{session.host}:{session.port}/metrics"
-        with urllib.request.urlopen(url, timeout=10) as resp:
-            text = resp.read().decode("utf-8")
-        agg = ClusterMetricsAggregator()
-        agg.ingest_prometheus_text("master", text)
+    session, summary, agg = fetch_cluster_view(args,
+                                               "/api/v1/cluster/metrics")
+    if agg is not None:
+        # C++ masters have /metrics but no JSON summary route: the
+        # folded exposition puts the scheduler's dct_master_sched_*
+        # families in the same summary view
         print(format_summary(agg.summary()))
         try:
             sched = session.get("/api/v1/cluster/scheduler")
@@ -766,24 +974,10 @@ def cmd_goodput(args) -> int:
             print(format_goodput(accounts))
         return 0
 
-    session = make_session(args)
-    try:
-        roll = session.get("/api/v1/cluster/goodput")
-    except MasterError as e:
-        if e.status != 404:
-            raise
-        # masters without the JSON route still expose the goodput_* gauge
-        # families in /metrics: fold the text back through the aggregator
-        from determined_clone_tpu.telemetry.aggregate import (
-            ClusterMetricsAggregator,
-        )
-        import urllib.request
-
-        url = f"http://{session.host}:{session.port}/metrics"
-        with urllib.request.urlopen(url, timeout=10) as resp:
-            text = resp.read().decode("utf-8")
-        agg = ClusterMetricsAggregator()
-        agg.ingest_prometheus_text("master", text)
+    # masters without the JSON route still expose the goodput_* gauge
+    # families in /metrics: the folded text re-derives the rollup
+    _, roll, agg = fetch_cluster_view(args, "/api/v1/cluster/goodput")
+    if agg is not None:
         roll = agg.goodput_rollup()
     by_trial = roll.get("by_trial") or {}
     if args.experiment is not None:
@@ -1862,6 +2056,48 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--json", action="store_true",
                    help="print the evaluation as JSON")
     c.set_defaults(func=cmd_slo)
+
+    # query (windowed reductions over the master TSDB —
+    # docs/observability.md "Time series, queries & alert rules")
+    c = sub.add_parser("query",
+                       help="query the master's time-series store: "
+                            "rate/avg/max/quantile over a window")
+    c.add_argument("name", nargs="?", default=None,
+                   help="series name (omit to list stored series)")
+    c.add_argument("--labels", default=None, metavar="K=V[,K=V...]",
+                   help="label subset the series must match")
+    c.add_argument("--window", type=float, default=300.0, metavar="S",
+                   help="lookback window in seconds (default 300)")
+    c.add_argument("--reduce", default="raw",
+                   choices=["raw", "rate", "increase", "avg", "max",
+                            "min", "last", "quantile"],
+                   help="reduction over the window (default raw)")
+    c.add_argument("--q", type=float, default=0.95,
+                   help="quantile for --reduce quantile (default 0.95)")
+    c.add_argument("--json", action="store_true",
+                   help="print the query result as JSON")
+    c.set_defaults(func=cmd_query)
+
+    # alerts (declarative rule engine readout — docs/observability.md)
+    c = sub.add_parser("alerts",
+                       help="alert rules: firing/pending/resolved state "
+                            "per configured rule")
+    c.add_argument("--json", action="store_true",
+                   help="print the rule states as JSON")
+    c.set_defaults(func=cmd_alerts)
+
+    # top (live dashboard over the query API — docs/observability.md)
+    c = sub.add_parser("top",
+                       help="live cluster dashboard: throughput "
+                            "sparkline, per-replica queue/p99, goodput, "
+                            "firing alerts")
+    c.add_argument("--once", action="store_true",
+                   help="print one frame and exit (for scripts/tests)")
+    c.add_argument("--interval", type=float, default=2.0, metavar="S",
+                   help="redraw period in seconds (default 2)")
+    c.add_argument("--window", type=float, default=300.0, metavar="S",
+                   help="query lookback window in seconds (default 300)")
+    c.set_defaults(func=cmd_top)
 
     # mesh (collective accounting + straggler + scaling readout —
     # docs/parallelism.md)

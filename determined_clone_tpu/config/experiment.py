@@ -344,6 +344,16 @@ class ObservabilityConfig:
     anomaly_window: int = 64       # rolling baseline length
     anomaly_threshold: float = 5.0  # MAD multiples above median to fire
     anomaly_min_samples: int = 16  # warmup before the detector arms
+    # master-side time-series store (telemetry/tsdb.py); None = the
+    # TSDB is not enabled. Keys mirror TimeSeriesDB.from_dict.
+    timeseries: Optional[Dict[str, Any]] = None
+    # sources with no ingest for this long are flagged stale in
+    # `dct metrics` output and skipped by the TSDB scrape
+    stale_after_s: float = 60.0
+    # declarative alert rules (telemetry/rules.py AlertRule.from_dict)
+    rules: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    # install the two PR-13 burn-rate rules over dct_slo_burn_rate
+    stock_slo_rules: bool = False
 
     @staticmethod
     def from_dict(raw: Dict[str, Any]) -> "ObservabilityConfig":
@@ -362,6 +372,10 @@ class ObservabilityConfig:
             anomaly_window=int(raw.get("anomaly_window", 64)),
             anomaly_threshold=float(raw.get("anomaly_threshold", 5.0)),
             anomaly_min_samples=int(raw.get("anomaly_min_samples", 16)),
+            timeseries=raw.get("timeseries"),
+            stale_after_s=float(raw.get("stale_after_s", 60.0)),
+            rules=list(raw.get("rules") or []),
+            stock_slo_rules=bool(raw.get("stock_slo_rules", False)),
         )
         cfg.validate()
         return cfg
@@ -391,6 +405,26 @@ class ObservabilityConfig:
             raise ConfigError(
                 f"observability.anomaly_min_samples must be >= 2, "
                 f"got {self.anomaly_min_samples}")
+        if self.timeseries is not None and not isinstance(self.timeseries,
+                                                          dict):
+            raise ConfigError(
+                f"observability.timeseries must be a mapping, "
+                f"got {self.timeseries!r}")
+        if self.stale_after_s <= 0:
+            raise ConfigError(
+                f"observability.stale_after_s must be > 0, "
+                f"got {self.stale_after_s}")
+        # rule semantics (per-kind required fields, thresholds) live in
+        # telemetry/rules.py; validating here makes `dct experiment
+        # create` reject a bad rule instead of the master at scrape time
+        from determined_clone_tpu.telemetry.rules import AlertRule
+
+        for i, rule in enumerate(self.rules):
+            try:
+                AlertRule.from_dict(rule)
+            except (TypeError, ValueError) as e:
+                raise ConfigError(
+                    f"observability.rules[{i}]: {e}") from e
 
     def to_dict(self) -> Dict[str, Any]:
         return {k: v for k, v in dataclasses.asdict(self).items()

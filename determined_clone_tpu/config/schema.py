@@ -226,6 +226,66 @@ EXPERIMENT_SCHEMA = {
                 "anomaly_window": {"type": "integer"},
                 "anomaly_threshold": {"type": "number"},
                 "anomaly_min_samples": {"type": "integer"},
+                # master-side time-series store (telemetry/tsdb.py)
+                "timeseries": {
+                    "type": "object", "open": False,
+                    "properties": {
+                        "enabled": {"type": "boolean"},
+                        "scrape_period_s": {"type": "number"},
+                        "capacity_per_series": {"type": "integer"},
+                        "coarse_step_s": {"type": "number"},
+                        "coarse_capacity": {"type": "integer"},
+                        "memory_budget_mb": {"type": "number"},
+                        "max_series": {"type": "integer"},
+                        "persist_dir": {"type": "string"},
+                        "segment_scrapes": {"type": "integer"},
+                        "max_segments": {"type": "integer"},
+                    },
+                },
+                # sources with no ingest for this long are flagged
+                # stale in `dct metrics` / absence-rule evaluation
+                "stale_after_s": {"type": "number"},
+                # declarative alert rules (telemetry/rules.py); each
+                # item is validated in depth by AlertRule.from_dict
+                "rules": {
+                    "type": "array",
+                    "items": {
+                        "type": "object", "open": False,
+                        "properties": {
+                            "name": {"type": "string"},
+                            "kind": {"type": "string",
+                                     "enum": ["threshold",
+                                              "rate_of_change",
+                                              "burn_rate", "absence"]},
+                            "series": {"type": "string"},
+                            "labels": {"type": "object", "open": True},
+                            "window_s": {"type": "number"},
+                            "reduce": {"type": "string"},
+                            "op": {"type": "string",
+                                   "enum": ["gt", "ge", "lt", "le"]},
+                            "value": {"type": "number"},
+                            "for_s": {"type": "number"},
+                            "severity": {"type": "string",
+                                         "enum": ["page", "ticket"]},
+                            "stale_s": {"type": "number"},
+                            "windows": {
+                                "type": "array",
+                                "items": {"anyOf": [
+                                    {"type": "string"},
+                                    {"type": "number"},
+                                ]},
+                            },
+                            "threshold": {"type": "number"},
+                            "objective": {"type": "string"},
+                            "bad_series": {"type": "string"},
+                            "total_series": {"type": "string"},
+                        },
+                        "required": ["name", "kind"],
+                    },
+                },
+                # install the two PR-13 burn-rate rules over
+                # dct_slo_burn_rate (telemetry/rules.py stock_slo_rules)
+                "stock_slo_rules": {"type": "boolean"},
             },
         },
         # online inference via `dct serve` (continuous batching over a

@@ -57,4 +57,5 @@ from determined_clone_tpu.serving.autoscale import (  # noqa: F401
     AutoscalePolicy,
     Autoscaler,
     AutoscaleSignals,
+    TimeSeriesSignals,
 )

@@ -22,7 +22,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import threading
-from typing import Any, Optional
+from typing import Any, Callable, Optional, Sequence
 
 from determined_clone_tpu.telemetry import MetricsRegistry
 
@@ -57,6 +57,56 @@ class AutoscaleSignals:
     p99_s: float                   # worst replica p99 (NaN when no data)
 
 
+class TimeSeriesSignals:
+    """AutoscaleSignals read from the master TSDB instead of the
+    fleet's instantaneous stats (docs/observability.md "Time series,
+    queries & alert rules").
+
+    Instantaneous stats make the autoscaler react to whatever the
+    current tick happens to look like; the TSDB gives it *trends* —
+    queue depth averaged over ``window_s``, the worst p99 seen in the
+    window — and, optionally, alert-rule verdicts as overrides: while
+    any named ``congestion_rule`` fires, the signals read as congested
+    (p99 forced over any threshold) regardless of the raw numbers;
+    while an ``idle_rule`` fires (and nothing is congested), they read
+    as idle. Pass an instance as ``Autoscaler(signals_fn=...)``.
+    """
+
+    def __init__(self, tsdb: Any, *, window_s: float = 60.0,
+                 rules: Any = None,
+                 congestion_rules: Sequence[str] = (),
+                 idle_rules: Sequence[str] = ()) -> None:
+        self.tsdb = tsdb
+        self.window_s = float(window_s)
+        self.rules = rules
+        self.congestion_rules = set(congestion_rules)
+        self.idle_rules = set(idle_rules)
+
+    def _reduced(self, name: str, reduce: str,
+                 default: float) -> float:
+        res = self.tsdb.query(name, window_s=self.window_s,
+                              reduce=reduce)
+        vals = [s["value"] for s in res["series"]
+                if s.get("value") is not None
+                and s["value"] == s["value"]]
+        return vals[0] if vals else default
+
+    def __call__(self) -> AutoscaleSignals:
+        healthy = int(self._reduced("dct_fleet_replicas", "last", 1.0))
+        queue = self._reduced("dct_fleet_queue_depth", "avg", 0.0)
+        p99 = self._reduced("dct_fleet_max_replica_p99_seconds", "max",
+                            float("nan"))
+        if self.rules is not None:
+            firing = set(self.rules.firing())
+            if firing & self.congestion_rules:
+                p99 = float("inf")
+            elif firing & self.idle_rules:
+                queue, p99 = 0.0, 0.0
+        return AutoscaleSignals(healthy=max(1, healthy),
+                                queue_depth=int(round(queue)),
+                                p99_s=p99)
+
+
 class Autoscaler:
     """Deterministic grow/shrink decisions over a ServingFleet.
 
@@ -69,10 +119,15 @@ class Autoscaler:
 
     def __init__(self, fleet: Any, policy: AutoscalePolicy = AutoscalePolicy(),
                  *, registry: Optional[MetricsRegistry] = None,
-                 dry_run: bool = False) -> None:
+                 dry_run: bool = False,
+                 signals_fn: Optional[Callable[[], AutoscaleSignals]]
+                 = None) -> None:
         self.fleet = fleet
         self.policy = policy
         self.dry_run = bool(dry_run)
+        # alternative signal source (e.g. TimeSeriesSignals); None reads
+        # the fleet's instantaneous stats
+        self.signals_fn = signals_fn
         self.registry = (registry if registry is not None
                          else getattr(fleet, "registry", None)
                          or MetricsRegistry())
@@ -95,6 +150,8 @@ class Autoscaler:
     # -- the decision ------------------------------------------------------
 
     def _read_signals(self) -> AutoscaleSignals:
+        if self.signals_fn is not None:
+            return self.signals_fn()
         st = self.fleet.stats()
         return AutoscaleSignals(healthy=st.healthy,
                                 queue_depth=st.queue_depth,

@@ -73,6 +73,12 @@ from determined_clone_tpu.telemetry.metrics import (
     MetricsRegistry,
     parse_prometheus_text,
 )
+from determined_clone_tpu.telemetry.rules import (
+    AlertRule,
+    RuleEngine,
+    format_alerts,
+    stock_slo_rules,
+)
 from determined_clone_tpu.telemetry.slo import (
     SLOEngine,
     format_slo,
@@ -83,20 +89,26 @@ from determined_clone_tpu.telemetry.spans import (
     Tracer,
     null_span,
 )
+from determined_clone_tpu.telemetry.tsdb import (
+    TSDBScraper,
+    TimeSeriesDB,
+)
 
 __all__ = [
-    "CollectiveSummary", "Counter", "FlightRecorder",
+    "AlertRule", "CollectiveSummary", "Counter", "FlightRecorder",
     "GOODPUT_CATEGORIES", "Gauge", "GoodputJournal", "GoodputLedger",
     "Histogram", "MULTICHIP_SCHEMA_VERSION", "MeshStragglerDetector",
-    "MetricsRegistry", "NULL_SPAN", "RequestArchive", "SLOEngine", "Span",
-    "Telemetry", "Tracer", "check_conservation", "chrome_trace_events",
+    "MetricsRegistry", "NULL_SPAN", "RequestArchive", "RuleEngine",
+    "SLOEngine", "Span", "TSDBScraper", "Telemetry", "TimeSeriesDB",
+    "Tracer", "check_conservation", "chrome_trace_events",
     "comm_compute_fraction", "device_lane_records", "export_collectives",
-    "flight_summary", "flight_to_chrome_trace", "format_goodput",
+    "flight_summary", "flight_to_chrome_trace", "format_alerts",
+    "format_goodput",
     "format_multichip", "format_slo", "merge_goodput", "null_span", "parse_hlo_collectives",
     "parse_prometheus_text", "per_device_completion_seconds",
     "read_flight", "read_goodput", "read_request_archive",
     "request_archive_summary", "request_chrome_trace", "request_records",
-    "spans_from_profiler_samples", "stitch_chrome_trace",
+    "spans_from_profiler_samples", "stitch_chrome_trace", "stock_slo_rules",
     "telemetry_from_config", "to_chrome_trace", "validate_chrome_trace",
     "validate_multichip", "write_chrome_trace",
 ]

@@ -32,7 +32,7 @@ import logging
 import statistics
 import threading
 import time
-from typing import Any, Deque, Dict, List, Optional, Tuple
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 from determined_clone_tpu.telemetry.metrics import (
     MetricsRegistry,
@@ -54,6 +54,11 @@ SPANS_PER_TRIAL_MAX = 20_000
 
 _KNOWN_GROUPS = ("telemetry", "span", "timing", "system")
 
+# a source (trial or component) whose last ingest is older than this is
+# flagged stale in `dct metrics` — its latest-wins gauges would otherwise
+# render as frozen-healthy forever
+STALE_SOURCE_AFTER_SEC = 60.0
+
 
 def _fmt(v: Any) -> str:
     f = float(v)
@@ -61,13 +66,16 @@ def _fmt(v: Any) -> str:
 
 
 class _TrialState:
-    __slots__ = ("snapshot", "batches_trained", "last_time", "spans",
-                 "experiment_id")
+    __slots__ = ("snapshot", "batches_trained", "last_time", "last_ingest",
+                 "spans", "experiment_id")
 
     def __init__(self) -> None:
         self.snapshot: Dict[str, Dict[str, Any]] = {}
         self.batches_trained: Optional[int] = None
         self.last_time: float = 0.0
+        # master-clock stamp of the last ingest for this trial; the
+        # sample's own `time` field is the trial's claim, this is ours
+        self.last_ingest: Optional[float] = None
         self.spans: Deque[Dict[str, Any]] = collections.deque(
             maxlen=SPANS_PER_TRIAL_MAX)
         self.experiment_id: Optional[int] = None
@@ -76,11 +84,13 @@ class _TrialState:
 class ClusterMetricsAggregator:
     """Ingests trial/component telemetry into one cluster-level view."""
 
-    def __init__(self) -> None:
+    def __init__(self, *, clock: Callable[[], float] = time.time) -> None:
+        self._clock = clock
         self._lock = threading.Lock()
         self._trials: Dict[int, _TrialState] = {}
         # non-trial components (runner, master) keyed by component name
         self._components: Dict[str, Dict[str, Dict[str, Any]]] = {}
+        self._component_ingest: Dict[str, float] = {}
         self._component_spans: Dict[
             str, Deque[Tuple[Optional[int], Dict[str, Any]]]] = {}
         self._seen_keys: "collections.OrderedDict[str, None]" = (
@@ -189,6 +199,7 @@ class ClusterMetricsAggregator:
             if experiment_id is not None:
                 st.experiment_id = int(experiment_id)
             st.last_time = float(sample.get("time") or time.time())
+            st.last_ingest = self._clock()
             group = sample.get("group")
             if group == "telemetry":
                 metrics = sample.get("metrics")
@@ -221,6 +232,7 @@ class ClusterMetricsAggregator:
             return
         with self._lock:
             self._components[str(component)] = snap
+            self._component_ingest[str(component)] = self._clock()
 
     def ingest_prometheus_text(self, component: str, text: str) -> int:
         """Fold a component's raw Prometheus exposition (e.g. the C++
@@ -288,9 +300,41 @@ class ClusterMetricsAggregator:
                     continue
                 dq.append((experiment_id, dict(rec)))
                 accepted += 1
+            if accepted:  # spans count as liveness too
+                self._component_ingest[str(component)] = self._clock()
         return accepted
 
     # -- views -------------------------------------------------------------
+
+    def source_ingest_times(self) -> Dict[str, float]:
+        """Master-clock stamp of the last ingest per source (``trial_<id>``
+        / component name). The TSDB scrape diffs these against its
+        previous tick so it never re-stores a snapshot whose source went
+        quiet — a latest-wins gauge that nobody re-sent is not a new
+        observation."""
+        with self._lock:
+            out = {f"trial_{tid}": st.last_ingest
+                   for tid, st in self._trials.items()
+                   if st.last_ingest is not None}
+            out.update(self._component_ingest)
+        return out
+
+    def source_ages(self, now: Optional[float] = None) -> Dict[str, float]:
+        """Seconds since each source last ingested anything."""
+        now = self._clock() if now is None else float(now)
+        return {src: max(0.0, now - ts)
+                for src, ts in self.source_ingest_times().items()}
+
+    def _staleness_lines(self) -> List[str]:
+        ages = self.source_ages()
+        if not ages:
+            return []
+        lines = ["# TYPE dct_master_source_age_seconds gauge"]
+        for src in sorted(ages):
+            lines.append(
+                f"dct_master_source_age_seconds"
+                f"{_label_str({'source': src})} {_fmt(round(ages[src], 3))}")
+        return lines
 
     def trial_ids(self) -> List[int]:
         with self._lock:
@@ -368,6 +412,7 @@ class ClusterMetricsAggregator:
         lines.extend(self._serving_fleet_lines(fams))
         lines.extend(self._mesh_lines(fams))
         lines.extend(self._exec_cache_lines(fams))
+        lines.extend(self._staleness_lines())
         text = "\n".join(ln for ln in lines if ln)
         return text + ("\n" if text else "")
 
@@ -714,7 +759,9 @@ class ClusterMetricsAggregator:
 
     # -- CLI summary -------------------------------------------------------
 
-    def summary(self, top_n: int = 10) -> Dict[str, Any]:
+    def summary(self, top_n: int = 10, *,
+                stale_after_s: float = STALE_SOURCE_AFTER_SEC
+                ) -> Dict[str, Any]:
         """Structured cluster summary for ``dct metrics``."""
         fams = self._families()
 
@@ -791,8 +838,14 @@ class ClusterMetricsAggregator:
                 m.value for m in self.registry.metrics()
                 if m.name == "dct_master_ingest_rejected_total"),
         }
+        ages = self.source_ages()
+        stale = {src: round(age, 1) for src, age in sorted(ages.items())
+                 if age > stale_after_s}
         return {
             "trials": n_trials,
+            "sources": {"reporting": len(ages),
+                        "stale_after_s": stale_after_s,
+                        "stale": stale},
             "top_trials_by_throughput": top,
             "throughput_total": sum(throughput.values()),
             "mfu_by_trial": mfu,
@@ -815,6 +868,14 @@ def format_summary(summary: Dict[str, Any]) -> str:
     out.append(f"trials reporting: {summary['trials']}   "
                f"cluster throughput: "
                f"{summary['throughput_total']:.2f} samples/sec")
+    sources = summary.get("sources") or {}
+    if sources.get("stale"):
+        cutoff = sources.get("stale_after_s", STALE_SOURCE_AFTER_SEC)
+        out.append(
+            f"STALE sources (no ingest in {cutoff:g}s — latest-wins "
+            f"gauges below may be frozen): " + ", ".join(
+                f"{src} ({age:.0f}s)"
+                for src, age in sources["stale"].items()))
     if summary["top_trials_by_throughput"]:
         out.append("top trials by throughput:")
         for tid, sps in summary["top_trials_by_throughput"]:

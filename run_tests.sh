@@ -106,6 +106,7 @@ elif [ "$1" = "--observability" ]; then
         tests/test_xla_telemetry.py tests/test_device_telemetry.py \
         tests/test_flight_recorder.py tests/test_goodput.py \
         tests/test_request_tracing.py tests/test_slo.py \
+        tests/test_tsdb_rules.py \
         tests/test_bench_schema.py tests/test_static_checks.py \
         -m "not slow" "$@"
 fi

@@ -143,6 +143,23 @@ def test_serving_section_schema(bench_result):
     assert isinstance(slo["burning_fast"], bool)
 
 
+def test_tsdb_section_schema(bench_result):
+    """The tsdb section (telemetry/tsdb.py measured by bench's synthetic
+    scrape soak): the acceptance criterion is a scrape+store+rule-eval
+    duty cycle under 2% of the scrape period with the store inside its
+    memory budget — a null here means the soak fell out of the wiring."""
+    ts = bench_result["detail"]["tsdb"]
+    assert ts.get("error") is None, ts
+    assert ts["series"] > 0
+    assert ts["samples_per_scrape"] > 0
+    assert ts["dump_ms"] > 0
+    assert ts["scrape_ms"] > 0
+    assert ts["scrape_period_s"] > 0
+    assert 0 < ts["duty_fraction"] < 0.02
+    assert ts["bytes_estimate"] > 0
+    assert ts["within_budget"] is True
+
+
 def test_gate_accepts_fresh_round(bench_result):
     """The regression gate passes a round against itself and prints the
     advisory xla + goodput lines — wiring proof that gate and schema
@@ -154,8 +171,37 @@ def test_gate_accepts_fresh_round(bench_result):
     assert any(line.startswith("ok: xla compile=") for line in report)
     assert any(line.startswith("ok: goodput fraction=") for line in report)
     assert any(line.startswith("ok: serving ") for line in report)
+    assert any(line.startswith("ok: tsdb ") for line in report)
     warns = [line for line in report if line.startswith("WARN:")]
     assert not warns, warns
+
+
+def test_gate_report_lines_convert_to_json(bench_result):
+    """--json is a faithful re-encoding: every text report line maps to
+    one {level, section, message} record, with the section recovered
+    from the line itself (the contract CI dashboards consume)."""
+    from tools.bench_gate import gate, report_line_to_json
+
+    _, report = gate(bench_result, bench_result)
+    for line in report:
+        rec = report_line_to_json(line)
+        assert rec["level"] in ("ok", "warn", "fail", "note", "info")
+        assert rec["message"] and rec["message"] in line
+    by_section = {report_line_to_json(line)["section"]
+                  for line in report}
+    assert {"throughput", "xla", "goodput", "serving", "tsdb"} <= \
+        by_section
+    # spot-check the three prefix levels and the section-note form
+    assert report_line_to_json("FAIL: mfu missing")["level"] == "fail"
+    assert report_line_to_json(
+        "WARN: tsdb errored: boom") == {
+            "level": "warn", "section": "tsdb",
+            "message": "tsdb errored: boom"}
+    note = report_line_to_json(
+        "note: section 'exec_cache' present in the previous round is "
+        "missing in the new one; compare skipped")
+    assert note == {"level": "note", "section": "exec_cache",
+                    "message": note["message"]}
 
 
 def test_gate_enforces_bench_history():

@@ -323,6 +323,81 @@ class TestPromExposition:
         assert flat[("h_seconds", (("quantile", "0.5"),))] == \
             pytest.approx(h.percentile(50))
 
+    @pytest.mark.parametrize("seed", [7, 23, 1031])
+    def test_randomized_exposition_round_trips(self, seed):
+        """Property test: whatever a registry holds — random names,
+        hostile label values, exemplar rings — parsing its own dump()
+        must reconstruct every family, label set, quantile child, and
+        exemplar line. This is the contract the TSDB scrape and the
+        `dct metrics` fallback both stand on."""
+        import random
+
+        rng = random.Random(seed)
+        label_values = ["a", "b-7", 'quo"te', "back\\slash", "new\nline",
+                        "sp ace", "ünïcode", ""]
+
+        def labelset():
+            return {f"l{j}": rng.choice(label_values)
+                    for j in range(rng.randint(0, 3))}
+
+        reg = MetricsRegistry()
+        want = {}          # (name, frozen labels) -> expected value
+        want_quant = set()  # histogram family names
+        want_ex = set()     # (family, request_id) expected in exemplars
+        want_ex_val = {}    # family -> max observation value
+        for i in range(rng.randint(5, 15)):
+            style = rng.choice(["counter", "gauge", "hist"])
+            name = f"m{i}_{style}" + ("_total" if style == "counter"
+                                      else "")
+            labels = labelset()
+            key = (name, tuple(sorted(labels.items())))
+            if style == "counter":
+                v = rng.randint(0, 10 ** rng.randint(0, 9))
+                reg.counter(name, "r", labels=labels).inc(v)
+                want[key] = float(v)
+            elif style == "gauge":
+                v = rng.uniform(-1e6, 1e6)
+                reg.gauge(name, "r", labels=labels).set(v)
+                want[key] = v
+            else:
+                h = reg.histogram(name, "r", labels=labels)
+                obs = [rng.uniform(0, 100) for _ in range(
+                    rng.randint(1, 20))]
+                ids = []
+                for j, v in enumerate(obs):
+                    rid = f"req-{i}-{j}"
+                    h.observe(v, exemplar=rid)
+                    ids.append(rid)
+                want[(name + "_sum", key[1])] = sum(obs)
+                want[(name + "_count", key[1])] = float(len(obs))
+                want_quant.add((name, key[1]))
+                # dump() emits one # EXEMPLAR line per histogram: the
+                # newest observation at the all-time max
+                best = max(range(len(obs)),
+                           key=lambda j: (obs[j], j))
+                want_ex.add((name, ids[best]))
+                want_ex_val[name] = obs[best]
+        parsed = parse_prometheus_text(reg.dump())
+        got = {(n, tuple(sorted(labels.items()))): v
+               for n, labels, v in parsed["samples"]
+               if "quantile" not in labels}
+        assert set(got) == set(want)
+        for key, v in want.items():
+            assert got[key] == pytest.approx(v, rel=1e-9), key
+        for fam, lbls in want_quant:
+            quantiles = {labels["quantile"]
+                         for n, labels, _ in parsed["samples"]
+                         if n == fam and "quantile" in labels
+                         and tuple(sorted((k, v) for k, v in
+                                          labels.items()
+                                          if k != "quantile")) == lbls}
+            assert {"0.5", "0.95", "0.99"} <= quantiles, fam
+        got_ex = {(n, labels.get("request_id"))
+                  for n, labels, _ in parsed["exemplars"]}
+        assert got_ex == want_ex
+        for n, labels, v in parsed["exemplars"]:
+            assert v == pytest.approx(want_ex_val[n], rel=1e-9)
+
 
 # ---------------------------------------------------------------------------
 # Chrome trace export: schema validity

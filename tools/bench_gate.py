@@ -81,7 +81,8 @@ def newest_rounds(directory: str = ".") -> Tuple[str, str]:
 # headline throughput/mfu checks below are the contract.
 OPTIONAL_SECTIONS = ("control_plane", "checkpoint_io", "pipeline",
                      "mnist_cnn", "tpu_probe_telemetry", "xla", "goodput",
-                     "serving", "serving_fleet", "exec_cache", "multichip")
+                     "serving", "serving_fleet", "exec_cache", "multichip",
+                     "tsdb")
 
 
 def _section_notes(old_detail: Dict[str, Any], new_detail: Dict[str, Any],
@@ -548,6 +549,45 @@ def _multichip_lines(old_detail: Dict[str, Any],
     return ok
 
 
+def _tsdb_lines(old_detail: Dict[str, Any],
+                new_detail: Dict[str, Any], report: list) -> None:
+    """Advisory time-series-layer reporting (telemetry/tsdb.py measured
+    by bench's synthetic scrape soak): WARNs when the section errored,
+    when the scrape+store+rule-evaluation duty cycle exceeds 2% of the
+    scrape period (the scrape loop shares the master process — it must
+    stay invisible next to request handling), or when the store ended
+    the soak over its memory budget (eviction stopped keeping up).
+    Advisory-only: wall-times share the box with the bench; the
+    enforced contracts are the tier-1 TSDB tests."""
+    ts_new = new_detail.get("tsdb")
+    if not isinstance(ts_new, dict):
+        return
+    if ts_new.get("error"):
+        report.append(f"WARN: tsdb errored: {ts_new['error']}")
+        return
+    duty = ts_new.get("duty_fraction")
+    duty_s = f"{duty:.3%}" if isinstance(duty, (int, float)) else "null"
+    report.append(
+        f"ok: tsdb {ts_new.get('series')} series, "
+        f"{ts_new.get('samples_per_scrape')} samples/scrape, "
+        f"scrape {ts_new.get('scrape_ms')}ms → duty {duty_s} of the "
+        f"{ts_new.get('scrape_period_s')}s period")
+    if not isinstance(duty, (int, float)):
+        report.append("WARN: tsdb duty_fraction is null — the scrape "
+                      "soak banked no timing")
+    elif duty > 0.02:
+        report.append(
+            f"WARN: tsdb scrape duty cycle {duty:.2%} > 2% of the "
+            f"scrape period — storing the cluster view is crowding "
+            f"the master")
+    if ts_new.get("within_budget") is False:
+        report.append(
+            f"WARN: tsdb ended the soak over its memory budget "
+            f"({ts_new.get('bytes_estimate')} > "
+            f"{ts_new.get('memory_budget_bytes')} bytes) — eviction "
+            f"is not keeping up with series churn")
+
+
 def gate(old: Dict[str, Any], new: Dict[str, Any], *,
          tolerance: float = DEFAULT_TOLERANCE,
          allow_null_mfu: bool = False) -> Tuple[bool, list]:
@@ -601,8 +641,39 @@ def gate(old: Dict[str, Any], new: Dict[str, Any], *,
     _serving_lines(old_detail, new_detail, report)
     _serving_fleet_lines(old_detail, new_detail, report)
     _exec_cache_lines(old_detail, new_detail, report)
+    _tsdb_lines(old_detail, new_detail, report)
     ok = _multichip_lines(old_detail, new_detail, report) and ok
     return ok, report
+
+
+# the gate's report lines are prefix-tagged prose; --json re-emits them
+# as one structured object per line without touching the text format
+_LINE_LEVELS = (("ok: ", "ok"), ("WARN: ", "warn"), ("FAIL: ", "fail"),
+                ("note: ", "note"))
+_SECTION_WORDS = set(OPTIONAL_SECTIONS) | {"serving-optimized", "rollout",
+                                           "throughput", "tracing", "mfu"}
+
+
+def report_line_to_json(line: str) -> Dict[str, Any]:
+    """One report line → {"level", "section", "message"}. The section is
+    recovered from the line's leading word (every section helper starts
+    its lines with the section name); headline checks that carry no
+    section name are tagged "headline"."""
+    level, msg = "info", line
+    for prefix, lvl in _LINE_LEVELS:
+        if line.startswith(prefix):
+            level, msg = lvl, line[len(prefix):]
+            break
+    word = msg.split(None, 1)[0] if msg.split() else ""
+    word = word.split("[")[0].split("=")[0].rstrip(":,")
+    if word == "section":
+        m = re.search(r"section '([^']+)'", msg)
+        section = m.group(1) if m else "headline"
+    elif word in _SECTION_WORDS:
+        section = word
+    else:
+        section = "headline"
+    return {"level": level, "section": section, "message": msg}
 
 
 def main(argv=None) -> int:
@@ -616,12 +687,20 @@ def main(argv=None) -> int:
                              "negative = allowed drop (default -0.05)")
     parser.add_argument("--allow-null-mfu", action="store_true",
                         help="demote the null-mfu failure to a warning")
+    parser.add_argument("--json", action="store_true",
+                        help="emit one JSON object per report line "
+                             "({level, section, message}) instead of text")
     args = parser.parse_args(argv)
 
     try:
         if args.old is None or args.new is None:
             old_path, new_path = newest_rounds()
-            print(f"auto-selected rounds: {old_path} → {new_path}")
+            if args.json:
+                print(json.dumps({"level": "info", "section": "gate",
+                                  "message": f"auto-selected rounds: "
+                                             f"{old_path} → {new_path}"}))
+            else:
+                print(f"auto-selected rounds: {old_path} → {new_path}")
         else:
             old_path, new_path = args.old, args.new
         old = load_bench(old_path)
@@ -632,9 +711,15 @@ def main(argv=None) -> int:
 
     ok, report = gate(old, new, tolerance=args.tolerance,
                       allow_null_mfu=args.allow_null_mfu)
-    for line in report:
-        print(line)
-    print("bench gate: " + ("PASS" if ok else "FAIL"))
+    if args.json:
+        for line in report:
+            print(json.dumps(report_line_to_json(line)))
+        print(json.dumps({"level": "verdict", "section": "gate",
+                          "message": "PASS" if ok else "FAIL", "ok": ok}))
+    else:
+        for line in report:
+            print(line)
+        print("bench gate: " + ("PASS" if ok else "FAIL"))
     return 0 if ok else 1
 
 
