@@ -104,9 +104,16 @@ class FlightRecorder:
         except (TypeError, ValueError):
             self._drop(1)
             return
+        # fault point outside the lock (CONC003/4 lock hierarchy): a
+        # delay-action fault stalls this writer only, not every thread
+        # serializing on _lock; raise-action still counts as a drop
+        try:
+            faults.point("flight.write")
+        except Exception:  # noqa: BLE001 - observer, never a dependency
+            self._drop(1)
+            return
         with self._lock:
             try:
-                faults.point("flight.write")
                 if self._file is None:
                     self._open_segment()
                 self._file.write(line + "\n")

@@ -378,9 +378,16 @@ class GoodputJournal:
         except (TypeError, ValueError):
             self._drop()
             return
+        # fault point outside the lock (CONC003/4 lock hierarchy): a
+        # delay-action fault stalls this writer only, not every thread
+        # serializing on _lock; raise-action still counts as a drop
+        try:
+            faults.point("goodput.write")
+        except Exception:  # noqa: BLE001 - observer, never a dependency
+            self._drop()
+            return
         with self._lock:
             try:
-                faults.point("goodput.write")
                 if self._file is None:
                     self._open(int(snapshot.get("trial_id") or 0))
                 self._file.write(line + "\n")

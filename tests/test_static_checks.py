@@ -72,8 +72,18 @@ def test_cli_lint_subcommand():
 
 
 def test_all_rules_registered():
-    assert {"JAX001", "JAX002", "JAX003", "CONC001", "CONC002",
-            "TIME001", "EXC001", "RETRY001"} <= set(CHECKERS)
+    assert {"JAX001", "JAX002", "JAX003", "JAX004", "CONC001",
+            "CONC002", "CONC003", "CONC004", "CONTRACT001",
+            "CONTRACT002", "CONTRACT003", "TIME001", "EXC001",
+            "RETRY001"} <= set(CHECKERS)
+
+
+def test_project_rules_marked_project_scope():
+    for rule in ("CONC003", "CONC004", "CONTRACT001", "CONTRACT002",
+                 "CONTRACT003", "JAX004"):
+        assert CHECKERS[rule].project, rule
+    for rule in ("JAX001", "CONC001", "TIME001"):
+        assert not CHECKERS[rule].project, rule
 
 
 # ---------------------------------------------------------------------------

@@ -11,6 +11,7 @@ from tools.dctlint.core import (  # noqa: F401
     Checker,
     Diagnostic,
     FileContext,
+    ProjectChecker,
     apply_baseline,
     lint_file,
     lint_source,
@@ -18,6 +19,10 @@ from tools.dctlint.core import (  # noqa: F401
     register,
     run,
     write_baseline,
+)
+from tools.dctlint.project import (  # noqa: F401
+    ProjectIndex,
+    extract_facts,
 )
 
 DEFAULT_PATHS = ("determined_clone_tpu", "tools", "bench.py")

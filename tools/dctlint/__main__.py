@@ -38,7 +38,41 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-hints", action="store_true",
                    help="omit fix hints from text output")
     p.add_argument("--list-checkers", action="store_true")
+    p.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                   metavar="REF",
+                   help="only report diagnostics in files changed vs "
+                        "REF (default HEAD) plus untracked files; the "
+                        "full ProjectIndex is still built so cross-file "
+                        "checks stay sound — the fast pre-commit path")
+    p.add_argument("--stats", action="store_true",
+                   help="print wall-time/files/cache-hit stats and "
+                        "project-checker summaries (e.g. the verified "
+                        "lock hierarchy) to stderr")
+    p.add_argument("--jobs", type=int, default=0, metavar="N",
+                   help="worker processes for the per-file pass "
+                        "(0 auto, 1 serial)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="ignore and do not write the per-file facts "
+                        "cache (.dctlint_cache.json)")
     return p
+
+
+def _changed_files(ref: str) -> Optional[set]:
+    """Display paths (relative to the repo root) changed vs ``ref``,
+    plus untracked files. None if git is unavailable."""
+    import subprocess
+    out: set = set()
+    for cmd in (["git", "diff", "--name-only", ref, "--"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            proc = subprocess.run(
+                cmd, cwd=REPO_ROOT, capture_output=True, text=True,
+                timeout=30, check=True)
+        except (OSError, subprocess.SubprocessError):
+            return None
+        out.update(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip().endswith(".py"))
+    return out
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -66,16 +100,37 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 2
 
     baseline = None if args.no_baseline else Path(args.baseline)
+    cache_path = None if args.no_cache else \
+        REPO_ROOT / ".dctlint_cache.json"
+    changed_only = None
+    if args.changed is not None:
+        changed_only = _changed_files(args.changed)
+        if changed_only is None:
+            print("--changed: git unavailable, linting everything",
+                  file=sys.stderr)
     if args.write_baseline:
         diags = core.run(paths, select=select, baseline=None,
-                         relative_to=REPO_ROOT)
+                         relative_to=REPO_ROOT, jobs=args.jobs,
+                         cache_path=cache_path)
         n = core.write_baseline(Path(args.baseline), diags)
         print(f"wrote {n} baseline entr{'y' if n == 1 else 'ies'} to "
               f"{args.baseline} — fill in the justifications")
         return 0
 
+    stats: dict = {}
     diags = core.run(paths, select=select, baseline=baseline,
-                     relative_to=REPO_ROOT)
+                     relative_to=REPO_ROOT, jobs=args.jobs,
+                     cache_path=cache_path, changed_only=changed_only,
+                     stats=stats)
+    if args.stats:
+        print(f"dctlint: {stats['files']} files in "
+              f"{stats['wall_s']:.2f}s ({stats['cache_hits']} cached, "
+              f"{stats['analyzed']} analyzed, {stats['jobs']} worker"
+              f"{'s' if stats['jobs'] != 1 else ''}); project pass: "
+              f"{', '.join(stats['project_checkers']) or 'none'}",
+              file=sys.stderr)
+        for rule, summary in sorted(stats["summaries"].items()):
+            print(f"  {rule}: {summary}", file=sys.stderr)
 
     if args.format == "json":
         print(json.dumps([dataclasses.asdict(d) for d in diags], indent=2))

@@ -2,8 +2,11 @@
 add a new module here to enroll it (docs/static_analysis.md §adding)."""
 from tools.dctlint.checkers import (  # noqa: F401  (import = registration)
     concurrency,
+    contracts,
     exceptions,
     jax_checks,
+    jit_purity,
+    lockorder,
     retry,
     timeutils,
 )

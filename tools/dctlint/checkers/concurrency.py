@@ -34,13 +34,13 @@ class ThreadNeedsDaemonAndName(Checker):
 
     def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
         thread_classes = set()
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes:
             if isinstance(node, ast.ClassDef) and any(
                     (ctx.qualified_name(b) or "") in THREAD_NAMES
                     for b in node.bases):
                 thread_classes.add(node)
 
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes:
             if not isinstance(node, ast.Call):
                 continue
             if (ctx.qualified_name(node.func) or "") in THREAD_NAMES:
@@ -124,7 +124,7 @@ class AcquireWithoutRelease(Checker):
             "very next statement must be try/finally: lock.release()")
 
     def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes:
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
                     and node.func.attr == "acquire"):

@@ -1,0 +1,255 @@
+"""CONTRACT001/002/003 — cross-layer registry sync (ISSUE 18).
+
+Three registries in this tree live half in code and half in docs or
+config, and until now nothing but convention kept the halves in sync:
+
+- **CONTRACT001** fault-point catalog: every ``faults.point("name")``
+  site (and constant ``fault_*=`` kwargs naming one) must have a row in
+  the "Fault points" table of docs/fault_tolerance.md, and every row
+  must still name a point that exists in code — so the chaos matrix the
+  docs promise is the chaos matrix that runs.
+- **CONTRACT002** metric families: a family name must map to exactly
+  one metric type across the whole tree (``counter`` in one module and
+  ``gauge`` in another under the same name corrupts scrapes silently),
+  and every family must appear backticked in docs/observability.md.
+- **CONTRACT003** config schema round-trip: every top-level
+  ``properties`` key of ``EXPERIMENT_SCHEMA`` (config/schema.py) must
+  be consumed — an ``ExperimentConfig`` field or a ``raw["key"]`` /
+  ``raw.get("key")`` read inside the config package — and every
+  ``ExperimentConfig`` field must map back to a schema key, so the
+  validated surface and the consumed surface are the same surface.
+
+All three skip quietly when the docs/schema artifact is absent from
+the linted root, which is how fixture trees opt in: provide the
+artifact and the contract is enforced.
+"""
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from tools.dctlint.core import Diagnostic, ProjectChecker, register
+from tools.dctlint.project import ProjectIndex
+
+_BACKTICK = re.compile(r"`([^`]+)`")
+
+# Schema keys accepted for Determined-config compatibility and carried
+# in ``raw`` without a dedicated consumer. Keep each entry justified,
+# or shrink the set.
+PASSTHROUGH_KEYS = frozenset({
+    "template",    # server-side template merge: validated, kept in raw
+    "unmanaged",   # unmanaged-mode marker: read straight off raw
+})
+
+# ExperimentConfig fields whose schema key has a different name.
+FIELD_TO_KEY_RENAMES = {
+    "experiment_seed": "reproducibility",
+    "profiling_enabled": "profiling",
+}
+
+# Internal bookkeeping fields with no schema surface.
+INTERNAL_FIELDS = frozenset({"raw", "deprecations"})
+
+
+def _read_doc(index: ProjectIndex, rel: str) -> Optional[List[str]]:
+    if index.root is None:
+        return None
+    p = Path(index.root) / rel
+    try:
+        return p.read_text().splitlines()
+    except OSError:
+        return None
+
+
+def _catalog_rows(lines: List[str], heading: str) -> List[Tuple[str, int]]:
+    """(backticked name, 1-based line) for each markdown table row in
+    the section under ``heading``. A first cell like ```` `a` / `b` ````
+    yields both names."""
+    rows: List[Tuple[str, int]] = []
+    in_section = False
+    for i, line in enumerate(lines, start=1):
+        stripped = line.strip()
+        if stripped.startswith("#"):
+            in_section = stripped.lstrip("#").strip().lower() \
+                .startswith(heading.lower())
+            continue
+        if not in_section or not stripped.startswith("|"):
+            continue
+        first_cell = stripped.strip("|").split("|", 1)[0]
+        if set(first_cell.strip()) <= {"-", ":", " "}:
+            continue  # header separator row
+        for name in _BACKTICK.findall(first_cell):
+            rows.append((name.strip(), i))
+    return rows
+
+
+@register
+class FaultCatalogChecker(ProjectChecker):
+    rule = "CONTRACT001"
+    title = ("fault point missing from docs/fault_tolerance.md "
+             "catalog, or stale catalog row")
+    hint = ("keep the \"Fault points\" table in docs/fault_tolerance.md "
+            "in lockstep with faults.point() sites: add the missing "
+            "row / delete the stale one")
+
+    DOC = "docs/fault_tolerance.md"
+    SECTION = "fault points"
+
+    def project_check(self, index: ProjectIndex) -> Iterator[Diagnostic]:
+        doc = _read_doc(index, self.DOC)
+        if doc is None:
+            return
+        code: Dict[str, Tuple[str, int]] = {}
+        for path, facts in index.files.items():
+            for name, line in facts.get("fault_points", []):
+                code.setdefault(name, (path, line))
+        rows = _catalog_rows(doc, self.SECTION)
+        documented = {name for name, _ in rows}
+        for name in sorted(code):
+            if name not in documented:
+                path, line = code[name]
+                yield self.pdiag(
+                    path, line,
+                    f'fault point "{name}" has no row in the '
+                    f"{self.DOC} catalog")
+        # the stale-row direction is only sound when the faults runtime
+        # itself is in the linted set — on a subtree run (``dct lint
+        # tools/``) the call sites are simply out of view, not gone
+        full_view = any(m == "faults" or m.endswith(".faults")
+                        for m in index.modules)
+        seen_rows = set()
+        for name, line in rows:
+            if not full_view or name in code or name in seen_rows:
+                continue
+            seen_rows.add(name)
+            yield self.pdiag(
+                self.DOC, line,
+                f'catalog row "{name}" names a fault point that no '
+                f"longer exists in code")
+        index.summaries[self.rule] = (
+            f"{len(code)} fault points <-> {len(documented)} catalog "
+            f"rows")
+
+
+@register
+class MetricRegistryChecker(ProjectChecker):
+    rule = "CONTRACT002"
+    title = ("metric family type conflict, or family missing from "
+             "docs/observability.md")
+    hint = ("one family name -> one metric type across the tree; list "
+            "every family backticked in the docs/observability.md "
+            "metric catalog")
+
+    DOC = "docs/observability.md"
+
+    def project_check(self, index: ProjectIndex) -> Iterator[Diagnostic]:
+        families: Dict[str, List[Tuple[str, str, int]]] = {}
+        for path, facts in index.files.items():
+            for name, kind, line in facts.get("metrics", []):
+                families.setdefault(name, []).append((kind, path, line))
+        conflicts = 0
+        for name in sorted(families):
+            defs = sorted(families[name], key=lambda d: (d[1], d[2]))
+            kinds = {k for k, _p, _l in defs}
+            if len(kinds) <= 1:
+                continue
+            conflicts += 1
+            ref_kind, ref_path, ref_line = defs[0]
+            flagged = set()
+            for kind, path, line in defs[1:]:
+                if kind == ref_kind or kind in flagged:
+                    continue
+                flagged.add(kind)
+                yield self.pdiag(
+                    path, line,
+                    f'metric family "{name}" registered as {kind} '
+                    f"here but as {ref_kind} at {ref_path}:{ref_line} "
+                    f"— one name, one type")
+        doc = _read_doc(index, self.DOC)
+        documented = set()
+        if doc is not None:
+            for line in doc:
+                documented.update(_BACKTICK.findall(line))
+            for name in sorted(families):
+                if name in documented:
+                    continue
+                _k, path, line = min(families[name],
+                                     key=lambda d: (d[1], d[2]))
+                yield self.pdiag(
+                    path, line,
+                    f'metric family "{name}" is not documented in '
+                    f"{self.DOC}")
+        index.summaries[self.rule] = (
+            f"{len(families)} metric families, {conflicts} type "
+            f"conflict(s)")
+
+
+@register
+class SchemaRoundTripChecker(ProjectChecker):
+    rule = "CONTRACT003"
+    title = "config schema key does not round-trip to ExperimentConfig"
+    hint = ("a key validated by EXPERIMENT_SCHEMA must be consumed — "
+            'an ExperimentConfig field or a raw.get("key") in the '
+            "config package — and every field needs a schema key; "
+            "PASSTHROUGH_KEYS / FIELD_TO_KEY_RENAMES in "
+            "tools/dctlint/checkers/contracts.py hold the sanctioned "
+            "exceptions")
+
+    SCHEMA_NAME = "EXPERIMENT_SCHEMA"
+    CONFIG_CLASS = "ExperimentConfig"
+
+    def project_check(self, index: ProjectIndex) -> Iterator[Diagnostic]:
+        for path, facts in sorted(index.files.items()):
+            if not facts.get("schemas"):
+                continue
+            if not Path(path).as_posix().endswith("config/schema.py"):
+                continue
+            yield from self._check_package(index, path, facts)
+
+    def _check_package(self, index: ProjectIndex, schema_path: str,
+                       schema_facts) -> Iterator[Diagnostic]:
+        pkg_dir = Path(schema_path).parent.as_posix()
+        consumed = set()
+        fields: List[str] = []
+        cfg_path: Optional[str] = None
+        cfg_line = 0
+        for path, facts in index.files.items():
+            if Path(path).parent.as_posix() != pkg_dir:
+                continue
+            consumed.update(facts.get("str_keys", []))
+            cls_fields = facts.get("dataclass_fields", {})
+            if self.CONFIG_CLASS in cls_fields:
+                fields = cls_fields[self.CONFIG_CLASS]
+                cfg_path = path
+                cfg_line = facts.get("classes", {}).get(
+                    self.CONFIG_CLASS, {}).get("line", 0)
+        if cfg_path is None:
+            return  # partial view: the config class is out of the
+            # linted set, so "unconsumed" would be unsound
+        for schema in schema_facts["schemas"]:
+            if schema["name"] != self.SCHEMA_NAME:
+                continue
+            keys = set(schema["keys"])
+            field_set = set(fields)
+            for key in sorted(keys):
+                if key in field_set or key in consumed \
+                        or key in PASSTHROUGH_KEYS:
+                    continue
+                yield self.pdiag(
+                    schema_path, schema["line"],
+                    f'schema key "{key}" has no {self.CONFIG_CLASS} '
+                    f"field and is never consumed in {pkg_dir}/")
+            for field in fields:
+                if field in INTERNAL_FIELDS:
+                    continue
+                key = FIELD_TO_KEY_RENAMES.get(field, field)
+                if key not in keys:
+                    yield self.pdiag(
+                        cfg_path, cfg_line,
+                        f'{self.CONFIG_CLASS} field "{field}" has '
+                        f"no {self.SCHEMA_NAME} key (expected "
+                        f'"{key}")')
+            index.summaries[self.rule] = (
+                f"{len(keys)} schema keys round-trip against "
+                f"{len(fields)} {self.CONFIG_CLASS} fields")

@@ -59,7 +59,7 @@ class SwallowedException(Checker):
             "or add a comment saying why silence is correct")
 
     def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes:
             if not isinstance(node, ast.ExceptHandler):
                 continue
             if _is_broad(node) and _is_noop_body(node.body) \

@@ -52,7 +52,7 @@ def _traced_functions(ctx: FileContext) -> Set[ast.AST]:
     level of ``alias = fn`` indirection), and everything nested inside."""
     defs_by_name: Dict[str, List[ast.FunctionDef]] = {}
     aliases: Dict[str, str] = {}
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if isinstance(node, ast.FunctionDef):
             defs_by_name.setdefault(node.name, []).append(node)
         elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
@@ -61,7 +61,7 @@ def _traced_functions(ctx: FileContext) -> Set[ast.AST]:
             aliases[node.targets[0].id] = node.value.id
 
     traced: Set[ast.AST] = set()
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if isinstance(node, ast.FunctionDef):
             if any(_decorator_traces(ctx, d) for d in node.decorator_list):
                 traced.add(node)
@@ -143,7 +143,7 @@ class ConstantKeyReuse(Checker):
         # (a) constant PRNGKey inside loss/step/eval-shaped functions:
         # the same key every invocation means the same dropout mask /
         # noise every step — silently wrong statistics
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes:
             if isinstance(node, ast.Call) \
                     and (_call_qname(ctx, node) or "") in KEY_NAMES \
                     and node.args \
@@ -162,7 +162,7 @@ class ConstantKeyReuse(Checker):
             yield from self._check_reuse(ctx, scope)
 
     def _top_level_functions(self, ctx: FileContext):
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes:
             if isinstance(node, ast.FunctionDef) \
                     and not ctx.enclosing_functions(node):
                 yield node
@@ -212,7 +212,7 @@ class MissingDonation(Checker):
     def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
         defs_by_name: Dict[str, List[ast.FunctionDef]] = {}
         aliases: Dict[str, str] = {}
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes:
             if isinstance(node, ast.FunctionDef):
                 defs_by_name.setdefault(node.name, []).append(node)
             elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
@@ -220,7 +220,7 @@ class MissingDonation(Checker):
                     and isinstance(node.value, ast.Name):
                 aliases[node.targets[0].id] = node.value.id
 
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes:
             if isinstance(node, ast.Call):
                 if (_call_qname(ctx, node) or "") not in JIT_NAMES:
                     continue

@@ -1,0 +1,174 @@
+"""JAX004 — jit-boundary purity (ISSUE 18).
+
+``jax.jit`` / ``shard_map`` / ``lax.scan`` trace a function once and
+replay the recorded computation: anything the Python body does besides
+array math either bakes a stale value into the compiled artifact
+(reading mutable state, ``time.time()``, ``os.environ``) or silently
+runs only at trace time (mutating ``self``, writing a module global,
+touching a socket). Lexical JAX001 catches ``print``/side effects in
+decorated bodies; JAX004 closes the gap *through the call graph*: it
+resolves every function passed to a trace entry point
+(:data:`~tools.dctlint.project.TRACE_ENTRIES`), walks the certain call
+edges reachable from it, and flags
+
+- a bound method passed to a trace entry (the closure captures
+  ``self``, whose mutable state is baked in at trace time),
+- stores to ``self`` or module globals anywhere in the traced region,
+- reads of *mutable* instance attributes (assigned outside
+  ``__init__``; frozen config read-only attrs are fine),
+- calls into side-effecting stdlib/platform APIs (``time``, ``os``
+  beyond ``os.path``, ``logging``, ``random``, ``socket``,
+  ``subprocess``, ``requests``, ``threading``, ``faults.point``,
+  ``open``/``input``).
+
+Only *certain* call edges propagate (same discipline as CONC004) so a
+heuristic method-name match can never produce a purity diagnostic.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.dctlint.core import Diagnostic, ProjectChecker, register
+from tools.dctlint.project import ProjectIndex
+
+_DEPTH_CAP = 8
+
+# stdlib/platform roots whose calls are side effects or trace-time
+# constants inside a traced region. os.path is pure path algebra and
+# exempt; jax.random is fine (the root here is the ``random`` module).
+_IMPURE_ROOTS = frozenset({
+    "time", "logging", "random", "socket", "subprocess",
+    "requests", "threading", "shutil", "tempfile",
+})
+_IMPURE_BARE = frozenset({"open", "input"})
+
+
+def _impure_api(dotted: str) -> Optional[str]:
+    root = dotted.split(".", 1)[0]
+    if root == "os":
+        return None if dotted.startswith("os.path.") else dotted
+    if root in _IMPURE_ROOTS:
+        return dotted
+    # project fault injection: faults.point() sleeps/raises by plan
+    if dotted == "faults.point" or dotted.endswith(".faults.point"):
+        return dotted
+    return None
+
+
+@register
+class JitPurityChecker(ProjectChecker):
+    rule = "JAX004"
+    title = "impure function reachable from a jit/shard_map/scan boundary"
+    hint = ("a traced function must be pure: pass state in as "
+            "arguments and return the new state; hoist clocks, RNG "
+            "seeds, env reads, and logging out of the traced region "
+            "(training/train_step.py's make_train_step is the "
+            "pattern)")
+
+    def project_check(self, index: ProjectIndex) -> Iterator[Diagnostic]:
+        roots: List[Tuple[str, str, str, int, str]] = []
+        emitted: Set[Tuple[str, int, str]] = set()
+        n_bound = 0
+        for path, facts in sorted(index.files.items()):
+            mod = facts.get("module")
+            for tgt in facts.get("jit_targets", []):
+                desc, entry = tgt["t"], tgt["entry"]
+                kind = desc[0]
+                if kind == "l":
+                    fq = f"{mod}.{desc[1]}" if mod else desc[1]
+                    if fq in index.functions:
+                        roots.append((fq, entry, path, tgt["line"], mod))
+                elif kind == "q":
+                    for fq in index.resolve_dotted(desc[1]):
+                        roots.append((fq, entry, path, tgt["line"], mod))
+                elif kind == "s":
+                    n_bound += 1
+                    d = self.pdiag(
+                        path, tgt["line"],
+                        f"bound method self.{desc[1]} passed to "
+                        f"{entry} — the traced closure captures self "
+                        f"and bakes its mutable state into the "
+                        f"compiled artifact",
+                        hint="trace a free function (or staticmethod) "
+                             "that takes the needed state as explicit "
+                             "arguments")
+                    key = (d.path, d.line, d.message)
+                    if key not in emitted:
+                        emitted.add(key)
+                        yield d
+                # ["n"]/["sa"]: unresolved — nothing sound to say
+        reachable: Set[str] = set()
+        flagged = 0
+        for fq, entry, rpath, rline, _mod in roots:
+            for d in self._check_root(index, fq, entry, rpath, rline,
+                                      reachable):
+                key = (d.path, d.line, d.message)
+                if key not in emitted:
+                    emitted.add(key)
+                    flagged += 1
+                    yield d
+        index.summaries[self.rule] = (
+            f"{len(roots)} traced entry points, {len(reachable)} "
+            f"reachable functions, {flagged + n_bound} purity "
+            f"violation(s)")
+
+    def _check_root(self, index: ProjectIndex, root_fq: str,
+                    entry: str, rpath: str, rline: int,
+                    reachable: Set[str]) -> Iterator[Diagnostic]:
+        origin = f"traced via {entry} at {rpath}:{rline}"
+        stack: List[Tuple[str, int]] = [(root_fq, 0)]
+        seen: Set[str] = set()
+        while stack:
+            fq, depth = stack.pop()
+            if fq in seen or depth > _DEPTH_CAP:
+                continue
+            seen.add(fq)
+            reachable.add(fq)
+            rec = index.functions.get(fq)
+            if rec is None:
+                continue
+            yield from self._check_fn(index, rec, fq, origin)
+            for call in rec["facts"].get("calls", []):
+                desc = call[0]
+                for callee, certain in index.resolve_call(fq, desc):
+                    if certain:
+                        stack.append((callee, depth + 1))
+
+    def _check_fn(self, index: ProjectIndex, rec: Dict[str, Any],
+                  fq: str, origin: str) -> Iterator[Diagnostic]:
+        facts, path = rec["facts"], rec["path"]
+        for attr, line in facts.get("stores_self", []):
+            yield self.pdiag(
+                path, line,
+                f"{fq} ({origin}) stores self.{attr} — the write "
+                f"happens once at trace time, not per step")
+        for name, line in facts.get("stores_global", []):
+            yield self.pdiag(
+                path, line,
+                f"{fq} ({origin}) writes module global {name} inside "
+                f"a traced region")
+        clsfq = rec.get("cls")
+        if clsfq and clsfq in index.classes:
+            mutable = index.mutable_attrs(clsfq)
+            flagged_attrs: Set[str] = set()
+            for attr, line in facts.get("reads_self", []):
+                if attr in mutable and attr not in flagged_attrs:
+                    flagged_attrs.add(attr)
+                    yield self.pdiag(
+                        path, line,
+                        f"{fq} ({origin}) reads mutable instance "
+                        f"attribute self.{attr} (assigned outside "
+                        f"__init__) — its trace-time value is baked "
+                        f"into the compiled artifact")
+        for call in facts.get("calls", []):
+            desc, line = call[0], call[1]
+            api = None
+            if desc[0] == "q":
+                api = _impure_api(desc[1])
+            elif desc[0] == "n" and desc[1] in _IMPURE_BARE:
+                api = desc[1]
+            if api:
+                yield self.pdiag(
+                    path, line,
+                    f"{fq} ({origin}) calls side-effecting {api} "
+                    f"inside a traced region")

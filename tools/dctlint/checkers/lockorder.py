@@ -1,0 +1,316 @@
+"""CONC003/CONC004 — whole-program lock discipline (ISSUE 18).
+
+CONC003 builds the static lock-acquisition graph: an edge L -> M means
+some thread may acquire M while holding L, either lexically (nested
+``with``) or through the call graph (a call made under L reaches an
+acquire of M). Deadlock needs a cycle in that graph plus concurrent
+threads — and the platform has two dozen daemon-thread loops, so every
+cycle is treated as real. Acyclicity is verified against the documented
+lock hierarchy (:data:`LOCK_HIERARCHY`, docs/static_analysis.md
+"Lock hierarchy"): an edge from a later tier back into an earlier one
+fails even before it closes a cycle, which keeps the graph a DAG by
+construction as the codebase grows.
+
+CONC004 flags blocking work reachable while a lock is held:
+``time.sleep``, blocking ``Queue.get/put``, HTTP/subprocess requests,
+``block_until_ready``, indefinite ``Event.wait``/``Thread.join``/
+``Future.result`` — lexically or through certain call-graph edges. The
+one sanctioned exception is ``Condition.wait`` while holding only that
+condition's own lock (that *is* the condition-variable protocol; the
+wait releases the lock).
+
+Reentrant locks (RLock, and Condition whose default lock is an RLock)
+do not self-edge; a plain ``Lock`` re-acquired on a call path is
+reported as a self-deadlock.
+"""
+from __future__ import annotations
+
+import fnmatch
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from tools.dctlint.core import Diagnostic, ProjectChecker, register
+from tools.dctlint.project import ProjectIndex
+
+# The documented lock hierarchy, outermost tier first: an acquisition
+# edge must go left-to-right (same tier is allowed only between
+# *different* locks that never close a cycle). Patterns are fnmatch
+# globs over lock ids (``module.Class.attr`` / ``module.varname``).
+# Derived from the measured acquisition graph of the tree (every one
+# of its edges is tier-descending) and enforced on all future edges.
+# Keep in sync with docs/static_analysis.md "Lock hierarchy".
+LOCK_HIERARCHY: List[Tuple[str, List[str]]] = [
+    # cluster-control plane: fleet/master/autoscaler/task lifecycles —
+    # these call into everything below, never the reverse
+    ("control", [
+        "*.serving.fleet.*",
+        "*.serving.autoscale.*",
+        "*.api.inprocess.*",
+        "*.core._unmanaged.*",
+        "*.core._distributed.*",
+        "*.exec.task.*",
+        "*.tensorboard.manager.*",
+    ]),
+    # a single replica's serving loop: scheduler condition + router
+    ("serving", [
+        "*.serving.engine.*",
+        "*.serving.router.*",
+    ]),
+    # resource pools the serving/training loops draw from: KV blocks,
+    # CAS blobs, executable cache, transfer pool
+    ("resource", [
+        "*.serving.kv_cache.*",
+        "*.storage.*",
+    ]),
+    # telemetry producers that write files/evaluate rules under their
+    # own lock while emitting into the sinks below
+    ("recorder", [
+        "*.telemetry.flight.*",
+        "*.telemetry.goodput.*",
+        "*.telemetry.rules.*",
+        "*.telemetry.aggregate.*",
+        "*.telemetry.device.*",
+        "*.profiler.*",
+    ]),
+    # leaf sinks: metric families, tracer, tsdb, SLO engine, fault
+    # plan — must never call out while holding their lock
+    ("sink", [
+        "*.telemetry.*",
+        "*.faults.*",
+    ]),
+]
+_LEAF_TIER = len(LOCK_HIERARCHY)  # unmatched locks: innermost
+
+
+def _tier(lock_id: str) -> int:
+    for i, (_name, patterns) in enumerate(LOCK_HIERARCHY):
+        for pat in patterns:
+            if fnmatch.fnmatchcase(lock_id, pat):
+                return i
+    return _LEAF_TIER
+
+
+def hierarchy_display() -> str:
+    return " < ".join(name for name, _ in LOCK_HIERARCHY) + " < leaf"
+
+
+def _chain_display(chain) -> str:
+    return " -> ".join(f"{fq}:{line}" for fq, line in chain)
+
+
+class _Edge:
+    __slots__ = ("src", "dst", "path", "line", "chain", "certain")
+
+    def __init__(self, src, dst, path, line, chain, certain):
+        self.src, self.dst = src, dst
+        self.path, self.line = path, line
+        self.chain, self.certain = chain, certain
+
+
+def _collect_edges(index: ProjectIndex) -> List[_Edge]:
+    edges: List[_Edge] = []
+    seen = set()
+
+    def add(src, dst, path, line, chain, certain):
+        key = (src, dst, path, line)
+        if key in seen:
+            return
+        seen.add(key)
+        edges.append(_Edge(src, dst, path, line, chain, certain))
+
+    for fq, rec in index.functions.items():
+        facts, path = rec["facts"], rec["path"]
+        for acq in facts.get("acquires", []):
+            held = index.held_lock_ids(fq, acq.get("held", []))
+            if not held:
+                continue
+            resolved = index.resolve_lockref(rec["module"], acq["l"])
+            if not resolved or resolved[1] not in ("lock", "rlock",
+                                                   "condition"):
+                continue
+            dst, _kind = resolved
+            for src, _k in held:
+                if src != dst:
+                    add(src, dst, path, acq["line"],
+                        [(fq, acq["line"])], True)
+        for call in facts.get("calls", []):
+            if len(call) < 3:
+                continue  # no locks held at this call site
+            desc, line, held_refs = call
+            held = index.held_lock_ids(fq, held_refs)
+            if not held:
+                continue
+            for callee, certain in index.resolve_call(fq, desc):
+                acquired = index.eventual_acquires(callee)
+                for dst, info in acquired.items():
+                    for src, src_kind in held:
+                        if src == dst:
+                            # reentrancy: fine for rlock/condition,
+                            # self-deadlock for a plain Lock
+                            if src_kind == "lock" and certain \
+                                    and info["certain"]:
+                                add(src, dst, path, line,
+                                    [(fq, line)] + list(info["chain"]),
+                                    True)
+                            continue
+                        add(src, dst, path, line,
+                            [(fq, line)] + list(info["chain"]),
+                            certain and info["certain"])
+    return edges
+
+
+def _find_cycles(edges: List[_Edge]) -> List[List[_Edge]]:
+    """Cycles in the lock graph, reported once each: for every edge
+    that closes a path back to its source, return the closing edges
+    along a shortest path."""
+    adj: Dict[str, List[_Edge]] = {}
+    for e in edges:
+        adj.setdefault(e.src, []).append(e)
+    cycles: List[List[_Edge]] = []
+    reported = set()
+    for start in sorted(adj):
+        # BFS from start; a path back to start is a cycle
+        frontier: List[Tuple[str, List[_Edge]]] = [(start, [])]
+        visited = {start: 0}
+        found: Optional[List[_Edge]] = None
+        while frontier and found is None:
+            nxt: List[Tuple[str, List[_Edge]]] = []
+            for node, path in frontier:
+                for e in adj.get(node, []):
+                    if e.src == e.dst:
+                        continue  # self-edges reported separately
+                    if e.dst == start:
+                        found = path + [e]
+                        break
+                    if e.dst not in visited:
+                        visited[e.dst] = 1
+                        nxt.append((e.dst, path + [e]))
+                if found:
+                    break
+            frontier = nxt
+        if found:
+            key = frozenset((e.src, e.dst) for e in found)
+            if key not in reported:
+                reported.add(key)
+                cycles.append(found)
+    return cycles
+
+
+@register
+class LockOrderChecker(ProjectChecker):
+    rule = "CONC003"
+    title = "lock-order cycle / hierarchy violation (deadlock risk)"
+    hint = ("acquire locks in the documented hierarchy order "
+            "(docs/static_analysis.md \"Lock hierarchy\") — move the "
+            "inner acquire out of the outer critical section, or take "
+            "both locks in hierarchy order up front")
+
+    def project_check(self, index: ProjectIndex) -> Iterator[Diagnostic]:
+        edges = _collect_edges(index)
+        n_locks = len({e.src for e in edges} | {e.dst for e in edges})
+        cycles = _find_cycles(edges)
+        violations = 0
+        for cyc in cycles:
+            first = cyc[0]
+            ring = " -> ".join([e.src for e in cyc] + [cyc[0].src])
+            violations += 1
+            yield self.pdiag(
+                first.path, first.line,
+                f"lock-order cycle {ring} (first edge held "
+                f"{first.src} while acquiring {first.dst} via "
+                f"{_chain_display(first.chain)})")
+        for e in edges:
+            if e.src == e.dst:
+                violations += 1
+                yield self.pdiag(
+                    e.path, e.line,
+                    f"non-reentrant lock {e.src} re-acquired on a "
+                    f"path that already holds it "
+                    f"(via {_chain_display(e.chain)})",
+                    hint="use threading.RLock, or split the helper "
+                         "into a _locked variant called under the "
+                         "lock")
+                continue
+            st, dt = _tier(e.src), _tier(e.dst)
+            if st > dt and e.certain:
+                violations += 1
+                yield self.pdiag(
+                    e.path, e.line,
+                    f"lock hierarchy violation: {e.src} (tier "
+                    f"{LOCK_HIERARCHY[st][0] if st < _LEAF_TIER else 'leaf'}"
+                    f") held while acquiring {e.dst} (tier "
+                    f"{LOCK_HIERARCHY[dt][0] if dt < _LEAF_TIER else 'leaf'}"
+                    f") via {_chain_display(e.chain)}")
+        index.summaries[self.rule] = (
+            f"{n_locks} ordered locks, {len(edges)} acquisition "
+            f"edges, {len(cycles)} cycle(s), {violations} "
+            f"violation(s); hierarchy verified: {hierarchy_display()}")
+
+
+@register
+class BlockingUnderLockChecker(ProjectChecker):
+    rule = "CONC004"
+    title = "blocking call reachable while a lock is held"
+    hint = ("do the blocking work outside the critical section: "
+            "snapshot state under the lock, release, then "
+            "sleep/wait/transfer (serving/fleet.py's drain-outside-"
+            "lock pattern)")
+
+    def project_check(self, index: ProjectIndex) -> Iterator[Diagnostic]:
+        emitted = set()
+        flagged = 0
+        for fq, rec in index.functions.items():
+            facts, path = rec["facts"], rec["path"]
+            for ev in facts.get("blocking", []):
+                held = index.held_lock_ids(fq, ev.get("held", []))
+                d = self._event_diag(index, rec, fq, ev, held,
+                                     ev["line"], None)
+                if d and (d.path, d.line, d.message) not in emitted:
+                    emitted.add((d.path, d.line, d.message))
+                    flagged += 1
+                    yield d
+            for call in facts.get("calls", []):
+                if len(call) < 3:
+                    continue
+                desc, line, held_refs = call
+                held = index.held_lock_ids(fq, held_refs)
+                if not held:
+                    continue
+                for callee, certain in index.resolve_call(fq, desc):
+                    if not certain:
+                        continue
+                    for ev in index.eventual_blocking(callee):
+                        d = self._event_diag(index, rec, fq, ev, held,
+                                             line, callee)
+                        if d and (d.path, d.line,
+                                  d.message) not in emitted:
+                            emitted.add((d.path, d.line, d.message))
+                            flagged += 1
+                            yield d
+        index.summaries[self.rule] = (
+            f"{flagged} blocking-under-lock site(s)")
+
+    def _event_diag(self, index, rec, fq, ev, held, line,
+                    callee) -> Optional[Diagnostic]:
+        if not held:
+            return None
+        kind = ev["kind"]
+        if kind == "event_wait" and ev.get("bounded"):
+            return None  # bounded stop-flag polls are the idiom
+        ev_lock = ev.get("lock")
+        if ev_lock is None and ev.get("ref") is not None:
+            resolved = index.resolve_lockref(rec["module"], ev["ref"])
+            ev_lock = resolved[0] if resolved else None
+        if kind == "cond_wait":
+            # waiting on a condition releases its own lock — exempt
+            # when that is the only lock held
+            if len(held) == 1 and ev_lock == held[0][0]:
+                return None
+        held_ids = ", ".join(h[0] for h in held)
+        if callee is None:
+            msg = (f"{ev['api']} while holding {held_ids}")
+        else:
+            tail = ev["chain"][-1]
+            msg = (f"call into {callee} may block "
+                   f"({ev['api']} at {tail[0]}:{tail[1]}) while "
+                   f"holding {held_ids}")
+        return self.pdiag(rec["path"], line, msg)

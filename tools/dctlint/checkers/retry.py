@@ -50,7 +50,7 @@ class HandRolledRetry(Checker):
         if Path(ctx.path).as_posix().endswith("utils/retry.py"):
             return
         flagged: Set[ast.AST] = set()  # dedupe sleeps under nested loops
-        for loop in ast.walk(ctx.tree):
+        for loop in ctx.nodes:
             if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
                 continue
             body = list(self._loop_nodes(loop))

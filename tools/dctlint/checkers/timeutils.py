@@ -10,11 +10,12 @@ arithmetic on ``time.time()`` is flagged.
 from __future__ import annotations
 
 import ast
-from typing import Iterator, Set
+from typing import Dict, Iterator, Optional, Set
 
 from tools.dctlint.core import Checker, Diagnostic, FileContext, register
 
 WALL_CLOCK = "time.time"
+_LAMBDA = object()  # sentinel scope: node lives inside a lambda body
 
 
 def _is_wall_call(ctx: FileContext, node: ast.AST) -> bool:
@@ -31,53 +32,59 @@ class WallClockArithmetic(Checker):
 
     def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
         # per-scope: `now` may be wall clock in one function and monotonic
-        # in its neighbor — taint must not leak across function boundaries
-        scopes = [ctx.tree] + [n for n in ast.walk(ctx.tree)
-                               if isinstance(n, (ast.FunctionDef,
-                                                 ast.AsyncFunctionDef))]
-        for scope in scopes:
-            yield from self._check_scope(ctx, scope)
-
-    def _scope_nodes(self, scope: ast.AST) -> Iterator[ast.AST]:
-        """Walk a scope without descending into nested function scopes."""
-        stack = list(ast.iter_child_nodes(scope))
-        while stack:
-            node = stack.pop()
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                 ast.Lambda)):
-                continue
-            yield node
-            stack.extend(ast.iter_child_nodes(node))
-
-    def _check_scope(self, ctx: FileContext,
-                     scope: ast.AST) -> Iterator[Diagnostic]:
-        # names assigned directly from time.time() in THIS scope
-        wall_names: Set[str] = set()
-        for node in self._scope_nodes(scope):
+        # in its neighbor — taint must not leak across function boundaries.
+        # Two linear passes over the prebuilt node list (grouping each
+        # node under its innermost function via the parent links) replace
+        # the old walk-per-scope, which was quadratic in nesting depth.
+        wall_names: Dict[Optional[ast.AST], Set[str]] = {}
+        for node in ctx.nodes:
             if isinstance(node, ast.Assign) \
                     and _is_wall_call(ctx, node.value):
+                scope = self._scope_of(ctx, node)
+                if scope is _LAMBDA:
+                    continue
                 for t in node.targets:
                     if isinstance(t, ast.Name):
-                        wall_names.add(t.id)
+                        wall_names.setdefault(scope, set()).add(t.id)
 
-        def tainted(expr: ast.AST) -> bool:
+        def tainted(scope, expr: ast.AST) -> bool:
             if _is_wall_call(ctx, expr):
                 return True
-            return isinstance(expr, ast.Name) and expr.id in wall_names
+            return isinstance(expr, ast.Name) \
+                and expr.id in wall_names.get(scope, ())
 
-        for node in self._scope_nodes(scope):
+        for node in ctx.nodes:
             if isinstance(node, ast.BinOp) \
-                    and isinstance(node.op, (ast.Add, ast.Sub)) \
-                    and (tainted(node.left) or tainted(node.right)):
-                yield self.diag(
-                    ctx, node,
-                    f"duration/deadline arithmetic on time.time() "
-                    f"(`{ast.unparse(node)}`): wall clock can jump under "
-                    f"NTP, so the result may be negative or never expire")
+                    and isinstance(node.op, (ast.Add, ast.Sub)):
+                scope = self._scope_of(ctx, node)
+                if scope is _LAMBDA:
+                    continue
+                if tainted(scope, node.left) or tainted(scope, node.right):
+                    yield self.diag(
+                        ctx, node,
+                        f"duration/deadline arithmetic on time.time() "
+                        f"(`{ast.unparse(node)}`): wall clock can jump "
+                        f"under NTP, so the result may be negative or "
+                        f"never expire")
             elif isinstance(node, ast.AugAssign) \
-                    and isinstance(node.op, (ast.Add, ast.Sub)) \
-                    and tainted(node.value):
-                yield self.diag(
-                    ctx, node,
-                    f"duration accumulation from time.time() "
-                    f"(`{ast.unparse(node)}`): use time.monotonic()")
+                    and isinstance(node.op, (ast.Add, ast.Sub)):
+                scope = self._scope_of(ctx, node)
+                if scope is not _LAMBDA and tainted(scope, node.value):
+                    yield self.diag(
+                        ctx, node,
+                        f"duration accumulation from time.time() "
+                        f"(`{ast.unparse(node)}`): use time.monotonic()")
+
+    @staticmethod
+    def _scope_of(ctx: FileContext, node: ast.AST):
+        """Innermost enclosing function def, None at module scope, or
+        the _LAMBDA sentinel (lambda bodies are not scopes here — the
+        old walker skipped them entirely)."""
+        cur = ctx.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.Lambda):
+                return _LAMBDA
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = ctx.parents.get(cur)
+        return None

@@ -26,6 +26,7 @@ Concepts
 from __future__ import annotations
 
 import ast
+import collections
 import dataclasses
 import json
 import re
@@ -62,18 +63,43 @@ class Diagnostic:
 
 
 class FileContext:
-    """A parsed source file plus the alias tables checkers share."""
+    """A parsed source file plus the alias tables checkers share.
 
-    def __init__(self, path: str, source: str, tree: ast.Module) -> None:
+    When ``module`` is known (the runner derives it from the path
+    relative to the project root), relative imports resolve too:
+    ``from .transfer import get_pool`` inside
+    ``determined_clone_tpu.storage.cas`` lands in ``name_imports`` as
+    ``determined_clone_tpu.storage.transfer.get_pool``, so cross-file
+    call-graph edges survive the project's own import style.
+    """
+
+    def __init__(self, path: str, source: str, tree: ast.Module, *,
+                 module: Optional[str] = None,
+                 is_package: bool = False) -> None:
         self.path = path
         self.source = source
         self.lines = source.splitlines()
         self.tree = tree
+        self.module = module
+        self.is_package = is_package
         # module alias -> canonical module ("np" -> "numpy"), and
         # imported name -> canonical dotted name ("scan" -> "jax.lax.scan")
         self.module_aliases: Dict[str, str] = {}
         self.name_imports: Dict[str, str] = {}
-        for node in ast.walk(tree):
+        # one traversal builds the flat node list (ast.walk order),
+        # parent links, and the import tables — checkers iterate
+        # ``self.nodes`` instead of re-walking the tree (the repeated
+        # ast.walk per checker dominated the per-file pass)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        self.nodes: List[ast.AST] = []
+        todo = collections.deque([tree])
+        while todo:
+            n = todo.popleft()
+            self.nodes.append(n)
+            for child in ast.iter_child_nodes(n):
+                self.parents[child] = n
+                todo.append(child)
+        for node in self.nodes:
             if isinstance(node, ast.Import):
                 for a in node.names:
                     self.module_aliases[a.asname or a.name.split(".")[0]] = (
@@ -83,11 +109,22 @@ class FileContext:
                 for a in node.names:
                     self.name_imports[a.asname or a.name] = (
                         f"{node.module}.{a.name}")
-        # parent links let checkers walk enclosing scopes
-        self.parents: Dict[ast.AST, ast.AST] = {}
-        for parent in ast.walk(tree):
-            for child in ast.iter_child_nodes(parent):
-                self.parents[child] = parent
+            elif isinstance(node, ast.ImportFrom) and node.level > 0 \
+                    and self.module:
+                pkg = self.module.split(".")
+                if not self.is_package:
+                    pkg = pkg[:-1]
+                cut = len(pkg) - (node.level - 1)
+                if cut < 0:
+                    continue  # beyond the root: unresolvable here
+                base = pkg[:cut]
+                target = ".".join(
+                    base + ([node.module] if node.module else []))
+                if not target:
+                    continue
+                for a in node.names:
+                    self.name_imports[a.asname or a.name] = (
+                        f"{target}.{a.name}")
 
     def qualified_name(self, node: ast.AST) -> Optional[str]:
         """Canonical dotted name of a Name/Attribute chain, with import
@@ -127,6 +164,8 @@ class Checker:
     rule: str = "DCT999"
     title: str = ""
     hint: str = ""
+    #: True for whole-program checkers (see :class:`ProjectChecker`)
+    project: bool = False
 
     def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
         raise NotImplementedError
@@ -135,6 +174,28 @@ class Checker:
              hint: Optional[str] = None) -> Diagnostic:
         return Diagnostic(rule=self.rule, path=ctx.path,
                           line=getattr(node, "lineno", 0), message=message,
+                          hint=self.hint if hint is None else hint)
+
+
+class ProjectChecker(Checker):
+    """Whole-program checker: sees the :class:`ProjectIndex` built over
+    every linted file instead of one FileContext at a time. The
+    per-file hook is a no-op; implement ``project_check`` and yield
+    diagnostics whose ``path`` is a display path from the index so
+    per-line suppressions and the baseline apply as usual."""
+
+    project = True
+
+    def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
+        return iter(())
+
+    def project_check(self, index) -> Iterator[Diagnostic]:
+        raise NotImplementedError
+
+    def pdiag(self, path: str, line: int, message: str,
+              hint: Optional[str] = None) -> Diagnostic:
+        return Diagnostic(rule=self.rule, path=path, line=line,
+                          message=message,
                           hint=self.hint if hint is None else hint)
 
 
@@ -277,13 +338,196 @@ def iter_python_files(roots: Sequence[str]) -> Iterator[Path]:
             yield p
 
 
+# -- per-file worker (runs in the pool; must stay module-level) ------------
+
+def _analyze_source(display: str, source: str, module: Optional[str],
+                    is_package: bool) -> Dict[str, object]:
+    """Parse + per-file checkers + facts extraction for one file.
+    Returns a JSON/pickle-friendly dict (the cache entry payload)."""
+    import tools.dctlint  # noqa: F401  (registers checkers in workers)
+    from tools.dctlint import project as _project
+    try:
+        tree = ast.parse(source, filename=display)
+    except SyntaxError as e:
+        d = Diagnostic(rule="DCT001", path=display, line=e.lineno or 0,
+                       message=f"syntax error: {e.msg}",
+                       hint="dctlint only lints parseable files")
+        return {"display": display, "diags": [dataclasses.asdict(d)],
+                "facts": None}
+    ctx = FileContext(display, source, tree, module=module,
+                      is_package=is_package)
+    suppressed, diags = parse_suppressions(ctx.lines, display)
+    for checker in CHECKERS.values():
+        if not checker.project:
+            diags.extend(checker.check(ctx))
+    kept = [dataclasses.asdict(d) for d in diags
+            if not _is_suppressed(d, suppressed)]
+    return {"display": display, "diags": kept,
+            "facts": _project.extract_facts(ctx)}
+
+
+def _analyze_args(args) -> Dict[str, object]:
+    return _analyze_source(*args)
+
+
+def _toolchain_signature() -> str:
+    """Fingerprint of the dctlint sources themselves: a cache entry is
+    stale the moment any checker or the extractor changes."""
+    import hashlib
+    h = hashlib.sha256()
+    pkg = Path(__file__).resolve().parent
+    for f in sorted(pkg.rglob("*.py")):
+        st = f.stat()
+        h.update(f"{f.name}:{st.st_mtime_ns}:{st.st_size};".encode())
+    return h.hexdigest()[:16]
+
+
+def _load_cache(path: Optional[Path]) -> Dict[str, dict]:
+    if path is None or not Path(path).exists():
+        return {}
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return data if isinstance(data, dict) else {}
+
+
+def _save_cache(path: Optional[Path], entries: Dict[str, dict]) -> None:
+    if path is None:
+        return
+    tmp = Path(str(path) + ".tmp")
+    try:
+        with open(tmp, "w") as f:
+            json.dump(entries, f)
+        tmp.replace(path)
+    except OSError:
+        pass  # a cold cache next run is the only consequence
+
+
+def _select_rules(select: Optional[Sequence[str]]) -> Optional[set]:
+    if not select:
+        return None
+    # framework diagnostics always surface, whatever the selection
+    return set(select) | {"DCT000", "DCT001"}
+
+
 def run(paths: Sequence[str], *, select: Optional[Sequence[str]] = None,
         baseline: Optional[Path] = None,
-        relative_to: Optional[Path] = None) -> List[Diagnostic]:
-    """Lint ``paths`` (files or directories), minus baseline entries."""
-    diags: List[Diagnostic] = []
+        relative_to: Optional[Path] = None,
+        jobs: int = 0,
+        cache_path: Optional[Path] = None,
+        changed_only: Optional[set] = None,
+        stats: Optional[dict] = None) -> List[Diagnostic]:
+    """Lint ``paths`` (files or directories), minus baseline entries.
+
+    The per-file pass (parse, per-file checkers, facts extraction) runs
+    over a worker pool (``jobs``: 0 auto, 1 serial) with a content-hash
+    cache at ``cache_path``; the project pass then builds a
+    :class:`ProjectIndex` from every file's facts and runs the
+    project-scope checkers. ``changed_only`` (a set of display paths)
+    filters *reporting* to touched files after the full-index project
+    pass, so cross-file checks stay sound under ``--changed``.
+    ``stats``, when a dict, is filled with wall/cache/summary info.
+    """
+    import hashlib
+    import time
+    from tools.dctlint import project as _project
+
+    t0 = time.perf_counter()
+    root = Path(relative_to).resolve() if relative_to else None
+    work: List[Tuple[str, str, Optional[str], bool]] = []
+    seen: set = set()
     for f in iter_python_files(paths):
-        diags.extend(lint_file(f, select=select, relative_to=relative_to))
+        display = str(f)
+        rel = None
+        if root is not None:
+            try:
+                rel = Path(f).resolve().relative_to(root)
+                display = str(rel)
+            except ValueError:
+                pass  # outside the root: keep the path as given
+        if display in seen:
+            continue
+        seen.add(display)
+        module, is_package = _project.module_name_for(
+            rel.as_posix() if rel is not None else display)
+        work.append((display, Path(f).read_text(), module, is_package))
+
+    sig = _toolchain_signature()
+    cache = _load_cache(cache_path) if cache_path else {}
+    results: Dict[str, dict] = {}
+    pending: List[Tuple[str, str, Optional[str], bool]] = []
+    hashes: Dict[str, str] = {}
+    for display, source, module, is_package in work:
+        sha = hashlib.sha256(source.encode()).hexdigest()
+        hashes[display] = sha
+        entry = cache.get(display)
+        if entry and entry.get("sha") == sha and entry.get("sig") == sig:
+            results[display] = entry["result"]
+        else:
+            pending.append((display, source, module, is_package))
+    cache_hits = len(results)
+
+    if jobs == 0:
+        import os
+        jobs = min(8, os.cpu_count() or 1) if len(pending) >= 24 else 1
+    if jobs > 1 and len(pending) > 1:
+        import concurrent.futures
+        try:
+            with concurrent.futures.ProcessPoolExecutor(
+                    max_workers=jobs) as pool:
+                for res in pool.map(_analyze_args, pending,
+                                    chunksize=8):
+                    results[res["display"]] = res
+        except (OSError, concurrent.futures.process.BrokenProcessPool):
+            jobs = 1  # fall back below on whatever is still missing
+    if jobs <= 1 or any(d not in results for d, *_ in pending):
+        for args in pending:
+            if args[0] not in results:
+                results[args[0]] = _analyze_args(args)
+
+    if cache_path is not None:
+        _save_cache(cache_path, {
+            display: {"sha": hashes[display], "sig": sig,
+                      "result": results[display]}
+            for display, *_ in work})
+
+    rules = _select_rules(select)
+    diags: List[Diagnostic] = []
+    files_facts: Dict[str, dict] = {}
+    for display, *_ in work:
+        res = results[display]
+        for d in res["diags"]:
+            if rules is None or d["rule"] in rules:
+                diags.append(Diagnostic(**d))
+        if res.get("facts"):
+            files_facts[display] = res["facts"]
+
+    index = _project.build_index(files_facts, root=root)
+    project_checkers = [c for c in CHECKERS.values() if c.project
+                        and (select is None or c.rule in select)]
+    for checker in project_checkers:
+        for d in checker.project_check(index):
+            if not _is_suppressed(d, index.suppressed_for(d.path)):
+                diags.append(d)
+
+    if changed_only is not None:
+        changed = {Path(p).as_posix() for p in changed_only}
+        diags = [d for d in diags
+                 if Path(d.path).as_posix() in changed]
     if baseline is not None:
         diags = apply_baseline(diags, load_baseline(baseline))
-    return sorted(diags, key=lambda d: (d.path, d.line, d.rule))
+    diags = sorted(diags, key=lambda d: (d.path, d.line, d.rule))
+    if stats is not None:
+        stats.update({
+            "files": len(work),
+            "cache_hits": cache_hits,
+            "analyzed": len(pending),
+            "jobs": max(jobs, 1),
+            "wall_s": time.perf_counter() - t0,
+            "project_checkers": sorted(c.rule for c in project_checkers),
+            "summaries": dict(index.summaries),
+            "violations": len(diags),
+        })
+    return diags

@@ -1,0 +1,1200 @@
+"""Whole-program analysis for dctlint (ISSUE 18): per-file facts and the
+ProjectIndex.
+
+PR 3's dctlint is per-file AST only: it cannot see that ``ServingFleet``
+holds ``_lock`` while calling into a ``Replica`` whose ``drain`` blocks
+on the engine condition, or that a ``faults.point("x")`` site has no row
+in docs/fault_tolerance.md. This module adds the project pass:
+
+- :func:`extract_facts` reduces one parsed file to a JSON-serializable
+  **facts** dict — symbols (classes, functions, typed ``self`` attrs,
+  locks/queues/events), alias-resolved call descriptors per function,
+  lock-acquisition events with the lexically-held lock stack, candidate
+  blocking calls, fault points, metric families, jit/shard_map/scan
+  trace targets, config-schema literals, and the per-line suppression
+  map. Facts are small and picklable, so the per-file pass can run in a
+  worker pool and be cached keyed by content hash (see core.run).
+- :class:`ProjectIndex` stitches the facts of every file into a symbol
+  table and an import-aware call graph (``self.m`` via the class MRO,
+  typed attributes/locals via recorded constructor calls, bare names via
+  module scope, imports via alias resolution including relative
+  imports), then offers the primitives project-scope checkers build on:
+  :meth:`resolve_call`, :meth:`resolve_lockref`,
+  :meth:`eventual_acquires`, :meth:`eventual_blocking`.
+
+Design notes (docs/static_analysis.md "Whole-program analysis"):
+
+- The call graph is *may-call* and deliberately over-approximate, but
+  every edge carries a confidence bit: **certain** edges come from
+  ``self`` calls, typed receivers, module functions and imports;
+  **heuristic** edges come from method-name matching on untyped
+  receivers and are capped (a name defined on more than
+  ``HEURISTIC_CLASS_CAP`` classes, or in ``HEURISTIC_STOPLIST``, makes
+  no edge). Checkers choose which confidence they propagate over.
+- Lock identity is the *defining site*: ``module.Class.attr`` or
+  ``module.varname``. ``Condition(self._lock)`` aliases to the wrapped
+  lock's identity, so waiting on the condition and holding the lock are
+  the same lock to the analysis (storage/transfer.py does exactly
+  this).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
+
+# Bump whenever the shape of the facts dict changes: the content-hash
+# cache in core.run keys on (source sha, FACTS_VERSION, toolchain sig).
+FACTS_VERSION = 1
+
+# Constructor qualified-names that give a ``self`` attribute (or module
+# global) a kind the concurrency checkers understand.
+LOCK_FACTORIES = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+    "threading.Event": "event",
+    "threading.Semaphore": "semaphore",
+    "threading.BoundedSemaphore": "semaphore",
+    "threading.Thread": "thread",
+    "queue.Queue": "queue",
+    "queue.LifoQueue": "queue",
+    "queue.PriorityQueue": "queue",
+    "queue.SimpleQueue": "queue",
+}
+_HELD_KINDS = {"lock", "rlock", "condition"}
+
+# Entry points whose first function argument is traced by XLA.
+TRACE_ENTRIES = {
+    "jax.jit", "jax.pjit", "pjit", "jax.experimental.pjit.pjit",
+    "jax.pmap", "jax.shard_map", "shard_map",
+    "jax.experimental.shard_map.shard_map", "jax.lax.scan",
+}
+
+# Attribute-call fallback: a bare ``x.m()`` with unknown receiver type
+# only resolves heuristically when ``m`` is defined on few classes and
+# is not a ubiquitous protocol name.
+HEURISTIC_CLASS_CAP = 3
+HEURISTIC_STOPLIST = frozenset({
+    "get", "put", "set", "add", "remove", "close", "start", "stop",
+    "run", "join", "items", "keys", "values", "append", "pop",
+    "update", "copy", "clear", "read", "write", "send", "recv",
+    "result", "wait", "acquire", "release", "notify", "notify_all",
+    "observe", "inc", "dec", "format", "validate", "dump", "load",
+    "open", "next", "reset", "flush", "name", "info", "debug",
+    "warning", "error", "exists", "submit", "encode", "decode",
+})
+
+_METRIC_METHODS = {"counter", "gauge", "histogram"}
+_METRIC_CLASSES = {"Counter": "counter", "Gauge": "gauge",
+                   "Histogram": "histogram"}
+_HTTP_PREFIXES = ("requests.", "urllib.request.", "http.client.")
+_SUBPROCESS_BLOCKING = {"subprocess.run", "subprocess.check_call",
+                        "subprocess.check_output", "subprocess.call"}
+
+
+def module_name_for(display_path: str) -> Tuple[Optional[str], bool]:
+    """(dotted module name, is_package) for a root-relative path.
+
+    ``determined_clone_tpu/serving/fleet.py`` ->
+    ``determined_clone_tpu.serving.fleet``; ``pkg/__init__.py`` ->
+    ``pkg`` (is_package=True); non-``.py`` or absolute-ish paths fall
+    back to the stem so fixture files still get a namespace.
+    """
+    p = display_path.replace("\\", "/")
+    if not p.endswith(".py"):
+        return None, False
+    parts = [s for s in p[:-3].split("/") if s and s != "."]
+    if not parts:
+        return None, False
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+        return (".".join(parts) or None), True
+    return ".".join(parts), False
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _attr_chain(func: ast.Attribute) -> Tuple[Optional[str], List[str]]:
+    """(base Name id or None, attribute parts outermost-last)."""
+    chain: List[str] = []
+    cur: ast.AST = func
+    while isinstance(cur, ast.Attribute):
+        chain.append(cur.attr)
+        cur = cur.value
+    chain.reverse()
+    if isinstance(cur, ast.Name):
+        return cur.id, chain
+    return None, chain
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for k in call.keywords:
+        if k.arg == name:
+            return k.value
+    return None
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    return bool(call.args) or _kw(call, "timeout") is not None
+
+
+class _Extractor:
+    """One file -> facts dict. Drives an explicit recursive walk so the
+    lexically-held lock stack is tracked through ``with`` nesting and
+    reset at nested function boundaries (a closure defined under a lock
+    does not *run* under it)."""
+
+    def __init__(self, ctx) -> None:
+        self.ctx = ctx
+        self.module: Optional[str] = getattr(ctx, "module", None)
+        if self.module is None:
+            self.module, _ = module_name_for(ctx.path)
+        self.functions: Dict[str, Dict[str, Any]] = {}
+        self.classes: Dict[str, Dict[str, Any]] = {}
+        self.module_locks: Dict[str, Dict[str, Any]] = {}
+        self.fault_points: List[List[Any]] = []
+        self.metrics: List[List[Any]] = []
+        self.jit_targets: List[Dict[str, Any]] = []
+        self.schemas: List[Dict[str, Any]] = []
+        self.dataclass_fields: Dict[str, List[str]] = {}
+        self.str_keys: Set[str] = set()
+        # module-scope names defined in this file: name -> local path
+        self.module_defs: Dict[str, str] = {}
+        self.module_classes: Set[str] = set()
+        # transient per-function state
+        self._fn: Optional[Dict[str, Any]] = None
+        self._cls: Optional[str] = None
+        self._held: List[List[Any]] = []
+        self._local_types: Dict[str, str] = {}
+        self._nested: Dict[str, str] = {}
+        self._globals: Set[str] = set()
+
+    # -- top level ----------------------------------------------------
+
+    def extract(self) -> Dict[str, Any]:
+        for stmt in self.ctx.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.module_defs[stmt.name] = stmt.name
+            elif isinstance(stmt, ast.ClassDef):
+                self.module_defs[stmt.name] = stmt.name
+                self.module_classes.add(stmt.name)
+        for stmt in self.ctx.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._visit_function(stmt, stmt.name, None)
+            elif isinstance(stmt, ast.ClassDef):
+                self._visit_class(stmt)
+            else:
+                self._module_level_stmt(stmt)
+        suppressed, _ = _parse_suppressions(self.ctx)
+        return {
+            "v": FACTS_VERSION,
+            "path": self.ctx.path,
+            "module": self.module,
+            "name_imports": dict(self.ctx.name_imports),
+            "module_aliases": dict(self.ctx.module_aliases),
+            "classes": self.classes,
+            "module_locks": self.module_locks,
+            "functions": self.functions,
+            "fault_points": self.fault_points,
+            "metrics": self.metrics,
+            "jit_targets": self.jit_targets,
+            "schemas": self.schemas,
+            "dataclass_fields": self.dataclass_fields,
+            "str_keys": sorted(self.str_keys),
+            "suppressed": {str(k): sorted(v)
+                           for k, v in suppressed.items()},
+        }
+
+    def _module_level_stmt(self, stmt: ast.stmt) -> None:
+        # module-global locks/queues: ``_pool_lock = threading.Lock()``
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and isinstance(stmt.value, ast.Call):
+            q = self.ctx.qualified_name(stmt.value.func)
+            kind = LOCK_FACTORIES.get(q or "")
+            if kind:
+                self.module_locks[stmt.targets[0].id] = {
+                    "kind": kind, "line": stmt.lineno,
+                    "alias_of": self._cond_alias(stmt.value, None),
+                }
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and stmt.targets[0].id.endswith("_SCHEMA") \
+                and isinstance(stmt.value, ast.Dict):
+            # walk the AST instead of literal_eval: property values may
+            # reference other *_SCHEMA names, only the keys must be
+            # constant strings
+            props = None
+            for k, v in zip(stmt.value.keys, stmt.value.values):
+                if _const_str(k) == "properties" \
+                        and isinstance(v, ast.Dict):
+                    props = v
+                    break
+            if props is not None:
+                keys = [s for s in (_const_str(k) for k in props.keys)
+                        if s is not None]
+                self.schemas.append({
+                    "name": stmt.targets[0].id,
+                    "line": stmt.lineno,
+                    "keys": sorted(keys),
+                })
+        # still collect calls (metrics/fault points at module scope)
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._walk_expr(child)
+
+    # -- classes ------------------------------------------------------
+
+    def _visit_class(self, node: ast.ClassDef, prefix: str = "") -> None:
+        name = prefix + node.name
+        bases = [b for b in
+                 (self.ctx.qualified_name(x) for x in node.bases) if b]
+        info = {"line": node.lineno, "bases": bases,
+                "attrs": {}, "methods": []}
+        self.classes[name] = info
+        if self._is_dataclass(node):
+            fields = [s.target.id for s in node.body
+                      if isinstance(s, ast.AnnAssign)
+                      and isinstance(s.target, ast.Name)]
+            self.dataclass_fields[name] = fields
+        self._prescan_class_attrs(node, name)
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info["methods"].append(stmt.name)
+                self._visit_function(stmt, f"{name}.{stmt.name}", name)
+            elif isinstance(stmt, ast.ClassDef):
+                self._visit_class(stmt, prefix=name + ".")
+
+    def _prescan_class_attrs(self, node: ast.ClassDef,
+                             name: str) -> None:
+        """Collect ``self.X = factory()`` attrs from every method before
+        any body is analyzed, so a method defined above ``__init__`` can
+        still classify ``self._cond.wait()`` receivers."""
+        saved = self._cls
+        self._cls = name
+        try:
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                for t in sub.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        self._class_attr_assign(
+                            t.attr, sub.value, t.lineno)
+        finally:
+            self._cls = saved
+
+    def _is_dataclass(self, node: ast.ClassDef) -> bool:
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            q = self.ctx.qualified_name(target)
+            if q in ("dataclasses.dataclass", "dataclass"):
+                return True
+        return False
+
+    # -- functions ----------------------------------------------------
+
+    def _visit_function(self, node, local: str,
+                        cls: Optional[str]) -> None:
+        outer = (self._fn, self._cls, self._held, self._local_types,
+                 self._nested, self._globals)
+        decorators = []
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            q = self.ctx.qualified_name(target)
+            if q:
+                decorators.append(q)
+            if isinstance(dec, ast.Call):  # @partial(jax.jit, ...)
+                inner = self._unwrap_partial_entry(dec)
+                if inner:
+                    decorators.append(inner)
+        fn = {"line": node.lineno, "cls": cls, "calls": [],
+              "acquires": [], "blocking": [], "stores_self": [],
+              "reads_self": [], "stores_global": [],
+              "decorators": decorators}
+        self.functions[local] = fn
+        self._fn, self._cls = fn, cls
+        self._held = []
+        self._local_types = {}
+        self._nested = {}
+        self._globals = set()
+        if any(q in TRACE_ENTRIES for q in decorators):
+            self.jit_targets.append({"t": ["l", local],
+                                     "line": node.lineno,
+                                     "entry": "decorator"})
+        self._prescan_nested(node.body, local)
+        self._walk_stmts(node.body, local, cls)
+        (self._fn, self._cls, self._held, self._local_types,
+         self._nested, self._globals) = outer
+
+    def _unwrap_partial_entry(self, call: ast.Call) -> Optional[str]:
+        q = self.ctx.qualified_name(call.func)
+        if q in ("functools.partial", "partial") and call.args:
+            inner = self.ctx.qualified_name(call.args[0])
+            if inner in TRACE_ENTRIES:
+                return inner
+        return None
+
+    def _prescan_nested(self, body, local: str) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._nested[stmt.name] = f"{local}.<locals>.{stmt.name}"
+
+    # -- statement walk with a held-lock stack ------------------------
+
+    def _walk_stmts(self, body, local: str, cls: Optional[str]) -> None:
+        i = 0
+        while i < len(body):
+            stmt = body[i]
+            consumed = self._maybe_acquire_try(body, i, local, cls)
+            if consumed:
+                i += consumed
+                continue
+            self._walk_stmt(stmt, local, cls)
+            i += 1
+
+    def _maybe_acquire_try(self, body, i, local, cls) -> int:
+        """Handle ``X.acquire(); try: ... finally: X.release()`` as a
+        lock region (the shape CONC002 enforces). Returns number of
+        statements consumed, 0 if the pattern does not match."""
+        stmt = body[i]
+        if not (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Call)):
+            return 0
+        func = stmt.value.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr == "acquire"):
+            return 0
+        ref = self._lockref(func.value, cls)
+        if ref is None or i + 1 >= len(body) \
+                or not isinstance(body[i + 1], ast.Try):
+            return 0
+        self._record_acquire(ref, stmt.lineno)
+        self._held.append(ref)
+        try:
+            self._walk_stmt(body[i + 1], local, cls)
+        finally:
+            self._held.pop()
+        return 2
+
+    def _walk_stmt(self, stmt: ast.stmt, local: str,
+                   cls: Optional[str]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            nested_local = self._nested.get(
+                stmt.name, f"{local}.<locals>.{stmt.name}")
+            self._visit_function(stmt, nested_local, cls)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return  # classes defined inside functions: out of scope
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self._walk_with(stmt, local, cls)
+            return
+        if isinstance(stmt, (ast.Global, ast.Nonlocal)):
+            self._globals.update(stmt.names)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            self._walk_assign(stmt)
+            return
+        # generic: walk child expressions, recurse into child stmt lists
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._walk_expr(child)
+            elif isinstance(child, ast.stmt):
+                self._walk_stmt(child, local, cls)
+            elif isinstance(child, (ast.excepthandler, ast.match_case)):
+                for sub in ast.iter_child_nodes(child):
+                    if isinstance(sub, ast.stmt):
+                        self._walk_stmt(sub, local, cls)
+                    elif isinstance(sub, ast.expr):
+                        self._walk_expr(sub)
+
+    def _walk_with(self, stmt, local: str, cls: Optional[str]) -> None:
+        pushed = 0
+        for item in stmt.items:
+            ref = self._lockref(item.context_expr, cls)
+            if ref is not None:
+                self._record_acquire(ref, item.context_expr.lineno)
+                self._held.append(ref)
+                pushed += 1
+            else:
+                self._walk_expr(item.context_expr)
+        try:
+            self._walk_stmts(stmt.body, local, cls)
+        finally:
+            for _ in range(pushed):
+                self._held.pop()
+
+    def _record_acquire(self, ref, line: int) -> None:
+        if self._fn is not None:
+            self._fn["acquires"].append(
+                {"l": ref, "line": line, "held": list(self._held)})
+
+    def _lockref(self, expr: ast.AST,
+                 cls: Optional[str]) -> Optional[List[Any]]:
+        """A lock-identity reference for an acquired expression:
+        ``["c", Class, attr]`` for ``self.attr``, ``["g", name]`` for a
+        module-level lock, ``["i", dotted]`` for an imported one."""
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and cls:
+            return ["c", cls, expr.attr]
+        if isinstance(expr, ast.Name):
+            if expr.id in self.module_locks:
+                return ["g", expr.id]
+            dotted = self.ctx.name_imports.get(expr.id)
+            if dotted:
+                return ["i", dotted]
+        return None
+
+    # -- assignments --------------------------------------------------
+
+    def _walk_assign(self, stmt) -> None:
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        value = stmt.value
+        if value is not None:
+            self._walk_expr(value)
+        for t in targets:
+            self._assign_target(t, value, aug=isinstance(
+                stmt, ast.AugAssign))
+
+    def _assign_target(self, t, value, *, aug: bool) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                self._assign_target(el, None, aug=aug)
+            return
+        fn = self._fn
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name):
+            if t.value.id == "self" and self._cls:
+                if fn is not None:
+                    fn["stores_self"].append([t.attr, t.lineno])
+                    if aug:
+                        fn["reads_self"].append([t.attr, t.lineno])
+                self._class_attr_assign(t.attr, value, t.lineno)
+            elif t.value.id in self.ctx.module_aliases:
+                # ``mod.GLOBAL = x`` — a module-attribute store
+                if fn is not None:
+                    dotted = self.ctx.qualified_name(t)
+                    fn["stores_global"].append(
+                        [dotted or t.attr, t.lineno])
+            return
+        if isinstance(t, ast.Name):
+            if fn is not None and t.id in self._globals:
+                fn["stores_global"].append([t.id, t.lineno])
+            inst = self._instance_type(value)
+            if inst:
+                self._local_types[t.id] = inst
+            elif not aug:
+                self._local_types.pop(t.id, None)
+            return
+        if isinstance(t, ast.Subscript):
+            self._walk_expr(t.value)
+            self._walk_expr(t.slice)
+            key = _const_str(t.slice)
+            if key is not None:
+                self.str_keys.add(key)
+
+    def _class_attr_assign(self, attr: str, value, line: int) -> None:
+        cls = self._cls
+        if cls is None or cls not in self.classes:
+            return
+        attrs = self.classes[cls]["attrs"]
+        if isinstance(value, ast.Call):
+            q = self.ctx.qualified_name(value.func)
+            kind = LOCK_FACTORIES.get(q or "")
+            if kind:
+                attrs[attr] = {"kind": kind, "line": line,
+                               "alias_of": self._cond_alias(value, cls)}
+                return
+            inst = self._instance_type(value)
+            if inst and attr not in attrs:
+                attrs[attr] = {"kind": "instance", "of": inst,
+                               "line": line}
+                return
+        # plain data attribute: remember the store site for mutability
+        if attr not in attrs:
+            attrs[attr] = {"kind": "data", "line": line}
+
+    def _cond_alias(self, call: ast.Call,
+                    cls: Optional[str]) -> Optional[List[Any]]:
+        """``threading.Condition(self._lock)`` -> the wrapped lockref."""
+        q = self.ctx.qualified_name(call.func)
+        if q != "threading.Condition" or not call.args:
+            return None
+        return self._lockref(call.args[0], cls)
+
+    def _instance_type(self, value) -> Optional[str]:
+        """``v = ClassName(...)`` -> dotted class name, for receiver
+        typing. Only names that look like classes (Capitalized last
+        part) count."""
+        if not isinstance(value, ast.Call):
+            return None
+        func = value.func
+        if isinstance(func, ast.Name) and func.id in self.module_classes:
+            base = f"{self.module}." if self.module else ""
+            return base + func.id
+        q = self.ctx.qualified_name(func)
+        if q and "." in q:
+            last = q.rsplit(".", 1)[1]
+            if last[:1].isupper() and q.split(".", 1)[0] not in (
+                    "typing", "collections"):
+                return q
+        elif q and q[:1].isupper():
+            return q
+        return None
+
+    # -- expressions --------------------------------------------------
+
+    def _walk_expr(self, expr: ast.AST) -> None:
+        if expr is None:
+            return
+        if isinstance(expr, ast.Lambda):
+            # a lambda runs later: analyze its body with no held locks
+            saved = self._held
+            self._held = []
+            try:
+                self._walk_expr(expr.body)
+            finally:
+                self._held = saved
+            return
+        if isinstance(expr, ast.Call):
+            self._walk_call(expr)
+            return
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) \
+                    and expr.value.id == "self" and self._fn is not None:
+                self._fn["reads_self"].append([expr.attr, expr.lineno])
+            self._walk_expr(expr.value)
+            return
+        if isinstance(expr, ast.Subscript):
+            key = _const_str(expr.slice)
+            if key is not None:
+                self.str_keys.add(key)
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                self._walk_expr(child)
+            elif isinstance(child, ast.comprehension):
+                self._walk_expr(child.iter)
+                for cond in child.ifs:
+                    self._walk_expr(cond)
+
+    def _walk_call(self, call: ast.Call) -> None:
+        desc = self._call_desc(call.func)
+        if desc is not None and self._fn is not None:
+            rec = [desc, call.lineno]
+            if self._held:
+                rec.append(list(self._held))
+            self._fn["calls"].append(rec)
+        # ``raw.get("key", ...)`` is dict consumption just like
+        # ``raw["key"]`` — CONTRACT003 counts both
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "get" and call.args:
+            key = _const_str(call.args[0])
+            if key is not None:
+                self.str_keys.add(key)
+        self._domain_facts(call, desc)
+        blk = self._blocking_event(call, desc)
+        if blk is not None and self._fn is not None:
+            blk["line"] = call.lineno
+            blk["held"] = list(self._held)
+            self._fn["blocking"].append(blk)
+        # receiver attribute reads (``self.router.submit()`` reads
+        # ``self.router``) and argument expressions
+        if isinstance(call.func, ast.Attribute):
+            self._walk_expr(call.func.value)
+        for a in call.args:
+            self._walk_expr(a)
+        for k in call.keywords:
+            self._walk_expr(k.value)
+
+    def _call_desc(self, func: ast.AST) -> Optional[List[Any]]:
+        """Call descriptor for later graph resolution:
+        ``["l", localpath]`` same-file def, ``["q", dotted]`` resolved
+        import, ``["s", meth]`` self method, ``["sa", attr, meth]``
+        method on a self attribute, ``["t", classdotted, meth]`` method
+        on a constructor-typed local, ``["m", meth]`` unknown-receiver
+        method (heuristic), ``["n", name]`` unresolved bare name."""
+        if isinstance(func, ast.Name):
+            nid = func.id
+            if nid in self._nested:
+                return ["l", self._nested[nid]]
+            if nid in self.module_defs:
+                return ["l", self.module_defs[nid]]
+            if nid in self.ctx.name_imports:
+                return ["q", self.ctx.name_imports[nid]]
+            if nid in self.ctx.module_aliases:
+                return ["q", self.ctx.module_aliases[nid]]
+            return ["n", nid]
+        if isinstance(func, ast.Attribute):
+            base, chain = _attr_chain(func)
+            if base == "self" and self._cls:
+                if len(chain) == 1:
+                    return ["s", chain[0]]
+                if len(chain) == 2:
+                    return ["sa", chain[0], chain[1]]
+                return ["m", chain[-1]]
+            if base is not None and len(chain) == 1 \
+                    and base in self._local_types:
+                return ["t", self._local_types[base], chain[0]]
+            if base is not None and (base in self.ctx.name_imports
+                                     or base in self.ctx.module_aliases):
+                q = self.ctx.qualified_name(func)
+                if q:
+                    return ["q", q]
+            if base is not None and base in self.module_classes:
+                mod = f"{self.module}." if self.module else ""
+                return ["q", f"{mod}{base}." + ".".join(chain)]
+            return ["m", chain[-1]]
+        return None
+
+    # -- domain facts: faults, metrics, jit targets -------------------
+
+    def _domain_facts(self, call: ast.Call,
+                      desc: Optional[List[Any]]) -> None:
+        q = desc[1] if desc and desc[0] == "q" else None
+        # fault points: ``faults.point("name")`` however imported
+        if q and (q == "faults.point" or q.endswith(".faults.point")):
+            name = _const_str(call.args[0]) if call.args else None
+            if name:
+                self.fault_points.append([name, call.lineno])
+        # fault names passed as configuration: ``fault_store="cas..."``
+        for k in call.keywords:
+            if k.arg and k.arg.startswith("fault_"):
+                name = _const_str(k.value)
+                if name:
+                    self.fault_points.append([name, k.value.lineno])
+        # metric families
+        self._metric_fact(call, desc, q)
+        # trace entry points: jit(f) / shard_map(f, ...) / scan(f, ...)
+        entry = q if q in TRACE_ENTRIES else None
+        if entry is None and q in ("functools.partial", "partial") \
+                and call.args:
+            inner = self.ctx.qualified_name(call.args[0])
+            if inner in TRACE_ENTRIES:
+                # partial(jax.jit, static_argnums=...)(f) — rare; the
+                # outer call carries the traced fn, not this one
+                entry = None
+        if entry is not None and call.args:
+            target = self._trace_target(call.args[0])
+            if target is not None:
+                self.jit_targets.append(
+                    {"t": target, "line": call.lineno, "entry": entry})
+
+    def _trace_target(self, arg: ast.AST) -> Optional[List[Any]]:
+        if isinstance(arg, ast.Call):
+            q = self.ctx.qualified_name(arg.func)
+            if q in ("functools.partial", "partial") and arg.args:
+                return self._trace_target(arg.args[0])
+            return None
+        if isinstance(arg, ast.Lambda):
+            return None  # lexical JAX001 already covers lambda bodies
+        if isinstance(arg, ast.Name):
+            nid = arg.id
+            if nid in self._nested:
+                return ["l", self._nested[nid]]
+            if nid in self.module_defs:
+                return ["l", self.module_defs[nid]]
+            if nid in self.ctx.name_imports:
+                return ["q", self.ctx.name_imports[nid]]
+            return ["n", nid]
+        if isinstance(arg, ast.Attribute):
+            base, chain = _attr_chain(arg)
+            if base == "self" and len(chain) == 1:
+                return ["s", chain[0]]
+            q = self.ctx.qualified_name(arg)
+            if q and base is not None and (
+                    base in self.ctx.name_imports
+                    or base in self.ctx.module_aliases):
+                return ["q", q]
+        return None
+
+    def _metric_fact(self, call: ast.Call, desc, q) -> None:
+        name = _const_str(call.args[0]) if call.args else None
+        if name is None:
+            return
+        if desc and desc[0] in ("s", "sa", "m", "t"):
+            meth = desc[-1]
+            if meth in _METRIC_METHODS:
+                self.metrics.append([name, meth, call.lineno])
+                return
+        last = None
+        if q:
+            root = q.split(".", 1)[0]
+            if root in ("collections", "typing"):
+                return
+            last = q.rsplit(".", 1)[-1]
+        elif desc and desc[0] in ("l", "n"):
+            last = desc[1]
+        if last in _METRIC_CLASSES:
+            self.metrics.append(
+                [name, _METRIC_CLASSES[last], call.lineno])
+
+    # -- blocking-call classification ---------------------------------
+
+    def _blocking_event(self, call: ast.Call,
+                        desc) -> Optional[Dict[str, Any]]:
+        q = desc[1] if desc and desc[0] == "q" else None
+        if q == "time.sleep":
+            return {"api": "time.sleep", "kind": "sleep"}
+        if q and (q == "faults.point" or q.endswith(".faults.point")):
+            # a delay-action fault rule sleeps inside point(); holding
+            # a lock across it stalls every thread sharing the lock
+            return {"api": "faults.point", "kind": "sleep"}
+        if q == "jax.block_until_ready":
+            return {"api": q, "kind": "block_until_ready"}
+        if q in _SUBPROCESS_BLOCKING or q == "socket.create_connection":
+            return {"api": q, "kind": "http"}
+        if q and q.startswith(_HTTP_PREFIXES):
+            return {"api": q, "kind": "http"}
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        meth = call.func.attr
+        if meth == "block_until_ready":
+            return {"api": ".block_until_ready()",
+                    "kind": "block_until_ready"}
+        recv = self._recv_kind(call.func.value)
+        if recv is None:
+            return None
+        ref, kind = recv
+        if kind == "queue" and meth in ("get", "put"):
+            blk = _kw(call, "block")
+            if isinstance(blk, ast.Constant) and blk.value is False:
+                return None
+            return {"api": f"Queue.{meth}", "kind": "queue", "ref": ref,
+                    "bounded": _has_timeout(call)}
+        if kind == "condition" and meth in ("wait", "wait_for"):
+            return {"api": f"Condition.{meth}", "kind": "cond_wait",
+                    "ref": ref}
+        if kind == "event" and meth == "wait":
+            return {"api": "Event.wait", "kind": "event_wait",
+                    "ref": ref, "bounded": _has_timeout(call)}
+        if kind == "thread" and meth == "join":
+            return {"api": "Thread.join", "kind": "join", "ref": ref}
+        return None
+
+    def _recv_kind(self, recv: ast.AST):
+        """(lockref, kind) when the receiver is a known lock/queue/
+        event/thread attribute or module global; None otherwise."""
+        if isinstance(recv, ast.Attribute) \
+                and isinstance(recv.value, ast.Name) \
+                and recv.value.id == "self" and self._cls:
+            info = self.classes.get(self._cls, {}).get(
+                "attrs", {}).get(recv.attr)
+            if info and info.get("kind") in LOCK_FACTORIES.values():
+                return ["c", self._cls, recv.attr], info["kind"]
+            return None
+        if isinstance(recv, ast.Name):
+            info = self.module_locks.get(recv.id)
+            if info:
+                return ["g", recv.id], info["kind"]
+        return None
+
+
+def _parse_suppressions(ctx) -> Tuple[Dict[int, set], list]:
+    from tools.dctlint.core import parse_suppressions
+    return parse_suppressions(ctx.lines, ctx.path)
+
+
+def extract_facts(ctx) -> Dict[str, Any]:
+    """Reduce a parsed FileContext to the JSON facts the project pass
+    consumes. Pure function of the file content (cache-safe)."""
+    return _Extractor(ctx).extract()
+
+
+# ---------------------------------------------------------------------------
+# the index
+# ---------------------------------------------------------------------------
+
+_ACQ_DEPTH = 8
+_BLOCK_DEPTH = 5
+
+
+class ProjectIndex:
+    """Facts of every file stitched into a queryable whole-program
+    view. Built once per run (from fresh extraction or the per-file
+    cache) and handed to every project-scope checker."""
+
+    def __init__(self, files: Dict[str, Dict[str, Any]],
+                 root=None) -> None:
+        self.files = files            # display path -> facts
+        self.root = root              # Path the display paths hang off
+        self.modules: Dict[str, str] = {}
+        self.functions: Dict[str, Dict[str, Any]] = {}
+        self.classes: Dict[str, Dict[str, Any]] = {}
+        self.method_index: Dict[str, List[str]] = {}
+        # checkers may leave a one-line human summary here; --stats and
+        # the tests surface it (e.g. the verified lock hierarchy)
+        self.summaries: Dict[str, str] = {}
+        self._acq_memo: Dict[Tuple[str, bool], Dict[str, Any]] = {}
+        self._blk_memo: Dict[str, List[Dict[str, Any]]] = {}
+        for path, facts in files.items():
+            mod = facts.get("module")
+            if mod and mod not in self.modules:
+                self.modules[mod] = path
+            for local, info in facts.get("classes", {}).items():
+                self.classes[f"{mod}.{local}" if mod else local] = {
+                    "path": path, "module": mod, "local": local,
+                    "info": info,
+                }
+            for local, fn in facts.get("functions", {}).items():
+                fq = f"{mod}.{local}" if mod else local
+                cls = fn.get("cls")
+                self.functions[fq] = {
+                    "path": path, "module": mod, "local": local,
+                    "cls": f"{mod}.{cls}" if mod and cls else cls,
+                    "facts": fn,
+                }
+                if cls and "<locals>" not in local:
+                    meth = local.rsplit(".", 1)[-1]
+                    self.method_index.setdefault(meth, []).append(fq)
+
+    # -- symbols ------------------------------------------------------
+
+    def suppressed_for(self, path: str) -> Dict[int, set]:
+        facts = self.files.get(path, {})
+        return {int(k): set(v)
+                for k, v in facts.get("suppressed", {}).items()}
+
+    def class_mro(self, clsfq: str) -> List[str]:
+        """The project-visible part of a class's MRO (BFS, self
+        first). External bases (threading.Thread) simply end a path."""
+        out, queue = [], [clsfq]
+        while queue:
+            c = queue.pop(0)
+            if c in out or c not in self.classes:
+                continue
+            out.append(c)
+            rec = self.classes[c]
+            for base in rec["info"].get("bases", []):
+                resolved = self._resolve_class_dotted(
+                    base, rec["module"])
+                if resolved:
+                    queue.append(resolved)
+        return out
+
+    def _resolve_class_dotted(self, dotted: str,
+                              from_module: Optional[str],
+                              depth: int = 0) -> Optional[str]:
+        if depth > 4:
+            return None
+        if from_module:
+            cand = f"{from_module}.{dotted}"
+            if cand in self.classes:
+                return cand
+        if dotted in self.classes:
+            return dotted
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:i])
+            if mod not in self.modules:
+                continue
+            rest = ".".join(parts[i:])
+            cand = f"{mod}.{rest}"
+            if cand in self.classes:
+                return cand
+            facts = self.files[self.modules[mod]]
+            ni = facts.get("name_imports", {}).get(rest)
+            if ni:
+                return self._resolve_class_dotted(ni, None, depth + 1)
+            break
+        return None
+
+    def find_attr(self, clsfq: str, attr: str):
+        """(defining class fq, attr info) through the MRO, or None."""
+        for c in self.class_mro(clsfq):
+            info = self.classes[c]["info"]["attrs"].get(attr)
+            if info is not None:
+                return c, info
+        return None
+
+    def find_method(self, clsfq: str, meth: str) -> Optional[str]:
+        for c in self.class_mro(clsfq):
+            fq = f"{c}.{meth}"
+            if fq in self.functions:
+                return fq
+        return None
+
+    def mutable_attrs(self, clsfq: str) -> Set[str]:
+        """Attributes stored outside ``__init__``/``__post_init__`` —
+        mutable instance state a jitted body must not read."""
+        out: Set[str] = set()
+        for c in self.class_mro(clsfq):
+            rec = self.classes[c]
+            local = rec["local"]
+            facts = self.files[rec["path"]]
+            for fnlocal, fn in facts.get("functions", {}).items():
+                if fn.get("cls") != local:
+                    continue
+                meth = fnlocal.rsplit(".", 1)[-1]
+                if meth in ("__init__", "__post_init__", "__new__"):
+                    continue
+                for attr, _line in fn.get("stores_self", []):
+                    out.add(attr)
+        return out
+
+    # -- call resolution ----------------------------------------------
+
+    def _resolve_export(self, module: str, name: str,
+                        depth: int = 0) -> List[str]:
+        if depth > 4 or module not in self.modules:
+            return []
+        fq = f"{module}.{name}"
+        if fq in self.functions:
+            return [fq]
+        if fq in self.classes:
+            init = self.find_method(fq, "__init__")
+            return [init] if init else []
+        facts = self.files[self.modules[module]]
+        ni = facts.get("name_imports", {}).get(name)
+        if ni:
+            mod, _, nm = ni.rpartition(".")
+            return self._resolve_export(mod, nm, depth + 1)
+        return []
+
+    def resolve_dotted(self, dotted: str) -> List[str]:
+        """Project functions a fully-qualified dotted call resolves to
+        (module function, re-export, Class() ctor, Class.method)."""
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:i])
+            if mod not in self.modules:
+                continue
+            rest = parts[i:]
+            if len(rest) == 1:
+                return self._resolve_export(mod, rest[0])
+            if len(rest) == 2:
+                clsfq = self._resolve_class_dotted(rest[0], mod)
+                if clsfq:
+                    m = self.find_method(clsfq, rest[1])
+                    return [m] if m else []
+                return []
+            break
+        return []
+
+    def _heuristic_targets(self, meth: str) -> List[str]:
+        if meth in HEURISTIC_STOPLIST:
+            return []
+        cands = self.method_index.get(meth, [])
+        owners = {fq.rsplit(".", 1)[0] for fq in cands}
+        if not cands or len(owners) > HEURISTIC_CLASS_CAP:
+            return []
+        return cands
+
+    def resolve_call(self, caller_fq: str,
+                     desc: List[Any]) -> List[Tuple[str, bool]]:
+        """Callee candidates for one call descriptor: a list of
+        (function fq, certain). Heuristic method-name matches come back
+        with certain=False."""
+        rec = self.functions.get(caller_fq)
+        if rec is None or not desc:
+            return []
+        module, clsfq = rec["module"], rec["cls"]
+        kind = desc[0]
+        if kind == "l":
+            fq = f"{module}.{desc[1]}" if module else desc[1]
+            if fq in self.functions:
+                return [(fq, True)]
+            if fq in self.classes:
+                init = self.find_method(fq, "__init__")
+                return [(init, True)] if init else []
+            return []
+        if kind == "q":
+            return [(fq, True) for fq in self.resolve_dotted(desc[1])]
+        if kind == "s" and clsfq:
+            m = self.find_method(clsfq, desc[1])
+            return [(m, True)] if m else []
+        if kind == "sa" and clsfq:
+            attr, meth = desc[1], desc[2]
+            found = self.find_attr(clsfq, attr)
+            if found and found[1].get("kind") == "instance":
+                tfq = self._resolve_class_dotted(
+                    found[1]["of"], self.classes[found[0]]["module"])
+                if tfq:
+                    m = self.find_method(tfq, meth)
+                    return [(m, True)] if m else []
+            return [(fq, False)
+                    for fq in self._heuristic_targets(meth)]
+        if kind == "t":
+            tfq = self._resolve_class_dotted(desc[1], module)
+            if tfq:
+                m = self.find_method(tfq, desc[2])
+                return [(m, True)] if m else []
+            return [(fq, False)
+                    for fq in self._heuristic_targets(desc[2])]
+        if kind == "m":
+            return [(fq, False)
+                    for fq in self._heuristic_targets(desc[1])]
+        return []
+
+    # -- lock identity ------------------------------------------------
+
+    def resolve_lockref(self, module: Optional[str], ref: List[Any],
+                        depth: int = 0):
+        """(lock id, kind) for a lockref from a file in ``module``.
+        Lock identity is the defining site; Condition aliases collapse
+        onto the wrapped lock. None for refs that are not locks."""
+        if ref is None or depth > 3:
+            return None
+        if ref[0] == "c":
+            clsfq = f"{module}.{ref[1]}" if module else ref[1]
+            found = self.find_attr(clsfq, ref[2])
+            if not found:
+                return None
+            defcls, info = found
+            kind = info.get("kind")
+            if kind not in LOCK_FACTORIES.values():
+                return None
+            alias = info.get("alias_of")
+            if kind == "condition" and alias:
+                sub = self.resolve_lockref(
+                    self.classes[defcls]["module"], alias, depth + 1)
+                if sub:
+                    return sub
+            return f"{defcls}.{ref[2]}", kind
+        if ref[0] == "g":
+            if module not in self.modules:
+                return None
+            info = self.files[self.modules[module]].get(
+                "module_locks", {}).get(ref[1])
+            if not info:
+                return None
+            alias = info.get("alias_of")
+            if info["kind"] == "condition" and alias:
+                sub = self.resolve_lockref(module, alias, depth + 1)
+                if sub:
+                    return sub
+            return f"{module}.{ref[1]}", info["kind"]
+        if ref[0] == "i":
+            mod, _, nm = ref[1].rpartition(".")
+            if mod in self.modules:
+                return self.resolve_lockref(mod, ["g", nm], depth + 1)
+        return None
+
+    def held_lock_ids(self, fq: str,
+                      held: List[List[Any]]) -> List[Tuple[str, str]]:
+        """Resolve a held-lockref stack to [(lock id, kind)] keeping
+        only kinds that actually exclude other threads."""
+        rec = self.functions.get(fq)
+        if rec is None:
+            return []
+        out: List[Tuple[str, str]] = []
+        for ref in held:
+            resolved = self.resolve_lockref(rec["module"], ref)
+            if resolved and resolved[1] in _HELD_KINDS:
+                if resolved[0] not in [x[0] for x in out]:
+                    out.append(resolved)
+        return out
+
+    # -- transitive lock / blocking propagation -----------------------
+
+    def eventual_acquires(self, fq: str, *, certain_only: bool = False,
+                          _depth: int = 0,
+                          _stack: Optional[Set[str]] = None
+                          ) -> Dict[str, Dict[str, Any]]:
+        """All lock ids a call to ``fq`` may end up acquiring, each
+        with the call chain that reaches the acquire:
+        ``{lock_id: {"kind", "certain", "chain": [(fq, line), ...]}}``.
+        The chain's last element is the acquiring function and the
+        acquire line itself."""
+        key = (fq, certain_only)
+        if key in self._acq_memo:
+            return self._acq_memo[key]
+        if _depth > _ACQ_DEPTH:
+            return {}
+        stack = _stack if _stack is not None else set()
+        if fq in stack:
+            return {}
+        rec = self.functions.get(fq)
+        if rec is None:
+            return {}
+        stack.add(fq)
+        result: Dict[str, Dict[str, Any]] = {}
+        facts = rec["facts"]
+        for acq in facts.get("acquires", []):
+            resolved = self.resolve_lockref(rec["module"], acq["l"])
+            if resolved and resolved[1] in _HELD_KINDS:
+                lid, kind = resolved
+                result.setdefault(lid, {
+                    "kind": kind, "certain": True,
+                    "chain": [(fq, acq["line"])]})
+        for call in facts.get("calls", []):
+            desc, line = call[0], call[1]
+            for callee, certain in self.resolve_call(fq, desc):
+                if certain_only and not certain:
+                    continue
+                if callee in stack:
+                    continue
+                sub = self.eventual_acquires(
+                    callee, certain_only=certain_only,
+                    _depth=_depth + 1, _stack=stack)
+                for lid, info in sub.items():
+                    if lid in result:
+                        continue
+                    result[lid] = {
+                        "kind": info["kind"],
+                        "certain": certain and info["certain"],
+                        "chain": [(fq, line)] + list(info["chain"]),
+                    }
+        stack.discard(fq)
+        if _depth == 0 or _stack is None:
+            self._acq_memo[key] = result
+        return result
+
+    def eventual_blocking(self, fq: str, *, _depth: int = 0,
+                          _stack: Optional[Set[str]] = None
+                          ) -> List[Dict[str, Any]]:
+        """Blocking events a call to ``fq`` may reach (lexical plus
+        propagated through certain call edges), each with a resolved
+        lock id for wait-style events and the reaching call chain."""
+        if fq in self._blk_memo:
+            return self._blk_memo[fq]
+        if _depth > _BLOCK_DEPTH:
+            return []
+        stack = _stack if _stack is not None else set()
+        if fq in stack:
+            return []
+        rec = self.functions.get(fq)
+        if rec is None:
+            return []
+        stack.add(fq)
+        out: List[Dict[str, Any]] = []
+        facts = rec["facts"]
+        for ev in facts.get("blocking", []):
+            ref = ev.get("ref")
+            resolved = self.resolve_lockref(rec["module"], ref) \
+                if ref else None
+            out.append({
+                "api": ev["api"], "kind": ev["kind"],
+                "line": ev["line"], "bounded": ev.get("bounded", False),
+                "lock": resolved[0] if resolved else None,
+                "chain": [(fq, ev["line"])],
+            })
+        for call in facts.get("calls", []):
+            desc, line = call[0], call[1]
+            for callee, certain in self.resolve_call(fq, desc):
+                if not certain or callee in stack:
+                    continue
+                for ev in self.eventual_blocking(
+                        callee, _depth=_depth + 1, _stack=stack):
+                    if len(out) >= 64:
+                        break
+                    out.append(dict(
+                        ev, chain=[(fq, line)] + list(ev["chain"])))
+        stack.discard(fq)
+        if _depth == 0 or _stack is None:
+            self._blk_memo[fq] = out
+        return out
+
+    def fn_display(self, fq: str) -> str:
+        """Human-readable location for a function: qualified name."""
+        return fq
+
+
+def build_index(files: Dict[str, Dict[str, Any]],
+                root=None) -> ProjectIndex:
+    return ProjectIndex(files, root=root)
