@@ -1273,10 +1273,32 @@ def cmd_fleet_status(args) -> int:
               f"{st['free_blocks']} free KV blocks, "
               f"{st['completed']} completed, "
               f"{st['tokens_generated']} tokens")
+        health = view.get("health") or {}
+        by_id = {r["id"]: r for r in health.get("replicas", [])}
         for rep in view["replicas"]:
             mark = (" [excluded]" if rep["id"] in view.get("excluded", [])
                     else "")
-            print(f"  {rep['id']}: {rep['state']}{mark}")
+            line = f"  {rep['id']}: {rep['state']}{mark}"
+            h = by_id.get(rep["id"])
+            if h:
+                line += (f" (breaker {h['breaker']}, "
+                         f"beat {h['beat_age_s']:.1f}s ago")
+                if h.get("fatal"):
+                    line += f", FATAL: {h['fatal']}"
+                line += ")"
+            print(line)
+        if health.get("quarantined_requests"):
+            print(f"  {health['quarantined_requests']} request(s) "
+                  f"quarantined as poison pills")
+        last = health.get("last_incident")
+        if last:
+            repl = ", ".join(last.get("replacement") or []) or "none"
+            print(f"  last incident: replica {last.get('replica')} "
+                  f"{last.get('reason')} — {last.get('failed_requests')} "
+                  f"request(s) failed over, "
+                  f"{last.get('leaked_blocks')} block(s) leaked, "
+                  f"recovered in {last.get('recovery_s', 0):.2f}s "
+                  f"(replacement: {repl})")
         return 0
     session = make_session(args)
     fleets = session.get("/api/v1/serving/fleets").get("fleets", [])

@@ -15,7 +15,11 @@ service (docs/serving.md):
   failover on the shared RetryPolicy;
 - :mod:`.fleet` — replica gangs: drain protocol, blue-green rollout,
   master integration (the ``serving`` allocation type);
-- :mod:`.autoscale` — queue-driven grow, drain-protected shrink.
+- :mod:`.autoscale` — queue-driven grow, drain-protected shrink;
+- :mod:`.supervisor` — liveness probing + automatic replica
+  replacement (the self-healing loop);
+- :mod:`.chaos` — the seeded chaos scenario catalog and its invariant
+  audit (``tools/chaosfleet.py`` front end).
 """
 from determined_clone_tpu.serving.bucketing import (  # noqa: F401
     BucketSpec,
@@ -33,6 +37,7 @@ from determined_clone_tpu.serving.engine import (  # noqa: F401
     ADMISSION_RETRY,
     EngineStats,
     InferenceEngine,
+    ReplicaFailed,
     Request,
     RequestResult,
     ServerOverloaded,
@@ -49,9 +54,14 @@ from determined_clone_tpu.serving.router import (  # noqa: F401
 from determined_clone_tpu.serving.fleet import (  # noqa: F401
     FleetStats,
     MasterLink,
+    PoisonPillRequest,
     Replica,
+    RequestLedger,
     RolloutReport,
     ServingFleet,
+)
+from determined_clone_tpu.serving.supervisor import (  # noqa: F401
+    FleetSupervisor,
 )
 from determined_clone_tpu.serving.autoscale import (  # noqa: F401
     AutoscalePolicy,

@@ -62,6 +62,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from determined_clone_tpu import faults
 from determined_clone_tpu.models import gpt
 from determined_clone_tpu.serving.bucketing import BucketSpec, bucket_for
 from determined_clone_tpu.serving.kv_cache import (
@@ -77,6 +78,20 @@ from determined_clone_tpu.utils.retry import RetryPolicy, retry_call
 class ServerOverloaded(RuntimeError):
     """Admission rejected: queue full. Retryable — clients should back
     off (see :meth:`InferenceEngine.submit_with_backoff`)."""
+
+
+class ReplicaFailed(RuntimeError):
+    """The engine serving this request died (scheduler crash) or was
+    condemned by the fleet supervisor. The fleet front door treats this
+    as "requeue to a surviving replica"; ``active`` distinguishes
+    requests that were *running* on the dead engine (they count toward
+    the poison-pill strike budget — one of them may be what killed it)
+    from ones that merely sat in its queue (innocent orphans, requeued
+    without a strike)."""
+
+    def __init__(self, msg: str, *, active: bool = False) -> None:
+        super().__init__(msg)
+        self.active = active
 
 
 ADMISSION_RETRY = RetryPolicy(
@@ -186,6 +201,10 @@ class Request:
     # cross-process trace identity minted at the front door; rides every
     # per-request span so the stitched trace shows one request end to end
     trace_id: Optional[str] = None
+    # absolute monotonic deadline (time.monotonic() clock). Expired work
+    # is retired with finish_reason "expired" at the next iteration
+    # boundary — never decoded into the void — and its blocks freed
+    deadline_t: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -193,7 +212,7 @@ class RequestResult:
     request_id: str
     prompt_len: int
     tokens: List[int]
-    finish_reason: str          # "length" | "eos" | "aborted"
+    finish_reason: str          # "length" | "eos" | "aborted" | "expired"
     queue_wait_s: float
     prefill_s: float            # total prefill device time it rode
     decode_s: float             # prefill-done → last token
@@ -231,11 +250,20 @@ class EngineStats:
 
 
 class _Handle:
-    """Future for one in-flight request."""
+    """Future for one in-flight request.
+
+    Settlement is first-write-wins: once either `_finish` or `_fail`
+    lands, later calls are no-ops. The fleet supervisor can fail a
+    wedged replica's handles (so waiters requeue immediately) while the
+    wedged scheduler thread is still alive — when that thread finally
+    wakes and tears down, it must not clobber the verdict the client
+    already acted on.
+    """
 
     def __init__(self, req: Request) -> None:
         self.req = req
         self._done = threading.Event()
+        self._lk = threading.Lock()  # leaf: guards the settle race only
         self._result: Optional[RequestResult] = None
         self._error: Optional[BaseException] = None
         # timestamps stamped by the engine (monotonic)
@@ -245,13 +273,21 @@ class _Handle:
         self.prefill_done_t = 0.0
         self.cancelled = False  # set by InferenceEngine.abort
 
-    def _finish(self, result: RequestResult) -> None:
-        self._result = result
-        self._done.set()
+    def _finish(self, result: RequestResult) -> bool:
+        with self._lk:
+            if self._done.is_set():
+                return False
+            self._result = result
+            self._done.set()
+        return True
 
-    def _fail(self, exc: BaseException) -> None:
-        self._error = exc
-        self._done.set()
+    def _fail(self, exc: BaseException) -> bool:
+        with self._lk:
+            if self._done.is_set():
+                return False
+            self._error = exc
+            self._done.set()
+        return True
 
     def done(self) -> bool:
         return self._done.is_set()
@@ -312,8 +348,16 @@ class InferenceEngine:
                  chunk_prefill_len: int = 0,
                  speculative_k: int = 0,
                  draft_params: Optional[gpt.Params] = None,
-                 draft_cfg: Optional[gpt.GPTConfig] = None) -> None:
+                 draft_cfg: Optional[gpt.GPTConfig] = None,
+                 fault_scope: str = "") -> None:
         self.model_cfg = model_cfg
+        # chaos targeting: with a scope (the fleet passes the replica
+        # id) the scheduler also hits "engine.step.<scope>" /
+        # "engine.admit.<request_id>" so a seeded FaultPlan can kill ONE
+        # replica or poison ONE request by fnmatch pattern. Built by
+        # concatenation on purpose: scoped names stay out of the static
+        # CONTRACT001 catalog, which lists the constant base points.
+        self._fault_scope = str(fault_scope)
         self.buckets = buckets or BucketSpec.build(
             8, min(128, model_cfg.max_seq_len))
         if self.buckets.max_prefill_len > model_cfg.max_seq_len:
@@ -450,6 +494,9 @@ class InferenceEngine:
         self._h_spec_accept = m.histogram(
             "serving_spec_request_acceptance_rate",
             "per-request draft acceptance rate at retirement")
+        self._c_expired = m.counter(
+            "serving_requests_expired_total",
+            "requests retired at their deadline (blocks freed, not decoded)")
 
         self._cond = threading.Condition()
         self._queue: collections.deque[_Handle] = collections.deque()
@@ -459,6 +506,15 @@ class InferenceEngine:
         self._warming = False
         self._busy = False  # scheduler outside its wait with device work
         self._fatal: Optional[BaseException] = None
+        # set by fail_inflight (the supervisor's condemn): the scheduler
+        # raises it at the next iteration boundary so the crash teardown
+        # — the only place that may release a possibly-mid-step row's
+        # blocks — runs exactly once, on the owning thread
+        self._condemned: Optional[BaseException] = None
+        # scheduler-loop heartbeat watermark: stamped every pass, so a
+        # *wedged* scheduler (alive but stuck mid-iteration) reads as
+        # stale-beat-with-pending-work to the supervisor's liveness probe
+        self._beat_t = time.monotonic()
         self._submitted = 0
         self._completed = 0
         self._total_tokens = 0
@@ -541,9 +597,13 @@ class InferenceEngine:
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 16, *,
                eos_token_id: Optional[int] = None,
                request_id: Optional[str] = None,
-               trace_id: Optional[str] = None) -> _Handle:
+               trace_id: Optional[str] = None,
+               deadline_t: Optional[float] = None) -> _Handle:
         """Enqueue one request. Raises ValueError for never-servable
-        requests and ServerOverloaded when the queue is full."""
+        requests and ServerOverloaded when the queue is full.
+        ``deadline_t`` is an absolute ``time.monotonic()`` deadline:
+        work still unfinished then is retired as "expired" at the next
+        iteration boundary and its KV blocks freed."""
         prompt = tuple(int(t) for t in prompt)
         if not prompt:
             raise ValueError("empty prompt")
@@ -564,7 +624,12 @@ class InferenceEngine:
                 f"max_seq_len {self.model_cfg.max_seq_len}")
         with self._cond:
             if self._fatal is not None:
-                raise RuntimeError("serving engine died") from self._fatal
+                # ReplicaFailed (a RuntimeError) so the router treats a
+                # dead-but-not-yet-removed replica as a failover target,
+                # not a client error; active=False — never admitted, so
+                # no poison-pill strike
+                raise ReplicaFailed("serving engine died",
+                                    active=False) from self._fatal
             if self._stop:
                 raise RuntimeError("serving engine is closed")
             if len(self._queue) >= self.max_queue_depth:
@@ -574,8 +639,14 @@ class InferenceEngine:
             self._req_seq += 1
             rid = request_id or f"req-{self._req_seq}"
             handle = _Handle(Request(prompt, int(max_new_tokens),
-                                     eos_token_id, rid, trace_id))
+                                     eos_token_id, rid, trace_id,
+                                     deadline_t))
             handle.submit_t = time.monotonic()
+            if not self._busy:
+                # first work after an idle stretch: the parked scheduler's
+                # beat is arbitrarily old — restart the liveness clock so
+                # the supervisor grants it a fresh window to wake up in
+                self._beat_t = handle.submit_t
             self._queue.append(handle)
             self._submitted += 1
             self._c_admitted.inc()
@@ -778,6 +849,68 @@ class InferenceEngine:
                         f"prefilling={len(self._prefilling)})")
                 self._cond.wait(remaining)
 
+    # -- self-healing surface (fleet supervisor) ---------------------------
+
+    def liveness(self) -> Dict[str, Any]:
+        """Snapshot for the supervisor's liveness probe. The wedged
+        verdict is the caller's: ``pending and beat_age_s > deadline``
+        means the scheduler has had work for that long without
+        completing a pass — stale-beat-while-idle is just a parked
+        thread and perfectly healthy."""
+        now = time.monotonic()
+        with self._cond:
+            return {
+                "thread_alive": self._thread.is_alive(),
+                "fatal": self._fatal,
+                "condemned": self._condemned is not None,
+                "warming": self._warming,
+                "pending": bool(self._queue or self._active
+                                or self._prefilling or self._busy),
+                "beat_age_s": now - self._beat_t,
+            }
+
+    def fail_inflight(self, reason: str) -> int:
+        """Condemn this engine: immediately fail every queued and
+        running request with :class:`ReplicaFailed` (so front-door
+        waiters requeue to surviving replicas without waiting out a
+        wedged thread) and mark the scheduler to tear itself down at its
+        next wakeup. Blocks are NOT released here — the scheduler thread
+        may still be mid-device-call against the pools; it releases them
+        exactly once in its own crash teardown. Returns the number of
+        requests newly failed."""
+        condemned = ReplicaFailed(f"replica condemned: {reason}",
+                                  active=True)
+        with self._cond:
+            if self._fatal is None:
+                self._fatal = condemned
+            if self._condemned is None:
+                self._condemned = condemned
+            queued = list(self._queue)
+            self._queue.clear()
+            self._g_queue.set(0)
+            inflight = [a.handle
+                        for a in self._active + self._prefilling]
+            self._cond.notify_all()
+        n = 0
+        orphaned = ReplicaFailed(f"replica condemned: {reason}",
+                                 active=False)
+        for h in queued:
+            n += 1 if h._fail(orphaned) else 0
+        for h in inflight:
+            n += 1 if h._fail(condemned) else 0
+        return n
+
+    def kv_outstanding(self) -> int:
+        """KV blocks currently owned (active sequences + prefix-cache
+        retains). Zero on an idle engine with no prefix cache."""
+        return self._allocator.outstanding()
+
+    def assert_kv_balanced(self, expected_outstanding: int = 0) -> None:
+        """Chaos/test audit: raise AssertionError unless exactly
+        ``expected_outstanding`` blocks are held (see
+        :meth:`BlockAllocator.assert_balanced`)."""
+        self._allocator.assert_balanced(expected_outstanding)
+
     # -- introspection -----------------------------------------------------
 
     def programs_compiled(self) -> int:
@@ -863,8 +996,9 @@ class InferenceEngine:
             while True:
                 with self._cond:
                     self._busy = False
+                    self._beat_t = time.monotonic()
                     self._cond.notify_all()  # wakes warmup's idle wait
-                    while (not self._stop
+                    while (not self._stop and self._condemned is None
                            and (self._warming
                                 or (not self._queue and not self._active
                                     and not self._prefilling
@@ -872,14 +1006,11 @@ class InferenceEngine:
                         self._cond.wait()
                     if self._stop:
                         closed = RuntimeError("serving engine closed")
-                        for h in self._queue:
+                        for h, _was_active in self._teardown_locked():
                             h._fail(closed)
-                        self._queue.clear()
-                        for a in self._active + self._prefilling:
-                            a.handle._fail(closed)
-                        self._active.clear()
-                        self._prefilling.clear()
                         return
+                    if self._condemned is not None:
+                        raise self._condemned
                     if self._pending_params is not None:
                         self._params = self._pending_params
                         self._pending_params = None
@@ -888,10 +1019,20 @@ class InferenceEngine:
                             self._prefix.flush()
                             self._g_free_blocks.set(
                                 self._allocator.free_blocks())
-                    self._admit_locked()
+                    admitted = self._admit_locked()
                     self._busy = True
+                # fault points fire OUTSIDE the condition (a delay rule
+                # must wedge only this scheduler, never a lock every
+                # client thread needs), and only with a plan active
+                if faults.active_plan() is not None:
+                    for rid in admitted:
+                        faults.point("engine.admit")
+                        faults.point("engine.admit." + rid)
+                    faults.point("engine.step")
+                    if self._fault_scope:
+                        faults.point("engine.step." + self._fault_scope)
                 iter_t0 = time.monotonic()
-                worked = self._reap_aborted()
+                worked = self._reap_expired()
                 if self._prefilling:
                     self._prefill_step()
                     worked = True
@@ -901,25 +1042,61 @@ class InferenceEngine:
                     else:
                         self._decode_step()
                     worked = True
+                self._beat_t = time.monotonic()
                 if worked and self.iteration_floor_s > 0.0:
                     pad = self.iteration_floor_s \
                         - (time.monotonic() - iter_t0)
                     if pad > 0.0:
                         time.sleep(pad)
         except BaseException as exc:  # noqa: BLE001 — fail every waiter
+            queued = ReplicaFailed(f"serving engine died: {exc!r}",
+                                   active=False)
+            queued.__cause__ = exc
+            running = ReplicaFailed(f"serving engine died: {exc!r}",
+                                    active=True)
+            running.__cause__ = exc
             with self._cond:
-                self._fatal = exc
+                if self._fatal is None:
+                    self._fatal = exc
                 self._busy = False
+                handles = self._teardown_locked()
                 self._cond.notify_all()
-                for h in self._queue:
-                    h._fail(exc)
-                self._queue.clear()
-                for a in self._active + self._prefilling:
-                    a.handle._fail(exc)
-                self._active.clear()
-                self._prefilling.clear()
+            # settle outside the condition: nothing here needs it, and
+            # the waiters woken by these events immediately requeue
+            for h, was_active in handles:
+                h._fail(running if was_active else queued)
 
-    def _admit_locked(self) -> None:
+    def _teardown_locked(self):
+        """Under ``self._cond``: the abnormal-retirement path. Releases
+        every in-flight row's pool blocks (including pending COW source
+        references) and the prefix cache's retains, clears the batch,
+        and returns the handles to fail. Run only on the scheduler
+        thread — it is the sole owner of the rows, so nothing can race
+        the releases — and exactly once per row, keeping the allocator
+        balanced (``assert_balanced``) through any crash or close.
+
+        Returns ``(handle, was_active)`` pairs: the crash path needs to
+        tell running rows (poison-pill strike candidates) from queued
+        orphans; the stop path ignores the flag.
+        """
+        pairs = [(h, False) for h in self._queue]
+        self._queue.clear()
+        for a in self._active + self._prefilling:
+            if a.pending_copy is not None:
+                self._allocator.release([a.pending_copy[0]])
+                a.pending_copy = None
+            self._allocator.release(a.blocks)
+            pairs.append((a.handle, True))
+        self._active.clear()
+        self._prefilling.clear()
+        if self._prefix is not None:
+            self._prefix.flush()
+        self._g_active.set(0)
+        self._g_queue.set(0)
+        self._g_free_blocks.set(self._allocator.free_blocks())
+        return pairs
+
+    def _admit_locked(self) -> List[str]:
         """Move queued requests into the prefilling set while slots AND
         pool blocks allow. FIFO — a head-of-line request the pool can't
         fit yet blocks later ones (no starvation by bypass). With the
@@ -928,17 +1105,25 @@ class InferenceEngine:
         fresh blocks for the remainder; under pool pressure LRU cache
         entries are evicted (dropping the cache's references — blocks
         shared with running sequences survive) before admission defers.
+        Returns the admitted request ids (the scheduler hits their
+        admission fault points outside the lock).
         """
         now = time.monotonic()
+        admitted: List[str] = []
         while self._queue and (len(self._active) + len(self._prefilling)
                                < self.buckets.max_batch):
             head = self._queue[0]
-            if head.cancelled:
+            if head.cancelled or (head.req.deadline_t is not None
+                                  and now >= head.req.deadline_t):
+                expired = not head.cancelled
+                if expired:
+                    self._c_expired.inc()
                 self._queue.popleft()
                 head._finish(RequestResult(
                     request_id=head.req.request_id,
                     prompt_len=len(head.req.prompt), tokens=[],
-                    finish_reason="aborted", queue_wait_s=0.0,
+                    finish_reason="expired" if expired else "aborted",
+                    queue_wait_s=0.0,
                     prefill_s=0.0, decode_s=0.0,
                     total_s=now - head.submit_t))
                 continue
@@ -998,30 +1183,41 @@ class InferenceEngine:
             self._c_prefix_hit.inc(a.hit_blocks)
             self._c_prefix_miss.inc(a.miss_blocks)
             self._prefilling.append(a)
+            admitted.append(head.req.request_id)
             self._peak_active = max(
                 self._peak_active,
                 len(self._active) + len(self._prefilling))
             self._g_active.set(len(self._active) + len(self._prefilling))
         self._g_queue.set(len(self._queue))
         self._g_free_blocks.set(self._allocator.free_blocks())
+        return admitted
 
-    def _reap_aborted(self) -> bool:
-        """Retire cancelled rows at the iteration boundary, releasing
-        their blocks (and a pending COW source's extra reference) exactly
-        like a natural finish."""
-        doomed = [a for a in self._active + self._prefilling
-                  if a.handle.cancelled]
+    def _reap_expired(self) -> bool:
+        """Retire cancelled and deadline-expired rows at the iteration
+        boundary, releasing their blocks (and a pending COW source's
+        extra reference) exactly like a natural finish — expired work is
+        aborted, never decoded into the void."""
+        now = time.monotonic()
+        doomed: List[Tuple[_Active, str]] = []
+        for a in self._active + self._prefilling:
+            if a.handle.cancelled:
+                doomed.append((a, "aborted"))
+            elif (a.handle.req.deadline_t is not None
+                  and now >= a.handle.req.deadline_t):
+                self._c_expired.inc()
+                doomed.append((a, "expired"))
         if not doomed:
             return False
-        for a in doomed:
+        dead = {id(a) for a, _r in doomed}
+        for a, reason in doomed:
             if a.pending_copy is not None:
                 self._allocator.release([a.pending_copy[0]])
                 a.pending_copy = None
-            self._retire(a, "aborted")
+            self._retire(a, reason)
         with self._cond:
-            self._active = [a for a in self._active if a not in doomed]
+            self._active = [a for a in self._active if id(a) not in dead]
             self._prefilling = [a for a in self._prefilling
-                                if a not in doomed]
+                                if id(a) not in dead]
             self._g_active.set(len(self._active) + len(self._prefilling))
             self._g_free_blocks.set(self._allocator.free_blocks())
         return True

@@ -49,6 +49,7 @@ A fleet-level :class:`SLOEngine` accounts every front-door completion.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import os
 import threading
@@ -58,10 +59,12 @@ import urllib.request
 import uuid
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from determined_clone_tpu import faults
 from determined_clone_tpu.models import gpt
 from determined_clone_tpu.serving.bucketing import BucketSpec
 from determined_clone_tpu.serving.engine import (
     InferenceEngine,
+    ReplicaFailed,
     make_paged_forward,
 )
 from determined_clone_tpu.serving.kv_cache import KVCacheConfig
@@ -84,6 +87,97 @@ STOPPED = "stopped"
 # ring size for each serving tracer lane; archive sinks see every record
 # regardless, so the ring only bounds what the aggregator can drain
 _TRACE_EVENTS = 32_768
+
+
+class PoisonPillRequest(RuntimeError):
+    """This request crashed ``max_request_crashes`` replicas in a row
+    and is quarantined: the front door refuses it outright (HTTP 422
+    with diagnostics) instead of letting it take down a fourth replica.
+    Requeue-after-crash is only safe for requests that are victims, not
+    causes — N consecutive kills is the causal evidence."""
+
+    def __init__(self, msg: str,
+                 diagnostics: Optional[Dict[str, Any]] = None) -> None:
+        super().__init__(msg)
+        self.diagnostics = dict(diagnostics or {})
+
+
+def _request_key(request_id: Optional[str], prompt: Sequence[int],
+                 max_new_tokens: int) -> str:
+    """Stable ledger/quarantine key: the minted request id when there is
+    one, else a digest of the work itself (tracing-off callers get no
+    uuid, but an identical resubmission of a poison payload must still
+    hit the quarantine)."""
+    if request_id:
+        return request_id
+    h = hashlib.sha256()
+    h.update(repr((tuple(prompt), int(max_new_tokens))).encode())
+    return "p:" + h.hexdigest()[:16]
+
+
+class RequestLedger:
+    """Accepted-request ledger behind exactly-once failover.
+
+    Every request the front door accepts is entered here and settled
+    exactly once (completed / expired / failed / quarantined); a request
+    orphaned by a replica crash stays OPEN across its requeue hops, so
+    "zero lost accepted requests" is checkable as ``open_requests() ==
+    []`` once traffic quiesces — the chaos conductor's first invariant.
+    With a directory it also appends one JSON line per transition,
+    line-buffered like the RequestArchive so a kill -9'd front door
+    leaves a durable record of what it had accepted.
+    """
+
+    def __init__(self, path: Optional[str] = None) -> None:
+        self._lock = threading.Lock()
+        self._open: Dict[str, Dict[str, Any]] = {}
+        self._accepted = 0
+        self._file = None
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._file = open(path, "a", buffering=1)
+
+    def accept(self, key: str, **info: Any) -> None:
+        with self._lock:
+            self._accepted += 1
+            self._open[key] = {"hops": 0, **info}
+            self._write_locked(key, "accepted", info)
+
+    def event(self, key: str, kind: str, **info: Any) -> None:
+        with self._lock:
+            entry = self._open.get(key)
+            if entry is not None:
+                entry["hops"] += 1
+            self._write_locked(key, kind, info)
+
+    def settle(self, key: str, outcome: str, **info: Any) -> None:
+        with self._lock:
+            if self._open.pop(key, None) is None:
+                return  # already settled (idempotent, like the handles)
+            self._write_locked(key, outcome, info)
+
+    def _write_locked(self, key: str, kind: str,
+                      info: Dict[str, Any]) -> None:
+        if self._file is None:
+            return
+        rec = {"request": key, "event": kind, "t": time.time(), **info}
+        self._file.write(json.dumps(rec, sort_keys=True) + "\n")
+
+    def accepted_total(self) -> int:
+        with self._lock:
+            return self._accepted
+
+    def open_requests(self) -> List[str]:
+        """Accepted but not yet settled — MUST be empty once traffic
+        quiesces, or a request was lost."""
+        with self._lock:
+            return sorted(self._open)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
 
 
 class _EngineTelemetry:
@@ -118,11 +212,13 @@ class Replica:
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 16, *,
                eos_token_id: Optional[int] = None,
                request_id: Optional[str] = None,
-               trace_id: Optional[str] = None) -> Any:
+               trace_id: Optional[str] = None,
+               deadline_t: Optional[float] = None) -> Any:
         return self.engine.submit(prompt, max_new_tokens,
                                   eos_token_id=eos_token_id,
                                   request_id=request_id,
-                                  trace_id=trace_id)
+                                  trace_id=trace_id,
+                                  deadline_t=deadline_t)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -187,8 +283,13 @@ class ServingFleet:
                  tracing: Optional[bool] = None,
                  archive_dir: Optional[str] = None,
                  slo: Any = None,
-                 exec_cache: Any = None) -> None:
+                 exec_cache: Any = None,
+                 max_request_crashes: int = 3) -> None:
         self.name = name
+        # poison-pill strike budget: a request that was RUNNING on this
+        # many consecutively-crashing replicas is quarantined instead of
+        # requeued a further time
+        self.max_request_crashes = max(1, int(max_request_crashes))
         self.model_cfg = model_cfg
         self.buckets = buckets
         self.cache = cache
@@ -249,6 +350,31 @@ class ServingFleet:
         # cold-vs-warm replica-start A/B reads this directly
         self.scale_up_latencies_s: List[float] = []
 
+        # -- self-healing state (docs/serving.md "Self-healing") ----------
+        self._c_replacements = self.registry.counter(
+            "fleet_replica_replacements_total",
+            "failed replicas torn down and replaced")
+        self._h_recovery = self.registry.histogram(
+            "fleet_recovery_seconds",
+            "failure declared → replacement serving (MTTR)")
+        self._c_requeued = self.registry.counter(
+            "fleet_requests_requeued_total",
+            "orphaned requests requeued to a surviving replica")
+        self._c_quarantined = self.registry.counter(
+            "fleet_requests_quarantined_total",
+            "poison-pill requests refused after crashing replicas")
+        # the durable journal rides the archive gate: disabled telemetry
+        # means zero on-disk work, but the in-memory exactly-once ledger
+        # always runs — failover correctness is not an observability
+        # feature
+        ledger_path = (os.path.join(archive_dir, "ledger.jsonl")
+                       if archive_dir and self.tracing else None)
+        self.ledger = RequestLedger(ledger_path)
+        self._quarantined: Dict[str, Dict[str, Any]] = {}
+        self._incidents: List[Dict[str, Any]] = []
+        # optional FleetSupervisor, attached by start_supervisor()
+        self.supervisor: Any = None
+
     def _make_tracer(self, process_name: str) -> Optional[Tracer]:
         """One tracer lane of the stitched request trace; None (and zero
         per-request work anywhere downstream) when tracing is off."""
@@ -300,7 +426,8 @@ class ServingFleet:
                 cache=self.cache, max_queue_depth=self.max_queue_depth,
                 telemetry=telemetry, fwd=self._fwd,
                 iteration_floor_s=self.iteration_floor_s,
-                prefix_cache=self.prefix_cache)
+                prefix_cache=self.prefix_cache,
+                fault_scope=rid)
             rep = Replica(rid, engine, tracer=tracer)
             if self.warmup:
                 engine.warmup()
@@ -337,6 +464,73 @@ class ServingFleet:
             self._g_replicas.set(len(self._replicas))
         return drain_s
 
+    def replace_replica(self, replica_id: str, *, reason: str = "failed",
+                        replacement: bool = True,
+                        close_timeout: float = 30.0) -> List[str]:
+        """Tear down a FAILED replica and bring up a fresh one — the
+        self-healing counterpart of :meth:`stop_replica`, which drains
+        politely and assumes the engine still works. Here the engine is
+        dead or wedged: it is condemned (in-flight requests fail with
+        ReplicaFailed so the front door requeues them), unrouted,
+        closed, and replaced via the shared-program/exec-cache warm
+        start (the replacement compiles nothing). MTTR lands in
+        ``fleet_recovery_seconds``; the incident is recorded for
+        ``dct fleet status``. Returns the replacement ids."""
+        faults.point("fleet.replace")
+        t0 = time.monotonic()
+        with self._lock:
+            rep = self._replicas.get(replica_id)
+        if rep is None:
+            return []
+        rep.state = STOPPED
+        self.router.remove(replica_id)
+        failed_n = rep.engine.fail_inflight(reason)
+        rep.close(close_timeout)
+        # after a clean join the crash teardown has run: anything still
+        # held is a real leak, worth its own line in the incident
+        leaked = rep.engine.kv_outstanding()
+        with self._lock:
+            self._replicas.pop(replica_id, None)
+            self._tps_last.pop(replica_id, None)
+            self._span_cursor.pop(f"serving_replica_{replica_id}", None)
+            self._g_replicas.set(len(self._replicas))
+        added = self.scale_up(1) if replacement else []
+        dt = time.monotonic() - t0
+        self._c_replacements.inc()
+        self._h_recovery.observe(dt)
+        self.note_incident({
+            "replica": replica_id,
+            "reason": str(reason),
+            "failed_requests": failed_n,
+            "leaked_blocks": leaked,
+            "replacement": added,
+            "recovery_s": round(dt, 6),
+        })
+        return added
+
+    def note_incident(self, incident: Dict[str, Any]) -> None:
+        with self._lock:
+            self._incidents.append(dict(incident))
+            del self._incidents[:-32]  # bounded history
+
+    def incidents(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(i) for i in self._incidents]
+
+    def last_incident(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return dict(self._incidents[-1]) if self._incidents else None
+
+    def start_supervisor(self, **kw: Any) -> Any:
+        """Attach a FleetSupervisor probing this fleet (serving/
+        supervisor.py); stopped automatically by :meth:`close`."""
+        from determined_clone_tpu.serving.supervisor import FleetSupervisor
+
+        if self.supervisor is not None:
+            raise RuntimeError("supervisor already running")
+        self.supervisor = FleetSupervisor(self, **kw)
+        return self.supervisor
+
     def scale_down(self, n: int = 1, timeout: float = 60.0) -> List[str]:
         """Remove the ``n`` newest replicas through the drain protocol
         (newest-first mirrors the master's shrink policy)."""
@@ -359,6 +553,9 @@ class ServingFleet:
 
     def close(self, timeout: float = 30.0) -> None:
         """Tear the fleet down, draining politely first (bounded)."""
+        if self.supervisor is not None:
+            self.supervisor.close()
+            self.supervisor = None
         for rid in sorted(self._replicas, reverse=True):
             rep = self._replicas.get(rid)
             if rep is None:
@@ -374,6 +571,7 @@ class ServingFleet:
             self._g_replicas.set(0)
         if self.archive is not None:
             self.archive.close()
+        self.ledger.close()
 
     # -- traffic -----------------------------------------------------------
 
@@ -381,12 +579,13 @@ class ServingFleet:
                eos_token_id: Optional[int] = None,
                request_id: Optional[str] = None,
                trace_id: Optional[str] = None,
-               timeout: Optional[float] = None) -> Any:
+               timeout: Optional[float] = None,
+               deadline_t: Optional[float] = None) -> Any:
         """Route one request to the least-loaded healthy replica."""
         return self.router.submit(prompt, max_new_tokens,
                                   eos_token_id=eos_token_id,
                                   request_id=request_id, trace_id=trace_id,
-                                  timeout=timeout)
+                                  timeout=timeout, deadline_t=deadline_t)
 
     def mint_ids(self, request_id: Optional[str] = None,
                  trace_id: Optional[str] = None
@@ -405,23 +604,89 @@ class ServingFleet:
                        eos_token_id: Optional[int] = None,
                        request_id: Optional[str] = None,
                        trace_id: Optional[str] = None,
-                       timeout: float = 120.0) -> Tuple[Any, Any]:
+                       timeout: float = 120.0,
+                       deadline_s: Optional[float] = None) -> Tuple[Any, Any]:
         """Full front-door lifecycle for one request: mint the trace
-        identity, dispatch through the router, block for the result, and
-        account the outcome (front-door span, SLO ingest, archive
-        retention decision). Returns ``(result, handle)``; raises exactly
-        what :meth:`submit` / ``handle.result`` raise, after accounting
-        the failure. The HTTP front door and in-process callers share
-        this path so traces look identical either way."""
+        identity, enter the accepted-request ledger, dispatch through
+        the router, block for the result, and account the outcome
+        (front-door span, SLO ingest, archive retention decision).
+        Returns ``(result, handle)``; raises exactly what :meth:`submit`
+        / ``handle.result`` raise, after accounting the failure. The
+        HTTP front door and in-process callers share this path so traces
+        look identical either way.
+
+        Failover is exactly-once from the client's view: a request
+        orphaned by a replica crash (:class:`ReplicaFailed`) is requeued
+        to a surviving replica — safe because greedy decode is
+        deterministic, so the re-run emits bit-identical tokens — until
+        it either completes, expires, or crashes
+        ``max_request_crashes`` replicas in a row and is quarantined as
+        a poison pill. ``deadline_s`` (relative seconds) propagates
+        router → engine: an already-expired request never touches a
+        replica (TimeoutError → HTTP 504), and mid-decode expiry aborts
+        the work and frees its KV blocks."""
         rid, tid = self.mint_ids(request_id, trace_id)
+        key = _request_key(rid, prompt, max_new_tokens)
+        with self._lock:
+            poison = self._quarantined.get(key)
+        if poison is not None:
+            raise PoisonPillRequest(
+                f"request {key!r} is quarantined as a poison pill",
+                diagnostics=poison)
+        deadline_t = (time.monotonic() + float(deadline_s)
+                      if deadline_s is not None else None)
         ft = self.frontdoor_tracer
         t0 = time.perf_counter()
+        self.ledger.accept(key, prompt_len=len(prompt),
+                           max_new_tokens=int(max_new_tokens))
         try:
-            handle = self.submit(prompt, max_new_tokens,
-                                 eos_token_id=eos_token_id,
-                                 request_id=rid, trace_id=tid,
-                                 timeout=timeout)
-            result = handle.result(timeout=timeout)
+            crashes = 0
+            while True:
+                if deadline_t is not None \
+                        and time.monotonic() >= deadline_t:
+                    raise TimeoutError(
+                        f"request {key!r} expired before dispatch")
+                handle = self.submit(prompt, max_new_tokens,
+                                     eos_token_id=eos_token_id,
+                                     request_id=rid, trace_id=tid,
+                                     timeout=timeout,
+                                     deadline_t=deadline_t)
+                try:
+                    result = handle.result(timeout=timeout)
+                except ReplicaFailed as exc:
+                    was_active = bool(getattr(exc, "active", False))
+                    if was_active:
+                        crashes += 1
+                    self.ledger.event(
+                        key, "orphaned", active=was_active,
+                        replica=getattr(handle, "replica_id", ""))
+                    if crashes >= self.max_request_crashes:
+                        diag = {
+                            "request_id": rid or key,
+                            "crashes": crashes,
+                            "last_replica": getattr(
+                                handle, "replica_id", ""),
+                            "last_error": str(exc),
+                        }
+                        with self._lock:
+                            self._quarantined[key] = diag
+                        self._c_quarantined.inc()
+                        self.ledger.settle(key, "quarantined", **diag)
+                        raise PoisonPillRequest(
+                            f"request {rid or key!r} crashed {crashes} "
+                            f"replicas in a row — quarantined, not "
+                            f"requeued a {crashes + 1}th time",
+                            diagnostics=diag) from exc
+                    faults.point("fleet.requeue")
+                    self._c_requeued.inc()
+                    continue
+                if result.finish_reason == "expired":
+                    # surfaced as the same 504 an expired-before-dispatch
+                    # request gets; its blocks were freed by the engine
+                    raise TimeoutError(
+                        f"request {key!r} deadline expired after "
+                        f"{len(result.tokens)} tokens")
+                break
         except Exception as exc:
             dt = time.perf_counter() - t0
             if ft is not None:
@@ -430,6 +695,8 @@ class ServingFleet:
                                error=type(exc).__name__)
             self.note_request(rid, ok=False, latency_s=None,
                               error=str(exc))
+            # idempotent: the quarantine path settled its own outcome
+            self.ledger.settle(key, "failed", error=type(exc).__name__)
             raise
         dt = time.perf_counter() - t0
         if ft is not None:
@@ -441,6 +708,7 @@ class ServingFleet:
         else:
             self._h_frontdoor.observe(dt)
         self.note_request(rid, ok=True, latency_s=dt)
+        self.ledger.settle(key, "completed", tokens=len(result.tokens))
         return result, handle
 
     def note_request(self, request_id: Optional[str], *, ok: bool = True,
@@ -571,6 +839,34 @@ class ServingFleet:
                           queue_depth=qd, free_blocks=fb, completed=done,
                           tokens_generated=toks, rejected=rej,
                           max_p99_s=max_p99)
+
+    def health_view(self) -> Dict[str, Any]:
+        """Replica health + last-incident summary for ``/v1/fleet`` and
+        ``dct fleet status``: per replica the lifecycle state, router
+        breaker state, scheduler heartbeat age, and whether it died."""
+        states = self.router.replica_states()
+        reps: List[Dict[str, Any]] = []
+        for rep in self.replicas():
+            live = rep.engine.liveness()
+            reps.append({
+                "id": rep.replica_id,
+                "state": rep.state,
+                "breaker": states.get(rep.replica_id, "closed"),
+                "beat_age_s": round(live["beat_age_s"], 3),
+                "pending": live["pending"],
+                "fatal": (repr(live["fatal"])
+                          if live["fatal"] is not None else None),
+            })
+        with self._lock:
+            quarantined = len(self._quarantined)
+        return {
+            "replicas": reps,
+            "last_incident": self.last_incident(),
+            "incidents": len(self.incidents()),
+            "quarantined_requests": quarantined,
+            "open_requests": len(self.ledger.open_requests()),
+            "supervised": self.supervisor is not None,
+        }
 
     def sample_telemetry(self) -> None:
         """Stamp per-replica ``serving_tokens_per_sec`` (from the token

@@ -14,10 +14,14 @@ Single-engine routes (:class:`ServingHTTPServer`):
   GET  /metrics       Prometheus exposition of the serving registry
 
 Fleet routes (:class:`FleetHTTPServer`, docs/serving.md): same
-``/v1/generate`` contract, but dispatch goes through the least-loaded
-router, so a 429 from one replica fails over instead of reaching the
-client. Plus the operations surface ``dct fleet`` drives:
-  GET  /v1/fleet      fleet stats + per-replica states
+``/v1/generate`` contract (plus ``"deadline_s"`` — relative deadline
+propagated router → engine; expiry is 504), but dispatch goes through
+the least-loaded router, so a 429 from one replica fails over instead
+of reaching the client; a request quarantined as a poison pill
+(docs/serving.md "Self-healing") is 422 with crash diagnostics. Plus
+the operations surface ``dct fleet`` drives:
+  GET  /v1/fleet      fleet stats + per-replica states + health view
+                      (breaker state, heartbeat age, last incident)
   POST /v1/scale      {"replicas": n} → drain-protected resize
   POST /v1/rollout    {"checkpoint": dir} → blue-green rollout
   GET  /metrics       fleet registry + per-replica series with
@@ -163,6 +167,7 @@ class ServingHTTPServer:
 
 
 def _make_fleet_handler(fleet: Any, aggregator: Any):
+    from determined_clone_tpu.serving.fleet import PoisonPillRequest
     from determined_clone_tpu.serving.router import NoHealthyReplica
 
     class Handler(BaseHTTPRequestHandler):
@@ -200,6 +205,7 @@ def _make_fleet_handler(fleet: Any, aggregator: Any):
                     "replicas": [{"id": r.replica_id, "state": r.state}
                                  for r in fleet.replicas()],
                     "excluded": fleet.router.excluded(),
+                    "health": fleet.health_view(),
                     "slo_verdict": (slo.evaluate()["verdict"]
                                     if slo is not None else None),
                 })
@@ -226,6 +232,7 @@ def _make_fleet_handler(fleet: Any, aggregator: Any):
                         raise ValueError(
                             "'prompt' must be a list of token ids")
                     timeout = float(req.get("timeout_s", 120.0))
+                    deadline_s = req.get("deadline_s")
                     handler = getattr(fleet, "handle_request", None)
                     if handler is not None:
                         # the front door proper: mints request_id/trace_id,
@@ -235,7 +242,10 @@ def _make_fleet_handler(fleet: Any, aggregator: Any):
                             eos_token_id=req.get("eos_token_id"),
                             request_id=req.get("request_id"),
                             trace_id=req.get("trace_id"),
-                            timeout=timeout)
+                            timeout=timeout,
+                            deadline_s=(float(deadline_s)
+                                        if deadline_s is not None
+                                        else None))
                     else:  # minimal fleet fakes in tests
                         handle = fleet.submit(
                             prompt, int(req.get("max_new_tokens", 16)),
@@ -282,6 +292,12 @@ def _make_fleet_handler(fleet: Any, aggregator: Any):
                 self._send(400, {"error": str(e)})
             except TimeoutError as e:
                 self._send(504, {"error": str(e)})
+            except PoisonPillRequest as e:
+                # quarantined: the request's own fault, not the fleet's
+                # — 4xx with the crash diagnostics, before the generic
+                # RuntimeError → 503 (PoisonPillRequest IS a RuntimeError)
+                self._send(422, {"error": str(e),
+                                 "diagnostics": e.diagnostics})
             except RuntimeError as e:
                 self._send(503, {"error": str(e)})
 

@@ -140,6 +140,28 @@ class BlockAllocator:
                 if self._ref[b] == 0:
                     self._free.append(b)
 
+    def outstanding(self) -> int:
+        """Blocks currently owned by someone (refcount > 0)."""
+        with self._lock:
+            return self._cache.num_blocks - len(self._free)
+
+    def assert_balanced(self, expected_outstanding: int = 0) -> None:
+        """Audit hook: every block not on the free list must be accounted
+        for by ``expected_outstanding`` live owners' worth of blocks.
+
+        Used by tests and the chaos invariant audit after a fleet drains:
+        with no active sequences and no prefix cache, a nonzero balance
+        is a leak (a crash path that dropped refs on the floor)."""
+        with self._lock:
+            held = self._cache.num_blocks - len(self._free)
+            if held != expected_outstanding:
+                owners = [i for i, r in enumerate(self._ref) if r > 0]
+                raise AssertionError(
+                    f"KV block balance: {held} blocks outstanding, "
+                    f"expected {expected_outstanding} "
+                    f"(held block ids: {owners[:16]}"
+                    f"{'...' if len(owners) > 16 else ''})")
+
 
 @dataclasses.dataclass
 class PrefixMatch:

@@ -22,16 +22,28 @@ does the router itself back off, riding the repo-standard
 :class:`RetryPolicy` (utils/retry.py) with full jitter. Every
 re-dispatch is counted in ``router_redispatch_total{reason=...}``.
 
+Exclusion is a per-replica **circuit breaker**, not a fixed cooldown: a
+replica that keeps failing would otherwise get a slice of live traffic
+every cooldown expiry forever. The first failure opens the breaker for
+``exclude_cooldown_s``; each consecutive failure doubles the window (up
+to ``exclude_max_s``). When the window lapses the breaker goes
+**half-open** and admits exactly ONE probe request — concurrent picks
+skip the replica until the probe resolves. Probe success closes the
+breaker (backoff forgotten); probe failure re-opens it with the next
+doubling. State is exported as ``router_replica_state{replica}``
+(0=closed, 1=half-open, 2=open).
+
 Replicas are anything implementing the small :class:`RoutablePort`
 surface; fleet.py's ``Replica`` is the real one, tests use fakes.
 """
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
-from determined_clone_tpu.serving.engine import ServerOverloaded
+from determined_clone_tpu.serving.engine import ReplicaFailed, ServerOverloaded
 from determined_clone_tpu.telemetry import MetricsRegistry
 from determined_clone_tpu.utils.retry import RetryPolicy, retry_call
 
@@ -48,8 +60,25 @@ ROUTER_RETRY = RetryPolicy(
 #: Exceptions that mean "this replica, right now" rather than "this
 #: request is malformed": the router excludes the replica and re-
 #: dispatches instead of surfacing them to the client.
-_FAILOVER_ERRORS = (ServerOverloaded, ConnectionError, TimeoutError,
-                    OSError)
+_FAILOVER_ERRORS = (ServerOverloaded, ReplicaFailed, ConnectionError,
+                    TimeoutError, OSError)
+
+# router_replica_state gauge values
+_STATE_CLOSED, _STATE_HALF_OPEN, _STATE_OPEN = 0, 1, 2
+
+
+@dataclasses.dataclass
+class _Breaker:
+    """Per-replica circuit-breaker record. Exists only while the replica
+    has unforgiven failures — a closed breaker is the absence of one."""
+    failures: int = 0
+    open_until: float = 0.0
+    probing: bool = False  # the half-open single probe is in flight
+
+    def state(self, now: float) -> str:
+        if now < self.open_until:
+            return "open"
+        return "half_open"
 
 
 class RoutablePort:
@@ -79,20 +108,25 @@ class RoutablePort:
 
 
 class LeastLoadedRouter:
-    """Thread-safe least-queue-depth dispatcher with exclusion failover.
+    """Thread-safe least-queue-depth dispatcher with circuit-breaker
+    failover.
 
-    ``exclude_cooldown_s`` bounds how long one 429 keeps a replica out
-    of rotation; the next successful dispatch window re-probes it. The
-    clock is injectable for deterministic tests.
+    ``exclude_cooldown_s`` is the breaker's BASE exclusion window (one
+    failure opens it for exactly that long — the pre-breaker behavior);
+    consecutive failures double it up to ``exclude_max_s``, and a lapsed
+    window admits a single half-open probe before closing. The clock is
+    injectable for deterministic tests.
     """
 
     def __init__(self, registry: Optional[MetricsRegistry] = None, *,
                  exclude_cooldown_s: float = 0.5,
+                 exclude_max_s: float = 30.0,
                  policy: RetryPolicy = ROUTER_RETRY,
                  clock: Any = time.monotonic,
                  tracer: Any = None) -> None:
         self.registry = registry if registry is not None else MetricsRegistry()
         self.exclude_cooldown_s = float(exclude_cooldown_s)
+        self.exclude_max_s = float(exclude_max_s)
         self.policy = policy
         self._clock = clock
         # per-request tracing lane ("router" process in the stitched
@@ -101,11 +135,12 @@ class LeastLoadedRouter:
                         and getattr(tracer, "enabled", False) else None)
         self._lock = threading.Lock()
         self._replicas: Dict[str, RoutablePort] = {}
-        self._excluded_until: Dict[str, float] = {}
+        self._breakers: Dict[str, _Breaker] = {}
         self._c_dispatch = self.registry.counter(
             "router_requests_total", "requests dispatched through the router")
         self._redispatch: Dict[str, Any] = {}
         self._dispatch_by_replica: Dict[str, Any] = {}
+        self._state_by_replica: Dict[str, Any] = {}
         self._g_replicas = self.registry.gauge(
             "router_replicas", "replicas registered with the router")
         self._g_healthy = self.registry.gauge(
@@ -125,8 +160,9 @@ class LeastLoadedRouter:
     def remove(self, replica_id: str) -> None:
         with self._lock:
             self._replicas.pop(replica_id, None)
-            self._excluded_until.pop(replica_id, None)
+            self._breakers.pop(replica_id, None)
             self._g_replicas.set(len(self._replicas))
+            self._state_gauge_locked(replica_id).set(_STATE_CLOSED)
 
     def replica_ids(self) -> List[str]:
         with self._lock:
@@ -154,52 +190,109 @@ class LeastLoadedRouter:
             self._dispatch_by_replica[replica_id] = c
         return c
 
+    def _state_gauge_locked(self, replica_id: str) -> Any:
+        g = self._state_by_replica.get(replica_id)
+        if g is None:
+            g = self.registry.gauge(
+                "router_replica_state",
+                "circuit-breaker state (0=closed, 1=half-open, 2=open)",
+                labels={"replica": replica_id})
+            self._state_by_replica[replica_id] = g
+        return g
+
     def _set_excluded_locked(self, now: float) -> None:
         self._g_excluded.set(
-            sum(1 for t in self._excluded_until.values() if t > now))
+            sum(1 for b in self._breakers.values() if now < b.open_until))
 
     def excluded(self) -> List[str]:
-        """Replica ids currently in exclusion cooldown (observability)."""
+        """Replica ids whose breaker is open (observability). Half-open
+        replicas are NOT excluded — they are probe-eligible."""
         now = self._clock()
         with self._lock:
-            out = sorted(r for r, t in self._excluded_until.items()
-                         if t > now)
+            out = sorted(r for r, b in self._breakers.items()
+                         if now < b.open_until)
             self._set_excluded_locked(now)
         return out
 
+    def replica_states(self) -> Dict[str, str]:
+        """Breaker state per registered replica:
+        "closed" | "half_open" | "open"."""
+        now = self._clock()
+        with self._lock:
+            return {rid: (self._breakers[rid].state(now)
+                          if rid in self._breakers else "closed")
+                    for rid in self._replicas}
+
     def _exclude(self, replica_id: str, reason: str) -> None:
+        """One more failure: open (or re-open) the breaker with the
+        next exponential window."""
         with self._lock:
             now = self._clock()
-            self._excluded_until[replica_id] = (
-                now + self.exclude_cooldown_s)
+            br = self._breakers.get(replica_id)
+            if br is None:
+                br = self._breakers[replica_id] = _Breaker()
+            br.failures += 1
+            window = min(self.exclude_max_s,
+                         self.exclude_cooldown_s
+                         * (2.0 ** (br.failures - 1)))
+            br.open_until = now + window
+            br.probing = False
             self._set_excluded_locked(now)
+            self._state_gauge_locked(replica_id).set(_STATE_OPEN)
         self._redispatch_counter(reason).inc()
+
+    def _note_success(self, replica_id: str) -> None:
+        """A dispatch landed: close the breaker, forgetting the backoff
+        history (the probe proved the replica back)."""
+        with self._lock:
+            if self._breakers.pop(replica_id, None) is not None:
+                self._state_gauge_locked(replica_id).set(_STATE_CLOSED)
+
+    def _probe_release(self, replica_id: str) -> None:
+        """The half-open probe resolved without saying anything about
+        replica health (e.g. the request was malformed): re-arm the
+        probe slot without touching the failure count."""
+        with self._lock:
+            br = self._breakers.get(replica_id)
+            if br is not None:
+                br.probing = False
 
     def pick(self, skip: Sequence[str] = ()) -> Optional[RoutablePort]:
         """Least-loaded healthy replica, or None. Ties break on free
-        blocks (more is better), then replica id (determinism)."""
+        blocks (more is better), then replica id (determinism). An
+        open-breaker replica is skipped; a half-open one competes
+        normally but at most one in-flight pick gets it (the probe) —
+        claiming the probe slot happens here, so a standalone pick()
+        counts as the probe until the next dispatch outcome resolves
+        it."""
         now = self._clock()
         with self._lock:
             candidates = []
             healthy = 0
             for rid, rep in self._replicas.items():
-                until = self._excluded_until.get(rid, 0.0)
-                if until <= now:
-                    self._excluded_until.pop(rid, None)
+                br = self._breakers.get(rid)
                 if not rep.admitting():
                     continue
-                if until > now:
-                    continue
+                if br is not None:
+                    if now < br.open_until:
+                        continue  # open: no traffic, period
+                    if br.probing:
+                        continue  # half-open: probe already in flight
                 healthy += 1
                 if rid in skip:
                     continue
                 candidates.append((rep.load(), rid, rep))
             self._g_healthy.set(healthy)
             self._set_excluded_locked(now)
-        if not candidates:
-            return None
-        candidates.sort(key=lambda c: (c[0], c[1]))
-        return candidates[0][2]
+            if not candidates:
+                return None
+            candidates.sort(key=lambda c: (c[0], c[1]))
+            chosen_id = candidates[0][1]
+            br = self._breakers.get(chosen_id)
+            if br is not None:
+                br.probing = True
+                self._state_gauge_locked(chosen_id).set(_STATE_HALF_OPEN)
+            return candidates[0][2]
 
     # -- dispatch ----------------------------------------------------------
 
@@ -207,7 +300,8 @@ class LeastLoadedRouter:
                eos_token_id: Optional[int] = None,
                request_id: Optional[str] = None,
                trace_id: Optional[str] = None,
-               timeout: Optional[float] = None) -> Any:
+               timeout: Optional[float] = None,
+               deadline_t: Optional[float] = None) -> Any:
         """Dispatch one request; returns the replica's handle (annotated
         with ``.replica_id``). One pass over the fleet per attempt:
         failing replicas are excluded and the next-least-loaded tried
@@ -215,7 +309,13 @@ class LeastLoadedRouter:
         only a fully excluded fleet backs off, under ``self.policy``.
         ``timeout`` bounds the total dispatch wait, mapping to the
         policy's deadline semantics. ``trace_id`` (minted at the front
-        door) rides every failover hop into the chosen replica."""
+        door) rides every failover hop into the chosen replica.
+        ``deadline_t`` (absolute monotonic) propagates to the replica;
+        a request already expired is refused HERE — TimeoutError, no
+        replica touched — instead of burning a slot on doomed work."""
+        if deadline_t is not None and time.monotonic() >= deadline_t:
+            raise TimeoutError(
+                f"request {request_id!r} expired before dispatch")
         policy = self.policy
         if timeout is not None:
             policy = RetryPolicy(
@@ -226,7 +326,8 @@ class LeastLoadedRouter:
                 deadline_s=timeout, retryable=policy.retryable)
         return retry_call(self._dispatch_once, prompt, max_new_tokens,
                           eos_token_id=eos_token_id, request_id=request_id,
-                          trace_id=trace_id, policy=policy)
+                          trace_id=trace_id, deadline_t=deadline_t,
+                          policy=policy)
 
     def _trace_args(self, request_id: Optional[str],
                     trace_id: Optional[str],
@@ -241,7 +342,8 @@ class LeastLoadedRouter:
     def _dispatch_once(self, prompt: Sequence[int], max_new_tokens: int, *,
                        eos_token_id: Optional[int],
                        request_id: Optional[str],
-                       trace_id: Optional[str] = None) -> Any:
+                       trace_id: Optional[str] = None,
+                       deadline_t: Optional[float] = None) -> Any:
         tried: List[str] = []
         pt0 = time.perf_counter() if self._tracer is not None else 0.0
         while True:
@@ -257,9 +359,15 @@ class LeastLoadedRouter:
                     # only when minted, so minimal RoutablePort fakes
                     # (tests) need not grow the kwarg
                     kw["trace_id"] = trace_id
+                if deadline_t is not None:
+                    # same forwarded-only-when-set contract as trace_id
+                    kw["deadline_t"] = deadline_t
                 handle = target.submit(prompt, max_new_tokens, **kw)
             except ValueError:
-                raise  # never-servable: not a replica's fault
+                # never-servable: not a replica's fault — a half-open
+                # probe slot this pick claimed is re-armed, not judged
+                self._probe_release(target.replica_id)
+                raise
             except _FAILOVER_ERRORS as exc:
                 reason = ("overloaded" if isinstance(exc, ServerOverloaded)
                           else "connection")
@@ -272,6 +380,7 @@ class LeastLoadedRouter:
                             replica=target.replica_id, reason=reason))
                 continue
             handle.replica_id = target.replica_id
+            self._note_success(target.replica_id)
             self._c_dispatch.inc()
             self._dispatch_counter(target.replica_id).inc()
             if self._tracer is not None:

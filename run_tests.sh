@@ -21,9 +21,11 @@
 # tests/test_static_checks.py gates in CI.
 #
 # `./run_tests.sh --chaos` runs the fault-tolerance + flight-recorder +
-# goodput-ledger suites (docs/fault_tolerance.md) with no marker filter,
-# so the slow kill -9 subprocess tests (including the restart-leg ledger
-# merge) run too — the tier-1 lane skips them via `-m "not slow"`.
+# goodput-ledger + fleet self-healing suites (docs/fault_tolerance.md)
+# with no marker filter, so the slow kill -9 subprocess tests (including
+# the restart-leg ledger merge) and the full chaos-conductor scenario
+# catalog (tools/chaosfleet.py) run too — the tier-1 lane skips them via
+# `-m "not slow"`.
 #
 # `./run_tests.sh --storage` runs the checkpoint-storage surface
 # (docs/checkpoint_storage.md): backends, the content-addressed store +
@@ -74,7 +76,7 @@ elif [ "$1" = "--tier1" ]; then
 elif [ "$1" = "--chaos" ]; then
     shift
     set -- tests/test_fault_tolerance.py tests/test_flight_recorder.py \
-        tests/test_goodput.py "$@"
+        tests/test_goodput.py tests/test_self_healing.py "$@"
 elif [ "$1" = "--storage" ]; then
     shift
     set -- tests/test_storage_backends.py tests/test_cas_store.py \
@@ -93,6 +95,7 @@ elif [ "$1" = "--serving" ]; then
 elif [ "$1" = "--fleet" ]; then
     shift
     set -- tests/test_serving_fleet.py tests/test_serving.py \
+        tests/test_self_healing.py \
         -m "not slow" "$@"
 elif [ "$1" = "--multichip" ]; then
     shift

@@ -39,6 +39,7 @@ _LIBRARY_THREAD_PREFIXES = (
     "profiler-", "ckpt-upload", "tb-sync",
     "serving-engine", "serving-http",
     "fleet-link", "fleet-drain", "fleet-autoscaler", "fleet-http",
+    "fleet-supervisor",
     "dct-tsdb-scrape",
 )
 

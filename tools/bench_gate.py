@@ -82,7 +82,7 @@ def newest_rounds(directory: str = ".") -> Tuple[str, str]:
 OPTIONAL_SECTIONS = ("control_plane", "checkpoint_io", "pipeline",
                      "mnist_cnn", "tpu_probe_telemetry", "xla", "goodput",
                      "serving", "serving_fleet", "exec_cache", "multichip",
-                     "tsdb")
+                     "tsdb", "recovery")
 
 
 def _section_notes(old_detail: Dict[str, Any], new_detail: Dict[str, Any],
@@ -588,6 +588,58 @@ def _tsdb_lines(old_detail: Dict[str, Any],
             f"is not keeping up with series churn")
 
 
+def _recovery_lines(old_detail: Dict[str, Any],
+                    new_detail: Dict[str, Any], report: list) -> None:
+    """Advisory self-healing reporting (serving/supervisor.py measured
+    by bench's fault-storm section): WARNs when the section errored,
+    when any leg lost an accepted request or left a ledger entry open
+    (exactly-once failover is the tentpole contract), when KV blocks
+    leaked through a crash teardown, when MTTR blew the budget, or when
+    the supervised leg recovered to under half the clean leg's
+    throughput. Advisory-only: the enforced contracts are the chaos
+    lane's scenario asserts (tests/test_self_healing.py)."""
+    rec = new_detail.get("recovery")
+    if not isinstance(rec, dict):
+        return
+    if rec.get("error"):
+        report.append(f"WARN: recovery errored: {rec['error']}")
+        return
+    healed = rec.get("supervised") or {}
+    frac = rec.get("recovered_throughput_fraction")
+    report.append(
+        f"ok: recovery supervised leg {healed.get('completed')}/"
+        f"{rec.get('requests')} completed, p99 {healed.get('p99_s')}s, "
+        f"mttr {healed.get('mttr_s')}s, "
+        f"{healed.get('replacements')} replacement(s), "
+        f"throughput x{frac} of clean")
+    budget = float(rec.get("mttr_budget_s") or 30.0)
+    for leg_name in ("clean", "unsupervised", "supervised"):
+        leg = rec.get(leg_name)
+        if not isinstance(leg, dict):
+            continue
+        lost = int(leg.get("lost") or 0)
+        open_n = int(leg.get("open_ledger_entries") or 0)
+        if lost or open_n:
+            report.append(
+                f"WARN: recovery {leg_name} leg lost {lost} request(s) "
+                f"({open_n} ledger entries left open) — exactly-once "
+                f"failover dropped accepted work")
+        leaked = int(leg.get("leaked_blocks") or 0)
+        if leaked:
+            report.append(
+                f"WARN: recovery {leg_name} leg leaked {leaked} KV "
+                f"block(s) — a crash teardown dropped refs")
+        mttr = leg.get("mttr_s")
+        if isinstance(mttr, (int, float)) and mttr > budget:
+            report.append(
+                f"WARN: recovery {leg_name} leg MTTR {mttr}s > "
+                f"{budget}s budget — replacement warm-start regressed")
+    if isinstance(frac, (int, float)) and frac < 0.5:
+        report.append(
+            f"WARN: recovery supervised throughput only x{frac} of the "
+            f"clean run — self-healing is not restoring capacity")
+
+
 def gate(old: Dict[str, Any], new: Dict[str, Any], *,
          tolerance: float = DEFAULT_TOLERANCE,
          allow_null_mfu: bool = False) -> Tuple[bool, list]:
@@ -642,6 +694,7 @@ def gate(old: Dict[str, Any], new: Dict[str, Any], *,
     _serving_fleet_lines(old_detail, new_detail, report)
     _exec_cache_lines(old_detail, new_detail, report)
     _tsdb_lines(old_detail, new_detail, report)
+    _recovery_lines(old_detail, new_detail, report)
     ok = _multichip_lines(old_detail, new_detail, report) and ok
     return ok, report
 

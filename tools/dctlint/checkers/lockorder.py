@@ -43,6 +43,7 @@ LOCK_HIERARCHY: List[Tuple[str, List[str]]] = [
     # these call into everything below, never the reverse
     ("control", [
         "*.serving.fleet.*",
+        "*.serving.supervisor.*",
         "*.serving.autoscale.*",
         "*.api.inprocess.*",
         "*.core._unmanaged.*",
