@@ -1092,6 +1092,59 @@ def _run_child() -> None:
         finally:
             shutil.rmtree(cache_dir, ignore_errors=True)
 
+    def time_kv_hierarchy() -> dict:
+        """Fleet-wide KV memory hierarchy A/B (serving/kv_store.py,
+        docs/serving.md "KV memory hierarchy"): the same seeded Zipf
+        burst — shared system-prefix heads over a prompt-template pool —
+        against a 4-replica fleet twice. Leg A is the per-replica
+        prefix-cache baseline; leg B adds the host/CAS KVBlockStore,
+        prefix-affinity routing, and a mid-burst replica restart. The
+        gate's advisory bars: the tiered leg's fleet-wide prefix hit
+        rate is no lower than the baseline's, p99 doesn't regress, and
+        the restarted replica warms the shared prefix from the tier
+        instead of re-prefilling it (``kv_miss_blocks == 0`` on the
+        replacement is the receipt)."""
+        from tools.loadgen import run_zipf_load
+
+        kw = dict(requests=64, replicas=4, templates=12, skew=1.1,
+                  seed=0, tokens_per_request=8, shared_blocks=1,
+                  iteration_floor_s=0.0, budget_s=240.0)
+        base = run_zipf_load(kv_store=False, **kw)
+        tiered = run_zipf_load(kv_store=True, restart_at=0.5, **kw)
+        if "error" in base or "error" in tiered:
+            return {"error": base.get("error") or tiered.get("error")}
+        restart = tiered.get("restart") or {}
+        return {
+            "requests": kw["requests"],
+            "replicas": kw["replicas"],
+            "zipf_skew": kw["skew"],
+            "baseline_prefix_hit_rate": base.get("prefix_hit_rate"),
+            "tiered_prefix_hit_rate": tiered.get("prefix_hit_rate"),
+            "kv_tier_hit_rate": tiered.get("kv_tier_hit_rate"),
+            "kv_host_hit_blocks": tiered.get("kv_host_hit_blocks"),
+            "kv_cas_hit_blocks": tiered.get("kv_cas_hit_blocks"),
+            "kv_promoted_blocks": tiered.get("kv_promoted_blocks"),
+            "kv_spilled_blocks": tiered.get("kv_spilled_blocks"),
+            "baseline_p99_s": (base.get("request_total_s")
+                               or {}).get("p99"),
+            "tiered_p99_s": (tiered.get("request_total_s")
+                             or {}).get("p99"),
+            "baseline_errors": base.get("errors"),
+            "tiered_errors": tiered.get("errors"),
+            # the restarted replica's first-contact counters: promoted
+            # from the tier vs re-prefilled cold. warm == promoted >= 1;
+            # misses here can be never-seen Zipf template bodies, so the
+            # strict zero-miss pin lives in the kv_warm_failover chaos
+            # scenario where every chain key is the shared block
+            "restart": restart,
+            "restart_warm": bool(restart
+                                 and restart.get("kv_promoted_blocks",
+                                                 0) >= 1),
+            "host_tier": (tiered.get("kv_stats") or {}).get("entries"),
+            "duration_s": round(base.get("duration_s", 0.0)
+                                + tiered.get("duration_s", 0.0), 3),
+        }
+
     def time_multichip(device_counts=(8, 16)) -> dict:
         """Measured multichip scaling lane (docs/parallelism.md): one
         ``parallel/scaling_bench.py`` subprocess per simulated mesh size —
@@ -1286,6 +1339,7 @@ def _run_child() -> None:
     multichip_section = None
     tsdb_section = None
     recovery_section = None
+    kv_hierarchy_section = None
     if not on_tpu:
         # cheap on CPU, and computing it before the ladder means the very
         # first banked result line already carries a non-null
@@ -1329,6 +1383,13 @@ def _run_child() -> None:
             recovery_section = time_recovery()
         except Exception as exc:  # noqa: BLE001
             recovery_section = {"error": repr(exc)[:200]}
+        # KV memory hierarchy Zipf A/B + warm-failover restart leg —
+        # the advisory kv gate reads hit rates, p99, and the restarted
+        # replica's promoted/miss counters off this section
+        try:
+            kv_hierarchy_section = time_kv_hierarchy()
+        except Exception as exc:  # noqa: BLE001
+            kv_hierarchy_section = {"error": repr(exc)[:200]}
     for i, rung in enumerate(ladder):
         if remaining() < rung["min_s"]:
             _emit({"skipped_rung": rung["name"],
@@ -1452,6 +1513,9 @@ def _run_child() -> None:
                     # replica-killed vs supervisor-healed burst (lost
                     # requests / leaked blocks / MTTR / p99)
                     "recovery": recovery_section,
+                    # KV memory hierarchy: Zipf A/B hit rates + p99 and
+                    # the mid-burst restart leg warmed from the tier
+                    "kv_hierarchy": kv_hierarchy_section,
                     "init_s": round(t_init, 1),
                 },
             }
@@ -1528,6 +1592,13 @@ def _run_child() -> None:
                 recovery_section = time_recovery()
             except Exception as exc:  # noqa: BLE001
                 recovery_section = {"error": repr(exc)[:200]}
+        if kv_hierarchy_section is None and remaining() > 60:
+            # TPU lane: two Zipf legs against an already-warm compile
+            # cache; the restart leg reuses the fleet programs too
+            try:
+                kv_hierarchy_section = time_kv_hierarchy()
+            except Exception as exc:  # noqa: BLE001
+                kv_hierarchy_section = {"error": repr(exc)[:200]}
         if multichip_section is None and remaining() > 100:
             # post-bank on BOTH lanes: the two scaling-bench subprocesses
             # run concurrently (~75 s on this box) and never delay the

@@ -379,6 +379,53 @@ def cmd_exec_cache_stats(args) -> int:
     return 0
 
 
+def cmd_kv_stats(args) -> int:
+    """KV memory-hierarchy readout (docs/serving.md, "KV memory
+    hierarchy"): from a live fleet front door (--url → the ``kv_tier``
+    block of GET /v1/fleet — host tier counters plus nested CAS stats)
+    or straight off a CAS store's ``cas/kv/`` namespace (--config /
+    --host-path, same addressing as `exec-cache stats`)."""
+    if args.url:
+        import urllib.request
+
+        with urllib.request.urlopen(f"{args.url.rstrip('/')}/v1/fleet",
+                                    timeout=10) as resp:
+            view = json.loads(resp.read().decode("utf-8"))
+        kv = view.get("kv_tier")
+        if kv is None:
+            print("fleet has no KV memory hierarchy (kv_store off)",
+                  file=sys.stderr)
+            return 2
+        print_json(kv)
+        return 0
+    from determined_clone_tpu.config.experiment import (
+        CheckpointStorageConfig,
+    )
+    from determined_clone_tpu.storage import CASStorageManager, build
+
+    if args.config:
+        import yaml
+
+        with open(args.config) as f:
+            doc = yaml.safe_load(f) or {}
+        raw = doc.get("checkpoint_storage") or doc
+    elif args.host_path:
+        raw = {"type": "cas", "inner": {
+            "type": "shared_fs", "host_path": args.host_path}}
+    else:
+        print("kv stats needs --url, --config or --host-path",
+              file=sys.stderr)
+        return 2
+    manager = build(CheckpointStorageConfig.from_dict(raw))
+    if not isinstance(manager, CASStorageManager):
+        print(f"checkpoint_storage type {raw.get('type')!r} is not "
+              "content-addressed; spilled KV blocks live on `type: cas`",
+              file=sys.stderr)
+        return 2
+    print_json(manager.kv_store().stats())
+    return 0
+
+
 def cmd_task_list(args) -> int:
     tasks = make_session(args).list_tasks(args.type)
     print_table(tasks, ["id", "task_type", "name", "state", "proxy_address"])
@@ -1802,6 +1849,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="bare exec-cache root (the DCT_EXEC_CACHE_DIR "
                         "convention)")
     c.set_defaults(func=cmd_exec_cache_stats)
+
+    # kv (fleet-wide KV memory hierarchy — docs/serving.md)
+    p_kv = sub.add_parser(
+        "kv", help="fleet-wide KV memory hierarchy (host tier + "
+                   "cas/kv/ spill)")
+    skv = p_kv.add_subparsers(dest="subcommand", required=True)
+    c = skv.add_parser("stats",
+                       help="tier entries, bytes, hit split, CAS spill "
+                            "accounting")
+    c.add_argument("--url", default=None,
+                   help="fleet front-door URL (live host-tier + CAS "
+                        "counters)")
+    c.add_argument("--config", default=None,
+                   help="experiment config yaml with a checkpoint_storage "
+                        "cas block")
+    c.add_argument("--host-path", default=None,
+                   help="shared_fs storage root (shortcut for a config)")
+    c.set_defaults(func=cmd_kv_stats)
 
     # task (generic) + NTSC types
     p_task = sub.add_parser("task", help="NTSC tasks")

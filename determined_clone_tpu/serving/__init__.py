@@ -7,6 +7,9 @@ service (docs/serving.md):
   compiles a small fixed set of XLA programs;
 - :mod:`.kv_cache` — the preallocated paged KV pool and its block
   allocator (vLLM-style block tables, per-sequence);
+- :mod:`.kv_store` — the fleet-wide KV memory hierarchy above it:
+  host-RAM tier for demoted blocks, ``cas/kv/`` spill, and the
+  prefix-inventory digests router affinity reads;
 - :mod:`.engine` — the iteration-level continuous-batching scheduler
   (Orca-style): prefill/decode split, admission control on RetryPolicy,
   CAS checkpoint hot-load, per-request telemetry spans;
@@ -44,6 +47,12 @@ from determined_clone_tpu.serving.engine import (  # noqa: F401
     make_block_copy,
     make_paged_forward,
     make_paged_verify,
+)
+from determined_clone_tpu.serving.kv_store import (  # noqa: F401
+    KVBlockStore,
+    PrefixInventory,
+    params_fingerprint,
+    prompt_chain_keys,
 )
 from determined_clone_tpu.serving.router import (  # noqa: F401
     ROUTER_RETRY,

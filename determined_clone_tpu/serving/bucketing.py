@@ -93,7 +93,8 @@ class BucketSpec:
         return chunk_len
 
     def extended_budget(self, *, speculative: bool = False,
-                        prefix_cache: bool = False) -> int:
+                        prefix_cache: bool = False,
+                        kv_store: bool = False) -> int:
         """Worst-case jit cache size across ALL the engine's jitted
         entry points (the number warmup precompiles to and the tier-1
         probe asserts against):
@@ -104,12 +105,16 @@ class BucketSpec:
           k-token proposal loop is T=1 decode), plus one k+1-token
           verify program per batch bucket on the target;
         - prefix sharing: one copy-on-write block-copy program per pool
-          pair (target, and draft when speculative).
+          pair (target, and draft when speculative);
+        - KV tier: one host→pool block-write (promotion scatter)
+          program per pool pair (target, and draft when speculative).
         """
         budget = self.program_budget
         if speculative:
             budget += self.program_budget + len(self.batch_buckets)
         if prefix_cache:
+            budget += 2 if speculative else 1
+        if kv_store:
             budget += 2 if speculative else 1
         return budget
 

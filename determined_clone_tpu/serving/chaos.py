@@ -453,6 +453,90 @@ class ChaosRunner:
             fleet.close()
         return self._finish("poison_pill", t0, checks, fleet)
 
+    def kv_warm_failover(self) -> ScenarioResult:
+        """Replica restarted mid-burst warms the shared prefix from the
+        KV tier (docs/serving.md "KV memory hierarchy"): every request
+        opens with the same full KV block of system prompt; after half
+        the burst, ``chaos-1`` is condemned through the self-healing
+        path (its resident blocks flush to the fleet-shared
+        :class:`KVBlockStore`) and ``chaos-2`` leaves via the drain
+        protocol, so the replacement serves the rest of the burst alone
+        — promoting the shared block from the tier instead of
+        re-prefilling it (``kv_tier_miss_blocks == 0`` is the pin),
+        bit-identical, with zero leaked blocks."""
+        from determined_clone_tpu.serving.kv_store import KVBlockStore
+
+        t0 = time.monotonic()
+        checks: List[Check] = []
+        store = KVBlockStore(budget_bytes=32 << 20)
+        # wider prefill ladder than the default chaos fleet: the shared
+        # system prefix must be a FULL block (block_size 8) plus a tail
+        fleet = self._fleet(prefix_cache=True, kv_store=store,
+                            buckets=BucketSpec.build(2, 16))
+        try:
+            fleet.scale_up(2)
+            system = [5, 9, 2, 7, 4, 8, 3, 6]  # one full KV block
+            rng = random.Random(self.seed * 104729 + 7)
+            prompts = [system
+                       + [1 + rng.randrange(CHAOS_CFG.vocab_size - 7)
+                          for _ in range(2 + (i % 3))]
+                       for i in range(self.requests)]
+            ref = self._reference(fleet, prompts)
+            half = max(1, len(prompts) // 2)
+            results = self._run_workload(
+                fleet, prompts[:half],
+                request_ids={i: f"req-{i}" for i in range(half)})
+            # mid-burst restart: the self-healing path records the
+            # incident and flushes chaos-1's resident blocks to the tier.
+            # Settle the victim first — replace_replica only flushes a
+            # flushable engine (pending=False), and the scheduler's
+            # _busy window can outlive the last front-door handle.
+            for rep in fleet.replicas():
+                if rep.replica_id == "chaos-1":
+                    rep.engine.wait_idle(15.0)
+            replacement = fleet.replace_replica("chaos-1",
+                                                reason="kv_restart")
+            fleet.stop_replica("chaos-2")
+            results.update(self._run_workload(
+                fleet, prompts[half:],
+                request_ids={i: f"req-{half + i}"
+                             for i in range(len(prompts) - half)}))
+            warm = {}
+            for rep in fleet.replicas():
+                if rep.replica_id in replacement:
+                    st = rep.engine.stats()
+                    warm = {"promoted": st.kv_promoted_blocks,
+                            "host_hits": st.kv_host_hit_blocks,
+                            "cas_hits": st.kv_cas_hit_blocks,
+                            "misses": st.kv_miss_blocks}
+            checks.append(Check(
+                "replacement_warmed_from_tier",
+                bool(warm) and warm.get("promoted", 0) >= 1
+                and warm.get("misses", 1) == 0,
+                f"replacement={replacement} kv={warm}"))
+            # >= 1, not >= 2: prefix-affinity routing concentrates the
+            # shared-prefix traffic on one replica, so the drained peer
+            # may have nothing resident to contribute
+            checks.append(Check(
+                "tier_captured_flushes",
+                store.stats()["puts"] + store.stats()["duplicate_puts"]
+                >= 1,
+                f"store={store.stats()!r:.200}"))
+            # release the survivors' resident prefix blocks before the
+            # balance audit: spill to tier, then a same-params hot_swap
+            # (the scheduler-synchronized prefix flush)
+            for rep in fleet.replicas():
+                rep.engine.wait_idle(15.0)
+                rep.engine.flush_kv_to_tier()
+                rep.engine.hot_swap(self.params)
+            self._wait(lambda: all(r.engine.kv_outstanding() == 0
+                                   for r in fleet.replicas()), 10.0)
+            self._audit(fleet, checks, ref, results,
+                        expect_replicas=1, expect_min_incidents=1)
+        finally:
+            fleet.close()
+        return self._finish("kv_warm_failover", t0, checks, fleet)
+
     def deadline_storm(self) -> ScenarioResult:
         """Deadline propagation under stall: an already-expired request
         504s without touching a replica; a request whose deadline lapses
@@ -498,6 +582,7 @@ SCENARIOS: Dict[str, Callable[[ChaosRunner], ScenarioResult]] = {
     "torn_warmstart": ChaosRunner.torn_warmstart,
     "double_fault": ChaosRunner.double_fault,
     "poison_pill": ChaosRunner.poison_pill,
+    "kv_warm_failover": ChaosRunner.kv_warm_failover,
     "deadline_storm": ChaosRunner.deadline_storm,
 }
 

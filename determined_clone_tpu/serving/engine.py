@@ -71,6 +71,10 @@ from determined_clone_tpu.serving.kv_cache import (
     PrefixCache,
     init_kv_pools,
 )
+from determined_clone_tpu.serving.kv_store import (
+    PrefixInventory,
+    params_fingerprint,
+)
 from determined_clone_tpu.telemetry import MetricsRegistry
 from determined_clone_tpu.utils.retry import RetryPolicy, retry_call
 
@@ -189,6 +193,20 @@ def make_block_copy(exec_cache: Any = None) -> Any:
     return _maybe_dispatch(fwd, exec_cache, "serving_block_copy")
 
 
+def _block_write(k_pool: jax.Array, v_pool: jax.Array, dst: jax.Array,
+                 k_blk: jax.Array, v_blk: jax.Array):
+    """KV-tier promotion: scatter one host-gathered block payload (all
+    layers) into a pool slot — the exact inverse of the spill gather."""
+    return (k_pool.at[:, dst].set(k_blk), v_pool.at[:, dst].set(v_blk))
+
+
+def make_block_write(exec_cache: Any = None) -> Any:
+    """Jitted :func:`_block_write` — dst is a dynamic scalar, so tier
+    promotion costs exactly one XLA program per pool pair."""
+    fwd = jax.jit(_block_write, donate_argnums=(0, 1))
+    return _maybe_dispatch(fwd, exec_cache, "serving_block_write")
+
+
 @dataclasses.dataclass(frozen=True)
 class Request:
     """One generation request. Greedy decoding (argmax) — the serving
@@ -247,6 +265,11 @@ class EngineStats:
     spec_tokens_proposed: int = 0
     spec_tokens_accepted: int = 0
     spec_acceptance_rate: Optional[float] = None
+    kv_host_hit_blocks: int = 0
+    kv_cas_hit_blocks: int = 0
+    kv_miss_blocks: int = 0
+    kv_promoted_blocks: int = 0
+    kv_spilled_blocks: int = 0
 
 
 class _Handle:
@@ -349,6 +372,7 @@ class InferenceEngine:
                  speculative_k: int = 0,
                  draft_params: Optional[gpt.Params] = None,
                  draft_cfg: Optional[gpt.GPTConfig] = None,
+                 kv_store: Any = None,
                  fault_scope: str = "") -> None:
         self.model_cfg = model_cfg
         # chaos targeting: with a scope (the fleet passes the replica
@@ -417,9 +441,30 @@ class InferenceEngine:
             self.draft_cfg = None
             self._draft_fwd = None
             self._verify_fwd = None
-        self._prefix = PrefixCache(cache, self._allocator) \
+        # -- KV memory hierarchy (serving/kv_store.py) -------------------
+        # the host/CAS tiers below the prefix cache: eviction demotes
+        # blocks into the store, admission promotes tier hits back into
+        # pool blocks before prefilling only the uncovered tail
+        if kv_store is not None and not prefix_cache:
+            raise ValueError("kv_store requires prefix_cache=True — the "
+                             "tier is keyed by the prefix cache's chain "
+                             "hashes")
+        self._kv_store = kv_store
+        self._prefix = PrefixCache(
+            cache, self._allocator,
+            spill=(self._spill_block if kv_store is not None else None)) \
             if prefix_cache else None
         self._copy = make_block_copy() if prefix_cache else None
+        self._write = make_block_write() if kv_store is not None else None
+        # tier-key scope: cached K/V is a function of the params, so a
+        # weight change (hot_swap/rollout) switches fingerprints and can
+        # never be served another set of weights' blocks
+        self._params_fp = (params_fingerprint(params)
+                           if kv_store is not None else "")
+        # host→pool promotion writes queued at admission; each dst block
+        # carries an extra allocator reference until the write lands in
+        # _do_writes (so no eviction/teardown race can free it first)
+        self._pending_writes: List[Tuple[int, Dict[str, Any]]] = []
 
         # simulated device-step floor: pad every scheduler iteration that
         # did device work up to this many seconds. 0.0 (the default) is a
@@ -447,7 +492,7 @@ class InferenceEngine:
         # replica's registry/tracer; a fleet-shared dispatcher rebinds to
         # whichever replica is currently warming
         for entry in (self._fwd, self._draft_fwd, self._verify_fwd,
-                      self._copy):
+                      self._copy, self._write):
             bind = getattr(entry, "bind_telemetry", None)
             if callable(bind):
                 bind(self.registry, self._tracer)
@@ -497,6 +542,21 @@ class InferenceEngine:
         self._c_expired = m.counter(
             "serving_requests_expired_total",
             "requests retired at their deadline (blocks freed, not decoded)")
+        self._c_kv_host_hit = m.counter(
+            "kv_tier_host_hit_blocks_total",
+            "prompt blocks promoted from the host KV tier")
+        self._c_kv_cas_hit = m.counter(
+            "kv_tier_cas_hit_blocks_total",
+            "prompt blocks promoted from the CAS KV tier")
+        self._c_kv_miss = m.counter(
+            "kv_tier_miss_blocks_total",
+            "prompt blocks absent from every KV tier (prefilled fresh)")
+        self._c_kv_promoted = m.counter(
+            "kv_tier_promoted_blocks_total",
+            "host→pool promotion writes landed (re-prefill avoided)")
+        self._c_kv_spilled = m.counter(
+            "kv_tier_spilled_blocks_total",
+            "pool blocks demoted into the host tier instead of dropped")
 
         self._cond = threading.Condition()
         self._queue: collections.deque[_Handle] = collections.deque()
@@ -791,6 +851,21 @@ class InferenceEngine:
                         self._dk_pool, self._dv_pool = call(
                             self._copy, self._dk_pool, self._dv_pool, 0, 0)
                     jax.block_until_ready(self._k_pool)
+                if self._write is not None:
+                    # warmed by writing block 0's own contents back:
+                    # materialize the slice BEFORE the donated call, so
+                    # the write is bit-identical (all zeros at warmup)
+                    kb = jnp.array(self._k_pool[:, 0])
+                    vb = jnp.array(self._v_pool[:, 0])
+                    self._k_pool, self._v_pool = call(
+                        self._write, self._k_pool, self._v_pool, 0, kb, vb)
+                    if self._spec_k:
+                        dkb = jnp.array(self._dk_pool[:, 0])
+                        dvb = jnp.array(self._dv_pool[:, 0])
+                        self._dk_pool, self._dv_pool = call(
+                            self._write, self._dk_pool, self._dv_pool, 0,
+                            dkb, dvb)
+                    jax.block_until_ready(self._k_pool)
         finally:
             with self._cond:
                 self._warming = False
@@ -921,7 +996,7 @@ class InferenceEngine:
         total = 0
         seen = []
         for f in (self._fwd, self._draft_fwd, self._verify_fwd,
-                  self._copy):
+                  self._copy, self._write):
             if f is None:
                 continue
             # jax keys the jit cache on the underlying function: _fwd
@@ -944,7 +1019,8 @@ class InferenceEngine:
         engine was built with; :meth:`warmup` compiles exactly this many."""
         return self.buckets.extended_budget(
             speculative=self._spec_k > 0,
-            prefix_cache=self._prefix is not None)
+            prefix_cache=self._prefix is not None,
+            kv_store=self._kv_store is not None)
 
     def exec_dispatchers(self) -> List[Any]:
         """The engine's distinct AOT dispatchers (empty when the engine
@@ -953,7 +1029,7 @@ class InferenceEngine:
         dispatcher no matter how many engines run through it."""
         out: List[Any] = []
         for f in (self._fwd, self._draft_fwd, self._verify_fwd,
-                  self._copy):
+                  self._copy, self._write):
             if callable(getattr(f, "cache_summary", None)) and not any(
                     f is s for s in out):
                 out.append(f)
@@ -987,7 +1063,12 @@ class InferenceEngine:
                 spec_tokens_proposed=proposed,
                 spec_tokens_accepted=accepted,
                 spec_acceptance_rate=(accepted / proposed
-                                      if proposed else None))
+                                      if proposed else None),
+                kv_host_hit_blocks=int(self._c_kv_host_hit.value),
+                kv_cas_hit_blocks=int(self._c_kv_cas_hit.value),
+                kv_miss_blocks=int(self._c_kv_miss.value),
+                kv_promoted_blocks=int(self._c_kv_promoted.value),
+                kv_spilled_blocks=int(self._c_kv_spilled.value))
 
     # -- scheduler ---------------------------------------------------------
 
@@ -1019,6 +1100,12 @@ class InferenceEngine:
                             self._prefix.flush()
                             self._g_free_blocks.set(
                                 self._allocator.free_blocks())
+                        if self._kv_store is not None:
+                            # new weights, new tier scope: old-params
+                            # blocks stay fetchable under the old
+                            # fingerprint (rollback warms), never here
+                            self._params_fp = params_fingerprint(
+                                self._params)
                     admitted = self._admit_locked()
                     self._busy = True
                 # fault points fire OUTSIDE the condition (a delay rule
@@ -1028,11 +1115,16 @@ class InferenceEngine:
                     for rid in admitted:
                         faults.point("engine.admit")
                         faults.point("engine.admit." + rid)
+                    if self._pending_writes:
+                        faults.point("kv_store.promote")
                     faults.point("engine.step")
                     if self._fault_scope:
                         faults.point("engine.step." + self._fault_scope)
                 iter_t0 = time.monotonic()
                 worked = self._reap_expired()
+                if self._pending_writes:
+                    self._do_writes()
+                    worked = True
                 if self._prefilling:
                     self._prefill_step()
                     worked = True
@@ -1081,6 +1173,9 @@ class InferenceEngine:
         """
         pairs = [(h, False) for h in self._queue]
         self._queue.clear()
+        for block, _payload in self._pending_writes:
+            self._allocator.release([block])
+        self._pending_writes.clear()
         for a in self._active + self._prefilling:
             if a.pending_copy is not None:
                 self._allocator.release([a.pending_copy[0]])
@@ -1133,6 +1228,11 @@ class InferenceEngine:
             shared: List[int] = []
             fork_src: Optional[int] = None
             if self._prefix is not None:
+                if self._kv_store is not None:
+                    # warm the prefix cache from the lower tiers first,
+                    # so the ordinary match below aliases promoted
+                    # blocks exactly like always-resident ones
+                    self._promote_locked(head.req.prompt)
                 match = self._prefix.match(head.req.prompt)
                 # always leave >= 1 prompt token to process: the last
                 # prompt token is re-scored through the model to produce
@@ -1191,6 +1291,146 @@ class InferenceEngine:
         self._g_queue.set(len(self._queue))
         self._g_free_blocks.set(self._allocator.free_blocks())
         return admitted
+
+    # -- KV memory hierarchy (serving/kv_store.py) -------------------------
+
+    def _payload_ok(self, payload: Dict[str, Any]) -> bool:
+        """A tier payload is adoptable iff its arrays exactly match the
+        pool slot shape/dtype (a config change or foreign entry must be
+        a plain miss, never a bad scatter) and cover the draft pools
+        when speculation is on."""
+        want = [("k", self._k_pool), ("v", self._v_pool)]
+        if self._spec_k:
+            want += [("dk", self._dk_pool), ("dv", self._dv_pool)]
+        for name, pool in want:
+            arr = payload.get(name) if isinstance(payload, dict) else None
+            if arr is None:
+                return False
+            slot = pool.shape[:1] + pool.shape[2:]
+            if (tuple(getattr(arr, "shape", ())) != tuple(slot)
+                    or str(getattr(arr, "dtype", "")) != str(pool.dtype)):
+                return False
+        return True
+
+    def _promote_locked(self, prompt: Tuple[int, ...]) -> None:
+        """Under ``self._cond``: warm the prefix cache from the
+        host/CAS tiers before matching one prompt. Walks the prompt's
+        full blocks in chain order; for each key not already resident,
+        fetches the exact payload, allocates a pool block, indexes it
+        (the cache adopts the allocator reference) and queues the
+        host→pool write — which lands in :meth:`_do_writes` before any
+        admitted row's first forward, so a matched row always reads the
+        promoted bytes. Chain continuity: the first miss ends the walk
+        — a later hit would alias a block whose predecessors are
+        absent. Tail blocks never promote (they never spilled)."""
+        bs = self.cache.block_size
+        prev = b""
+        for i in range(len(prompt) // bs):
+            key = PrefixCache._chain(prev, prompt[i * bs:(i + 1) * bs])
+            prev = key
+            if self._prefix.has_key(key):
+                continue
+            key_hex = key.hex()
+            from_host = self._kv_store.contains(self._params_fp, key_hex)
+            payload = self._kv_store.get(self._params_fp, key_hex)
+            if payload is None or not self._payload_ok(payload):
+                self._c_kv_miss.inc()
+                break
+            if self._allocator.free_blocks() < 1:
+                self._prefix.evict(1)
+                if self._allocator.free_blocks() < 1:
+                    break
+            block = self._allocator.allocate_blocks(1)[0]
+            self._prefix.adopt(key, block, i)
+            # extra reference pins the dst until the write lands — no
+            # eviction or teardown between queue and write may free it
+            self._allocator.retain([block])
+            self._pending_writes.append((block, payload))
+            (self._c_kv_host_hit if from_host
+             else self._c_kv_cas_hit).inc()
+
+    def _do_writes(self) -> None:
+        """Land queued promotion writes before any prefill or decode
+        touches the pools — a matched row's first forward must read the
+        promoted bytes, not zeros. Drops each dst block's pinning
+        reference once its scatter lands."""
+        writes, self._pending_writes = self._pending_writes, []
+        for block, payload in writes:
+            self._k_pool, self._v_pool = self._write(
+                self._k_pool, self._v_pool, block,
+                jnp.asarray(payload["k"]), jnp.asarray(payload["v"]))
+            if self._spec_k:
+                self._dk_pool, self._dv_pool = self._write(
+                    self._dk_pool, self._dv_pool, block,
+                    jnp.asarray(payload["dk"]), jnp.asarray(payload["dv"]))
+            self._allocator.release([block])
+            self._c_kv_promoted.inc()
+
+    def _spill_block(self, key: bytes, block: int, depth: int) -> bool:
+        """PrefixCache demotion hook: capture one full block's exact
+        K/V into the host tier. Runs on the scheduler thread while the
+        cache still holds the block's reference, so the pool contents
+        are intact and no donated call is in flight. Never raises — a
+        failed spill just means the block is gone, as before the tier
+        existed."""
+        try:
+            payload = {"k": np.asarray(self._k_pool[:, block]),
+                       "v": np.asarray(self._v_pool[:, block])}
+            if self._spec_k:
+                payload["dk"] = np.asarray(self._dk_pool[:, block])
+                payload["dv"] = np.asarray(self._dv_pool[:, block])
+            self._kv_store.put(self._params_fp, key.hex(), payload)
+        except Exception:  # noqa: BLE001 — demotion is best-effort
+            return False
+        self._c_kv_spilled.inc()
+        return True
+
+    def flush_kv_to_tier(self) -> int:
+        """Demote every full-block prefix-cache entry into the
+        host/CAS tiers, so a teardown (rollout, replace, stop)
+        preserves the fleet's warm state instead of dropping it.
+        Requires an idle engine (the fleet calls this after its drain;
+        a dead or wedged engine raises, and the fleet degrades to a
+        cold teardown). Entries stay resident afterwards — the tier
+        holds copies; the usual flush/teardown still releases the
+        blocks. Returns blocks spilled."""
+        if self._prefix is None or self._kv_store is None:
+            return 0
+        n = 0
+        with self._cond:
+            self._await_idle_locked("flush_kv_to_tier")
+            for key, block, depth in self._prefix.entries():
+                if self._spill_block(key, block, depth):
+                    n += 1
+        return n
+
+    def prefix_inventory(self) -> Optional[Dict[str, Any]]:
+        """Router-facing digest of the chain keys this replica can
+        serve cheaply: resident prefix-cache entries (roots first —
+        a missed root zeroes coverage, so roots deserve the exact
+        top-K slots) followed by this fingerprint's host-tier keys.
+        None when the prefix cache is off."""
+        if self._prefix is None:
+            return None
+        # the scheduler thread may be registering entries concurrently
+        # (dict iteration can raise RuntimeError mid-insert) — retry a
+        # couple of times, then serve an empty digest; the inventory is
+        # a routing hint, never correctness
+        for _ in range(3):
+            try:
+                resident = sorted(self._prefix.entries(),
+                                  key=lambda e: e[2])
+                break
+            except RuntimeError:
+                continue
+        else:
+            resident = []
+        keys = [k.hex() for k, _block, _depth in resident]
+        if self._kv_store is not None:
+            seen = set(keys)
+            keys += [k for k in self._kv_store.keys(self._params_fp)
+                     if k not in seen]
+        return PrefixInventory.build(keys).to_dict()
 
     def _reap_expired(self) -> bool:
         """Retire cancelled and deadline-expired rows at the iteration

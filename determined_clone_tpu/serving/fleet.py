@@ -68,6 +68,7 @@ from determined_clone_tpu.serving.engine import (
     make_paged_forward,
 )
 from determined_clone_tpu.serving.kv_cache import KVCacheConfig
+from determined_clone_tpu.serving.kv_store import KVBlockStore
 from determined_clone_tpu.serving.router import LeastLoadedRouter
 from determined_clone_tpu.telemetry import (
     MetricsRegistry,
@@ -220,6 +221,11 @@ class Replica:
                                   trace_id=trace_id,
                                   deadline_t=deadline_t)
 
+    def prefix_inventory(self) -> Optional[Dict[str, Any]]:
+        """Serialized PrefixInventory digest for router affinity (None
+        when the engine runs without a prefix cache)."""
+        return self.engine.prefix_inventory()
+
     # -- lifecycle ---------------------------------------------------------
 
     def drain(self, timeout: float = 60.0) -> float:
@@ -280,6 +286,7 @@ class ServingFleet:
                  registry: Optional[MetricsRegistry] = None,
                  aggregator: Any = None,
                  prefix_cache: bool = False,
+                 kv_store: Any = None,
                  tracing: Optional[bool] = None,
                  archive_dir: Optional[str] = None,
                  slo: Any = None,
@@ -299,6 +306,21 @@ class ServingFleet:
         # each keeps its own prefix index; the router's least-loaded
         # spread means a hot shared prefix ends up cached everywhere)
         self.prefix_cache = bool(prefix_cache)
+        # fleet-shared KV memory hierarchy (serving/kv_store.py): pass a
+        # KVBlockStore (possibly CAS-backed) to share across fleets /
+        # restarts, or True for a default host-only tier. Evicted prefix
+        # blocks demote into it and admission promotes them back, so
+        # replacement replicas warm from the tier instead of
+        # re-prefilling shared prefixes.
+        if kv_store is True:
+            kv_store = KVBlockStore()
+        elif not kv_store:  # False / None / 0 all mean "off"
+            kv_store = None
+        if kv_store is not None and not self.prefix_cache:
+            raise ValueError(
+                "kv_store requires prefix_cache=True — the tier is keyed "
+                "by the prefix cache's chain hashes")
+        self.kv_store: Optional[KVBlockStore] = kv_store
         self.warmup = bool(warmup)
         self.registry = registry if registry is not None else MetricsRegistry()
         self.aggregator = aggregator
@@ -427,7 +449,13 @@ class ServingFleet:
                 telemetry=telemetry, fwd=self._fwd,
                 iteration_floor_s=self.iteration_floor_s,
                 prefix_cache=self.prefix_cache,
+                kv_store=self.kv_store,
                 fault_scope=rid)
+            if self.kv_store is not None:
+                # affinity keys must hash with the engines' actual block
+                # size (the engine derives a default when cache is None),
+                # so arm the router off the first built engine
+                self.router.prefix_block_size = engine.cache.block_size
             rep = Replica(rid, engine, tracer=tracer)
             if self.warmup:
                 engine.warmup()
@@ -456,6 +484,7 @@ class ServingFleet:
         drain_s = rep.drain(timeout)
         self._h_drain.observe(drain_s)
         self.router.remove(replica_id)
+        self._flush_kv(rep)
         rep.close()
         with self._lock:
             self._replicas.pop(replica_id, None)
@@ -484,6 +513,16 @@ class ServingFleet:
             return []
         rep.state = STOPPED
         self.router.remove(replica_id)
+        # best-effort demotion of the condemned replica's resident prefix
+        # blocks into the shared tier, BEFORE condemnation marks it dead.
+        # Gated on a liveness snapshot: flushing a wedged engine would
+        # wait out its stuck device call and stall the MTTR this method
+        # exists to bound — a dead/wedged/busy replica degrades to a cold
+        # teardown (the tier already holds whatever it evicted).
+        live = rep.engine.liveness()
+        if (live["thread_alive"] and live["fatal"] is None
+                and not live["pending"]):
+            self._flush_kv(rep)
         failed_n = rep.engine.fail_inflight(reason)
         rep.close(close_timeout)
         # after a clean join the crash teardown has run: anything still
@@ -507,6 +546,18 @@ class ServingFleet:
             "recovery_s": round(dt, 6),
         })
         return added
+
+    def _flush_kv(self, rep: Replica) -> int:
+        """Demote a replica's resident prefix blocks into the shared KV
+        tier before teardown (rollout / stop / replace), so the prefixes
+        it was hot on survive the replica. Best-effort: a dead or wedged
+        engine degrades to a cold teardown."""
+        if self.kv_store is None:
+            return 0
+        try:
+            return rep.engine.flush_kv_to_tier()
+        except Exception:  # noqa: BLE001 — flushing a dying engine
+            return 0
 
     def note_incident(self, incident: Dict[str, Any]) -> None:
         with self._lock:
@@ -565,6 +616,7 @@ class ServingFleet:
             except (TimeoutError, RuntimeError):
                 pass  # tearing down anyway; close() joins the thread
             self.router.remove(rid)
+            self._flush_kv(rep)
             rep.close()
         with self._lock:
             self._replicas.clear()
@@ -756,6 +808,9 @@ class ServingFleet:
         for i, rep in enumerate(reps):
             drain_s[rep.replica_id] = rep.drain(drain_timeout)
             self._h_drain.observe(drain_s[rep.replica_id])
+            # demote resident blocks under the OLD fingerprint before the
+            # swap flushes the prefix cache — a rollback warms from tier
+            self._flush_kv(rep)
             rep.engine.hot_swap(new_params)
             out = rep.submit(tuple(probe_prompt), probe_tokens).result(
                 drain_timeout).tokens
@@ -816,6 +871,11 @@ class ServingFleet:
                 if not any(d is s for s in seen):
                     seen.append(d)
         return _sum_cache_summaries(seen)
+
+    def kv_stats(self) -> Optional[Dict[str, Any]]:
+        """Shared KV-tier accounting (None when the hierarchy is off):
+        the host store's entries/bytes/hit-rate plus nested CAS stats."""
+        return self.kv_store.stats() if self.kv_store is not None else None
 
     def stats(self) -> FleetStats:
         reps = self.replicas()

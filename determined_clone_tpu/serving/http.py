@@ -199,6 +199,7 @@ def _make_fleet_handler(fleet: Any, aggregator: Any):
                                  "stats": dataclasses.asdict(st)})
             elif self.path == "/v1/fleet":
                 slo = getattr(fleet, "slo", None)
+                kv = getattr(fleet, "kv_stats", None)
                 self._send(200, {
                     "name": fleet.name,
                     "stats": dataclasses.asdict(fleet.stats()),
@@ -206,6 +207,7 @@ def _make_fleet_handler(fleet: Any, aggregator: Any):
                                  for r in fleet.replicas()],
                     "excluded": fleet.router.excluded(),
                     "health": fleet.health_view(),
+                    "kv_tier": kv() if callable(kv) else None,
                     "slo_verdict": (slo.evaluate()["verdict"]
                                     if slo is not None else None),
                 })

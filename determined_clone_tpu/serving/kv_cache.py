@@ -196,17 +196,29 @@ class PrefixCache:
     cache's reference — blocks stay alive until their last sequence
     retires, which is the never-freed-while-referenced invariant.
 
+    The optional ``spill`` callback turns eviction into *demotion*: it
+    fires for every evicted full-block entry, while the cache still
+    holds its reference (so the block's contents are intact), letting
+    the engine capture the exact K/V into the host/CAS tiers of
+    serving/kv_store.py instead of dropping them. Tail-keyed entries
+    never spill — they are private to one exact prompt. The callback
+    must not raise (the engine's closure swallows its own failures; a
+    failed spill just means the block is gone, like before).
+
     Single-writer: all mutation happens on the engine's scheduler
     thread; the lock only guards the counters HTTP threads read.
     """
 
     def __init__(self, cache: KVCacheConfig,
-                 allocator: BlockAllocator) -> None:
+                 allocator: BlockAllocator, *,
+                 spill: Optional[Any] = None) -> None:
         self._cfg = cache
         self._alloc = allocator
-        # key -> (block id, depth, last-used tick); depth = block index
-        # within the prompt, used to evict leaves before their parents.
-        self._entries: Dict[bytes, Tuple[int, int, int]] = {}
+        self._spill = spill
+        # key -> (block id, depth, last-used tick, tail?); depth = block
+        # index within the prompt, used to evict leaves before their
+        # parents; tail entries are salted keys that never spill.
+        self._entries: Dict[bytes, Tuple[int, int, int, bool]] = {}
         self._tick = 0
 
     # -- hashing -----------------------------------------------------------
@@ -239,7 +251,7 @@ class PrefixCache:
             ent = self._entries.get(key)
             if ent is None:
                 break
-            self._entries[key] = (ent[0], ent[1], self._tick)
+            self._entries[key] = (ent[0], ent[1], self._tick, ent[3])
             blocks.append(ent[0])
             shared += bs
             prev = key
@@ -249,7 +261,7 @@ class PrefixCache:
                 key = self._tail_key(prev, tail)
                 ent = self._entries.get(key)
                 if ent is not None:
-                    self._entries[key] = (ent[0], ent[1], self._tick)
+                    self._entries[key] = (ent[0], ent[1], self._tick, ent[3])
                     blocks.append(ent[0])
                     shared += len(tail)
         if blocks:
@@ -269,14 +281,39 @@ class PrefixCache:
             key = self._chain(prev, prompt[i * bs:(i + 1) * bs])
             if key not in self._entries:
                 self._alloc.retain([blocks[i]])
-                self._entries[key] = (blocks[i], i, self._tick)
+                self._entries[key] = (blocks[i], i, self._tick, False)
             prev = key
         tail = prompt[n_full * bs:]
         if tail:
             key = self._tail_key(prev, tail)
             if key not in self._entries:
                 self._alloc.retain([blocks[n_full]])
-                self._entries[key] = (blocks[n_full], n_full, self._tick)
+                self._entries[key] = (blocks[n_full], n_full, self._tick,
+                                      True)
+
+    # -- tier promotion / inventory (serving/kv_store.py) ------------------
+
+    def has_key(self, key: bytes) -> bool:
+        return key in self._entries
+
+    def adopt(self, key: bytes, block: int, depth: int) -> None:
+        """Index a block promoted from a lower tier. The cache takes
+        over the caller's allocator reference — the caller allocated
+        the block (refcount 1) and must NOT release it. Only full
+        blocks are ever promoted, so adopted entries are never
+        tail-keyed."""
+        if key in self._entries:
+            raise ValueError("adopt of an already-indexed prefix key")
+        self._tick += 1
+        self._entries[key] = (block, depth, self._tick, False)
+
+    def entries(self) -> List[Tuple[bytes, int, int]]:
+        """``(key, block, depth)`` of every full-block entry, for the
+        engine's flush-to-tier path and the prefix-inventory digest.
+        Tail-keyed entries are omitted — they are private to one exact
+        prompt and never spill or advertise."""
+        return [(k, e[0], e[1]) for k, e in self._entries.items()
+                if not e[3]]
 
     # -- pressure ----------------------------------------------------------
 
@@ -284,23 +321,29 @@ class PrefixCache:
         """Drop LRU entries until the allocator has ``want_free`` free
         blocks or the cache is empty. Oldest tick first, deepest block
         first on ties, so a chain's leaves go before its root and no
-        entry is ever left unreachable. Returns entries dropped."""
+        entry is ever left unreachable. Full-block entries are offered
+        to the ``spill`` callback (tier demotion) before their
+        reference is released. Returns entries dropped."""
         dropped = 0
         while (self._entries
                and self._alloc.free_blocks() < want_free):
             key = min(self._entries,
                       key=lambda k: (self._entries[k][2],
                                      -self._entries[k][1]))
-            block, _, _ = self._entries.pop(key)
+            block, depth, _, tail = self._entries.pop(key)
+            if self._spill is not None and not tail:
+                self._spill(key, block, depth)
             self._alloc.release([block])
             dropped += 1
         return dropped
 
     def flush(self) -> int:
         """Drop everything — cached KV is a function of the params, so
-        hot-swap invalidates the whole index."""
+        hot-swap invalidates the whole index. No spill: a deliberate
+        same-params flush-to-tier goes through the engine's
+        ``flush_kv_to_tier()``, which snapshots entries() first."""
         n = len(self._entries)
-        for block, _, _ in self._entries.values():
+        for block, _, _, _ in self._entries.values():
             self._alloc.release([block])
         self._entries.clear()
         return n

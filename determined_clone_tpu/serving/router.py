@@ -44,6 +44,10 @@ import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from determined_clone_tpu.serving.engine import ReplicaFailed, ServerOverloaded
+from determined_clone_tpu.serving.kv_store import (
+    PrefixInventory,
+    prompt_chain_keys,
+)
 from determined_clone_tpu.telemetry import MetricsRegistry
 from determined_clone_tpu.utils.retry import RetryPolicy, retry_call
 
@@ -106,6 +110,14 @@ class RoutablePort:
         not accept it."""
         raise NotImplementedError
 
+    def prefix_inventory(self) -> Optional[Dict[str, Any]]:
+        """Optional: serialized :class:`~determined_clone_tpu.serving.
+        kv_store.PrefixInventory` of the chain keys this replica can
+        serve without re-prefilling (resident prefix cache + its KV
+        tiers). None — the default — opts the replica out of
+        prefix-affinity routing."""
+        return None
+
 
 class LeastLoadedRouter:
     """Thread-safe least-queue-depth dispatcher with circuit-breaker
@@ -123,12 +135,26 @@ class LeastLoadedRouter:
                  exclude_max_s: float = 30.0,
                  policy: RetryPolicy = ROUTER_RETRY,
                  clock: Any = time.monotonic,
-                 tracer: Any = None) -> None:
+                 tracer: Any = None,
+                 prefix_block_size: int = 0,
+                 affinity_max_blocks: int = 8,
+                 affinity_queue_slack: int = 2) -> None:
         self.registry = registry if registry is not None else MetricsRegistry()
         self.exclude_cooldown_s = float(exclude_cooldown_s)
         self.exclude_max_s = float(exclude_max_s)
         self.policy = policy
         self._clock = clock
+        # -- prefix-affinity pre-filter (serving/kv_store.py) ------------
+        # prefix_block_size > 0 (the fleet passes its KV block size)
+        # turns it on: pick() hashes the prompt's first
+        # ``affinity_max_blocks`` full blocks and prefers the replica
+        # whose advertised inventory covers the deepest prefix — but
+        # only among replicas within ``affinity_queue_slack`` requests
+        # of the shortest queue, so affinity is a tie-shaper and never
+        # overrides overload (the least-loaded contract stands).
+        self.prefix_block_size = int(prefix_block_size)
+        self.affinity_max_blocks = int(affinity_max_blocks)
+        self.affinity_queue_slack = int(affinity_queue_slack)
         # per-request tracing lane ("router" process in the stitched
         # trace): dispatch decisions + every failover hop; None = off
         self._tracer = (tracer if tracer is not None
@@ -149,6 +175,9 @@ class LeastLoadedRouter:
         self._g_excluded = self.registry.gauge(
             "router_excluded_replicas",
             "replicas currently in exclusion cooldown")
+        self._c_affinity = self.registry.counter(
+            "router_affinity_picks_total",
+            "picks steered to a replica by prefix-inventory coverage")
 
     # -- membership (fleet-managed) ---------------------------------------
 
@@ -257,15 +286,30 @@ class LeastLoadedRouter:
             if br is not None:
                 br.probing = False
 
-    def pick(self, skip: Sequence[str] = ()) -> Optional[RoutablePort]:
+    def pick(self, skip: Sequence[str] = (),
+             prompt: Optional[Sequence[int]] = None
+             ) -> Optional[RoutablePort]:
         """Least-loaded healthy replica, or None. Ties break on free
         blocks (more is better), then replica id (determinism). An
         open-breaker replica is skipped; a half-open one competes
         normally but at most one in-flight pick gets it (the probe) —
         claiming the probe slot happens here, so a standalone pick()
         counts as the probe until the next dispatch outcome resolves
-        it."""
+        it.
+
+        With prefix affinity on (``prefix_block_size > 0``) and a
+        ``prompt`` given, replicas whose queue is within
+        ``affinity_queue_slack`` of the shortest compete first on how
+        deep their advertised prefix inventory covers the prompt's
+        chain keys; zero coverage everywhere falls back to the plain
+        least-loaded order. A replica with deep coverage but a long
+        queue is outside the slack band and never chosen over a short
+        queue — affinity shapes ties, never overrides overload."""
         now = self._clock()
+        affinity_keys: List[str] = []
+        if self.prefix_block_size > 0 and prompt is not None:
+            affinity_keys = prompt_chain_keys(
+                prompt, self.prefix_block_size, self.affinity_max_blocks)
         with self._lock:
             candidates = []
             healthy = 0
@@ -287,12 +331,33 @@ class LeastLoadedRouter:
             if not candidates:
                 return None
             candidates.sort(key=lambda c: (c[0], c[1]))
-            chosen_id = candidates[0][1]
+            chosen = candidates[0]
+            if affinity_keys:
+                min_depth = candidates[0][0][0]
+                eligible = [c for c in candidates
+                            if c[0][0] <= min_depth
+                            + self.affinity_queue_slack]
+                scored = []
+                for load, rid, rep in eligible:
+                    cov = 0
+                    try:
+                        doc = rep.prefix_inventory()
+                        if doc:
+                            cov = PrefixInventory.from_dict(
+                                doc).coverage_depth(affinity_keys)
+                    except Exception:  # noqa: BLE001 — a hint, never fatal
+                        cov = 0
+                    scored.append((-cov, load, rid, rep))
+                scored.sort(key=lambda s: (s[0], s[1], s[2]))
+                if scored and scored[0][0] < 0:
+                    chosen = (scored[0][1], scored[0][2], scored[0][3])
+                    self._c_affinity.inc()
+            chosen_id = chosen[1]
             br = self._breakers.get(chosen_id)
             if br is not None:
                 br.probing = True
                 self._state_gauge_locked(chosen_id).set(_STATE_HALF_OPEN)
-            return candidates[0][2]
+            return chosen[2]
 
     # -- dispatch ----------------------------------------------------------
 
@@ -347,7 +412,7 @@ class LeastLoadedRouter:
         tried: List[str] = []
         pt0 = time.perf_counter() if self._tracer is not None else 0.0
         while True:
-            target = self.pick(skip=tried)
+            target = self.pick(skip=tried, prompt=prompt)
             if target is None:
                 raise NoHealthyReplica(
                     f"no healthy replica (tried {tried or 'none'}, "

@@ -108,6 +108,18 @@ class StorageManager(abc.ABC):
         """
         return None
 
+    def file_mtimes(self, storage_id: str,
+                    paths: List[str]) -> Dict[str, float]:
+        """Wall-clock mtime per relative path (missing files omitted).
+
+        Optional capability: only backends that can stat cheaply
+        implement it. The CAS namespace budget sweep (storage/cas.py)
+        uses it for LRU-by-mtime ordering and skips the sweep —
+        gracefully, never erroring — when it's unavailable.
+        """
+        raise NotImplementedError(
+            f"{type(self).__name__} cannot stat per-file mtimes")
+
     @contextlib.contextmanager
     def store_path(self, storage_id: str, base_tmp: Optional[str] = None
                    ) -> Iterator[str]:
@@ -233,6 +245,17 @@ class SharedFSStorageManager(StorageManager):
                   for rel in _walk_relative(d)]
         newest = max(mtimes) if mtimes else os.path.getmtime(d)
         return time.time() - newest  # dctlint: disable=TIME001 file mtimes are wall-clock; only wall time can be compared against them
+
+    def file_mtimes(self, storage_id: str,
+                    paths: List[str]) -> Dict[str, float]:
+        d = self._dir(storage_id)
+        out: Dict[str, float] = {}
+        for rel in paths:
+            try:
+                out[rel] = os.path.getmtime(os.path.join(d, rel))
+            except (FileNotFoundError, OSError):
+                pass  # vanished mid-sweep (shared mount): simply absent
+        return out
 
     def list_files(self, storage_id: str) -> Dict[str, int]:
         d = self._dir(storage_id)

@@ -18,8 +18,13 @@ store** with two clients:
   executables as content-addressed blobs plus a key index, so replica
   fleets and restart legs skip recompiling programs another process
   already built.
+- **spilled KV blocks** (``cas/kv/``): :class:`KVBlobStore` is the
+  durable tier of the fleet KV memory hierarchy (serving/kv_store.py)
+  — exact K/V block payloads keyed by the prefix cache's chained
+  content hash, so a restarted or replacement replica warms shared
+  prefixes by *fetching* instead of re-prefilling (docs/serving.md).
 
-Both ride the same :class:`BlobService` transport — digest-keyed object
+All three ride the same :class:`BlobService` transport — digest-keyed object
 paths, sha256 verification on every read, local :class:`ChunkCache`
 read-through, fault-point injection — so the integrity and chaos
 machinery proven on checkpoints applies to executables unchanged.
@@ -48,9 +53,11 @@ import hashlib
 import json
 import logging
 import os
+import pickle
 import shutil
 import tempfile
 import threading
+import time
 import uuid
 from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
 
@@ -72,10 +79,14 @@ CHUNK_NAMESPACE = "cas"
 
 # Blob namespaces inside the reserved storage_id. Chunk GC only ever
 # deletes ``chunks/...`` rels (structurally — see BlobService.rel), so
-# ``exec/...`` entries can never be swept as orphan chunks.
+# ``exec/...`` and ``kv/...`` entries can never be swept as orphan
+# chunks; their lifecycle is the per-namespace budget sweep
+# (:func:`sweep_namespace`) instead.
 CHUNK_PREFIX = "chunks"
 EXEC_BLOB_PREFIX = "exec/blobs"
 EXEC_INDEX_PREFIX = "exec/index"
+KV_BLOB_PREFIX = "kv/blobs"
+KV_INDEX_PREFIX = "kv/index"
 
 # Per-upload-call chunk manifest written into the checkpoint's namespace.
 # One file per upload() call (so sharded ranks never collide); restore
@@ -416,6 +427,243 @@ class BlobService:
             CHUNK_NAMESPACE, [self.rel(d) for d in sorted(digests)])
 
 
+def namespace_usage(inner: StorageManager, namespace: str) -> Dict[str, int]:
+    """rel -> size for every object (blobs AND index files) under one
+    blob namespace (``exec``/``kv``) of the reserved ``cas`` storage_id."""
+    head = namespace.rstrip("/") + "/"
+    try:
+        listing = inner.list_files(CHUNK_NAMESPACE)
+    except (FileNotFoundError, KeyError):
+        return {}
+    return {rel: int(size) for rel, size in listing.items()
+            if rel.startswith(head)}
+
+
+def sweep_namespace(inner: StorageManager, namespace: str,
+                    budget_bytes: int) -> Dict[str, Any]:
+    """LRU-by-mtime byte-budget sweep for one blob namespace; the
+    shared eviction path for ``cas/exec/`` and ``cas/kv/``.
+
+    Deletes the oldest objects (by backend mtime, via the optional
+    ``file_mtimes`` capability) until the namespace fits its budget.
+    Objects are evicted individually — an index whose blob got swept
+    (or vice versa) is harmless, because both namespace clients
+    (storage/exec_cache.py, :class:`KVBlobStore`) treat ANY load
+    failure as a plain miss and re-create the pair on the next store.
+    Backends that cannot stat mtimes or delete per-object skip the
+    sweep gracefully (``swept: False``). Chunk GC never touches these
+    namespaces (structurally — see the CHUNK_PREFIX note), so this
+    sweep is their only eviction path.
+    """
+    usage = namespace_usage(inner, namespace)
+    total = sum(usage.values())
+    out: Dict[str, Any] = {"namespace": namespace, "swept": True,
+                           "budget_bytes": int(budget_bytes),
+                           "evicted": 0, "evicted_bytes": 0,
+                           "bytes": total}
+    if total <= budget_bytes:
+        return out
+    try:
+        mtimes = inner.file_mtimes(CHUNK_NAMESPACE, sorted(usage))
+    except NotImplementedError:
+        out["swept"] = False
+        return out
+    # oldest first; objects the backend could not stat sort first (age
+    # unknown — most likely vanished already, deleting them is a no-op)
+    order = sorted(usage, key=lambda rel: (mtimes.get(rel, 0.0), rel))
+    doomed: List[str] = []
+    for rel in order:
+        if total <= budget_bytes:
+            break
+        doomed.append(rel)
+        total -= usage[rel]
+        out["evicted"] += 1
+        out["evicted_bytes"] += usage[rel]
+    if doomed:
+        try:
+            inner.delete_files(CHUNK_NAMESPACE, doomed)
+        except NotImplementedError:
+            return {**out, "swept": False, "evicted": 0,
+                    "evicted_bytes": 0, "bytes": sum(usage.values())}
+        logger.info("cas namespace sweep: %s evicted %d objects "
+                    "(%d bytes) to fit %d-byte budget",
+                    namespace, out["evicted"], out["evicted_bytes"],
+                    budget_bytes)
+    out["bytes"] = total
+    return out
+
+
+class KVBlobStore:
+    """CAS tier of the fleet KV memory hierarchy (serving/kv_store.py).
+
+    Third (durable, cross-process) level of the device → host → CAS
+    hierarchy: exact K/V block payloads spilled by any replica land
+    under ``cas/kv/`` and can warm a restarted or replacement replica
+    in another process. The layout mirrors the executable cache — a
+    content-addressed pickle blob under ``kv/blobs/`` plus one small
+    JSON index record per chain key under ``kv/index/`` — so the same
+    integrity machinery applies: every blob read is sha256-verified,
+    the pickled payload carries its key for a final cross-check, and
+    EVERY failure mode (missing index, torn blob, foreign-blob index,
+    unpickling error, injected fault) degrades to a *plain miss*. The
+    engine then re-prefills, so the tier can only ever serve exact
+    bytes or nothing — which is what keeps greedy decoding
+    bit-identical (docs/serving.md).
+
+    ``kv_store.spill`` / ``kv_store.fetch`` fault points fire here
+    (docs/fault_tolerance.md); torn spills are injected by truncating
+    the staged blob under its full digest's key, so the fetch-side
+    digest check convicts.
+    """
+
+    def __init__(self, inner: StorageManager, *,
+                 budget_bytes: Optional[int] = None,
+                 sweep_every: int = 32) -> None:
+        self._inner = inner
+        self._blobs = BlobService(inner, KV_BLOB_PREFIX)
+        self.budget_bytes = budget_bytes
+        self.sweep_every = max(1, int(sweep_every))
+        self._lock = threading.Lock()
+        self._since_sweep = 0
+        self.session: Dict[str, int] = {
+            "hits": 0, "misses": 0, "stores": 0, "duplicate_stores": 0,
+            "errors": 0, "evictions": 0,
+            "bytes_stored": 0, "bytes_loaded": 0,
+        }
+
+    @staticmethod
+    def key_digest(key: Dict[str, str]) -> str:
+        """Stable digest of a tier key (params fingerprint + chain
+        hash); names the index record."""
+        return _sha256_bytes(
+            json.dumps(key, sort_keys=True).encode("utf-8"))
+
+    @staticmethod
+    def _index_rel(key_digest: str) -> str:
+        return f"{KV_INDEX_PREFIX}/{key_digest}.json"
+
+    def _note(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self.session[key] += n
+
+    def _read_index(self, key_digest: str) -> Optional[Dict[str, Any]]:
+        rel = self._index_rel(key_digest)
+        with tempfile.TemporaryDirectory(prefix="dct-kv-idx-") as tmp:
+            try:
+                self._inner.download(CHUNK_NAMESPACE, tmp, paths=[rel])
+                with open(os.path.join(tmp, rel)) as f:
+                    return json.load(f)
+            except (FileNotFoundError, KeyError, ValueError, OSError):
+                return None
+
+    def store(self, key: Dict[str, str], payload: Dict[str, Any]) -> bool:
+        """Spill one block's exact K/V arrays. Returns True when the
+        entry is durable — an already-present chain key counts (any
+        replica may race to spill a popular prefix; double-spill is an
+        idempotent no-op), False when an injected drop swallowed the
+        blob (no index is written, so readers see a plain miss)."""
+        faults.point("kv_store.spill")
+        key = dict(key)
+        digest_key = self.key_digest(key)
+        existing = self._read_index(digest_key)
+        if existing is not None and existing.get("key") == key:
+            self._note("duplicate_stores")
+            return True
+        doc = pickle.dumps({"format": 1, "key": key, "payload": payload},
+                           protocol=pickle.HIGHEST_PROTOCOL)
+        digest = _sha256_bytes(doc)
+        data = doc
+        keep = faults.truncate_bytes("kv_store.spill")
+        if keep is not None:
+            # injected torn spill: truncated bytes land under the full
+            # digest's key — the fetch-side digest check convicts
+            data = doc[:keep]
+        if self._blobs.put(data, digest=digest) is None:
+            return False
+        index = {"format": 1, "key": key, "blob": digest,
+                 "size": len(doc), "created": time.time()}
+        rel = self._index_rel(digest_key)
+        with tempfile.TemporaryDirectory(prefix="dct-kv-up-") as stage:
+            staged = os.path.join(stage, rel)
+            os.makedirs(os.path.dirname(staged), exist_ok=True)
+            with open(staged, "w") as f:
+                json.dump(index, f, indent=1)
+            self._inner.upload(stage, CHUNK_NAMESPACE, paths=[rel])
+        self._note("stores")
+        self._note("bytes_stored", len(doc))
+        self._maybe_sweep()
+        return True
+
+    def load(self, key: Dict[str, str]) -> Optional[Dict[str, Any]]:
+        """Exact K/V payload for a chain key, or None — a plain miss.
+        Every failure (missing/torn blob, index pointing at a foreign
+        blob, unpickling error) lands here as a miss: the caller
+        re-prefills, and wrong K/V is never served."""
+        faults.point("kv_store.fetch")
+        key = dict(key)
+        try:
+            entry = self._read_index(self.key_digest(key))
+            if entry is None or entry.get("key") != key:
+                self._note("misses")
+                return None
+            doc = pickle.loads(self._blobs.get(str(entry["blob"])))
+            if doc.get("key") != key:
+                # an index pointing at a foreign blob can only serve
+                # WRONG K/V for this prefix — refuse, treat as a miss
+                raise ValueError("kv blob key mismatch")
+            payload = doc["payload"]
+        except Exception as e:  # noqa: BLE001 — any failure is a miss
+            logger.warning("kv tier fetch failed (treated as a miss): %s", e)
+            self._note("misses")
+            self._note("errors")
+            return None
+        self._note("hits")
+        self._note("bytes_loaded", int(entry.get("size", 0)))
+        return payload
+
+    def contains(self, key: Dict[str, str]) -> bool:
+        """Index-only presence probe (no blob fetch, no counters)."""
+        key = dict(key)
+        entry = self._read_index(self.key_digest(key))
+        return entry is not None and entry.get("key") == key
+
+    def _maybe_sweep(self) -> None:
+        if self.budget_bytes is None:
+            return
+        with self._lock:
+            self._since_sweep += 1
+            if self._since_sweep < self.sweep_every:
+                return
+            self._since_sweep = 0
+        self.sweep()
+
+    def sweep(self) -> Dict[str, Any]:
+        """Apply the byte budget now (LRU-by-mtime over ``cas/kv/``)."""
+        if self.budget_bytes is None:
+            return {"namespace": "kv", "swept": False,
+                    "evicted": 0, "evicted_bytes": 0}
+        res = sweep_namespace(self._inner, "kv", self.budget_bytes)
+        self._note("evictions", int(res.get("evicted", 0)))
+        return res
+
+    def stats(self) -> Dict[str, Any]:
+        usage = namespace_usage(self._inner, "kv")
+        entries = sum(1 for rel in usage
+                      if rel.startswith(KV_INDEX_PREFIX + "/"))
+        with self._lock:
+            session = dict(self.session)
+        looked = session["hits"] + session["misses"]
+        return {
+            "entries": entries,
+            "objects": len(usage),
+            "bytes": sum(usage.values()),
+            "budget_bytes": self.budget_bytes,
+            "hit_rate": (round(session["hits"] / looked, 4)
+                         if looked else None),
+            "session": session,
+        }
+
+
 class CASStorageManager(StorageManager):
     """Content-addressed wrapper around a concrete storage backend.
 
@@ -427,7 +675,8 @@ class CASStorageManager(StorageManager):
     def __init__(self, inner: StorageManager, *,
                  chunk_size: int = DEFAULT_CHUNK_SIZE,
                  cache: Optional[ChunkCache] = None,
-                 pool: Optional[transfer.TransferPool] = None) -> None:
+                 pool: Optional[transfer.TransferPool] = None,
+                 namespace_budgets: Optional[Dict[str, int]] = None) -> None:
         if chunk_size < 1:
             raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
         if isinstance(inner, CASStorageManager):
@@ -461,6 +710,17 @@ class CASStorageManager(StorageManager):
             fault_store="cas.chunk_upload", fault_drop="cas.chunk_drop",
             fault_load="cas.chunk_download", counter=self._count)
         self._exec_cache: Optional[Any] = None
+        self._kv_store: Optional[KVBlobStore] = None
+        # per-namespace byte budgets ("exec"/"kv") enforced by
+        # sweep_namespaces(); chunk GC keys on checkpoint references,
+        # not bytes, so "chunks" is not budgetable here
+        self._ns_budgets: Dict[str, int] = dict(namespace_budgets or {})
+        bad = set(self._ns_budgets) - {"exec", "kv"}
+        if bad:
+            raise ValueError(
+                f"unknown namespace budget(s): {sorted(bad)} "
+                "(budgetable namespaces: exec, kv)")
+        self._ns_evictions: Dict[str, int] = {"exec": 0, "kv": 0}
 
     # -- telemetry ----------------------------------------------------------
 
@@ -865,6 +1125,32 @@ class CASStorageManager(StorageManager):
                     self._inner, cache=local)
             return self._exec_cache
 
+    def kv_store(self) -> KVBlobStore:
+        """The KV spill tier sharing this manager's backend: spilled
+        K/V blocks land in ``cas/kv/`` next to (but namespaced away
+        from) the checkpoint chunks. Built lazily — a deployment that
+        never serves pays nothing. Inherits this manager's ``kv``
+        namespace budget, if one was configured."""
+        with self._lock:
+            if self._kv_store is None:
+                self._kv_store = KVBlobStore(
+                    self._inner, budget_bytes=self._ns_budgets.get("kv"))
+            return self._kv_store
+
+    def sweep_namespaces(self) -> Dict[str, Any]:
+        """Enforce every configured namespace byte budget now
+        (LRU-by-mtime; see :func:`sweep_namespace`). Returns the
+        per-namespace sweep reports; eviction totals accumulate into
+        ``storage_stats()['namespaces'][ns]['evictions']``."""
+        out: Dict[str, Any] = {}
+        for ns in sorted(self._ns_budgets):
+            res = sweep_namespace(self._inner, ns, self._ns_budgets[ns])
+            with self._lock:
+                self._ns_evictions[ns] = (self._ns_evictions.get(ns, 0)
+                                          + int(res.get("evicted", 0)))
+            out[ns] = res
+        return out
+
     def storage_stats(self) -> Dict[str, Any]:
         """Durable store-wide dedup accounting + cache hit rate, broken
         out per blob namespace (checkpoint chunks vs cached executables
@@ -886,6 +1172,11 @@ class CASStorageManager(StorageManager):
             1 for rel in listing if rel.startswith(EXEC_BLOB_PREFIX + "/"))
         exec_index_count = sum(
             1 for rel in listing if rel.startswith(EXEC_INDEX_PREFIX + "/"))
+        kv_bytes = sum(size for rel, size in listing.items()
+                       if rel.startswith("kv/"))
+        kv_objects = sum(1 for rel in listing if rel.startswith("kv/"))
+        kv_entries = sum(
+            1 for rel in listing if rel.startswith(KV_INDEX_PREFIX + "/"))
         chunk_bytes = sum(physical.values())
         logical = 0
         checkpoints = 0
@@ -920,7 +1211,14 @@ class CASStorageManager(StorageManager):
                            "bytes": chunk_bytes},
                 "exec": {"objects": exec_blob_count,
                          "bytes": exec_blob_bytes,
-                         "executables": exec_index_count},
+                         "executables": exec_index_count,
+                         "budget_bytes": self._ns_budgets.get("exec"),
+                         "evictions": self._ns_evictions.get("exec", 0)},
+                "kv": {"objects": kv_objects,
+                       "bytes": kv_bytes,
+                       "entries": kv_entries,
+                       "budget_bytes": self._ns_budgets.get("kv"),
+                       "evictions": self._ns_evictions.get("kv", 0)},
             },
             "session": dict(self.session_stats),
         }

@@ -502,6 +502,16 @@ class ClusterMetricsAggregator:
             "serving_spec_tokens_accepted_total").values())
         hits = sum(per_replica("prefix_cache_hit_blocks_total").values())
         misses = sum(per_replica("prefix_cache_miss_blocks_total").values())
+        # KV memory-hierarchy tier split (serving/kv_store.py): blocks a
+        # replica promoted from host RAM / CAS instead of re-prefilling
+        kv_host = sum(per_replica("kv_tier_host_hit_blocks_total").values())
+        kv_cas = sum(per_replica("kv_tier_cas_hit_blocks_total").values())
+        kv_miss = sum(per_replica("kv_tier_miss_blocks_total").values())
+        kv_promoted = sum(per_replica(
+            "kv_tier_promoted_blocks_total").values())
+        kv_spilled = sum(per_replica(
+            "kv_tier_spilled_blocks_total").values())
+        kv_looked = kv_host + kv_cas + kv_miss
         # slowest request across the fleet: the latency histogram's
         # max exemplar carries the request_id (telemetry/metrics.py)
         slowest: Optional[Dict[str, Any]] = None
@@ -526,6 +536,13 @@ class ClusterMetricsAggregator:
                                      if proposed else None),
             "prefix_hit_rate": (hits / (hits + misses)
                                 if hits + misses else None),
+            "kv_host_hit_blocks": kv_host,
+            "kv_cas_hit_blocks": kv_cas,
+            "kv_miss_blocks": kv_miss,
+            "kv_promoted_blocks": kv_promoted,
+            "kv_spilled_blocks": kv_spilled,
+            "kv_tier_hit_rate": ((kv_host + kv_cas) / kv_looked
+                                 if kv_looked else None),
             "slowest_request": slowest,
         }
 
@@ -654,7 +671,17 @@ class ClusterMetricsAggregator:
                           ("dct_fleet_spec_acceptance_rate",
                            "spec_acceptance_rate"),
                           ("dct_fleet_prefix_hit_rate",
-                           "prefix_hit_rate")):
+                           "prefix_hit_rate"),
+                          ("dct_fleet_kv_host_hit_blocks",
+                           "kv_host_hit_blocks"),
+                          ("dct_fleet_kv_cas_hit_blocks",
+                           "kv_cas_hit_blocks"),
+                          ("dct_fleet_kv_promoted_blocks",
+                           "kv_promoted_blocks"),
+                          ("dct_fleet_kv_spilled_blocks",
+                           "kv_spilled_blocks"),
+                          ("dct_fleet_kv_tier_hit_rate",
+                           "kv_tier_hit_rate")):
             v = roll.get(key)
             if v is None:
                 continue
@@ -936,6 +963,17 @@ def format_summary(summary: Dict[str, Any]) -> str:
                 f"({slowest['latency_s']:.4f}s on {slowest['replica']})")
         if rates:
             out.append("  " + ", ".join(rates))
+        if (fleet.get("kv_promoted_blocks") or fleet.get("kv_spilled_blocks")
+                or fleet.get("kv_tier_hit_rate") is not None):
+            kv_rate = fleet.get("kv_tier_hit_rate")
+            kv_rate_s = f"{kv_rate:.1%}" if kv_rate is not None else "n/a"
+            out.append(
+                f"  kv: tier hit-rate {kv_rate_s} "
+                f"(host {int(fleet.get('kv_host_hit_blocks', 0))} / "
+                f"cas {int(fleet.get('kv_cas_hit_blocks', 0))} / "
+                f"miss {int(fleet.get('kv_miss_blocks', 0))} blocks), "
+                f"promoted {int(fleet.get('kv_promoted_blocks', 0))}, "
+                f"spilled {int(fleet.get('kv_spilled_blocks', 0))}")
     mesh = summary.get("mesh")
     if mesh:
         ops = mesh.get("collective_ops") or {}

@@ -49,7 +49,8 @@
 # "Replica fleets"): the least-loaded router + 429 failover, the drain
 # protocol and drain-protected scale-down, blue-green rollout parity, the
 # queue-driven autoscaler, the fleet HTTP/CLI surface and the aggregator
-# rollup — plus the single-engine suite the fleet builds on. The master
+# rollup — plus the single-engine suite the fleet builds on, and the
+# KV memory hierarchy (host/CAS tier, prefix-affinity routing). The master
 # integration tests skip cleanly when the C++ build is unavailable.
 #
 # `./run_tests.sh --multichip` runs the mesh-observability surface
@@ -95,7 +96,7 @@ elif [ "$1" = "--serving" ]; then
 elif [ "$1" = "--fleet" ]; then
     shift
     set -- tests/test_serving_fleet.py tests/test_serving.py \
-        tests/test_self_healing.py \
+        tests/test_self_healing.py tests/test_kv_store.py \
         -m "not slow" "$@"
 elif [ "$1" = "--multichip" ]; then
     shift

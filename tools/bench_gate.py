@@ -82,7 +82,7 @@ def newest_rounds(directory: str = ".") -> Tuple[str, str]:
 OPTIONAL_SECTIONS = ("control_plane", "checkpoint_io", "pipeline",
                      "mnist_cnn", "tpu_probe_telemetry", "xla", "goodput",
                      "serving", "serving_fleet", "exec_cache", "multichip",
-                     "tsdb", "recovery")
+                     "tsdb", "recovery", "kv_hierarchy")
 
 
 def _section_notes(old_detail: Dict[str, Any], new_detail: Dict[str, Any],
@@ -640,6 +640,60 @@ def _recovery_lines(old_detail: Dict[str, Any],
             f"clean run — self-healing is not restoring capacity")
 
 
+def _kv_hierarchy_lines(old_detail: Dict[str, Any],
+                        new_detail: Dict[str, Any], report: list) -> None:
+    """Advisory KV-memory-hierarchy reporting (serving/kv_store.py
+    measured by bench's Zipf A/B + restart leg): WARNs when the section
+    errored, when the tiered leg's fleet-wide prefix hit rate fell more
+    than 0.05 below the prefix-cache-only baseline (the tier should
+    only ever add hits), when the tiered p99 regressed more than 2x
+    against the baseline leg of the SAME round (both legs share the
+    box, so cross-round wall-time compares are noise), or when the
+    mid-burst replacement replica promoted nothing from the tier (a
+    cold restart — the hierarchy's whole point is the warm one).
+    Advisory-only: the enforced contracts are tests/test_kv_store.py
+    and the kv_warm_failover chaos scenario."""
+    kv = new_detail.get("kv_hierarchy")
+    if not isinstance(kv, dict):
+        return
+    if kv.get("error"):
+        report.append(f"WARN: kv_hierarchy errored: {kv['error']}")
+        return
+    restart = kv.get("restart") or {}
+    report.append(
+        f"ok: kv_hierarchy prefix hit rate "
+        f"{kv.get('baseline_prefix_hit_rate')} → "
+        f"{kv.get('tiered_prefix_hit_rate')} with tier "
+        f"(tier hit rate {kv.get('kv_tier_hit_rate')}), restart promoted "
+        f"{restart.get('kv_promoted_blocks')} block(s) from the tier")
+    base_rate = kv.get("baseline_prefix_hit_rate")
+    tier_rate = kv.get("tiered_prefix_hit_rate")
+    if (isinstance(base_rate, (int, float))
+            and isinstance(tier_rate, (int, float))
+            and tier_rate < base_rate - 0.05):
+        report.append(
+            f"WARN: kv_hierarchy tiered prefix hit rate {tier_rate} fell "
+            f"more than 0.05 below the baseline {base_rate} — promotion "
+            f"or affinity routing is losing coverage it should add")
+    base_p99 = kv.get("baseline_p99_s")
+    tier_p99 = kv.get("tiered_p99_s")
+    if (isinstance(base_p99, (int, float)) and base_p99 > 0
+            and isinstance(tier_p99, (int, float))
+            and tier_p99 > 2.0 * base_p99):
+        report.append(
+            f"WARN: kv_hierarchy tiered p99 {tier_p99}s > 2x baseline "
+            f"{base_p99}s — tier lookups/promotion are stalling the "
+            f"admission path")
+    if restart and not kv.get("restart_warm"):
+        report.append(
+            "WARN: kv_hierarchy restarted replica promoted 0 blocks from "
+            "the tier — the mid-burst replacement came up cold")
+    errs = int(kv.get("tiered_errors") or 0)
+    if errs:
+        report.append(
+            f"WARN: kv_hierarchy tiered leg failed {errs} request(s)")
+
+
 def gate(old: Dict[str, Any], new: Dict[str, Any], *,
          tolerance: float = DEFAULT_TOLERANCE,
          allow_null_mfu: bool = False) -> Tuple[bool, list]:
@@ -695,6 +749,7 @@ def gate(old: Dict[str, Any], new: Dict[str, Any], *,
     _exec_cache_lines(old_detail, new_detail, report)
     _tsdb_lines(old_detail, new_detail, report)
     _recovery_lines(old_detail, new_detail, report)
+    _kv_hierarchy_lines(old_detail, new_detail, report)
     ok = _multichip_lines(old_detail, new_detail, report) and ok
     return ok, report
 

@@ -34,6 +34,7 @@ import argparse
 import dataclasses
 import json
 import os
+import random
 import shutil
 import socket
 import subprocess
@@ -609,6 +610,202 @@ def run_mixed_load(trials: int = 400, agents: int = 4,
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+def make_zipf_prompts(count: int, *, templates: int = 12,
+                      skew: float = 1.1, seed: int = 0,
+                      block_size: int = 8, shared_blocks: int = 1,
+                      tail_len: int = 3) -> list:
+    """Seeded Zipf-shaped prompt stream over a template pool.
+
+    Every prompt opens with the same ``shared_blocks`` KV blocks of
+    system prefix (the "millions of users, one system prompt" head),
+    then one block of per-template body drawn Zipf(``skew``) — rank 1
+    dominates — then a short per-request tail. The shape is what the
+    KV hierarchy and router affinity are built for: a few hot chains
+    plus a long cold tail, fully deterministic per ``seed``.
+    """
+    rnd = random.Random(seed)
+    weights = [1.0 / (r ** skew) for r in range(1, max(1, templates) + 1)]
+    total = sum(weights)
+    system = [(7 * i + 3) % 89 + 1 for i in range(shared_blocks * block_size)]
+    pool = []
+    for t in range(max(1, templates)):
+        body_rnd = random.Random(10_000 + t)
+        pool.append(system
+                    + [body_rnd.randrange(1, 90) for _ in range(block_size)])
+    prompts = []
+    for _ in range(count):
+        x = rnd.random() * total
+        acc = 0.0
+        idx = 0
+        for i, w in enumerate(weights):
+            acc += w
+            if x <= acc:
+                idx = i
+                break
+        prompts.append(pool[idx]
+                       + [rnd.randrange(1, 90) for _ in range(tail_len)])
+    return prompts
+
+
+def run_zipf_load(requests: int = 160, replicas: int = 4,
+                  templates: int = 12, skew: float = 1.1, seed: int = 0,
+                  tokens_per_request: int = 8, shared_blocks: int = 1,
+                  iteration_floor_s: float = 0.01, kv_store=False,
+                  restart_at: float | None = None,
+                  budget_s: float = 300.0) -> dict:
+    """Zipf-shaped serving load against a standalone fleet (no master).
+
+    The measurement the KV memory hierarchy is judged by: fleet-wide
+    prefix hit rate printed beside the request p99, under a seeded Zipf
+    over a prompt-template pool whose heads share a system prefix.
+    ``kv_store=False`` is the per-replica prefix-cache baseline;
+    ``kv_store=True`` (or a ``KVBlockStore``) turns on the shared
+    host/CAS tier plus router prefix affinity — the A/B bench.py runs.
+
+    ``restart_at`` (a fraction of the burst) restarts one replica
+    mid-burst through the drain protocol: the departing replica flushes
+    its resident blocks to the tier, and the report's ``restart`` block
+    shows how many blocks the replacement promoted back instead of
+    re-prefilling (``kv_promoted_blocks`` > 0 with ``kv_miss_blocks``
+    low is the warm-failover signature).
+    """
+    t0 = time.monotonic()
+    fleet = None
+    try:
+        import jax
+
+        from determined_clone_tpu.models import gpt
+        from determined_clone_tpu.serving import ServingFleet
+        from determined_clone_tpu.serving.bucketing import BucketSpec
+        from determined_clone_tpu.serving.kv_cache import KVCacheConfig
+
+        cfg = gpt.GPTConfig(vocab_size=97, n_layers=2, d_model=32,
+                            n_heads=4, d_ff=64, max_seq_len=64,
+                            remat=False, attention_impl="mha")
+        params = gpt.init(jax.random.PRNGKey(0), cfg)
+        cache = KVCacheConfig(num_blocks=32, block_size=8)
+        fleet = ServingFleet(
+            params, cfg, name="zipf", buckets=BucketSpec.build(4, 32),
+            cache=cache, max_queue_depth=max(64, requests),
+            iteration_floor_s=iteration_floor_s,
+            prefix_cache=True, kv_store=kv_store)
+        fleet.scale_up(replicas)
+        prompts = make_zipf_prompts(
+            requests, templates=templates, skew=skew, seed=seed,
+            block_size=cache.block_size, shared_blocks=shared_blocks)
+        restart_idx = (min(requests - 1, max(1, int(requests * restart_at)))
+                       if restart_at is not None else None)
+
+        lat: list = []
+        errors = [0]
+        # engine counters survive replica teardown only if snapshotted
+        # first — the burst's fleet-wide totals fold these back in
+        retired = {"prefix_hits": 0, "prefix_misses": 0, "kv_host": 0,
+                   "kv_cas": 0, "kv_miss": 0, "kv_promoted": 0,
+                   "kv_spilled": 0}
+
+        def drain(handles: list) -> None:
+            for h in handles:
+                try:
+                    lat.append(h.result(60.0).total_s)
+                except Exception:  # noqa: BLE001 — counted, not fatal
+                    errors[0] += 1
+
+        def snapshot(rep) -> None:
+            st = rep.engine.stats()
+            retired["prefix_hits"] += st.prefix_hit_blocks
+            retired["prefix_misses"] += st.prefix_miss_blocks
+            retired["kv_host"] += st.kv_host_hit_blocks
+            retired["kv_cas"] += st.kv_cas_hit_blocks
+            retired["kv_miss"] += st.kv_miss_blocks
+            retired["kv_promoted"] += st.kv_promoted_blocks
+            retired["kv_spilled"] += st.kv_spilled_blocks
+
+        restarted = None
+        handles: list = []
+        for i, prompt in enumerate(prompts):
+            if restart_idx is not None and i == restart_idx:
+                # quiesce in-flight work, then restart one replica
+                # through the drain protocol (stop_replica flushes its
+                # resident blocks to the tier on the way down)
+                drain(handles)
+                handles = []
+                victim_id = fleet.replica_ids()[0]
+                with fleet._lock:
+                    victim = fleet._replicas[victim_id]
+                # flush before snapshotting so the victim's spill
+                # counters land in the totals (stop_replica's own flush
+                # then dedups as duplicate_puts)
+                fleet._flush_kv(victim)
+                snapshot(victim)
+                fleet.stop_replica(victim_id)
+                restarted = fleet.scale_up(1)[0]
+            if time.monotonic() - t0 > budget_s:
+                break
+            try:
+                handles.append(fleet.submit(prompt, tokens_per_request,
+                                            timeout=30.0))
+            except Exception:  # noqa: BLE001
+                errors[0] += 1
+        drain(handles)
+
+        hits = retired["prefix_hits"]
+        misses = retired["prefix_misses"]
+        kv = dict(retired)
+        warm = None
+        for rep in fleet.replicas():
+            st = rep.engine.stats()
+            hits += st.prefix_hit_blocks
+            misses += st.prefix_miss_blocks
+            kv["kv_host"] += st.kv_host_hit_blocks
+            kv["kv_cas"] += st.kv_cas_hit_blocks
+            kv["kv_miss"] += st.kv_miss_blocks
+            kv["kv_promoted"] += st.kv_promoted_blocks
+            kv["kv_spilled"] += st.kv_spilled_blocks
+            if rep.replica_id == restarted:
+                warm = {
+                    "replica": restarted,
+                    "kv_promoted_blocks": st.kv_promoted_blocks,
+                    "kv_host_hit_blocks": st.kv_host_hit_blocks,
+                    "kv_cas_hit_blocks": st.kv_cas_hit_blocks,
+                    "kv_miss_blocks": st.kv_miss_blocks,
+                    "prefix_hit_blocks": st.prefix_hit_blocks,
+                }
+        looked = hits + misses
+        kv_looked = kv["kv_host"] + kv["kv_cas"] + kv["kv_miss"]
+        return {
+            "requests": requests,
+            "completed": len(lat),
+            "errors": errors[0],
+            "replicas": replicas,
+            "templates": templates,
+            "skew": skew,
+            "seed": seed,
+            "kv_store": bool(kv_store),
+            "request_total_s": _percentiles(lat),
+            "prefix_hit_blocks": hits,
+            "prefix_miss_blocks": misses,
+            "prefix_hit_rate": (round(hits / looked, 4)
+                                if looked else None),
+            "kv_tier_hit_rate": (round(
+                (kv["kv_host"] + kv["kv_cas"]) / kv_looked, 4)
+                if kv_looked else None),
+            "kv_host_hit_blocks": kv["kv_host"],
+            "kv_cas_hit_blocks": kv["kv_cas"],
+            "kv_miss_blocks": kv["kv_miss"],
+            "kv_promoted_blocks": kv["kv_promoted"],
+            "kv_spilled_blocks": kv["kv_spilled"],
+            "kv_stats": fleet.kv_stats(),
+            "restart": warm,
+            "duration_s": round(time.monotonic() - t0, 3),
+        }
+    except ImportError as exc:
+        return {"error": f"ImportError: {exc}"}
+    finally:
+        if fleet is not None:
+            fleet.close()
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--trials", type=int, default=1000)
@@ -628,8 +825,31 @@ def main(argv=None) -> int:
                         help="serving traffic shares a common system "
                              "prompt (exercises the COW prefix cache; "
                              "reports block hit-rate beside p99)")
+    parser.add_argument("--zipf", action="store_true",
+                        help="master-free Zipf serving load: seeded Zipf "
+                             "over a prompt-template pool with shared "
+                             "system-prefix heads; reports fleet-wide "
+                             "prefix hit rate beside p99")
+    parser.add_argument("--zipf-templates", type=int, default=12)
+    parser.add_argument("--zipf-skew", type=float, default=1.1)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--kv-store", action="store_true",
+                        help="with --zipf: turn on the fleet-wide KV "
+                             "memory hierarchy (host tier + router "
+                             "prefix affinity)")
+    parser.add_argument("--restart-at", type=float, default=None,
+                        help="with --zipf: restart one replica after "
+                             "this fraction of the burst (warm-failover "
+                             "leg)")
     args = parser.parse_args(argv)
-    if args.mixed:
+    if args.zipf:
+        result = run_zipf_load(
+            requests=args.serving_requests,
+            replicas=args.serving_replicas,
+            templates=args.zipf_templates, skew=args.zipf_skew,
+            seed=args.seed, kv_store=args.kv_store,
+            restart_at=args.restart_at, budget_s=args.budget)
+    elif args.mixed:
         result = run_mixed_load(
             trials=args.trials, agents=args.agents,
             slots_per_agent=args.slots,
